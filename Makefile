@@ -1,0 +1,20 @@
+PYTHON ?= python
+
+.PHONY: proto test bench native clean
+
+proto:
+	protoc --proto_path=seldon_core_tpu/proto \
+	       --python_out=seldon_core_tpu/proto \
+	       seldon_core_tpu/proto/prediction.proto
+
+native:
+	$(MAKE) -C seldon_core_tpu/native
+
+test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+bench:
+	$(PYTHON) bench.py
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,29 @@
+"""seldon_core_tpu — a TPU-native model-serving plane.
+
+A ground-up rebuild of the capabilities of Seldon Core v0.2 (declarative
+inference graphs on Kubernetes) designed for Cloud TPU:
+
+* the wire contract stays Seldon-compatible (``SeldonMessage`` REST+gRPC),
+* the per-predictor orchestrator walks the inference graph **in-process**
+  (the reference pays a network hop per graph edge,
+  reference: engine/.../PredictiveUnitBean.java:58-124),
+* model math is JAX/XLA: ``jit``/``pjit`` over a ``jax.sharding.Mesh`` with a
+  continuous-batching queue feeding the device,
+* the operator materializes graphs onto TPU node pools.
+
+Subpackages
+-----------
+contract   wire messages, numpy codecs, typed graph parameters
+graph      inference-graph spec + async walker + built-in units
+runtime    user-model microservice runtime (REST/gRPC servers)
+engine     per-predictor orchestrator service
+executor   JAX execution plane: mesh, jit wrapper, batching queue
+models     Flax flagship models (MNIST, ResNet-50, BERT, Llama)
+ops        Pallas/JAX kernels
+parallel   sharding rules, ring attention, collectives
+gateway    external API gateway (auth, registry, proxy, metrics)
+operator   Kubernetes operator (CRD, reconcile, TPU resources)
+utils      metrics, puid, config
+"""
+
+__version__ = "0.1.0"

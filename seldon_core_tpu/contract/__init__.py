@@ -1,0 +1,51 @@
+"""Wire contract: payload model, codecs, typed parameters."""
+
+from seldon_core_tpu.contract.payload import (
+    DataKind,
+    FeedbackPayload,
+    Meta,
+    Metric,
+    Payload,
+)
+from seldon_core_tpu.contract.codec import (
+    CodecError,
+    feedback_from_dict,
+    feedback_from_proto,
+    feedback_to_dict,
+    feedback_to_proto,
+    payload_from_dict,
+    payload_from_json,
+    payload_from_proto,
+    payload_to_dict,
+    payload_to_json,
+    payload_to_proto,
+)
+from seldon_core_tpu.contract.parameters import (
+    ParameterError,
+    encode_parameters,
+    parameters_from_env,
+    parse_parameters,
+)
+
+__all__ = [
+    "DataKind",
+    "FeedbackPayload",
+    "Meta",
+    "Metric",
+    "Payload",
+    "CodecError",
+    "ParameterError",
+    "payload_from_dict",
+    "payload_from_json",
+    "payload_from_proto",
+    "payload_to_dict",
+    "payload_to_json",
+    "payload_to_proto",
+    "feedback_from_dict",
+    "feedback_from_proto",
+    "feedback_to_dict",
+    "feedback_to_proto",
+    "parse_parameters",
+    "parameters_from_env",
+    "encode_parameters",
+]

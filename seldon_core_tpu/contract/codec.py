@@ -1,0 +1,347 @@
+"""Wire codecs: SeldonMessage JSON / protobuf <-> :class:`Payload`.
+
+JSON layout is wire-compatible with the reference REST API
+(reference: proto/prediction.proto:12-40, docs/reference/external-api.md):
+
+    {"meta": {...}, "data": {"names": [...], "tensor": {"shape": [...],
+     "values": [...]}}}                       # or "ndarray": [[...]]
+    {"binData": "<base64>"} / {"strData": "..."}
+
+plus the TPU-native ``rawTensor`` extension carrying typed bytes.
+
+Decoding happens once per request at the process boundary; everything inside
+the graph walk is numpy (see payload.py).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any
+
+import numpy as np
+
+from seldon_core_tpu.contract.payload import (
+    DataKind,
+    FeedbackPayload,
+    Meta,
+    Metric,
+    Payload,
+)
+from seldon_core_tpu.proto import prediction_pb2 as pb
+
+# dtypes allowed on the rawTensor path.  bfloat16 is encoded via its uint16
+# bit pattern so numpy round-trips it without ml_dtypes at the boundary.
+_RAW_DTYPES = {
+    "float32", "float16", "bfloat16", "float64",
+    "int8", "uint8", "int16", "int32", "int64", "bool",
+}
+
+
+class CodecError(ValueError):
+    """Malformed wire message."""
+
+
+# ---------------------------------------------------------------------------
+# Meta
+# ---------------------------------------------------------------------------
+
+def meta_from_dict(d: dict[str, Any] | None) -> Meta:
+    d = d or {}
+    metrics = [
+        Metric(
+            key=m.get("key", ""),
+            type=m.get("type", "COUNTER"),
+            value=float(m.get("value", 0.0)),
+        )
+        for m in d.get("metrics", [])
+    ]
+    return Meta(
+        puid=d.get("puid", ""),
+        tags=dict(d.get("tags", {})),
+        routing={k: int(v) for k, v in d.get("routing", {}).items()},
+        request_path=dict(d.get("requestPath", {})),
+        metrics=metrics,
+    )
+
+
+def meta_to_dict(meta: Meta) -> dict[str, Any]:
+    out: dict[str, Any] = {"puid": meta.puid}
+    if meta.tags:
+        out["tags"] = meta.tags
+    if meta.routing:
+        out["routing"] = meta.routing
+    if meta.request_path:
+        out["requestPath"] = meta.request_path
+    if meta.metrics:
+        out["metrics"] = [
+            {"key": m.key, "type": m.type, "value": m.value} for m in meta.metrics
+        ]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JSON (dict) <-> Payload
+# ---------------------------------------------------------------------------
+
+def payload_from_dict(msg: dict[str, Any]) -> Payload:
+    """Decode a parsed SeldonMessage JSON object."""
+    if not isinstance(msg, dict):
+        raise CodecError("SeldonMessage must be a JSON object")
+    meta = meta_from_dict(msg.get("meta"))
+
+    if "data" in msg:
+        data = msg["data"]
+        names = list(data.get("names", []))
+        if "tensor" in data:
+            t = data["tensor"]
+            try:
+                shape = [int(s) for s in t.get("shape", [])]
+                arr = np.asarray(t["values"], dtype=np.float64)
+            except (KeyError, TypeError, ValueError) as e:
+                raise CodecError(f"bad tensor: {e}") from e
+            if shape:
+                try:
+                    arr = arr.reshape(shape)
+                except ValueError as e:
+                    raise CodecError(f"tensor shape mismatch: {e}") from e
+            return Payload(arr, names, DataKind.TENSOR, meta)
+        if "ndarray" in data:
+            try:
+                arr = np.asarray(data["ndarray"])
+            except (TypeError, ValueError) as e:
+                raise CodecError(f"bad ndarray: {e}") from e
+            return Payload(arr, names, DataKind.NDARRAY, meta)
+        raise CodecError("data must contain 'tensor' or 'ndarray'")
+
+    if "rawTensor" in msg:
+        rt = msg["rawTensor"]
+        dtype = rt.get("dtype", "float32")
+        if dtype not in _RAW_DTYPES:
+            raise CodecError(f"unsupported rawTensor dtype {dtype!r}")
+        buf = base64.b64decode(rt["data"]) if isinstance(rt.get("data"), str) else rt["data"]
+        arr = _raw_to_array(buf, dtype, [int(s) for s in rt.get("shape", [])])
+        return Payload(arr, list(rt.get("names", [])), DataKind.RAW, meta)
+
+    if "binData" in msg:
+        raw = msg["binData"]
+        data_b = base64.b64decode(raw) if isinstance(raw, str) else bytes(raw)
+        return Payload(data_b, [], DataKind.BINARY, meta)
+
+    if "strData" in msg:
+        return Payload(str(msg["strData"]), [], DataKind.STRING, meta)
+
+    return Payload(None, [], DataKind.EMPTY, meta)
+
+
+def payload_to_dict(payload: Payload, include_meta: bool = True) -> dict[str, Any]:
+    """Encode a payload back to SeldonMessage JSON structure."""
+    out: dict[str, Any] = {}
+    if include_meta:
+        out["meta"] = meta_to_dict(payload.meta)
+
+    kind, data = payload.kind, payload.data
+    if kind == DataKind.EMPTY or data is None:
+        return out
+    if kind == DataKind.BINARY:
+        out["binData"] = base64.b64encode(data).decode("ascii")
+    elif kind == DataKind.STRING:
+        out["strData"] = data
+    elif kind == DataKind.RAW:
+        arr = np.ascontiguousarray(data)
+        out["rawTensor"] = {
+            "shape": list(arr.shape),
+            "dtype": _dtype_name(arr.dtype),
+            "data": base64.b64encode(_array_to_raw(arr)).decode("ascii"),
+            "names": payload.names,
+        }
+    elif kind == DataKind.TENSOR:
+        arr = np.asarray(data, dtype=np.float64)
+        out["data"] = {
+            "names": payload.names,
+            "tensor": {"shape": list(arr.shape), "values": arr.ravel().tolist()},
+        }
+    else:  # NDARRAY
+        out["data"] = {"names": payload.names, "ndarray": np.asarray(data).tolist()}
+    return out
+
+
+def payload_from_json(raw: str | bytes) -> Payload:
+    try:
+        msg = json.loads(raw)
+    except json.JSONDecodeError as e:
+        raise CodecError(f"invalid JSON: {e}") from e
+    return payload_from_dict(msg)
+
+
+def payload_to_json(payload: Payload) -> str:
+    return json.dumps(payload_to_dict(payload), separators=(",", ":"))
+
+
+def feedback_from_dict(msg: dict[str, Any]) -> FeedbackPayload:
+    return FeedbackPayload(
+        request=payload_from_dict(msg["request"]) if "request" in msg else None,
+        response=payload_from_dict(msg["response"]) if "response" in msg else None,
+        reward=float(msg.get("reward", 0.0)),
+        truth=payload_from_dict(msg["truth"]) if "truth" in msg else None,
+    )
+
+
+def feedback_to_dict(fb: FeedbackPayload) -> dict[str, Any]:
+    out: dict[str, Any] = {"reward": fb.reward}
+    if fb.request is not None:
+        out["request"] = payload_to_dict(fb.request)
+    if fb.response is not None:
+        out["response"] = payload_to_dict(fb.response)
+    if fb.truth is not None:
+        out["truth"] = payload_to_dict(fb.truth)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# protobuf <-> Payload
+# ---------------------------------------------------------------------------
+
+def payload_from_proto(msg: pb.SeldonMessage) -> Payload:
+    meta = Meta(
+        puid=msg.meta.puid,
+        tags={k: _value_to_py(v) for k, v in msg.meta.tags.items()},
+        routing=dict(msg.meta.routing),
+        request_path=dict(msg.meta.requestPath),
+        metrics=[
+            Metric(m.key, pb.Metric.MetricType.Name(m.type), m.value)
+            for m in msg.meta.metrics
+        ],
+    )
+    which = msg.WhichOneof("data_oneof")
+    if which == "data":
+        names = list(msg.data.names)
+        dwhich = msg.data.WhichOneof("data_oneof")
+        if dwhich == "tensor":
+            arr = np.asarray(msg.data.tensor.values, dtype=np.float64)
+            shape = list(msg.data.tensor.shape)
+            if shape:
+                arr = arr.reshape(shape)
+            return Payload(arr, names, DataKind.TENSOR, meta)
+        if dwhich == "ndarray":
+            from google.protobuf import json_format
+
+            nd = json_format.MessageToDict(msg.data.ndarray)
+            return Payload(np.asarray(nd), names, DataKind.NDARRAY, meta)
+        return Payload(None, names, DataKind.EMPTY, meta)
+    if which == "rawTensor":
+        rt = msg.rawTensor
+        arr = _raw_to_array(rt.data, rt.dtype or "float32", list(rt.shape))
+        return Payload(arr, list(rt.names), DataKind.RAW, meta)
+    if which == "binData":
+        return Payload(bytes(msg.binData), [], DataKind.BINARY, meta)
+    if which == "strData":
+        return Payload(msg.strData, [], DataKind.STRING, meta)
+    return Payload(None, [], DataKind.EMPTY, meta)
+
+
+def payload_to_proto(payload: Payload) -> pb.SeldonMessage:
+    msg = pb.SeldonMessage()
+    meta = payload.meta
+    msg.meta.puid = meta.puid
+    for k, v in meta.tags.items():
+        _py_to_value(msg.meta.tags[k], v)
+    for k, v in meta.routing.items():
+        msg.meta.routing[k] = v
+    for k, v in meta.request_path.items():
+        msg.meta.requestPath[k] = v
+    for m in meta.metrics:
+        pm = msg.meta.metrics.add()
+        pm.key = m.key
+        pm.type = pb.Metric.MetricType.Value(m.type)
+        pm.value = m.value
+
+    kind, data = payload.kind, payload.data
+    if kind == DataKind.EMPTY or data is None:
+        return msg
+    if kind == DataKind.BINARY:
+        msg.binData = data
+    elif kind == DataKind.STRING:
+        msg.strData = data
+    elif kind == DataKind.RAW:
+        arr = np.ascontiguousarray(data)
+        msg.rawTensor.shape.extend(arr.shape)
+        msg.rawTensor.dtype = _dtype_name(arr.dtype)
+        msg.rawTensor.data = _array_to_raw(arr)
+        msg.rawTensor.names.extend(payload.names)
+    elif kind == DataKind.TENSOR:
+        arr = np.asarray(data, dtype=np.float64)
+        msg.data.names.extend(payload.names)
+        msg.data.tensor.shape.extend(arr.shape)
+        msg.data.tensor.values.extend(arr.ravel())
+    else:  # NDARRAY
+        from google.protobuf import json_format
+
+        msg.data.names.extend(payload.names)
+        json_format.ParseDict(np.asarray(data).tolist(), msg.data.ndarray)
+    return msg
+
+
+def feedback_from_proto(msg: pb.Feedback) -> FeedbackPayload:
+    return FeedbackPayload(
+        request=payload_from_proto(msg.request) if msg.HasField("request") else None,
+        response=payload_from_proto(msg.response) if msg.HasField("response") else None,
+        reward=msg.reward,
+        truth=payload_from_proto(msg.truth) if msg.HasField("truth") else None,
+    )
+
+
+def feedback_to_proto(fb: FeedbackPayload) -> pb.Feedback:
+    msg = pb.Feedback(reward=fb.reward)
+    if fb.request is not None:
+        msg.request.CopyFrom(payload_to_proto(fb.request))
+    if fb.response is not None:
+        msg.response.CopyFrom(payload_to_proto(fb.response))
+    if fb.truth is not None:
+        msg.truth.CopyFrom(payload_to_proto(fb.truth))
+    return msg
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _dtype_name(dtype: np.dtype) -> str:
+    name = dtype.name
+    if name == "uint16" :
+        # bfloat16 travels as its uint16 bit pattern (see _array_to_raw)
+        return "bfloat16"
+    return name
+
+
+def _array_to_raw(arr: np.ndarray) -> bytes:
+    if arr.dtype.name == "bfloat16":  # ml_dtypes array
+        arr = arr.view(np.uint16)
+    return np.ascontiguousarray(arr).tobytes()
+
+
+def _raw_to_array(buf: bytes, dtype: str, shape: list[int]) -> np.ndarray:
+    if dtype == "bfloat16":
+        try:
+            import ml_dtypes
+
+            arr = np.frombuffer(buf, dtype=np.uint16).view(ml_dtypes.bfloat16)
+        except ImportError:
+            arr = np.frombuffer(buf, dtype=np.uint16)
+    else:
+        arr = np.frombuffer(buf, dtype=np.dtype(dtype))
+    if shape:
+        arr = arr.reshape(shape)
+    return arr
+
+
+def _value_to_py(v) -> Any:
+    from google.protobuf import json_format
+
+    return json_format.MessageToDict(v)
+
+
+def _py_to_value(target, v: Any) -> None:
+    from google.protobuf import json_format
+
+    json_format.ParseDict(v, target)

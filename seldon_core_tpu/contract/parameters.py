@@ -1,0 +1,71 @@
+"""Typed graph-unit parameters.
+
+The reference delivers ``Parameter{name, value, type}`` lists to user code via
+the ``PREDICTIVE_UNIT_PARAMETERS`` env var and parses them by declared type
+(reference: wrappers/python/microservice.py:122-136,
+proto/seldon_deployment.proto Parameter message).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+_PARSERS = {
+    "STRING": str,
+    "INT": int,
+    "FLOAT": float,
+    "DOUBLE": float,
+    "BOOL": lambda v: str(v).lower() in ("true", "1", "yes"),
+}
+
+PARAMETERS_ENV_NAME = "PREDICTIVE_UNIT_PARAMETERS"
+
+
+class ParameterError(ValueError):
+    pass
+
+
+def parse_parameters(params: list[dict[str, Any]] | None) -> dict[str, Any]:
+    """``[{"name": .., "value": .., "type": ..}]`` -> ``{name: typed_value}``."""
+    out: dict[str, Any] = {}
+    for p in params or []:
+        name = p.get("name")
+        if not name:
+            raise ParameterError(f"parameter missing name: {p!r}")
+        ptype = p.get("type", "STRING")
+        parser = _PARSERS.get(ptype)
+        if parser is None:
+            raise ParameterError(f"unknown parameter type {ptype!r} for {name!r}")
+        try:
+            out[name] = parser(p.get("value"))
+        except (TypeError, ValueError) as e:
+            raise ParameterError(f"cannot parse {name!r} as {ptype}: {e}") from e
+    return out
+
+
+def parameters_from_env(environ: dict[str, str] | None = None) -> dict[str, Any]:
+    env = environ if environ is not None else os.environ
+    raw = env.get(PARAMETERS_ENV_NAME, "[]")
+    try:
+        return parse_parameters(json.loads(raw))
+    except json.JSONDecodeError as e:
+        raise ParameterError(f"{PARAMETERS_ENV_NAME} is not valid JSON: {e}") from e
+
+
+def encode_parameters(params: dict[str, Any]) -> list[dict[str, str]]:
+    """Inverse of :func:`parse_parameters`, used by the operator when
+    injecting env vars into unit containers."""
+    out = []
+    for name, value in params.items():
+        if isinstance(value, bool):
+            ptype = "BOOL"
+        elif isinstance(value, int):
+            ptype = "INT"
+        elif isinstance(value, float):
+            ptype = "FLOAT"
+        else:
+            ptype = "STRING"
+        out.append({"name": name, "value": str(value), "type": ptype})
+    return out

@@ -1,0 +1,12 @@
+"""Generated protobuf modules for the prediction wire contract.
+
+Regenerate with::
+
+    protoc --proto_path=seldon_core_tpu/proto \
+           --python_out=seldon_core_tpu/proto \
+           seldon_core_tpu/proto/prediction.proto
+"""
+
+from seldon_core_tpu.proto import prediction_pb2
+
+__all__ = ["prediction_pb2"]

@@ -1,0 +1,205 @@
+"""Contract-layer tests: JSON/proto round-trips and typed parameters.
+
+Mirrors the reference's proto round-trip suite
+(reference: engine/src/test/java/io/seldon/engine/pb/TestPredictionProto.java,
+TestMatrixOps.java) plus the rawTensor extension.
+"""
+
+import base64
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.contract import (
+    CodecError,
+    DataKind,
+    Meta,
+    ParameterError,
+    Payload,
+    encode_parameters,
+    feedback_from_dict,
+    feedback_to_dict,
+    parse_parameters,
+    payload_from_dict,
+    payload_from_json,
+    payload_from_proto,
+    payload_to_dict,
+    payload_to_json,
+    payload_to_proto,
+)
+
+
+class TestJsonCodec:
+    def test_tensor_round_trip(self):
+        msg = {
+            "meta": {"puid": "abc123"},
+            "data": {"names": ["f0", "f1"], "tensor": {"shape": [2, 2], "values": [1, 2, 3, 4]}},
+        }
+        p = payload_from_dict(msg)
+        assert p.kind == DataKind.TENSOR
+        assert p.names == ["f0", "f1"]
+        assert p.meta.puid == "abc123"
+        np.testing.assert_array_equal(p.array, [[1.0, 2.0], [3.0, 4.0]])
+
+        out = payload_to_dict(p)
+        assert out["data"]["tensor"]["shape"] == [2, 2]
+        assert out["data"]["tensor"]["values"] == [1.0, 2.0, 3.0, 4.0]
+        assert out["meta"]["puid"] == "abc123"
+
+    def test_ndarray_round_trip(self):
+        msg = {"data": {"ndarray": [[1.0, 2.0], [3.0, 4.0]]}}
+        p = payload_from_dict(msg)
+        assert p.kind == DataKind.NDARRAY
+        out = payload_to_dict(p)
+        assert out["data"]["ndarray"] == [[1.0, 2.0], [3.0, 4.0]]
+
+    def test_encoding_preserved_through_transform(self):
+        # Reference preserves ndarray-vs-tensor across node updates
+        # (PredictorUtils.java:107-127).
+        p = payload_from_dict({"data": {"tensor": {"shape": [1, 2], "values": [1, 2]}}})
+        p2 = p.with_array(np.array([[9.0, 9.0]]))
+        assert p2.kind == DataKind.TENSOR
+        assert "tensor" in payload_to_dict(p2)["data"]
+
+    def test_bin_and_str_data(self):
+        raw = b"\x00\x01binary"
+        p = payload_from_dict({"binData": base64.b64encode(raw).decode()})
+        assert p.kind == DataKind.BINARY and p.data == raw
+        assert base64.b64decode(payload_to_dict(p)["binData"]) == raw
+
+        p = payload_from_dict({"strData": "hello"})
+        assert p.kind == DataKind.STRING and p.data == "hello"
+        assert payload_to_dict(p)["strData"] == "hello"
+
+    def test_raw_tensor_float32(self):
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        p = Payload.from_array(arr, names=["x"], kind=DataKind.RAW)
+        d = payload_to_dict(p)
+        assert d["rawTensor"]["dtype"] == "float32"
+        p2 = payload_from_dict(json.loads(json.dumps(d)))
+        assert p2.array.dtype == np.float32
+        np.testing.assert_array_equal(p2.array, arr)
+
+    def test_raw_tensor_bfloat16(self):
+        import ml_dtypes
+
+        arr = np.asarray([[1.5, -2.25]], dtype=ml_dtypes.bfloat16)
+        p = Payload.from_array(arr, kind=DataKind.RAW)
+        d = payload_to_dict(p)
+        assert d["rawTensor"]["dtype"] == "bfloat16"
+        p2 = payload_from_dict(d)
+        np.testing.assert_array_equal(
+            p2.array.astype(np.float32), arr.astype(np.float32)
+        )
+
+    def test_json_string_round_trip(self):
+        p = Payload.from_array(np.eye(2), names=["a", "b"], kind=DataKind.TENSOR)
+        p.meta.puid = "p1"
+        p.meta.tags["v"] = "canary"
+        p2 = payload_from_json(payload_to_json(p))
+        np.testing.assert_array_equal(p2.array, np.eye(2))
+        assert p2.meta.tags == {"v": "canary"}
+
+    def test_errors(self):
+        with pytest.raises(CodecError):
+            payload_from_json(b"{not json")
+        with pytest.raises(CodecError):
+            payload_from_dict({"data": {}})
+        with pytest.raises(CodecError):
+            payload_from_dict({"data": {"tensor": {"shape": [3], "values": [1, 2]}}})
+        with pytest.raises(CodecError):
+            payload_from_dict({"rawTensor": {"dtype": "complex128", "data": ""}})
+
+    def test_meta_round_trip(self):
+        msg = {
+            "meta": {
+                "puid": "x",
+                "tags": {"a": 1, "b": "s"},
+                "routing": {"router": 1},
+                "requestPath": {"clf": "img:1"},
+                "metrics": [{"key": "lat", "type": "TIMER", "value": 2.5}],
+            }
+        }
+        p = payload_from_dict(msg)
+        d = payload_to_dict(p)["meta"]
+        assert d["routing"] == {"router": 1}
+        assert d["requestPath"] == {"clf": "img:1"}
+        assert d["metrics"][0]["key"] == "lat"
+
+
+class TestProtoCodec:
+    def test_tensor_round_trip(self):
+        p = Payload.from_array(
+            np.array([[0.5, 1.5]]), names=["a", "b"], kind=DataKind.TENSOR
+        )
+        p.meta.puid = "pp"
+        p.meta.routing["r"] = 2
+        msg = payload_to_proto(p)
+        assert list(msg.data.tensor.shape) == [1, 2]
+        p2 = payload_from_proto(msg)
+        assert p2.meta.puid == "pp"
+        assert p2.meta.routing == {"r": 2}
+        np.testing.assert_array_equal(p2.array, [[0.5, 1.5]])
+
+    def test_ndarray_round_trip(self):
+        p = Payload.from_array(np.array([[1.0, 2.0]]), kind=DataKind.NDARRAY)
+        p2 = payload_from_proto(payload_to_proto(p))
+        assert p2.kind == DataKind.NDARRAY
+        np.testing.assert_array_equal(p2.array, [[1.0, 2.0]])
+
+    def test_raw_tensor_round_trip(self):
+        arr = np.arange(4, dtype=np.int8)
+        p = Payload.from_array(arr, kind=DataKind.RAW)
+        p2 = payload_from_proto(payload_to_proto(p))
+        assert p2.array.dtype == np.int8
+        np.testing.assert_array_equal(p2.array, arr)
+
+    def test_serialized_bytes_round_trip(self):
+        p = Payload.from_array(np.ones((2, 2)), kind=DataKind.TENSOR)
+        wire = payload_to_proto(p).SerializeToString()
+        from seldon_core_tpu.proto import prediction_pb2 as pb
+
+        msg = pb.SeldonMessage()
+        msg.ParseFromString(wire)
+        np.testing.assert_array_equal(payload_from_proto(msg).array, np.ones((2, 2)))
+
+
+class TestFeedback:
+    def test_round_trip(self):
+        fb = feedback_from_dict(
+            {
+                "request": {"data": {"ndarray": [[1.0]]}},
+                "response": {"meta": {"routing": {"ab": 1}}, "data": {"ndarray": [[0.9]]}},
+                "reward": 1.0,
+            }
+        )
+        assert fb.reward == 1.0
+        assert fb.response.meta.routing == {"ab": 1}
+        d = feedback_to_dict(fb)
+        assert d["reward"] == 1.0
+        assert d["request"]["data"]["ndarray"] == [[1.0]]
+
+
+class TestParameters:
+    def test_typed_parse(self):
+        params = [
+            {"name": "ratioA", "value": "0.5", "type": "FLOAT"},
+            {"name": "n", "value": "3", "type": "INT"},
+            {"name": "verbose", "value": "true", "type": "BOOL"},
+            {"name": "label", "value": "x", "type": "STRING"},
+        ]
+        out = parse_parameters(params)
+        assert out == {"ratioA": 0.5, "n": 3, "verbose": True, "label": "x"}
+
+    def test_errors(self):
+        with pytest.raises(ParameterError):
+            parse_parameters([{"value": "1"}])
+        with pytest.raises(ParameterError):
+            parse_parameters([{"name": "x", "value": "1", "type": "TENSOR"}])
+        with pytest.raises(ParameterError):
+            parse_parameters([{"name": "x", "value": "abc", "type": "INT"}])
+
+    def test_encode_inverse(self):
+        src = {"a": 1, "b": 0.5, "c": True, "d": "s"}
+        assert parse_parameters(encode_parameters(src)) == src
