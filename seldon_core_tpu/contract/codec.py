@@ -16,6 +16,7 @@ the graph walk is numpy (see payload.py).
 from __future__ import annotations
 
 import base64
+import binascii
 import json
 from typing import Any
 
@@ -34,7 +35,8 @@ from seldon_core_tpu.proto import prediction_pb2 as pb
 # bit pattern so numpy round-trips it without ml_dtypes at the boundary.
 _RAW_DTYPES = {
     "float32", "float16", "bfloat16", "float64",
-    "int8", "uint8", "int16", "int32", "int64", "bool",
+    "int8", "uint8", "int16", "uint16", "int32", "uint32",
+    "int64", "uint64", "bool",
 }
 
 
@@ -46,16 +48,21 @@ class CodecError(ValueError):
 # Meta
 # ---------------------------------------------------------------------------
 
+_METRIC_TYPES = {"COUNTER", "GAUGE", "TIMER"}
+
+
 def meta_from_dict(d: dict[str, Any] | None) -> Meta:
     d = d or {}
-    metrics = [
-        Metric(
-            key=m.get("key", ""),
-            type=m.get("type", "COUNTER"),
-            value=float(m.get("value", 0.0)),
+    metrics = []
+    for m in d.get("metrics", []):
+        mtype = m.get("type", "COUNTER")
+        if mtype not in _METRIC_TYPES:
+            raise CodecError(
+                f"unknown metric type {mtype!r}; expected one of {sorted(_METRIC_TYPES)}"
+            )
+        metrics.append(
+            Metric(key=m.get("key", ""), type=mtype, value=float(m.get("value", 0.0)))
         )
-        for m in d.get("metrics", [])
-    ]
     return Meta(
         puid=d.get("puid", ""),
         tags=dict(d.get("tags", {})),
@@ -107,25 +114,36 @@ def payload_from_dict(msg: dict[str, Any]) -> Payload:
                     raise CodecError(f"tensor shape mismatch: {e}") from e
             return Payload(arr, names, DataKind.TENSOR, meta)
         if "ndarray" in data:
-            try:
-                arr = np.asarray(data["ndarray"])
-            except (TypeError, ValueError) as e:
-                raise CodecError(f"bad ndarray: {e}") from e
+            arr = _ndarray_to_array(data["ndarray"])
             return Payload(arr, names, DataKind.NDARRAY, meta)
         raise CodecError("data must contain 'tensor' or 'ndarray'")
 
     if "rawTensor" in msg:
         rt = msg["rawTensor"]
-        dtype = rt.get("dtype", "float32")
-        if dtype not in _RAW_DTYPES:
-            raise CodecError(f"unsupported rawTensor dtype {dtype!r}")
-        buf = base64.b64decode(rt["data"]) if isinstance(rt.get("data"), str) else rt["data"]
-        arr = _raw_to_array(buf, dtype, [int(s) for s in rt.get("shape", [])])
+        try:
+            dtype = rt.get("dtype", "float32")
+            if dtype not in _RAW_DTYPES:
+                raise CodecError(f"unsupported rawTensor dtype {dtype!r}")
+            buf = (
+                base64.b64decode(rt["data"], validate=True)
+                if isinstance(rt.get("data"), str)
+                else rt["data"]
+            )
+            if buf is None:
+                raise CodecError("rawTensor missing 'data'")
+            arr = _raw_to_array(buf, dtype, [int(s) for s in rt.get("shape", [])])
+        except CodecError:
+            raise
+        except (KeyError, TypeError, ValueError, binascii.Error) as e:
+            raise CodecError(f"bad rawTensor: {e}") from e
         return Payload(arr, list(rt.get("names", [])), DataKind.RAW, meta)
 
     if "binData" in msg:
         raw = msg["binData"]
-        data_b = base64.b64decode(raw) if isinstance(raw, str) else bytes(raw)
+        try:
+            data_b = base64.b64decode(raw, validate=True) if isinstance(raw, str) else bytes(raw)
+        except (binascii.Error, TypeError, ValueError) as e:
+            raise CodecError(f"bad binData: {e}") from e
         return Payload(data_b, [], DataKind.BINARY, meta)
 
     if "strData" in msg:
@@ -179,10 +197,14 @@ def payload_to_json(payload: Payload) -> str:
 
 
 def feedback_from_dict(msg: dict[str, Any]) -> FeedbackPayload:
+    try:
+        reward = float(msg.get("reward", 0.0))
+    except (TypeError, ValueError) as e:
+        raise CodecError(f"bad feedback reward: {e}") from e
     return FeedbackPayload(
         request=payload_from_dict(msg["request"]) if "request" in msg else None,
         response=payload_from_dict(msg["response"]) if "response" in msg else None,
-        reward=float(msg.get("reward", 0.0)),
+        reward=reward,
         truth=payload_from_dict(msg["truth"]) if "truth" in msg else None,
     )
 
@@ -221,17 +243,26 @@ def payload_from_proto(msg: pb.SeldonMessage) -> Payload:
             arr = np.asarray(msg.data.tensor.values, dtype=np.float64)
             shape = list(msg.data.tensor.shape)
             if shape:
-                arr = arr.reshape(shape)
+                try:
+                    arr = arr.reshape(shape)
+                except ValueError as e:
+                    raise CodecError(f"tensor shape mismatch: {e}") from e
             return Payload(arr, names, DataKind.TENSOR, meta)
         if dwhich == "ndarray":
             from google.protobuf import json_format
 
             nd = json_format.MessageToDict(msg.data.ndarray)
-            return Payload(np.asarray(nd), names, DataKind.NDARRAY, meta)
+            return Payload(_ndarray_to_array(nd), names, DataKind.NDARRAY, meta)
         return Payload(None, names, DataKind.EMPTY, meta)
     if which == "rawTensor":
         rt = msg.rawTensor
-        arr = _raw_to_array(rt.data, rt.dtype or "float32", list(rt.shape))
+        dtype = rt.dtype or "float32"
+        if dtype not in _RAW_DTYPES:
+            raise CodecError(f"unsupported rawTensor dtype {dtype!r}")
+        try:
+            arr = _raw_to_array(rt.data, dtype, list(rt.shape))
+        except ValueError as e:
+            raise CodecError(f"bad rawTensor: {e}") from e
         return Payload(arr, list(rt.names), DataKind.RAW, meta)
     if which == "binData":
         return Payload(bytes(msg.binData), [], DataKind.BINARY, meta)
@@ -307,31 +338,43 @@ def feedback_to_proto(fb: FeedbackPayload) -> pb.Feedback:
 # ---------------------------------------------------------------------------
 
 def _dtype_name(dtype: np.dtype) -> str:
-    name = dtype.name
-    if name == "uint16" :
-        # bfloat16 travels as its uint16 bit pattern (see _array_to_raw)
-        return "bfloat16"
-    return name
+    # bfloat16 arrays (ml_dtypes) report dtype.name == "bfloat16" directly;
+    # never infer it from the storage type.
+    return dtype.name
 
 
 def _array_to_raw(arr: np.ndarray) -> bytes:
-    if arr.dtype.name == "bfloat16":  # ml_dtypes array
+    if arr.dtype.name == "bfloat16":  # encode as its uint16 bit pattern
         arr = arr.view(np.uint16)
     return np.ascontiguousarray(arr).tobytes()
 
 
 def _raw_to_array(buf: bytes, dtype: str, shape: list[int]) -> np.ndarray:
+    # .copy(): np.frombuffer over wire bytes is read-only; user code must be
+    # able to mutate payloads regardless of which wire encoding was used.
     if dtype == "bfloat16":
-        try:
-            import ml_dtypes
+        import ml_dtypes
 
-            arr = np.frombuffer(buf, dtype=np.uint16).view(ml_dtypes.bfloat16)
-        except ImportError:
-            arr = np.frombuffer(buf, dtype=np.uint16)
+        arr = np.frombuffer(buf, dtype=np.uint16).view(ml_dtypes.bfloat16).copy()
     else:
-        arr = np.frombuffer(buf, dtype=np.dtype(dtype))
+        arr = np.frombuffer(buf, dtype=np.dtype(dtype)).copy()
     if shape:
         arr = arr.reshape(shape)
+    return arr
+
+
+def _ndarray_to_array(nd: Any) -> np.ndarray:
+    """Decode a JSON ndarray.  ListValue is heterogeneous by design; mixed or
+    string rows must not be silently coerced to numpy unicode."""
+    try:
+        arr = np.asarray(nd)
+    except (TypeError, ValueError):
+        try:
+            return np.asarray(nd, dtype=object)
+        except (TypeError, ValueError) as e:
+            raise CodecError(f"bad ndarray: {e}") from e
+    if arr.dtype.kind in "USV":  # strings / mixed -> keep python objects
+        arr = np.asarray(nd, dtype=object)
     return arr
 
 

@@ -1,0 +1,59 @@
+"""Inference-graph spec, async walker, and built-in units."""
+
+from seldon_core_tpu.graph.spec import (
+    Endpoint,
+    Implementation,
+    Method,
+    Parameter,
+    PredictiveUnitSpec,
+    PredictorSpec,
+    TransportType,
+    UnitType,
+)
+from seldon_core_tpu.graph.units import (
+    AverageCombiner,
+    EpsilonGreedy,
+    GraphUnitError,
+    MahalanobisOutlier,
+    RandomABTest,
+    SeldonComponent,
+    SimpleModel,
+    SimpleRouter,
+    ThompsonSampling,
+    create_builtin,
+    has_builtin,
+)
+from seldon_core_tpu.graph.walker import (
+    ROUTE_ALL,
+    GraphWalker,
+    LocalClient,
+    NodeClient,
+    walker_from_predictor,
+)
+
+__all__ = [
+    "Endpoint",
+    "Implementation",
+    "Method",
+    "Parameter",
+    "PredictiveUnitSpec",
+    "PredictorSpec",
+    "TransportType",
+    "UnitType",
+    "AverageCombiner",
+    "EpsilonGreedy",
+    "GraphUnitError",
+    "MahalanobisOutlier",
+    "RandomABTest",
+    "SeldonComponent",
+    "SimpleModel",
+    "SimpleRouter",
+    "ThompsonSampling",
+    "create_builtin",
+    "has_builtin",
+    "ROUTE_ALL",
+    "GraphWalker",
+    "LocalClient",
+    "NodeClient",
+    "walker_from_predictor",
+]

@@ -1,0 +1,130 @@
+"""Inference-graph specification.
+
+The declarative graph a user writes in the SeldonDeployment-style custom
+resource: a tree of predictive units with five types
+(reference: proto/seldon_deployment.proto:55-130 — PredictiveUnit, enums
+PredictiveUnitType / PredictiveUnitImplementation / Endpoint / Parameter).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional
+
+from pydantic import BaseModel, Field
+
+
+class UnitType(str, enum.Enum):
+    MODEL = "MODEL"
+    ROUTER = "ROUTER"
+    COMBINER = "COMBINER"
+    TRANSFORMER = "TRANSFORMER"
+    OUTPUT_TRANSFORMER = "OUTPUT_TRANSFORMER"
+
+
+class Implementation(str, enum.Enum):
+    """Built-in unit implementations runnable without user containers
+    (reference: PredictiveUnitImplementation enum + the four hardcoded beans,
+    engine/.../predictors/PredictorConfigBean.java:36-101)."""
+
+    UNKNOWN_IMPLEMENTATION = "UNKNOWN_IMPLEMENTATION"
+    SIMPLE_MODEL = "SIMPLE_MODEL"
+    SIMPLE_ROUTER = "SIMPLE_ROUTER"
+    RANDOM_ABTEST = "RANDOM_ABTEST"
+    AVERAGE_COMBINER = "AVERAGE_COMBINER"
+    # TPU-native extensions
+    EPSILON_GREEDY = "EPSILON_GREEDY"
+    THOMPSON_SAMPLING = "THOMPSON_SAMPLING"
+    MAHALANOBIS_OUTLIER = "MAHALANOBIS_OUTLIER"
+    JAX_MODEL = "JAX_MODEL"
+
+
+class Method(str, enum.Enum):
+    TRANSFORM_INPUT = "TRANSFORM_INPUT"
+    TRANSFORM_OUTPUT = "TRANSFORM_OUTPUT"
+    ROUTE = "ROUTE"
+    AGGREGATE = "AGGREGATE"
+    SEND_FEEDBACK = "SEND_FEEDBACK"
+
+
+class TransportType(str, enum.Enum):
+    REST = "REST"
+    GRPC = "GRPC"
+    LOCAL = "LOCAL"  # in-process — the TPU-native default inside a pod
+
+
+class Endpoint(BaseModel):
+    """Where a unit's implementation is reachable.  ``LOCAL`` means the unit
+    runs inside the orchestrator process (no per-edge network hop, unlike the
+    reference where every edge is REST/gRPC)."""
+
+    service_host: str = ""
+    service_port: int = 0
+    type: TransportType = TransportType.LOCAL
+
+
+class Parameter(BaseModel):
+    name: str
+    value: str
+    type: str = "STRING"
+
+
+# Which methods each unit type executes, mirroring the reference's
+# type->methods table (engine/.../predictors/PredictorConfigBean.java:36-72).
+TYPE_METHODS: dict[UnitType, list[Method]] = {
+    UnitType.MODEL: [Method.TRANSFORM_INPUT],
+    UnitType.ROUTER: [Method.ROUTE, Method.SEND_FEEDBACK],
+    UnitType.COMBINER: [Method.AGGREGATE],
+    UnitType.TRANSFORMER: [Method.TRANSFORM_INPUT],
+    UnitType.OUTPUT_TRANSFORMER: [Method.TRANSFORM_OUTPUT],
+}
+
+
+class PredictiveUnitSpec(BaseModel):
+    """One node of the inference graph."""
+
+    name: str
+    children: list["PredictiveUnitSpec"] = Field(default_factory=list)
+    type: Optional[UnitType] = None
+    implementation: Implementation = Implementation.UNKNOWN_IMPLEMENTATION
+    methods: Optional[list[Method]] = None
+    endpoint: Endpoint = Field(default_factory=Endpoint)
+    parameters: list[Parameter] = Field(default_factory=list)
+
+    def resolved_methods(self) -> list[Method]:
+        """Explicit methods win; otherwise derived from type."""
+        if self.methods is not None:
+            return self.methods
+        if self.type is not None:
+            return TYPE_METHODS[self.type]
+        return []
+
+    def parameters_dict(self) -> dict[str, Any]:
+        from seldon_core_tpu.contract.parameters import parse_parameters
+
+        return parse_parameters([p.model_dump() for p in self.parameters])
+
+    def iter_nodes(self):
+        """Pre-order traversal."""
+        yield self
+        for child in self.children:
+            yield from child.iter_nodes()
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PredictiveUnitSpec":
+        return cls.model_validate(d)
+
+
+PredictiveUnitSpec.model_rebuild()
+
+
+class PredictorSpec(BaseModel):
+    """A deployable predictor: a graph plus replica/annotation config
+    (reference: proto/seldon_deployment.proto:40-54 PredictorSpec)."""
+
+    name: str
+    graph: PredictiveUnitSpec
+    replicas: int = 1
+    annotations: dict[str, str] = Field(default_factory=dict)
+    labels: dict[str, str] = Field(default_factory=dict)
+    version: str = ""

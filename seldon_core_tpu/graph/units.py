@@ -1,0 +1,243 @@
+"""Component contract and built-in graph units.
+
+A *component* is the user-supplied (or built-in) object behind a graph node.
+The contract is duck-typed exactly like the reference wrapper runtime
+(reference: wrappers/python/model_microservice.py:23-33,
+router_microservice.py:18-22, transformer_microservice.py:15-38):
+
+    predict(X, feature_names) -> ndarray          MODEL
+    route(X, feature_names) -> int                ROUTER
+    aggregate(Xs, features_list) -> ndarray       COMBINER
+    transform_input(X, feature_names) -> ndarray  TRANSFORMER
+    transform_output(X, feature_names) -> ndarray OUTPUT_TRANSFORMER
+    send_feedback(X, feature_names, reward, truth, routing)  optional
+    class_names: list[str]                        optional
+
+Any method may be ``async def``.  Components may also implement the ``*_raw``
+variants taking/returning :class:`Payload` for full control of meta/encoding.
+
+Built-ins double as test fixtures and benchmark stubs, the reference's own
+pattern (engine/.../predictors/SimpleModelUnit.java:33-46 et al.).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from seldon_core_tpu.graph.spec import Implementation
+
+
+class GraphUnitError(Exception):
+    """A unit rejected its input (maps to Status FAILURE on the wire)."""
+
+
+class SeldonComponent:
+    """Optional convenience base class; duck typing is what matters."""
+
+    def init_metadata(self) -> dict[str, Any]:
+        return {}
+
+    def tags(self) -> dict[str, Any]:
+        return {}
+
+    def metrics(self) -> list[dict[str, Any]]:
+        return []
+
+
+# ---------------------------------------------------------------------------
+# Built-in units
+# ---------------------------------------------------------------------------
+
+class SimpleModel(SeldonComponent):
+    """Stub model returning a constant 3-class score row per input row —
+    the reference's benchmark/default model
+    (reference: engine/.../predictors/SimpleModelUnit.java:33-46)."""
+
+    values = np.array([0.1, 0.9, 0.5])
+    class_names = ["class0", "class1", "class2"]
+
+    def predict(self, X: np.ndarray, names: list[str]) -> np.ndarray:
+        rows = X.shape[0] if getattr(X, "ndim", 0) >= 2 else 1
+        return np.tile(self.values, (rows, 1))
+
+
+class SimpleRouter(SeldonComponent):
+    """Always routes to child 0
+    (reference: engine/.../predictors/SimpleRouterUnit.java:28-31)."""
+
+    def route(self, X: np.ndarray, names: list[str]) -> int:
+        return 0
+
+
+class RandomABTest(SeldonComponent):
+    """Routes to child 0 with probability ``ratioA``, else child 1; seeded for
+    reproducibility (reference: engine/.../predictors/RandomABTestUnit.java:33-57,
+    seeded Random(1337))."""
+
+    def __init__(self, ratioA: float = 0.5, seed: int = 1337, **_: Any):
+        self.ratio_a = float(ratioA)
+        self._rng = np.random.default_rng(seed)
+
+    def route(self, X: np.ndarray, names: list[str]) -> int:
+        return 0 if self._rng.random() < self.ratio_a else 1
+
+
+class AverageCombiner(SeldonComponent):
+    """Element-wise mean of children outputs with strict shape agreement
+    (reference: engine/.../predictors/AverageCombinerUnit.java:34-81)."""
+
+    def aggregate(self, Xs: list[np.ndarray], features: list[list[str]]) -> np.ndarray:
+        if not Xs:
+            raise GraphUnitError("AverageCombiner needs at least one input")
+        arrs = [np.asarray(x, dtype=np.float64) for x in Xs]
+        shape = arrs[0].shape
+        for i, a in enumerate(arrs[1:], start=1):
+            if a.shape != shape:
+                raise GraphUnitError(
+                    f"AverageCombiner shape mismatch: input 0 {shape} vs input {i} {a.shape}"
+                )
+        return np.mean(np.stack(arrs), axis=0)
+
+
+class EpsilonGreedy(SeldonComponent):
+    """Multi-armed-bandit router: explore with probability epsilon, otherwise
+    exploit the best-performing branch; rewards arrive via the feedback loop
+    (reference behaviour: examples/routers/epsilon_greedy/EpsilonGreedy.py:12-60)."""
+
+    def __init__(
+        self,
+        n_branches: int = 2,
+        epsilon: float = 0.1,
+        verbose: bool = False,
+        seed: int | None = 1337,
+        **_: Any,
+    ):
+        if n_branches < 1:
+            raise GraphUnitError("n_branches must be >= 1")
+        self.n_branches = int(n_branches)
+        self.epsilon = float(epsilon)
+        self.verbose = bool(verbose)
+        self._rng = np.random.default_rng(seed)
+        self.pulls = np.zeros(self.n_branches, dtype=np.int64)
+        self.value = np.zeros(self.n_branches, dtype=np.float64)
+
+    def route(self, X: np.ndarray, names: list[str]) -> int:
+        if self._rng.random() < self.epsilon:
+            return int(self._rng.integers(self.n_branches))
+        return int(np.argmax(self.value))
+
+    def send_feedback(
+        self,
+        X: np.ndarray,
+        names: list[str],
+        reward: float,
+        truth: Any = None,
+        routing: int | None = None,
+    ) -> None:
+        if routing is None or not (0 <= routing < self.n_branches):
+            return
+        self.pulls[routing] += 1
+        n = self.pulls[routing]
+        # incremental mean of observed rewards per branch
+        self.value[routing] += (reward - self.value[routing]) / n
+
+
+class ThompsonSampling(SeldonComponent):
+    """Beta-Bernoulli Thompson-sampling router (TPU-native extra beyond the
+    reference's bandit example): sample a win-rate per branch, route argmax."""
+
+    def __init__(self, n_branches: int = 2, seed: int | None = 1337, **_: Any):
+        self.n_branches = int(n_branches)
+        self._rng = np.random.default_rng(seed)
+        self.alpha = np.ones(self.n_branches)
+        self.beta = np.ones(self.n_branches)
+
+    def route(self, X: np.ndarray, names: list[str]) -> int:
+        samples = self._rng.beta(self.alpha, self.beta)
+        return int(np.argmax(samples))
+
+    def send_feedback(self, X, names, reward, truth=None, routing=None) -> None:
+        if routing is None or not (0 <= routing < self.n_branches):
+            return
+        if reward > 0:
+            self.alpha[routing] += reward
+        else:
+            self.beta[routing] += 1.0
+
+
+class MahalanobisOutlier(SeldonComponent):
+    """Online Mahalanobis-distance outlier scorer: incremental mean/covariance
+    over the request stream, score = squared Mahalanobis distance of each row;
+    annotates ``meta.tags.outlier_score`` as a TRANSFORMER
+    (reference behaviour: examples/transformers/outlier_mahalanobis/
+    OutlierMahalanobis.py:6-80 and wrappers/python/
+    outlier_detector_microservice.py:23-56)."""
+
+    def __init__(self, n_components: int = 0, n_stdev: float = 3.0, **_: Any):
+        self.n_components = int(n_components)
+        self.n_stdev = float(n_stdev)
+        self.count = 0
+        self._mean: np.ndarray | None = None
+        self._m2: np.ndarray | None = None  # sum of outer-product deviations
+        self._last_scores: np.ndarray | None = None
+
+    def score(self, X: np.ndarray) -> np.ndarray:
+        X = np.atleast_2d(np.asarray(X, dtype=np.float64))
+        d = X.shape[1]
+        if self._mean is None:
+            self._mean = np.zeros(d)
+            self._m2 = np.zeros((d, d))
+        scores = np.zeros(X.shape[0])
+        for i, row in enumerate(X):
+            if self.count >= 2:
+                cov = self._m2 / (self.count - 1)
+                cov = cov + 1e-6 * np.eye(d)  # ridge for invertibility
+                delta = row - self._mean
+                scores[i] = float(delta @ np.linalg.solve(cov, delta))
+            # Welford update
+            self.count += 1
+            delta = row - self._mean
+            self._mean += delta / self.count
+            self._m2 += np.outer(delta, row - self._mean)
+        self._last_scores = scores
+        return scores
+
+    def transform_input(self, X: np.ndarray, names: list[str]) -> np.ndarray:
+        self.score(X)
+        return X
+
+    def tags(self) -> dict[str, Any]:
+        if self._last_scores is None:
+            return {}
+        return {"outlier_score": self._last_scores.tolist()}
+
+
+# ---------------------------------------------------------------------------
+# Implementation registry
+# ---------------------------------------------------------------------------
+
+_BUILTINS: dict[Implementation, Callable[..., Any]] = {
+    Implementation.SIMPLE_MODEL: SimpleModel,
+    Implementation.SIMPLE_ROUTER: SimpleRouter,
+    Implementation.RANDOM_ABTEST: RandomABTest,
+    Implementation.AVERAGE_COMBINER: AverageCombiner,
+    Implementation.EPSILON_GREEDY: EpsilonGreedy,
+    Implementation.THOMPSON_SAMPLING: ThompsonSampling,
+    Implementation.MAHALANOBIS_OUTLIER: MahalanobisOutlier,
+}
+
+
+def create_builtin(impl: Implementation, parameters: dict[str, Any]) -> Any:
+    """Instantiate a built-in implementation with its typed parameters
+    (reference analogue: PredictorConfigBean's implementation->bean map)."""
+    try:
+        factory = _BUILTINS[impl]
+    except KeyError:
+        raise GraphUnitError(f"no built-in implementation {impl!r}") from None
+    return factory(**parameters)
+
+
+def has_builtin(impl: Implementation) -> bool:
+    return impl in _BUILTINS

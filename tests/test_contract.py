@@ -110,6 +110,39 @@ class TestJsonCodec:
             payload_from_dict({"data": {"tensor": {"shape": [3], "values": [1, 2]}}})
         with pytest.raises(CodecError):
             payload_from_dict({"rawTensor": {"dtype": "complex128", "data": ""}})
+        # malformed inputs must be CodecError, never KeyError/binascii.Error
+        with pytest.raises(CodecError):
+            payload_from_dict({"rawTensor": {"dtype": "float32", "shape": [2]}})
+        with pytest.raises(CodecError):
+            payload_from_dict(
+                {"rawTensor": {"dtype": "float32", "shape": [4], "data": base64.b64encode(b"\x00" * 8).decode()}}
+            )
+        with pytest.raises(CodecError):
+            payload_from_dict({"binData": "!!!notb64"})
+        with pytest.raises(CodecError):
+            payload_from_dict({"meta": {"metrics": [{"key": "k", "type": "HISTOGRAM"}]}})
+
+    def test_uint16_raw_not_confused_with_bfloat16(self):
+        arr = np.array([1, 2, 3], dtype=np.uint16)
+        p = Payload.from_array(arr, kind=DataKind.RAW)
+        d = payload_to_dict(p)
+        assert d["rawTensor"]["dtype"] == "uint16"
+        p2 = payload_from_dict(d)
+        assert p2.array.dtype == np.uint16
+        np.testing.assert_array_equal(p2.array, arr)
+
+    def test_raw_decode_is_writable(self):
+        p = Payload.from_array(np.ones(3, dtype=np.float32), kind=DataKind.RAW)
+        p2 = payload_from_dict(payload_to_dict(p))
+        arr = p2.array
+        arr += 1  # must not raise "read-only"
+        np.testing.assert_array_equal(p2.array, [2.0, 2.0, 2.0])
+
+    def test_mixed_type_ndarray_preserved(self):
+        p = payload_from_dict({"data": {"ndarray": [["a", 1.5]]}})
+        assert p.array.dtype == object
+        out = payload_to_dict(p)["data"]["ndarray"]
+        assert out == [["a", 1.5]]  # 1.5 stays a number, not "1.5"
 
     def test_meta_round_trip(self):
         msg = {
@@ -179,6 +212,11 @@ class TestFeedback:
         d = feedback_to_dict(fb)
         assert d["reward"] == 1.0
         assert d["request"]["data"]["ndarray"] == [[1.0]]
+
+
+    def test_bad_reward_is_codec_error(self):
+        with pytest.raises(CodecError):
+            feedback_from_dict({"reward": "not-a-number"})
 
 
 class TestParameters:

@@ -1,0 +1,305 @@
+"""Graph walker and built-in unit tests.
+
+Mirrors the reference's engine unit suite (reference:
+engine/src/test/java/io/seldon/engine/predictors/AverageCombinerTest.java,
+RandomABTestUnitTest.java, SimpleModelUnitTest.java) plus walker semantics:
+routing map recording, tag merge, feedback replay down the routed path.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.contract import DataKind, FeedbackPayload, Payload
+from seldon_core_tpu.graph import (
+    AverageCombiner,
+    EpsilonGreedy,
+    GraphUnitError,
+    GraphWalker,
+    MahalanobisOutlier,
+    PredictiveUnitSpec,
+    RandomABTest,
+    SimpleModel,
+    ThompsonSampling,
+)
+
+run = asyncio.run
+
+
+def payload(arr, names=None):
+    return Payload.from_array(np.asarray(arr, dtype=np.float64), names=names)
+
+
+def spec(d):
+    return PredictiveUnitSpec.from_dict(d)
+
+
+class TestBuiltinUnits:
+    def test_simple_model_constant_row_per_input(self):
+        out = SimpleModel().predict(np.zeros((3, 4)), [])
+        assert out.shape == (3, 3)
+        np.testing.assert_allclose(out[0], [0.1, 0.9, 0.5])
+
+    def test_average_combiner_mean(self):
+        comb = AverageCombiner()
+        out = comb.aggregate(
+            [np.array([[1.0, 2.0]]), np.array([[3.0, 4.0]])], [[], []]
+        )
+        np.testing.assert_allclose(out, [[2.0, 3.0]])
+
+    def test_average_combiner_shape_mismatch(self):
+        with pytest.raises(GraphUnitError):
+            AverageCombiner().aggregate(
+                [np.ones((1, 2)), np.ones((2, 2))], [[], []]
+            )
+        with pytest.raises(GraphUnitError):
+            AverageCombiner().aggregate([], [])
+
+    def test_random_abtest_distribution(self):
+        # seeded → reproducible split close to ratioA (reference:
+        # RandomABTestUnitTest uses a fixed seed the same way)
+        router = RandomABTest(ratioA=0.7, seed=1337)
+        picks = [router.route(np.zeros((1, 1)), []) for _ in range(1000)]
+        frac_a = picks.count(0) / len(picks)
+        assert 0.65 < frac_a < 0.75
+        assert set(picks) <= {0, 1}
+
+    def test_epsilon_greedy_learns_best_branch(self):
+        router = EpsilonGreedy(n_branches=3, epsilon=0.1, seed=7)
+        # branch 2 always rewards; others never
+        for _ in range(200):
+            b = router.route(np.zeros((1, 1)), [])
+            router.send_feedback(None, [], reward=1.0 if b == 2 else 0.0, routing=b)
+        exploit = [router.route(np.zeros((1, 1)), []) for _ in range(100)]
+        assert exploit.count(2) > 80
+
+    def test_thompson_sampling_learns(self):
+        router = ThompsonSampling(n_branches=2, seed=3)
+        for _ in range(300):
+            b = router.route(np.zeros((1, 1)), [])
+            router.send_feedback(None, [], reward=1.0 if b == 1 else 0.0, routing=b)
+        picks = [router.route(np.zeros((1, 1)), []) for _ in range(100)]
+        assert picks.count(1) > 80
+
+    def test_mahalanobis_flags_outlier(self):
+        det = MahalanobisOutlier()
+        rng = np.random.default_rng(0)
+        det.score(rng.normal(size=(200, 3)))
+        scores = det.score(np.array([[50.0, 50.0, 50.0], [0.0, 0.0, 0.0]]))
+        assert scores[0] > 100 * max(scores[1], 1e-9)
+        assert "outlier_score" in det.tags()
+
+
+SIMPLE_GRAPH = {
+    "name": "clf",
+    "type": "MODEL",
+    "implementation": "SIMPLE_MODEL",
+}
+
+ABTEST_GRAPH = {
+    "name": "ab",
+    "type": "ROUTER",
+    "implementation": "RANDOM_ABTEST",
+    "parameters": [{"name": "ratioA", "value": "1.0", "type": "FLOAT"}],
+    "children": [
+        {"name": "model-a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+        {"name": "model-b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+    ],
+}
+
+COMBINER_GRAPH = {
+    "name": "ens",
+    "type": "COMBINER",
+    "implementation": "AVERAGE_COMBINER",
+    "children": [
+        {"name": "m0", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+        {"name": "m1", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+    ],
+}
+
+
+class TestGraphWalker:
+    def test_single_model(self):
+        w = GraphWalker(spec(SIMPLE_GRAPH))
+        out = run(w.predict(payload(np.zeros((2, 4)))))
+        assert out.array.shape == (2, 3)
+        assert out.names == ["class0", "class1", "class2"]
+        assert out.meta.request_path == {"clf": "SimpleModel"}
+
+    def test_router_records_routing(self):
+        w = GraphWalker(spec(ABTEST_GRAPH))
+        out = run(w.predict(payload(np.zeros((1, 2)))))
+        assert out.meta.routing == {"ab": 0}
+        np.testing.assert_allclose(out.array, [[0.1, 0.9, 0.5]])
+
+    def test_router_bad_branch_raises(self):
+        class BadRouter:
+            def route(self, X, names):
+                return 7
+
+        g = spec(ABTEST_GRAPH)
+        w = GraphWalker(g, components={"ab": BadRouter()})
+        with pytest.raises(GraphUnitError):
+            run(w.predict(payload(np.zeros((1, 2)))))
+
+    def test_combiner_fans_out_and_averages(self):
+        w = GraphWalker(spec(COMBINER_GRAPH))
+        out = run(w.predict(payload(np.zeros((2, 2)))))
+        np.testing.assert_allclose(out.array, np.tile([0.1, 0.9, 0.5], (2, 1)))
+        assert set(out.meta.request_path) == {"ens", "m0", "m1"}
+
+    def test_multiple_children_without_combiner_raises(self):
+        g = dict(COMBINER_GRAPH)
+        g = {**g, "type": "MODEL", "implementation": "SIMPLE_MODEL", "name": "root"}
+        w = GraphWalker(spec(g))
+        with pytest.raises(GraphUnitError):
+            run(w.predict(payload(np.zeros((1, 2)))))
+
+    def test_transformer_chain_and_tag_merge(self):
+        class Doubler:
+            def transform_input(self, X, names):
+                return X * 2
+
+            def tags(self):
+                return {"doubled": True}
+
+        class Halver:
+            def transform_output(self, X, names):
+                return X / 2
+
+        g = spec(
+            {
+                "name": "t-in",
+                "type": "TRANSFORMER",
+                "children": [
+                    {
+                        "name": "t-out",
+                        "type": "OUTPUT_TRANSFORMER",
+                        "children": [
+                            {
+                                "name": "m",
+                                "type": "MODEL",
+                                "implementation": "SIMPLE_MODEL",
+                            }
+                        ],
+                    }
+                ],
+            }
+        )
+        w = GraphWalker(g, components={"t-in": Doubler(), "t-out": Halver()})
+        out = run(w.predict(payload(np.ones((1, 2)))))
+        np.testing.assert_allclose(out.array, [[0.05, 0.45, 0.25]])
+        assert out.meta.tags == {"doubled": True}
+
+    def test_outlier_transformer_tags_scores(self):
+        g = spec(
+            {
+                "name": "outlier",
+                "type": "TRANSFORMER",
+                "implementation": "MAHALANOBIS_OUTLIER",
+                "children": [
+                    {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+                ],
+            }
+        )
+        w = GraphWalker(g)
+        for _ in range(5):
+            out = run(w.predict(payload(np.random.default_rng(1).normal(size=(4, 3)))))
+        assert "outlier_score" in out.meta.tags
+
+    def test_async_component(self):
+        class AsyncModel:
+            async def predict(self, X, names):
+                await asyncio.sleep(0)
+                return X + 1
+
+        g = spec({"name": "am", "type": "MODEL"})
+        w = GraphWalker(g, components={"am": AsyncModel()})
+        out = run(w.predict(payload(np.zeros((1, 2)))))
+        np.testing.assert_allclose(out.array, [[1.0, 1.0]])
+
+    def test_raw_component_controls_payload(self):
+        class RawModel:
+            def predict_raw(self, p):
+                return Payload.from_array(
+                    np.array([[42.0]]), kind=DataKind.TENSOR
+                )
+
+        g = spec({"name": "raw", "type": "MODEL"})
+        w = GraphWalker(g, components={"raw": RawModel()})
+        out = run(w.predict(payload(np.zeros((1, 2)))))
+        assert out.kind == DataKind.TENSOR
+        np.testing.assert_allclose(out.array, [[42.0]])
+
+
+class TestFeedbackWalk:
+    def _bandit_walker(self):
+        g = spec(
+            {
+                "name": "eg",
+                "type": "ROUTER",
+                "implementation": "EPSILON_GREEDY",
+                "parameters": [
+                    {"name": "n_branches", "value": "2", "type": "INT"},
+                    {"name": "epsilon", "value": "0.0", "type": "FLOAT"},
+                ],
+                "children": [
+                    {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                ],
+            }
+        )
+        return GraphWalker(g)
+
+    def test_feedback_reaches_router_on_routed_path(self):
+        w = self._bandit_walker()
+        req = payload(np.zeros((1, 2)))
+        resp = run(w.predict(req))
+        assert "eg" in resp.meta.routing
+        fb = FeedbackPayload(request=req, response=resp, reward=1.0)
+        run(w.send_feedback(fb))
+        router = w.root.client.component
+        assert router.pulls.sum() == 1
+        routed = resp.meta.routing["eg"]
+        assert router.value[routed] == 1.0
+
+    def test_feedback_hook_fires(self):
+        seen = []
+        g = spec(
+            {
+                "name": "eg",
+                "type": "ROUTER",
+                "implementation": "EPSILON_GREEDY",
+                "children": [
+                    {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                ],
+            }
+        )
+        w = GraphWalker(g, feedback_hook=lambda name, fb: seen.append((name, fb.reward)))
+        resp = run(w.predict(payload(np.zeros((1, 2)))))
+        run(w.send_feedback(FeedbackPayload(response=resp, reward=0.5)))
+        assert seen == [("eg", 0.5)]
+
+    def test_model_send_feedback_called_when_method_listed(self):
+        rewards = []
+
+        class FeedbackModel:
+            def predict(self, X, names):
+                return X
+
+            def send_feedback(self, X, names, reward, truth=None, routing=None):
+                rewards.append(reward)
+
+        g = spec(
+            {
+                "name": "m",
+                "type": "MODEL",
+                "methods": ["TRANSFORM_INPUT", "SEND_FEEDBACK"],
+            }
+        )
+        w = GraphWalker(g, components={"m": FeedbackModel()})
+        resp = run(w.predict(payload(np.zeros((1, 1)))))
+        run(w.send_feedback(FeedbackPayload(response=resp, reward=2.0)))
+        assert rewards == [2.0]
