@@ -1,0 +1,21 @@
+"""Per-predictor orchestrator service (the reference's `engine/`)."""
+
+from seldon_core_tpu.engine.service import (
+    DEFAULT_PREDICTOR,
+    PredictionService,
+    load_predictor_spec,
+)
+from seldon_core_tpu.engine.transport import (
+    RemoteUnitError,
+    RestNodeClient,
+    TransportManager,
+)
+
+__all__ = [
+    "DEFAULT_PREDICTOR",
+    "PredictionService",
+    "load_predictor_spec",
+    "RemoteUnitError",
+    "RestNodeClient",
+    "TransportManager",
+]

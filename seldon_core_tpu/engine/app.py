@@ -1,0 +1,172 @@
+"""Engine HTTP application — the per-predictor orchestrator service.
+
+REST surface matches the reference engine (reference:
+engine/.../api/rest/RestClientController.java:62-175):
+
+    POST /api/v0.1/predictions   (alias /api/v1.0/predictions)
+    POST /api/v0.1/feedback      (alias /api/v1.0/feedback)
+    GET  /ping  /ready           liveness / readiness
+    GET  /pause /unpause         graceful-drain toggle used by the preStop
+                                 hook (readiness flips to 503 while paused;
+                                 reference: App.java:67-105 connector pause)
+    GET  /prometheus             metrics scrape endpoint
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+from typing import Any
+
+from aiohttp import web
+
+from seldon_core_tpu.contract import (
+    CodecError,
+    feedback_from_dict,
+    payload_from_dict,
+    payload_to_dict,
+)
+from seldon_core_tpu.engine.service import PredictionService, load_predictor_spec
+from seldon_core_tpu.graph.units import GraphUnitError
+from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
+
+log = logging.getLogger(__name__)
+
+
+def _status_body(code: int, reason: str) -> dict[str, Any]:
+    return {
+        "status": {"code": code, "info": reason, "reason": reason, "status": "FAILURE"}
+    }
+
+
+class EngineApp:
+    def __init__(self, service: PredictionService):
+        self.service = service
+        self.paused = False
+        self.metrics = service.metrics
+
+    def build(self) -> web.Application:
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        r = app.router
+        for prefix in ("/api/v0.1", "/api/v1.0"):
+            r.add_post(f"{prefix}/predictions", self.predictions)
+            r.add_post(f"{prefix}/feedback", self.feedback)
+        r.add_get("/ping", self.ping)
+        r.add_get("/ready", self.ready)
+        r.add_get("/pause", self.pause)
+        r.add_get("/unpause", self.unpause)
+        r.add_get("/prometheus", self.prometheus)
+        app.on_startup.append(self._startup)
+        app.on_cleanup.append(self._cleanup)
+        return app
+
+    async def _startup(self, app: web.Application) -> None:
+        await self.service.start()
+
+    async def _cleanup(self, app: web.Application) -> None:
+        await self.service.close()
+
+    # -- handlers ---------------------------------------------------------
+
+    async def predictions(self, request: web.Request) -> web.Response:
+        dep, pred = self.service.deployment_name, self.service.predictor.name
+        with self.metrics.time_server_request(dep, pred, "predictions", "POST") as h:
+            try:
+                body = await self._json(request)
+                payload = payload_from_dict(body)
+                out = await self.service.predict(payload)
+                resp = payload_to_dict(out)
+                resp["status"] = {"code": 200, "status": "SUCCESS"}
+                return web.json_response(resp)
+            except CodecError as e:
+                h["code"] = "400"
+                return web.json_response(_status_body(400, str(e)), status=400)
+            except GraphUnitError as e:
+                h["code"] = "500"
+                return web.json_response(_status_body(500, str(e)), status=500)
+
+    async def feedback(self, request: web.Request) -> web.Response:
+        dep, pred = self.service.deployment_name, self.service.predictor.name
+        with self.metrics.time_server_request(dep, pred, "feedback", "POST") as h:
+            try:
+                fb = feedback_from_dict(await self._json(request))
+                await self.service.send_feedback(fb)
+                return web.json_response({"status": {"code": 200, "status": "SUCCESS"}})
+            except (CodecError, KeyError) as e:
+                h["code"] = "400"
+                return web.json_response(_status_body(400, str(e)), status=400)
+            except GraphUnitError as e:
+                h["code"] = "500"
+                return web.json_response(_status_body(500, str(e)), status=500)
+
+    async def _json(self, request: web.Request) -> dict[str, Any]:
+        import json
+
+        ctype = request.content_type or ""
+        if "form" in ctype:
+            form = await request.post()
+            raw = form.get("json")
+            if raw is None:
+                raise CodecError("form request missing 'json' field")
+            return json.loads(raw)
+        try:
+            return await request.json()
+        except json.JSONDecodeError as e:
+            raise CodecError(f"invalid JSON body: {e}") from e
+
+    async def ping(self, request: web.Request) -> web.Response:
+        return web.Response(text="pong")
+
+    async def ready(self, request: web.Request) -> web.Response:
+        if self.paused:
+            return web.Response(text="paused", status=503)
+        return web.Response(text="ready")
+
+    async def pause(self, request: web.Request) -> web.Response:
+        self.paused = True
+        return web.Response(text="paused")
+
+    async def unpause(self, request: web.Request) -> web.Response:
+        self.paused = False
+        return web.Response(text="unpaused")
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.expose(), content_type="text/plain")
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="seldon-core-tpu engine")
+    parser.add_argument("--port", type=int, default=int(os.environ.get("ENGINE_SERVER_PORT", "8000")))
+    parser.add_argument("--grpc-port", type=int, default=int(os.environ.get("ENGINE_SERVER_GRPC_PORT", "5001")))
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    predictor = load_predictor_spec()
+    service = PredictionService(
+        predictor, deployment_name=os.environ.get("SELDON_DEPLOYMENT_ID", "")
+    )
+    engine = EngineApp(service)
+    app = engine.build()
+
+    async def _start_grpc(app_: web.Application) -> None:
+        try:
+            from seldon_core_tpu.engine.grpc_app import start_engine_grpc
+
+            app_["grpc_server"] = await start_engine_grpc(service, args.grpc_port)
+        except Exception as e:  # pragma: no cover - grpc optional at boot
+            log.warning("gRPC server not started: %s", e)
+
+    async def _stop_grpc(app_: web.Application) -> None:
+        server = app_.get("grpc_server")
+        if server is not None:
+            await server.stop(grace=5)
+
+    app.on_startup.append(_start_grpc)
+    app.on_cleanup.append(_stop_grpc)
+    web.run_app(app, port=args.port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

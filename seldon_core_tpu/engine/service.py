@@ -1,0 +1,111 @@
+"""Per-predictor prediction service.
+
+Request-scope logic around the graph walker: puid assignment, status
+stamping, feedback metric counters (reference:
+engine/.../service/PredictionService.java:52-90,
+engine/.../predictors/PredictiveUnitBean.java:239-242).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+from typing import Any
+
+from seldon_core_tpu.contract import FeedbackPayload, Payload
+from seldon_core_tpu.graph.spec import PredictorSpec, PredictiveUnitSpec
+from seldon_core_tpu.graph.walker import GraphWalker
+from seldon_core_tpu.engine.transport import TransportManager
+from seldon_core_tpu.utils.metrics import MetricsRegistry
+from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
+from seldon_core_tpu.utils.puid import make_puid
+
+log = logging.getLogger(__name__)
+
+ENGINE_PREDICTOR_ENV = "ENGINE_PREDICTOR"
+ENGINE_DEPLOYMENT_ENV = "ENGINE_SELDON_DEPLOYMENT"
+PREDICTOR_FILE_FALLBACK = "./deploymentdef.json"
+
+# Built-in default graph used when no spec is provided — also the benchmark
+# configuration (reference: EnginePredictor.java:131-150 falls back to a
+# SIMPLE_MODEL graph the same way).
+DEFAULT_PREDICTOR: dict[str, Any] = {
+    "name": "default",
+    "graph": {
+        "name": "simple-model",
+        "type": "MODEL",
+        "implementation": "SIMPLE_MODEL",
+    },
+}
+
+
+def load_predictor_spec(environ: dict[str, str] | None = None) -> PredictorSpec:
+    """Resolve the predictor: env ``ENGINE_PREDICTOR`` (base64 JSON) →
+    ``./deploymentdef.json`` → built-in SIMPLE_MODEL default (reference:
+    engine/.../predictors/EnginePredictor.java:56-117)."""
+    env = environ if environ is not None else os.environ
+    raw = env.get(ENGINE_PREDICTOR_ENV)
+    if raw:
+        decoded = base64.b64decode(raw)
+        return PredictorSpec.model_validate(json.loads(decoded))
+    if os.path.exists(PREDICTOR_FILE_FALLBACK):
+        with open(PREDICTOR_FILE_FALLBACK) as f:
+            return PredictorSpec.model_validate(json.load(f))
+    return PredictorSpec.model_validate(DEFAULT_PREDICTOR)
+
+
+class PredictionService:
+    """Owns one predictor's walker + transports for the process lifetime."""
+
+    def __init__(
+        self,
+        predictor: PredictorSpec,
+        deployment_name: str = "",
+        components: dict[str, Any] | None = None,
+        metrics: MetricsRegistry | None = None,
+        transport_timeout_s: float = 5.0,
+    ):
+        self.predictor = predictor
+        self.deployment_name = deployment_name or predictor.name
+        self.metrics = metrics or DEFAULT_METRICS
+        self.transports = TransportManager(timeout_s=transport_timeout_s)
+        self._components = components or {}
+        self.walker: GraphWalker | None = None
+
+    async def start(self) -> None:
+        await self.transports.start()
+        self.walker = GraphWalker(
+            self.predictor.graph,
+            components=self._components,
+            client_factory=self.transports.client_factory,
+            feedback_hook=self._on_feedback,
+        )
+
+    async def close(self) -> None:
+        await self.transports.close()
+
+    def _on_feedback(self, unit_name: str, fb: FeedbackPayload) -> None:
+        self.metrics.feedback.labels(
+            self.deployment_name, self.predictor.name, unit_name
+        ).inc()
+        self.metrics.feedback_reward.labels(
+            self.deployment_name, self.predictor.name, unit_name
+        ).inc(fb.reward)
+
+    async def predict(self, payload: Payload) -> Payload:
+        assert self.walker is not None, "PredictionService.start() not called"
+        if not payload.meta.puid:
+            payload.meta.puid = make_puid()
+        out = await self.walker.predict(payload)
+        if out.meta.metrics:
+            self.metrics.record_custom(
+                self.deployment_name, self.predictor.name, self.predictor.graph.name,
+                out.meta.metrics,
+            )
+        return out
+
+    async def send_feedback(self, fb: FeedbackPayload) -> None:
+        assert self.walker is not None, "PredictionService.start() not called"
+        await self.walker.send_feedback(fb)

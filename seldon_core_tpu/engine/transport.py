@@ -1,0 +1,131 @@
+"""Transports from the orchestrator to remote graph units.
+
+The reference engine speaks form-encoded REST or gRPC to every unit
+(reference: engine/.../service/InternalPredictionService.java:90-285, with a
+new channel per gRPC call — a known inefficiency).  Here remote units get a
+pooled aiohttp session (REST) or a cached channel (gRPC, see
+grpc_transport.py); in-pod units bypass transports entirely.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any
+
+import aiohttp
+import numpy as np
+
+from seldon_core_tpu.contract import (
+    FeedbackPayload,
+    Payload,
+    feedback_to_dict,
+    payload_from_dict,
+    payload_to_dict,
+)
+from seldon_core_tpu.graph.spec import PredictiveUnitSpec, TransportType, UnitType
+from seldon_core_tpu.graph.units import GraphUnitError
+from seldon_core_tpu.graph.walker import ROUTE_ALL, NodeClient
+
+
+class RemoteUnitError(GraphUnitError):
+    """A remote unit returned an error status."""
+
+
+class RestNodeClient:
+    """NodeClient over HTTP JSON to a wrapped model microservice."""
+
+    def __init__(
+        self,
+        spec: PredictiveUnitSpec,
+        session: aiohttp.ClientSession,
+        timeout_s: float = 5.0,
+    ):
+        self.spec = spec
+        self.session = session
+        self.timeout = aiohttp.ClientTimeout(total=timeout_s)
+        ep = spec.endpoint
+        self.base = f"http://{ep.service_host}:{ep.service_port}"
+
+    async def _post(self, path: str, body: dict[str, Any]) -> dict[str, Any]:
+        try:
+            async with self.session.post(
+                self.base + path, json=body, timeout=self.timeout
+            ) as resp:
+                data = await resp.json(content_type=None)
+                if resp.status != 200:
+                    reason = (data or {}).get("status", {}).get("info", "")
+                    raise RemoteUnitError(
+                        f"unit {self.spec.name!r} {path} -> HTTP {resp.status}: {reason}"
+                    )
+                return data
+        except (aiohttp.ClientError, asyncio.TimeoutError, json.JSONDecodeError) as e:
+            raise RemoteUnitError(
+                f"unit {self.spec.name!r} {path} unreachable: {e}"
+            ) from e
+
+    def _merge(self, p: Payload, out: Payload) -> Payload:
+        """Keep the single shared request meta, merging the remote's additions."""
+        p.meta.merge_from(out.meta)
+        out.meta = p.meta
+        out.meta.request_path.setdefault(self.spec.name, self.base)
+        return out
+
+    async def transform_input(self, p: Payload) -> Payload:
+        path = "/predict" if self.spec.type == UnitType.MODEL else "/transform-input"
+        out = payload_from_dict(await self._post(path, payload_to_dict(p)))
+        return self._merge(p, out)
+
+    async def transform_output(self, p: Payload) -> Payload:
+        out = payload_from_dict(await self._post("/transform-output", payload_to_dict(p)))
+        return self._merge(p, out)
+
+    async def route(self, p: Payload) -> int:
+        out = payload_from_dict(await self._post("/route", payload_to_dict(p)))
+        self._merge(p, out)
+        if not out.is_numeric():
+            return ROUTE_ALL
+        return int(np.asarray(out.array).ravel()[0])
+
+    async def aggregate(self, ps: list[Payload]) -> Payload:
+        body = {"seldonMessages": [payload_to_dict(p) for p in ps]}
+        out = payload_from_dict(await self._post("/aggregate", body))
+        return self._merge(ps[0], out)
+
+    async def send_feedback(self, fb: FeedbackPayload, routing: int | None) -> None:
+        body = feedback_to_dict(fb)
+        if routing is not None:
+            body["routing"] = routing
+        await self._post("/send-feedback", body)
+
+
+class TransportManager:
+    """Builds NodeClients for a graph and owns the shared HTTP session."""
+
+    def __init__(self, timeout_s: float = 5.0):
+        self.timeout_s = timeout_s
+        self._session: aiohttp.ClientSession | None = None
+
+    async def start(self) -> None:
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=256, keepalive_timeout=30)
+            )
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def client_factory(self, spec: PredictiveUnitSpec) -> NodeClient:
+        from seldon_core_tpu.graph.walker import default_client_factory
+
+        if spec.endpoint.type == TransportType.REST:
+            if self._session is None:
+                raise RuntimeError("TransportManager.start() not called")
+            return RestNodeClient(spec, self._session, self.timeout_s)
+        if spec.endpoint.type == TransportType.GRPC:
+            from seldon_core_tpu.engine.grpc_transport import GrpcNodeClient
+
+            return GrpcNodeClient(spec, self.timeout_s)
+        return default_client_factory(spec)

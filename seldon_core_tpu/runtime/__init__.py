@@ -1,0 +1,6 @@
+"""User-model microservice runtime (the reference's `wrappers/python`)."""
+
+from seldon_core_tpu.runtime.server import MicroserviceApp, serve
+from seldon_core_tpu.runtime.microservice import load_component
+
+__all__ = ["MicroserviceApp", "serve", "load_component"]

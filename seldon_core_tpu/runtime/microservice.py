@@ -1,0 +1,78 @@
+"""Microservice entry point.
+
+CLI-compatible with the reference wrapper entry point (reference:
+wrappers/python/microservice.py:138-188):
+
+    sct-microservice <module.Class or module> REST \
+        --service-type MODEL --parameters '[{"name":...}]'
+
+Environment contract (reference: SeldonDeploymentOperatorImpl.java:346-387
+injects these): PREDICTIVE_UNIT_SERVICE_PORT, PREDICTIVE_UNIT_PARAMETERS,
+PREDICTIVE_UNIT_ID, PREDICTOR_ID, SELDON_DEPLOYMENT_ID.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import logging
+import os
+from typing import Any
+
+from seldon_core_tpu.contract.parameters import parse_parameters
+
+log = logging.getLogger(__name__)
+
+SERVICE_TYPES = ("MODEL", "ROUTER", "TRANSFORMER", "COMBINER", "OUTLIER_DETECTOR")
+
+
+def load_component(interface_name: str, parameters: dict[str, Any]) -> Any:
+    """Import ``module`` or ``module.Class`` and instantiate with typed
+    parameters (reference: microservice.py:154-161 imports a same-named class
+    from the user module)."""
+    if "." in interface_name:
+        module_name, class_name = interface_name.rsplit(".", 1)
+    else:
+        module_name = class_name = interface_name
+    module = importlib.import_module(module_name)
+    cls = getattr(module, class_name)
+    return cls(**parameters)
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="seldon-core-tpu model microservice")
+    parser.add_argument("interface_name", help="user module or module.Class")
+    parser.add_argument("api_type", nargs="?", default="REST", choices=["REST", "GRPC"])
+    parser.add_argument("--service-type", default="MODEL", choices=SERVICE_TYPES)
+    parser.add_argument("--parameters", default=os.environ.get("PREDICTIVE_UNIT_PARAMETERS", "[]"))
+    parser.add_argument(
+        "--port",
+        type=int,
+        default=int(os.environ.get("PREDICTIVE_UNIT_SERVICE_PORT", "9000")),
+    )
+    parser.add_argument("--persistence", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    parameters = parse_parameters(json.loads(args.parameters))
+    component = load_component(args.interface_name, parameters)
+    name = os.environ.get("PREDICTIVE_UNIT_ID", args.interface_name)
+
+    if args.persistence:
+        from seldon_core_tpu.runtime.persistence import start_persistence
+
+        component = start_persistence(component, name)
+
+    if args.api_type == "GRPC":
+        from seldon_core_tpu.runtime.grpc_service import serve_grpc
+
+        serve_grpc(component, args.port, name=name, service_type=args.service_type)
+    else:
+        from seldon_core_tpu.runtime.server import serve
+
+        serve(component, args.port, name=name, service_type=args.service_type)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,179 @@
+"""Model-microservice REST server.
+
+The standalone runtime that wraps a user component behind the standard
+endpoint set, for graph units that run in their *own* pod (cross-pod nodes).
+Endpoint names, form-encoded ``json=`` request compat, and duck-typed user
+contract match the reference wrapper runtime (reference:
+wrappers/python/model_microservice.py:40-84, router_microservice.py:28-90,
+transformer_microservice.py:44-95, microservice.py:138-188) — so a model
+image written for the reference keeps the same HTTP surface.
+
+In-pod units never see this server: the engine calls them in-process.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from aiohttp import web
+
+from seldon_core_tpu.contract import (
+    CodecError,
+    FeedbackPayload,
+    Payload,
+    feedback_from_dict,
+    payload_from_dict,
+    payload_to_dict,
+)
+from seldon_core_tpu.graph.spec import PredictiveUnitSpec, TransportType, UnitType
+from seldon_core_tpu.graph.units import GraphUnitError
+from seldon_core_tpu.graph.walker import LocalClient, ROUTE_ALL
+from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
+
+import json as _json
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+async def _request_json(request: web.Request) -> dict[str, Any]:
+    """Accept either a JSON body or the reference's form-encoded ``json=``
+    parameter (reference: engine form-POSTs
+    `json=<SeldonMessage JSON>` — InternalPredictionService.java:240-242)."""
+    ctype = request.content_type or ""
+    if "form" in ctype:
+        form = await request.post()
+        raw = form.get("json")
+        if raw is None:
+            raise CodecError("form request missing 'json' field")
+        return _json.loads(raw)
+    try:
+        return await request.json()
+    except _json.JSONDecodeError as e:
+        raise CodecError(f"invalid JSON body: {e}") from e
+
+
+def _error_response(code: int, reason: str, status: int = 400) -> web.Response:
+    body = {
+        "status": {
+            "code": code,
+            "info": reason,
+            "reason": reason,
+            "status": "FAILURE",
+        }
+    }
+    return web.json_response(body, status=status)
+
+
+class MicroserviceApp:
+    """aiohttp application wrapping one user component."""
+
+    def __init__(self, component: Any, name: str = "model", service_type: str = "MODEL"):
+        self.component = component
+        self.name = name
+        self.service_type = service_type
+        # Two client views over the same component: MODEL maps
+        # transform_input->predict, TRANSFORMER maps it to transform_input.
+        self._model_client = LocalClient(
+            PredictiveUnitSpec(name=name, type=UnitType.MODEL), component
+        )
+        self._transformer_client = LocalClient(
+            PredictiveUnitSpec(name=name, type=UnitType.TRANSFORMER), component
+        )
+
+    def build(self) -> web.Application:
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        r = app.router
+        r.add_post("/predict", self.predict)
+        r.add_post("/api/v0.1/predictions", self.predict)
+        r.add_post("/transform-input", self.transform_input)
+        r.add_post("/transform-output", self.transform_output)
+        r.add_post("/route", self.route)
+        r.add_post("/aggregate", self.aggregate)
+        r.add_post("/send-feedback", self.send_feedback)
+        r.add_get("/ping", self.ping)
+        r.add_get("/ready", self.ready)
+        r.add_get("/health/status", self.ping)
+        r.add_get("/prometheus", self.prometheus)
+        return app
+
+    # -- handlers ---------------------------------------------------------
+
+    async def _transform(self, request: web.Request, client: LocalClient, method: str) -> web.Response:
+        try:
+            payload = payload_from_dict(await _request_json(request))
+            if method == "input":
+                out = await client.transform_input(payload)
+            else:
+                out = await client.transform_output(payload)
+            return web.json_response(payload_to_dict(out))
+        except CodecError as e:
+            return _error_response(400, str(e))
+        except GraphUnitError as e:
+            return _error_response(500, str(e), status=500)
+
+    async def predict(self, request: web.Request) -> web.Response:
+        return await self._transform(request, self._model_client, "input")
+
+    async def transform_input(self, request: web.Request) -> web.Response:
+        return await self._transform(request, self._transformer_client, "input")
+
+    async def transform_output(self, request: web.Request) -> web.Response:
+        return await self._transform(request, self._transformer_client, "output")
+
+    async def route(self, request: web.Request) -> web.Response:
+        try:
+            payload = payload_from_dict(await _request_json(request))
+            branch = await self._model_client.route(payload)
+            # routing returned as a 1x1 ndarray, like the reference router
+            # runtime (wrappers/python/router_microservice.py:28-56)
+            out = payload.with_array(np.array([[branch]]), names=[])
+            return web.json_response(payload_to_dict(out))
+        except CodecError as e:
+            return _error_response(400, str(e))
+        except GraphUnitError as e:
+            return _error_response(500, str(e), status=500)
+
+    async def aggregate(self, request: web.Request) -> web.Response:
+        try:
+            body = await _request_json(request)
+            msgs = body.get("seldonMessages", [])
+            if not msgs:
+                return _error_response(400, "seldonMessages list is empty")
+            payloads = [payload_from_dict(m) for m in msgs]
+            out = await self._model_client.aggregate(payloads)
+            return web.json_response(payload_to_dict(out))
+        except CodecError as e:
+            return _error_response(400, str(e))
+        except GraphUnitError as e:
+            return _error_response(500, str(e), status=500)
+
+    async def send_feedback(self, request: web.Request) -> web.Response:
+        try:
+            body = await _request_json(request)
+            fb = feedback_from_dict(body)
+            routing = body.get("routing")  # extension: explicit routed branch
+            if routing is None and fb.response is not None:
+                routing = fb.response.meta.routing.get(self.name)
+            await self._model_client.send_feedback(
+                fb, int(routing) if routing is not None else None
+            )
+            return web.json_response(payload_to_dict(Payload()))
+        except CodecError as e:
+            return _error_response(400, str(e))
+
+    async def ping(self, request: web.Request) -> web.Response:
+        return web.Response(text="pong")
+
+    async def ready(self, request: web.Request) -> web.Response:
+        return web.Response(text="ready")
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(body=DEFAULT_METRICS.expose(), content_type="text/plain")
+
+
+def serve(component: Any, port: int, name: str = "model", service_type: str = "MODEL") -> None:
+    app = MicroserviceApp(component, name=name, service_type=service_type).build()
+    web.run_app(app, port=port, access_log=None)

@@ -1,0 +1,7 @@
+"""Shared utilities: metrics, puid, config."""
+
+from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
+from seldon_core_tpu.utils.metrics import MetricsRegistry
+from seldon_core_tpu.utils.puid import make_puid
+
+__all__ = ["DEFAULT_METRICS", "MetricsRegistry", "make_puid"]

@@ -1,0 +1,138 @@
+"""Prometheus metrics with the reference's tag vocabulary.
+
+The reference exports `seldon_api_engine_server_requests_duration_seconds` /
+`..._client_requests_...` histograms tagged with deployment / predictor /
+model name+image+version (reference:
+engine/src/main/resources/application.properties:4-8,
+engine/.../metrics/SeldonRestTemplateExchangeTagsProvider.java:34-90) and
+feedback/reward counters (PredictiveUnitBean.java:239-242).  Same metric
+names here so existing Grafana dashboards keep working.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class MetricsRegistry:
+    """Per-process metrics registry for engine / gateway / microservice."""
+
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.server_requests = Histogram(
+            "seldon_api_engine_server_requests_duration_seconds",
+            "Engine ingress request latency",
+            ["deployment_name", "predictor_name", "service", "method", "code"],
+            registry=self.registry,
+            buckets=_BUCKETS,
+        )
+        self.client_requests = Histogram(
+            "seldon_api_engine_client_requests_duration_seconds",
+            "Per-graph-node downstream call latency",
+            ["deployment_name", "predictor_name", "model_name", "model_image",
+             "model_version", "method", "code"],
+            registry=self.registry,
+            buckets=_BUCKETS,
+        )
+        self.ingress_requests = Histogram(
+            "seldon_api_ingress_server_requests_duration_seconds",
+            "Gateway ingress request latency",
+            ["principal", "deployment_name", "service", "method", "code"],
+            registry=self.registry,
+            buckets=_BUCKETS,
+        )
+        self.feedback = Counter(
+            "seldon_api_model_feedback",
+            "Feedback events per unit",
+            ["deployment_name", "predictor_name", "model_name"],
+            registry=self.registry,
+        )
+        self.feedback_reward = Counter(
+            "seldon_api_model_feedback_reward",
+            "Accumulated reward per unit",
+            ["deployment_name", "predictor_name", "model_name"],
+            registry=self.registry,
+        )
+        self.custom_counter = Counter(
+            "seldon_model_custom_counter",
+            "User-code emitted counter metrics (Meta.metrics extension)",
+            ["deployment_name", "predictor_name", "model_name", "key"],
+            registry=self.registry,
+        )
+        self.custom_gauge = Gauge(
+            "seldon_model_custom_gauge",
+            "User-code emitted gauge metrics",
+            ["deployment_name", "predictor_name", "model_name", "key"],
+            registry=self.registry,
+        )
+        self.custom_timer = Histogram(
+            "seldon_model_custom_timer",
+            "User-code emitted timer metrics (seconds)",
+            ["deployment_name", "predictor_name", "model_name", "key"],
+            registry=self.registry,
+            buckets=_BUCKETS,
+        )
+        self.batch_size = Histogram(
+            "seldon_executor_batch_size",
+            "Continuous-batching effective batch sizes",
+            ["model_name"],
+            registry=self.registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+        )
+        self.queue_depth = Gauge(
+            "seldon_executor_queue_depth",
+            "Continuous-batching queue depth",
+            ["model_name"],
+            registry=self.registry,
+        )
+
+    @contextmanager
+    def time_server_request(
+        self, deployment: str, predictor: str, service: str, method: str
+    ):
+        """Times a request; records the status code set by the caller via
+        ``holder['code']``."""
+        holder = {"code": "200"}
+        start = time.perf_counter()
+        try:
+            yield holder
+        finally:
+            self.server_requests.labels(
+                deployment, predictor, service, method, holder["code"]
+            ).observe(time.perf_counter() - start)
+
+    def record_custom(
+        self, deployment: str, predictor: str, model: str, metrics
+    ) -> None:
+        for m in metrics:
+            if m.type == "GAUGE":
+                self.custom_gauge.labels(deployment, predictor, model, m.key).set(m.value)
+            elif m.type == "TIMER":
+                self.custom_timer.labels(deployment, predictor, model, m.key).observe(
+                    m.value / 1000.0
+                )
+            else:
+                self.custom_counter.labels(deployment, predictor, model, m.key).inc(
+                    m.value
+                )
+
+    def expose(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+# default process-wide registry
+DEFAULT = MetricsRegistry()

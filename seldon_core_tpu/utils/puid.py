@@ -1,0 +1,12 @@
+"""Request-correlation id generation.
+
+The reference tags every request with a 130-bit random ``puid`` carried in
+``Meta`` and used as the Kafka message key (reference:
+engine/.../service/PredictionService.java:52-58)."""
+
+import secrets
+
+
+def make_puid() -> str:
+    """33 base-32-ish hex chars of cryptographic randomness (>=130 bits)."""
+    return secrets.token_hex(17)

@@ -1,0 +1,283 @@
+"""End-to-end engine tests over real HTTP.
+
+The in-process analogue of the reference's MockMvc suite
+(reference: engine/src/test/java/io/seldon/engine/api/rest/
+TestRestClientController.java:1-103 — REST against the default SIMPLE_MODEL
+graph) plus a cross-service test where a graph node lives behind a real
+microservice HTTP server (the reference can only do this on a live cluster).
+"""
+
+import asyncio
+
+import numpy as np
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu.engine.app import EngineApp
+from seldon_core_tpu.engine.service import PredictionService, load_predictor_spec
+from seldon_core_tpu.graph.spec import PredictorSpec
+from seldon_core_tpu.runtime.server import MicroserviceApp
+from seldon_core_tpu.graph.units import EpsilonGreedy
+
+run = asyncio.run
+
+
+async def _engine_client(predictor: PredictorSpec, components=None) -> TestClient:
+    service = PredictionService(predictor, components=components)
+    app = EngineApp(service).build()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def default_predictor() -> PredictorSpec:
+    return load_predictor_spec(environ={})
+
+
+REQ = {"data": {"ndarray": [[1.0, 2.0, 3.0]]}}
+
+
+class TestEngineRest:
+    def test_predictions_default_graph(self):
+        async def go():
+            client = await _engine_client(default_predictor())
+            try:
+                resp = await client.post("/api/v0.1/predictions", json=REQ)
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["status"]["status"] == "SUCCESS"
+                assert body["data"]["ndarray"] == [[0.1, 0.9, 0.5]]
+                assert body["data"]["names"] == ["class0", "class1", "class2"]
+                assert len(body["meta"]["puid"]) >= 32
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_form_encoded_compat(self):
+        # the reference engine form-POSTs json=<msg> between services
+        async def go():
+            client = await _engine_client(default_predictor())
+            try:
+                import json as j
+
+                resp = await client.post(
+                    "/api/v1.0/predictions", data={"json": j.dumps(REQ)}
+                )
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["data"]["ndarray"] == [[0.1, 0.9, 0.5]]
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_bad_json_is_400(self):
+        async def go():
+            client = await _engine_client(default_predictor())
+            try:
+                resp = await client.post(
+                    "/api/v0.1/predictions",
+                    data=b"{not json",
+                    headers={"Content-Type": "application/json"},
+                )
+                assert resp.status == 400
+                body = await resp.json()
+                assert body["status"]["status"] == "FAILURE"
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_ping_ready_pause_cycle(self):
+        async def go():
+            client = await _engine_client(default_predictor())
+            try:
+                assert (await client.get("/ping")).status == 200
+                assert (await client.get("/ready")).status == 200
+                assert (await client.get("/pause")).status == 200
+                assert (await client.get("/ready")).status == 503
+                # paused engine still serves traffic (drain semantics,
+                # reference: RestClientController.java pause only flips ready)
+                assert (await client.post("/api/v0.1/predictions", json=REQ)).status == 200
+                assert (await client.get("/unpause")).status == 200
+                assert (await client.get("/ready")).status == 200
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_prometheus_scrape(self):
+        async def go():
+            client = await _engine_client(default_predictor())
+            try:
+                await client.post("/api/v0.1/predictions", json=REQ)
+                resp = await client.get("/prometheus")
+                text = await resp.text()
+                assert "seldon_api_engine_server_requests_duration_seconds" in text
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_feedback_updates_bandit(self):
+        predictor = PredictorSpec.model_validate(
+            {
+                "name": "ab",
+                "graph": {
+                    "name": "eg",
+                    "type": "ROUTER",
+                    "implementation": "EPSILON_GREEDY",
+                    "parameters": [
+                        {"name": "epsilon", "value": "0.0", "type": "FLOAT"}
+                    ],
+                    "children": [
+                        {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                        {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    ],
+                },
+            }
+        )
+
+        async def go():
+            service = PredictionService(predictor)
+            app = EngineApp(service).build()
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resp = await client.post("/api/v0.1/predictions", json=REQ)
+                body = await resp.json()
+                routed = body["meta"]["routing"]["eg"]
+                fb = {"request": REQ, "response": body, "reward": 1.0}
+                resp = await client.post("/api/v0.1/feedback", json=fb)
+                assert resp.status == 200
+                router = service.walker.root.client.component
+                assert isinstance(router, EpsilonGreedy)
+                assert router.pulls[routed] == 1
+                assert router.value[routed] == 1.0
+            finally:
+                await client.close()
+
+        run(go())
+
+
+class TestCrossServiceGraph:
+    """Engine orchestrating a remote REST microservice — process boundary #2
+    of the reference hot path (SURVEY §3.1) exercised in-process."""
+
+    def test_remote_model_node(self):
+        class TimesTen:
+            def predict(self, X, names):
+                return X * 10
+
+            def tags(self):
+                return {"remote": True}
+
+        async def go():
+            ms_app = MicroserviceApp(TimesTen(), name="m").build()
+            ms_server = TestServer(ms_app)
+            await ms_server.start_server()
+            port = ms_server.port
+
+            predictor = PredictorSpec.model_validate(
+                {
+                    "name": "p",
+                    "graph": {
+                        "name": "remote-model",
+                        "type": "MODEL",
+                        "endpoint": {
+                            "service_host": "127.0.0.1",
+                            "service_port": port,
+                            "type": "REST",
+                        },
+                    },
+                }
+            )
+            client = await _engine_client(predictor)
+            try:
+                resp = await client.post("/api/v0.1/predictions", json=REQ)
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["data"]["ndarray"] == [[10.0, 20.0, 30.0]]
+                assert body["meta"]["tags"] == {"remote": True}
+            finally:
+                await client.close()
+                await ms_server.close()
+
+        run(go())
+
+    def test_remote_router_and_feedback(self):
+        class PickOne:
+            def __init__(self):
+                self.rewards = []
+
+            def route(self, X, names):
+                return 1
+
+            def send_feedback(self, X, names, reward, truth=None, routing=None):
+                self.rewards.append((reward, routing))
+
+        router = PickOne()
+
+        async def go():
+            ms_server = TestServer(MicroserviceApp(router, name="r").build())
+            await ms_server.start_server()
+
+            predictor = PredictorSpec.model_validate(
+                {
+                    "name": "p",
+                    "graph": {
+                        "name": "r",
+                        "type": "ROUTER",
+                        "endpoint": {
+                            "service_host": "127.0.0.1",
+                            "service_port": ms_server.port,
+                            "type": "REST",
+                        },
+                        "children": [
+                            {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                            {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                        ],
+                    },
+                }
+            )
+            client = await _engine_client(predictor)
+            try:
+                resp = await client.post("/api/v0.1/predictions", json=REQ)
+                body = await resp.json()
+                assert body["meta"]["routing"]["r"] == 1
+                fb = {"request": REQ, "response": body, "reward": 0.7}
+                assert (await client.post("/api/v0.1/feedback", json=fb)).status == 200
+                assert router.rewards == [(0.7, 1)]
+            finally:
+                await client.close()
+                await ms_server.close()
+
+        run(go())
+
+    def test_remote_unit_error_propagates_500(self):
+        async def go():
+            predictor = PredictorSpec.model_validate(
+                {
+                    "name": "p",
+                    "graph": {
+                        "name": "gone",
+                        "type": "MODEL",
+                        "endpoint": {
+                            "service_host": "127.0.0.1",
+                            "service_port": 1,  # nothing listens here
+                            "type": "REST",
+                        },
+                    },
+                }
+            )
+            client = await _engine_client(predictor)
+            try:
+                resp = await client.post("/api/v0.1/predictions", json=REQ)
+                assert resp.status == 500
+                body = await resp.json()
+                assert body["status"]["status"] == "FAILURE"
+                assert "unreachable" in body["status"]["reason"]
+            finally:
+                await client.close()
+
+        run(go())
