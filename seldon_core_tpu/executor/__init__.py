@@ -1,0 +1,19 @@
+"""JAX execution plane.
+
+The reference's "executor" is a Tomcat thread pool calling user Python over
+HTTP per request (reference: engine/.../PredictiveUnitBean.java:68-112 +
+wrappers/python/model_microservice.py:40-84).  Here the execution plane is:
+
+* :class:`CompiledModel` — a jit/pjit-compiled forward function with params
+  resident in TPU HBM, bucketed batch padding so serving never recompiles,
+* :class:`BatchQueue` — a continuous micro-batching queue turning concurrent
+  single requests into large MXU-friendly device steps,
+* :class:`JaxModelComponent` — the adapter that makes a compiled model a
+  graph unit (``predict``) so it drops into any inference graph.
+"""
+
+from seldon_core_tpu.executor.compiled import BucketSpec, CompiledModel
+from seldon_core_tpu.executor.batcher import BatchQueue
+from seldon_core_tpu.executor.component import JaxModelComponent
+
+__all__ = ["BucketSpec", "CompiledModel", "BatchQueue", "JaxModelComponent"]

@@ -1,0 +1,153 @@
+"""Continuous micro-batching queue.
+
+The reference's concurrency model is one Tomcat thread per in-flight request,
+each doing its own network round-trip to the model server (reference:
+engine/.../PredictiveUnitBean.java:68-112).  On TPU the equivalent resource
+is *device steps*: many concurrent requests should coalesce into one large
+batch per step so the MXU runs full tiles.
+
+:class:`BatchQueue` accepts single requests from the asyncio event loop,
+groups compatible ones (same trailing shape + dtype), waits at most
+``max_delay_ms`` for stragglers, and runs one padded device step on a
+dedicated executor thread (JAX dispatch is blocking; one runner thread per
+model also serializes device access, which XLA requires anyway).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import concurrent.futures
+from typing import Callable
+
+import numpy as np
+
+
+class BatchQueue:
+    def __init__(
+        self,
+        runner: Callable[[np.ndarray], np.ndarray],
+        *,
+        max_batch: int = 64,
+        max_delay_ms: float = 2.0,
+        name: str = "model",
+    ):
+        self.runner = runner
+        self.max_batch = int(max_batch)
+        self.max_delay = max_delay_ms / 1000.0
+        self.name = name
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"batcher-{name}"
+        )
+        self._task: asyncio.Task | None = None
+        self._closed = False
+        # observability
+        self.steps = 0
+        self.rows = 0
+
+    # ------------------------------------------------------------- lifecycle
+    def _ensure_running(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def close(self) -> None:
+        """Stop the loop and fail every pending/in-flight request cleanly
+        (a hung awaiter is worse than an errored one during drain)."""
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        err = RuntimeError(f"BatchQueue {self.name!r} closed")
+        while not self._queue.empty():
+            _, fut = self._queue.get_nowait()
+            if not fut.done():
+                fut.set_exception(err)
+        self._pool.shutdown(wait=False)
+
+    # ------------------------------------------------------------- interface
+    async def submit(self, x: np.ndarray) -> np.ndarray:
+        """Submit one request batch (rows stay together); returns its rows."""
+        if self._closed:
+            raise RuntimeError("BatchQueue is closed")
+        self._ensure_running()
+        x = np.asarray(x)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((x, fut))
+        return await fut
+
+    # ------------------------------------------------------------- internals
+    @staticmethod
+    def _key(x: np.ndarray) -> tuple:
+        return (x.shape[1:] if x.ndim > 1 else x.shape, x.dtype.str)
+
+    @staticmethod
+    def _rows(x: np.ndarray) -> int:
+        return x.shape[0] if x.ndim > 1 else 1
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        pending: collections.deque = collections.deque()  # misfits, served first
+        group: list = []
+        try:
+            while True:
+                first = pending.popleft() if pending else await self._queue.get()
+                group = [first]
+                key = self._key(first[0])
+                rows = self._rows(first[0])
+                # absorb compatible held-over items before waiting on the queue
+                for item in list(pending):
+                    if rows >= self.max_batch:
+                        break
+                    if self._key(item[0]) == key:
+                        pending.remove(item)
+                        group.append(item)
+                        rows += self._rows(item[0])
+                deadline = loop.time() + self.max_delay
+                while rows < self.max_batch:
+                    timeout = deadline - loop.time()
+                    if timeout <= 0:
+                        break
+                    try:
+                        item = await asyncio.wait_for(self._queue.get(), timeout)
+                    except asyncio.TimeoutError:
+                        break
+                    if self._key(item[0]) != key:
+                        # hold for the *next* group so a minority shape is
+                        # served right after this step, not starved behind a
+                        # dominant-shape stream
+                        pending.append(item)
+                        continue
+                    group.append(item)
+                    rows += self._rows(item[0])
+                await self._step(loop, group)
+                group = []
+        except asyncio.CancelledError:
+            err = RuntimeError(f"BatchQueue {self.name!r} closed")
+            for _, fut in list(group) + list(pending):
+                if not fut.done():
+                    fut.set_exception(err)
+            raise
+
+    async def _step(self, loop, group) -> None:
+        xs = [np.atleast_2d(x) for x, _ in group]
+        batch = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
+        try:
+            out = await loop.run_in_executor(self._pool, self.runner, batch)
+        except Exception as exc:  # propagate to every waiter
+            for _, fut in group:
+                if not fut.done():
+                    fut.set_exception(exc)
+            return
+        self.steps += 1
+        self.rows += batch.shape[0]
+        out = np.asarray(out)
+        offset = 0
+        for (x, fut), rows in zip(group, (x.shape[0] for x in xs)):
+            if not fut.done():
+                res = out[offset : offset + rows]
+                fut.set_result(res if x.ndim > 1 else res[0])
+            offset += rows

@@ -1,0 +1,58 @@
+"""Graph-unit adapter for compiled JAX models.
+
+Makes a :class:`CompiledModel` (optionally behind a :class:`BatchQueue`) obey
+the duck-typed component contract (``predict(X, names)``) so it slots into
+any inference graph next to user Python components — the in-process
+replacement for the reference's model-microservice pod behind ``/predict``
+(reference: wrappers/python/model_microservice.py:23-84).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from seldon_core_tpu.executor.batcher import BatchQueue
+from seldon_core_tpu.executor.compiled import CompiledModel
+from seldon_core_tpu.graph.units import SeldonComponent
+
+
+class JaxModelComponent(SeldonComponent):
+    def __init__(
+        self,
+        model: CompiledModel,
+        *,
+        class_names: list[str] | None = None,
+        batching: bool = True,
+        max_batch: int = 64,
+        max_delay_ms: float = 2.0,
+    ):
+        self.model = model
+        if class_names is not None:
+            self.class_names = class_names
+        self._queue = (
+            BatchQueue(model, max_batch=max_batch, max_delay_ms=max_delay_ms, name=model.name)
+            if batching
+            else None
+        )
+
+    async def predict(self, X: np.ndarray, names: list[str]) -> np.ndarray:
+        if self._queue is not None:
+            return await self._queue.submit(np.asarray(X))
+        return self.model(np.asarray(X))
+
+    def metrics(self) -> list[dict[str, Any]]:
+        if self._queue is None:
+            return []
+        # cumulative totals -> GAUGE: the metrics pipeline records custom
+        # COUNTERs with inc(value) per request, which would sum running
+        # totals quadratically
+        return [
+            {"key": f"{self.model.name}_device_steps", "type": "GAUGE", "value": self._queue.steps},
+            {"key": f"{self.model.name}_device_rows", "type": "GAUGE", "value": self._queue.rows},
+        ]
+
+    async def close(self) -> None:
+        if self._queue is not None:
+            await self._queue.close()

@@ -1,0 +1,41 @@
+"""Parallelism layer: device meshes, sharding rules, sequence parallelism.
+
+The reference has no intra-model parallelism at all — no model invocation ever
+spans more than one process (reference: SURVEY.md §2.7; the engine's only
+concurrency is Spring ``@Async`` futures per graph node,
+engine/.../predictors/PredictiveUnitBean.java:68-112).  Scaling there means
+k8s replicas behind a ClusterIP Service.
+
+Here a *single* model spans TPU chips via a :class:`jax.sharding.Mesh`:
+
+* ``dp``    data parallel (batch dimension) — replaces replica fan-out for
+            throughput within one pod,
+* ``fsdp``  fully-sharded params along the batch axis group,
+* ``tp``    tensor parallel (hidden/heads) over ICI,
+* ``sp``    sequence/context parallel (ring attention) for long contexts.
+
+XLA inserts the collectives (psum/all-gather/reduce-scatter/ppermute) from the
+sharding annotations; nothing here hand-writes NCCL-style calls.
+"""
+
+from seldon_core_tpu.parallel.mesh import (
+    MeshPlan,
+    best_mesh,
+    local_mesh,
+    make_mesh,
+)
+from seldon_core_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_sharding,
+    shard_params,
+)
+
+__all__ = [
+    "MeshPlan",
+    "best_mesh",
+    "local_mesh",
+    "make_mesh",
+    "ShardingRules",
+    "logical_sharding",
+    "shard_params",
+]

@@ -1,0 +1,77 @@
+"""Device-mesh construction for serving.
+
+Axis order is (dp, fsdp, tp, sp) with ``tp`` innermost-but-one so tensor-
+parallel collectives ride the fastest ICI links; ``sp`` is innermost because
+ring attention only moves KV blocks between neighbours.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """A named factorization of the device count over the four serving axes."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def shape(self) -> tuple[int, int, int, int]:
+        return (self.dp, self.fsdp, self.tp, self.sp)
+
+    @property
+    def n_devices(self) -> int:
+        return math.prod(self.shape)
+
+
+def make_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
+    """Build a Mesh from a plan over ``devices`` (default: all local)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < plan.n_devices:
+        raise ValueError(
+            f"mesh plan {plan.shape} needs {plan.n_devices} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.asarray(devices[: plan.n_devices]).reshape(plan.shape)
+    return Mesh(arr, AXES)
+
+
+def best_mesh(
+    n_devices: int | None = None,
+    *,
+    tp: int | None = None,
+    sp: int = 1,
+    devices: list | None = None,
+) -> Mesh:
+    """Pick a sensible serving mesh for ``n_devices``.
+
+    Default policy: give ``tp`` the largest power-of-two divisor up to 8
+    (one v5e host's ICI domain), the rest to ``dp``.  Callers with long-
+    context models pass ``sp`` explicitly.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices if n_devices is not None else len(devices)
+    if tp is None:
+        tp = 1
+        while tp * 2 <= min(8, n // sp) and (n // sp) % (tp * 2) == 0:
+            tp *= 2
+    dp = n // (tp * sp)
+    if dp * tp * sp != n:
+        raise ValueError(f"cannot factor {n} devices into dp*tp={tp}*sp={sp}")
+    return make_mesh(MeshPlan(dp=dp, tp=tp, sp=sp), devices)
+
+
+def local_mesh() -> Mesh:
+    """Single-process mesh over every visible device (dp only)."""
+    return best_mesh(tp=1)

@@ -7,9 +7,17 @@ before the first ``import jax`` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment pins JAX_PLATFORMS to a TPU platform:
+# the suite needs 8 virtual devices for sharding tests.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# A TPU-tunnel plugin (if installed) re-pins jax_platforms to its own
+# backend during `import jax`, ignoring the env var — pin it back.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
