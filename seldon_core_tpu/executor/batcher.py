@@ -1,4 +1,4 @@
-"""Continuous micro-batching queue.
+"""Continuous micro-batching queue with a pipelined device stream.
 
 The reference's concurrency model is one Tomcat thread per in-flight request,
 each doing its own network round-trip to the model server (reference:
@@ -6,11 +6,19 @@ engine/.../PredictiveUnitBean.java:68-112).  On TPU the equivalent resource
 is *device steps*: many concurrent requests should coalesce into one large
 batch per step so the MXU runs full tiles.
 
-:class:`BatchQueue` accepts single requests from the asyncio event loop,
-groups compatible ones (same trailing shape + dtype), waits at most
-``max_delay_ms`` for stragglers, and runs one padded device step on a
-dedicated executor thread (JAX dispatch is blocking; one runner thread per
-model also serializes device access, which XLA requires anyway).
+Two latencies matter:
+
+* collection latency — how long a request waits for batch-mates
+  (``max_delay_ms``, one timer per step, drain via ``get_nowait``);
+* device round-trip — dispatch is sub-ms, but *materializing* a result
+  blocks for the full device (or tunnel) round trip.  The queue therefore
+  dispatches each step immediately on the event loop and fetches results on
+  a thread pool with up to ``pipeline_depth`` steps in flight, so round-trip
+  latency amortizes across the stream instead of serializing it.
+
+Runners may be a plain callable ``batch -> result`` or expose the
+``dispatch(batch) -> handle`` / ``fetch(*handle) -> result`` pair
+(:class:`~seldon_core_tpu.executor.compiled.CompiledModel` does).
 """
 
 from __future__ import annotations
@@ -30,16 +38,27 @@ class BatchQueue:
         *,
         max_batch: int = 64,
         max_delay_ms: float = 2.0,
+        pipeline_depth: int = 8,
         name: str = "model",
     ):
         self.runner = runner
         self.max_batch = int(max_batch)
         self.max_delay = max_delay_ms / 1000.0
         self.name = name
+        self._dispatch = getattr(runner, "dispatch", None)
+        self._fetch = getattr(runner, "fetch", None)
+        # only dispatch/fetch runners (CompiledModel) are promised to be
+        # thread-safe; a plain callable keeps the single-runner-thread
+        # guarantee and therefore a pipeline of 1
+        self._pipelined = self._dispatch is not None and self._fetch is not None
+        depth = max(1, pipeline_depth) if self._pipelined else 1
         self._queue: asyncio.Queue = asyncio.Queue()
         self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix=f"batcher-{name}"
+            max_workers=depth,
+            thread_name_prefix=f"batcher-{name}",
         )
+        self._sem = asyncio.Semaphore(depth)
+        self._inflight: set[asyncio.Task] = set()
         self._task: asyncio.Task | None = None
         self._closed = False
         # observability
@@ -61,6 +80,9 @@ class BatchQueue:
                 await self._task
             except asyncio.CancelledError:
                 pass
+        for t in list(self._inflight):
+            t.cancel()
+        await asyncio.gather(*self._inflight, return_exceptions=True)
         err = RuntimeError(f"BatchQueue {self.name!r} closed")
         while not self._queue.empty():
             _, fut = self._queue.get_nowait()
@@ -106,24 +128,49 @@ class BatchQueue:
                         pending.remove(item)
                         group.append(item)
                         rows += self._rows(item[0])
+
+                def drain(total: int) -> int:
+                    # drain immediately-available items without timer
+                    # machinery (a wait_for per item costs more than the
+                    # device step at high request rates)
+                    while total < self.max_batch:
+                        try:
+                            item = self._queue.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                        if self._key(item[0]) != key:
+                            # hold for the *next* group so a minority shape
+                            # is served right after this step, not starved
+                            # behind a dominant-shape stream
+                            pending.append(item)
+                            continue
+                        group.append(item)
+                        total += self._rows(item[0])
+                    return total
+
+                rows = drain(rows)
+                # wait out the collection window, but dispatch the moment the
+                # batch fills — a full batch must not sit out the timer
                 deadline = loop.time() + self.max_delay
                 while rows < self.max_batch:
-                    timeout = deadline - loop.time()
-                    if timeout <= 0:
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
                         break
                     try:
-                        item = await asyncio.wait_for(self._queue.get(), timeout)
+                        item = await asyncio.wait_for(self._queue.get(), remaining)
                     except asyncio.TimeoutError:
                         break
                     if self._key(item[0]) != key:
-                        # hold for the *next* group so a minority shape is
-                        # served right after this step, not starved behind a
-                        # dominant-shape stream
                         pending.append(item)
                         continue
                     group.append(item)
                     rows += self._rows(item[0])
-                await self._step(loop, group)
+                    rows = drain(rows)  # absorb any burst that came with it
+
+                await self._sem.acquire()  # bound the in-flight pipeline
+                task = loop.create_task(self._step(loop, group))
+                self._inflight.add(task)
+                task.add_done_callback(self._inflight.discard)
                 group = []
         except asyncio.CancelledError:
             err = RuntimeError(f"BatchQueue {self.name!r} closed")
@@ -136,18 +183,40 @@ class BatchQueue:
         xs = [np.atleast_2d(x) for x, _ in group]
         batch = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
         try:
-            out = await loop.run_in_executor(self._pool, self.runner, batch)
-        except Exception as exc:  # propagate to every waiter
-            for _, fut in group:
+            try:
+                cap = getattr(getattr(self.runner, "buckets", None), "max", None)
+                if self._pipelined and (cap is None or batch.shape[0] <= cap):
+                    # dispatch+fetch both on a pool thread: dispatch may
+                    # compile an un-warmed bucket (seconds) and must not
+                    # block the event loop; concurrent pool threads keep the
+                    # device stream pipelined
+                    def run_step(b=batch):
+                        return self._fetch(*self._dispatch(b))
+
+                    out = await loop.run_in_executor(self._pool, run_step)
+                else:
+                    # oversize group (multi-row requests can overflow the
+                    # ladder): the plain runner path chunks internally
+                    out = await loop.run_in_executor(self._pool, self.runner, batch)
+            except asyncio.CancelledError:
+                err: BaseException = RuntimeError(f"BatchQueue {self.name!r} closed")
+                for _, fut in group:
+                    if not fut.done():
+                        fut.set_exception(err)
+                raise
+            except Exception as exc:  # propagate to every waiter
+                for _, fut in group:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                return
+            self.steps += 1
+            self.rows += batch.shape[0]
+            out = np.asarray(out)
+            offset = 0
+            for (x, fut), rows in zip(group, (x.shape[0] for x in xs)):
                 if not fut.done():
-                    fut.set_exception(exc)
-            return
-        self.steps += 1
-        self.rows += batch.shape[0]
-        out = np.asarray(out)
-        offset = 0
-        for (x, fut), rows in zip(group, (x.shape[0] for x in xs)):
-            if not fut.done():
-                res = out[offset : offset + rows]
-                fut.set_result(res if x.ndim > 1 else res[0])
-            offset += rows
+                    res = out[offset : offset + rows]
+                    fut.set_result(res if x.ndim > 1 else res[0])
+                offset += rows
+        finally:
+            self._sem.release()

@@ -119,6 +119,29 @@ class CompiledModel:
             return jax.device_put(batch, self._in_sharding)
         return jnp.asarray(batch)
 
+    def dispatch(self, batch: np.ndarray) -> tuple[jax.Array, int]:
+        """Enqueue one padded device step WITHOUT materializing the result.
+
+        Dispatch is cheap (~sub-ms); the expensive part is the round trip
+        that :meth:`fetch` pays.  Splitting them lets the batching queue keep
+        several steps in flight, which matters enormously when the chip is
+        reached over a network tunnel (per-round-trip latency amortizes
+        across the pipeline).
+        """
+        batch = np.asarray(batch)
+        if batch.ndim == 1:
+            batch = batch[None, :]
+        if batch.shape[0] > self.buckets.max:
+            raise ValueError(
+                f"dispatch batch {batch.shape[0]} exceeds max bucket {self.buckets.max}"
+            )
+        padded, n = self._pad(batch)
+        return self._jitted(self.params, self._place(padded)), n
+
+    def fetch(self, out: jax.Array, n: int) -> np.ndarray:
+        """Materialize a dispatched step's result (blocks on the device)."""
+        return np.asarray(jax.device_get(out))[:n]
+
     def __call__(self, batch: np.ndarray) -> np.ndarray:
         """Run one padded device step; returns the unpadded result rows."""
         batch = np.asarray(batch)
@@ -131,9 +154,7 @@ class CompiledModel:
                 for i in range(0, batch.shape[0], self.buckets.max)
             ]
             return np.concatenate(outs, axis=0)
-        padded, n = self._pad(batch)
-        out = self._jitted(self.params, self._place(padded))
-        out = np.asarray(jax.device_get(out))[:n]
+        out = self.fetch(*self.dispatch(batch))
         return out[0] if squeeze else out
 
     def warmup(self, feature_shape: tuple[int, ...], dtype: Any = np.float32) -> int:

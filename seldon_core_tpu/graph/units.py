@@ -226,7 +226,33 @@ _BUILTINS: dict[Implementation, Callable[..., Any]] = {
     Implementation.EPSILON_GREEDY: EpsilonGreedy,
     Implementation.THOMPSON_SAMPLING: ThompsonSampling,
     Implementation.MAHALANOBIS_OUTLIER: MahalanobisOutlier,
+    Implementation.JAX_MODEL: lambda **p: _jax_model(p),
 }
+
+
+def _jax_model(parameters: dict[str, Any]) -> Any:
+    """JAX_MODEL implementation: compile a model-zoo family on device.
+
+    Graph parameters: ``family`` (required), ``preset``, ``dtype``
+    ("bfloat16"/"float32"), ``max_batch``, ``max_delay_ms``, plus any
+    model-config field override (e.g. ``n_classes``).
+    """
+    import jax.numpy as jnp
+
+    from seldon_core_tpu.models import registry as model_registry
+
+    params = dict(parameters)
+    try:
+        family = params.pop("family")
+    except KeyError:
+        raise GraphUnitError("JAX_MODEL requires a 'family' parameter") from None
+    dtypes = {"bfloat16": jnp.bfloat16, "float32": None, None: None}
+    raw_dtype = params.pop("dtype", None)
+    if raw_dtype not in dtypes:
+        raise GraphUnitError(
+            f"JAX_MODEL dtype must be one of {sorted(k for k in dtypes if k)}, got {raw_dtype!r}"
+        )
+    return model_registry.build_component(family, dtype=dtypes[raw_dtype], **params)
 
 
 def create_builtin(impl: Implementation, parameters: dict[str, Any]) -> Any:

@@ -1,0 +1,24 @@
+"""Flagship model zoo.
+
+The reference ships *examples* (deep_mnist TF, keras_mnist, sk_mnist,
+sklearn_iris, mean_classifier — reference: examples/models/) that users wrap
+into microservice images; the platform itself has no model code.  Here the
+framework ships TPU-ready Flax models with logical-axis sharding annotations
+so a SeldonDeployment graph node can name a model family and get a compiled,
+mesh-sharded, batch-bucketed unit:
+
+mlp        MNIST-scale MLP classifier (the "sk_mnist" tier)
+cnn        deep_mnist-style convnet
+resnet     ResNet-50 (BASELINE north-star vision model)
+bert       BERT-base encoder classifier (BASELINE north-star NLP model)
+llama      Llama-style decoder for generative serving (KV cache, RoPE, GQA)
+
+Every family exposes ``Config``, ``init_params(rng)``, ``apply(params, batch)``
+and ``param_logical_axes(params)``; ``registry.build_component`` turns a
+family name + config into a graph-ready :class:`JaxModelComponent`.
+"""
+
+from seldon_core_tpu.models import registry
+from seldon_core_tpu.models.registry import build_component, build_compiled, get_family
+
+__all__ = ["registry", "build_component", "build_compiled", "get_family"]

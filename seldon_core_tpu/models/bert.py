@@ -1,0 +1,124 @@
+"""BERT-base encoder classifier — the BASELINE north-star NLP model.
+
+Tensor-parallel-friendly layout: attention projections are DenseGeneral
+with explicit (heads, head_dim) output so the ``heads`` logical axis shards
+over ``tp``; the FFN shards its intermediate dim.  XLA then inserts exactly
+the Megatron-style all-reduces (psum after out-proj / down-proj) from the
+sharding annotations alone.
+
+Inputs are token-id batches ``(B, L) int32``; attention masks derive from
+padding (token 0).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.common import annotate_params
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    vocab_size: int = 30522
+    hidden: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn: int = 3072
+    max_len: int = 512
+    n_segments: int = 2
+    n_classes: int = 2
+    pad_id: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+
+class SelfAttention(nn.Module):
+    cfg: Config
+
+    @nn.compact
+    def __call__(self, x, mask):
+        c = self.cfg
+        proj = lambda name: nn.DenseGeneral(  # noqa: E731
+            (c.n_heads, c.head_dim), axis=-1, name=name
+        )
+        q = proj("query")(x)
+        k = proj("key")(x)
+        v = proj("value")(x)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(c.head_dim).astype(x.dtype)
+        scores = jnp.where(mask[:, None, None, :], scores, jnp.finfo(x.dtype).min)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(c.hidden, axis=(-2, -1), name="out")(out)
+
+
+class Layer(nn.Module):
+    cfg: Config
+
+    @nn.compact
+    def __call__(self, x, mask):
+        c = self.cfg
+        a = SelfAttention(c, name="attention")(x, mask)
+        x = nn.LayerNorm(name="ln_att")(x + a)
+        h = nn.Dense(c.ffn, name="ffn_up")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(c.hidden, name="ffn_down")(h)
+        return nn.LayerNorm(name="ln_ffn")(x + h)
+
+
+class Bert(nn.Module):
+    cfg: Config
+
+    @nn.compact
+    def __call__(self, token_ids, segment_ids=None):
+        c = self.cfg
+        token_ids = token_ids.astype(jnp.int32)
+        mask = token_ids != c.pad_id
+        pos = jnp.arange(token_ids.shape[1])[None, :]
+        x = nn.Embed(c.vocab_size, c.hidden, name="tok_emb")(token_ids)
+        x = x + nn.Embed(c.max_len, c.hidden, name="pos_emb")(pos)
+        if segment_ids is None:
+            segment_ids = jnp.zeros_like(token_ids)
+        x = x + nn.Embed(c.n_segments, c.hidden, name="seg_emb")(segment_ids)
+        x = nn.LayerNorm(name="ln_emb")(x)
+        for i in range(c.n_layers):
+            x = Layer(c, name=f"layer_{i}")(x, mask)
+        cls = x[:, 0]
+        pooled = jnp.tanh(nn.Dense(c.hidden, name="pooler")(cls))
+        return nn.softmax(nn.Dense(c.n_classes, name="head")(pooled))
+
+
+def init_params(rng: jax.Array, cfg: Config = Config()):
+    ids = jnp.zeros((1, 8), jnp.int32)
+    return Bert(cfg).init(rng, ids)
+
+
+def apply(params, batch, cfg: Config = Config()):
+    return Bert(cfg).apply(params, batch)
+
+
+_AXIS_RULES = [
+    (r"(query|key|value)/kernel", ("embed", "heads", "head_dim")),
+    (r"(query|key|value)/bias", ("heads", "head_dim")),
+    (r"attention/out/kernel", ("heads", "head_dim", "embed")),
+    (r"attention/out/bias", ("embed",)),
+    (r"ffn_up/kernel", ("embed", "mlp")),
+    (r"ffn_up/bias", ("mlp",)),
+    (r"ffn_down/kernel", ("mlp", "embed")),
+    (r"ffn_down/bias", ("embed",)),
+    (r"tok_emb/embedding", ("vocab", "embed")),
+    # position/segment tables are tiny; keep them replicated (seg table has
+    # only n_segments rows — unshardable)
+    (r"(pos_emb|seg_emb)/embedding", (None, "embed")),
+    (r"pooler/kernel", ("embed", "embed")),
+    (r"head/kernel", ("embed", None)),
+]
+
+
+def param_logical_axes(params):
+    return annotate_params(params, _AXIS_RULES)

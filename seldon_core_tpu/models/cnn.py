@@ -1,0 +1,65 @@
+"""deep_mnist-style convnet (the reference's TF example,
+reference: examples/models/deep_mnist/) rebuilt in Flax: two conv+pool
+blocks, one dense layer, softmax head.  Accepts flat 784 rows (the wire
+format the reference example used) or NHWC images."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.common import annotate_params
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    image_size: int = 28
+    channels: int = 1
+    n_classes: int = 10
+    hidden: int = 1024
+
+
+class CNN(nn.Module):
+    cfg: Config
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        if x.ndim == 2:  # flat rows off the wire
+            x = x.reshape((-1, c.image_size, c.image_size, c.channels))
+        x = nn.Conv(32, (5, 5), padding="SAME", name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(c.hidden, name="fc1")(x))
+        x = nn.Dense(c.n_classes, name="head")(x)
+        return nn.softmax(x)
+
+
+def init_params(rng: jax.Array, cfg: Config = Config()):
+    x = jnp.zeros((1, cfg.image_size, cfg.image_size, cfg.channels), jnp.float32)
+    return CNN(cfg).init(rng, x)
+
+
+def apply(params, batch, cfg: Config = Config()):
+    return CNN(cfg).apply(params, batch)
+
+
+_AXIS_RULES = [
+    (r"conv\d+/kernel", (None, None, None, "conv_out")),
+    (r"conv\d+/bias", ("conv_out",)),
+    (r"fc1/kernel", ("embed", "mlp")),
+    (r"fc1/bias", ("mlp",)),
+    (r"head/kernel", ("mlp", None)),
+    (r"head/bias", None),
+]
+
+
+def param_logical_axes(params):
+    return annotate_params(params, _AXIS_RULES)

@@ -1,0 +1,41 @@
+"""Shared model utilities: path-pattern logical-axis annotation.
+
+Sharding annotations are derived from param *paths* (e.g.
+``.../attention/query/kernel``) with an ordered regex table per model —
+params stay a plain pytree, no custom pytree classes.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+
+
+def annotate_params(params: Any, rules: list[tuple[str, tuple[str | None, ...] | None]]) -> Any:
+    """Build a pytree of logical-axis tuples matching ``params``.
+
+    ``rules`` is an ordered list of ``(path_regex, axes)``; first match wins;
+    no match -> ``None`` (replicated).  Axis tuple length must equal the
+    leaf's ndim (checked).
+    """
+
+    def _one(path, leaf):
+        pathstr = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        )
+        for pattern, axes in rules:
+            if re.search(pattern, pathstr):
+                if axes is not None and len(axes) != getattr(leaf, "ndim", len(axes)):
+                    raise ValueError(
+                        f"axes {axes} rank-mismatch param {pathstr} shape {leaf.shape}"
+                    )
+                return axes
+        return None
+
+    return jax.tree_util.tree_map_with_path(_one, params)
+
+
+def param_count(params: Any) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

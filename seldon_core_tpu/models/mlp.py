@@ -1,0 +1,56 @@
+"""MNIST-scale MLP classifier — the smallest serving tier (the reference's
+``sk_mnist``/``sklearn_iris`` examples live here, reference:
+examples/models/sk_mnist/, examples/models/sklearn_iris/)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.common import annotate_params
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    in_features: int = 784
+    hidden: int = 512
+    n_layers: int = 2
+    n_classes: int = 10
+
+
+class MLP(nn.Module):
+    cfg: Config
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for i in range(self.cfg.n_layers):
+            x = nn.Dense(self.cfg.hidden, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.cfg.n_classes, name="head")(x)
+        return nn.softmax(x)
+
+
+def init_params(rng: jax.Array, cfg: Config = Config()):
+    model = MLP(cfg)
+    x = jnp.zeros((1, cfg.in_features), jnp.float32)
+    return model.init(rng, x)
+
+
+def apply(params, batch, cfg: Config = Config()):
+    return MLP(cfg).apply(params, batch)
+
+
+_AXIS_RULES = [
+    (r"dense_\d+/kernel", ("embed", "mlp")),
+    (r"dense_\d+/bias", ("mlp",)),
+    (r"head/kernel", ("mlp", None)),
+    (r"head/bias", None),
+]
+
+
+def param_logical_axes(params):
+    return annotate_params(params, _AXIS_RULES)

@@ -1,0 +1,142 @@
+"""Model-family registry: name -> compiled, mesh-sharded graph unit.
+
+A SeldonDeployment graph node can say ``implementation: JAX_MODEL`` with
+parameters ``{"family": "resnet", "preset": "tiny"}`` and the engine builds
+the corresponding :class:`JaxModelComponent` — the TPU-native replacement for
+pointing a node's Endpoint at a model-microservice pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from seldon_core_tpu.executor import BucketSpec, CompiledModel, JaxModelComponent
+from seldon_core_tpu.models import bert, cnn, llama, mlp, resnet
+
+
+@dataclasses.dataclass(frozen=True)
+class Family:
+    name: str
+    config_cls: type
+    init_params: Callable
+    apply: Callable  # apply(params, batch, cfg)
+    param_logical_axes: Callable
+    presets: dict[str, Callable[[], Any]]
+    example_input: Callable[[Any, int], np.ndarray]  # (cfg, batch) -> array
+
+
+def _f32(shape):
+    return np.zeros(shape, np.float32)
+
+
+_FAMILIES: dict[str, Family] = {
+    "mlp": Family(
+        "mlp", mlp.Config, mlp.init_params, mlp.apply, mlp.param_logical_axes,
+        presets={"default": mlp.Config, "tiny": lambda: mlp.Config(in_features=16, hidden=32, n_classes=3)},
+        example_input=lambda c, b: _f32((b, c.in_features)),
+    ),
+    "cnn": Family(
+        "cnn", cnn.Config, cnn.init_params, cnn.apply, cnn.param_logical_axes,
+        presets={"default": cnn.Config, "tiny": lambda: cnn.Config(image_size=8, hidden=32)},
+        example_input=lambda c, b: _f32((b, c.image_size * c.image_size * c.channels)),
+    ),
+    "resnet": Family(
+        "resnet", resnet.Config, resnet.init_params, resnet.apply, resnet.param_logical_axes,
+        presets={
+            "resnet50": resnet.Config,
+            "tiny": lambda: resnet.Config(stage_sizes=(1, 1), width=8, n_classes=10, image_size=32),
+        },
+        example_input=lambda c, b: _f32((b, c.image_size, c.image_size, c.channels)),
+    ),
+    "bert": Family(
+        "bert", bert.Config, bert.init_params, bert.apply, bert.param_logical_axes,
+        presets={
+            "base": bert.Config,
+            "tiny": lambda: bert.Config(vocab_size=128, hidden=32, n_layers=2, n_heads=2, ffn=64, max_len=64),
+        },
+        example_input=lambda c, b: np.ones((b, 16), np.int32),
+    ),
+    "llama": Family(
+        "llama", llama.Config,
+        lambda rng, cfg: llama.init_params(rng, cfg),
+        llama.apply, llama.param_logical_axes,
+        presets={"llama3-8b": llama.Config.llama3_8b, "tiny": llama.Config.tiny},
+        example_input=lambda c, b: np.ones((b, 16), np.int32),
+    ),
+}
+
+
+def get_family(name: str) -> Family:
+    try:
+        return _FAMILIES[name]
+    except KeyError:
+        raise KeyError(f"unknown model family {name!r}; have {sorted(_FAMILIES)}") from None
+
+
+def resolve_config(family: str, preset: str | None = None, **overrides) -> Any:
+    fam = get_family(family)
+    if preset is not None:
+        cfg = fam.presets[preset]()
+    else:
+        cfg = fam.config_cls()
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg
+
+
+def build_compiled(
+    family: str,
+    *,
+    preset: str | None = None,
+    cfg: Any = None,
+    mesh: Mesh | None = None,
+    rng: int = 0,
+    dtype: Any = None,
+    buckets: BucketSpec = BucketSpec(),
+    params: Any = None,
+    **overrides,
+) -> CompiledModel:
+    fam = get_family(family)
+    if cfg is None:
+        cfg = resolve_config(family, preset, **overrides)
+    if params is None:
+        params = fam.init_params(jax.random.PRNGKey(rng), cfg)
+    apply_fn = lambda p, x: fam.apply(p, x, cfg)  # noqa: E731
+    return CompiledModel(
+        apply_fn,
+        params,
+        mesh=mesh,
+        param_axes=fam.param_logical_axes(params) if mesh is not None else None,
+        buckets=buckets,
+        dtype=dtype,
+        name=f"{family}:{preset or 'default'}",
+    )
+
+
+def build_component(
+    family: str,
+    *,
+    class_names: list[str] | None = None,
+    batching: bool = True,
+    max_batch: int = 64,
+    max_delay_ms: float = 2.0,
+    **kwargs,
+) -> JaxModelComponent:
+    model = build_compiled(family, **kwargs)
+    return JaxModelComponent(
+        model,
+        class_names=class_names,
+        batching=batching,
+        max_batch=max_batch,
+        max_delay_ms=max_delay_ms,
+    )
+
+
+def example_input(family: str, cfg: Any, batch: int = 1) -> np.ndarray:
+    return get_family(family).example_input(cfg, batch)

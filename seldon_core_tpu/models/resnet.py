@@ -1,0 +1,95 @@
+"""ResNet-50 for image classification serving — the BASELINE north-star
+vision model (BASELINE.md: ≥10k predictions/sec on v5e-8).
+
+Serving-mode batch norm: running statistics are part of the params
+(``batch_stats`` collection) and are used directly — no mutable state inside
+``jit``, so the forward pass is a pure function XLA can fuse end-to-end.
+NHWC layout (TPU conv native).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from seldon_core_tpu.models.common import annotate_params
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    stage_sizes: tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    n_classes: int = 1000
+    image_size: int = 224
+    channels: int = 3
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        norm = partial(nn.BatchNorm, use_running_average=True, momentum=0.9)
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False, name="conv1")(x)
+        y = norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), self.strides, use_bias=False, name="conv2")(y)
+        y = norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False, name="conv3")(y)
+        y = norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.features * 4, (1, 1), self.strides, use_bias=False, name="proj"
+            )(residual)
+            residual = norm(name="bn_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    cfg: Config
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        if x.ndim == 2:
+            x = x.reshape((-1, c.image_size, c.image_size, c.channels))
+        x = nn.Conv(c.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], use_bias=False, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=True, name="bn_stem")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(c.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = Bottleneck(c.width * 2**i, strides, name=f"stage{i}_block{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(c.n_classes, name="head")(x)
+        return nn.softmax(x)
+
+
+def init_params(rng: jax.Array, cfg: Config = Config()):
+    x = jnp.zeros((1, cfg.image_size, cfg.image_size, cfg.channels), jnp.float32)
+    return ResNet(cfg).init(rng, x)
+
+
+def apply(params, batch, cfg: Config = Config()):
+    return ResNet(cfg).apply(params, batch)
+
+
+_AXIS_RULES = [
+    (r"head/kernel", ("embed", "vocab")),
+    (r"head/bias", ("vocab",)),
+    # conv kernels: shard output channels over tp when large
+    (r"conv\d/kernel|proj/kernel|stem/kernel", (None, None, None, "conv_out")),
+    (r"bn.*/(scale|bias|mean|var)", ("conv_out",)),
+]
+
+
+def param_logical_axes(params):
+    return annotate_params(params, _AXIS_RULES)

@@ -1,0 +1,64 @@
+"""Sequence-parallel attention correctness: ring and Ulysses vs. dense
+reference, causal and bidirectional, on the 8-virtual-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from seldon_core_tpu.parallel import best_mesh
+from seldon_core_tpu.parallel.ring import ring_self_attention
+
+B, L, H, D = 2, 32, 4, 16
+
+
+def dense_reference(q, k, v, causal):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        mask = np.tril(np.ones((L, L), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    return tuple(
+        jnp.asarray(rng.normal(size=(B, L, H, D)).astype(np.float32)) for _ in range(3)
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(qkv, causal):
+    q, k, v = qkv
+    mesh = best_mesh(8, tp=1, sp=8)
+    out = ring_self_attention(mesh, q, k, v, causal=causal, impl="ring")
+    ref = dense_reference(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_dense(qkv, causal):
+    q, k, v = qkv
+    mesh = best_mesh(8, tp=2, sp=4)  # H=4 divisible by sp=4
+    out = ring_self_attention(mesh, q, k, v, causal=causal, impl="ulysses")
+    ref = dense_reference(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_inside_jit():
+    """ring attention must compose with jit (it runs inside step functions)."""
+    mesh = best_mesh(8, tp=1, sp=8)
+    rng = np.random.default_rng(1)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, L, H, D)).astype(np.float32)) for _ in range(3)
+    )
+
+    @jax.jit
+    def step(q, k, v):
+        return ring_self_attention(mesh, q, k, v, causal=True, impl="ring")
+
+    out = step(q, k, v)
+    ref = dense_reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
