@@ -1,0 +1,54 @@
+"""Engine gRPC server: the ``Seldon`` service (Predict / SendFeedback).
+
+gRPC twin of the engine REST endpoints (reference:
+engine/src/main/java/io/seldon/engine/grpc/SeldonGrpcServer.java:34-59,
+grpc/SeldonService.java:45-63 — gRPC port 5000/ENGINE_SERVER_GRPC_PORT,
+delegating to PredictionService).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import grpc
+
+from seldon_core_tpu.contract import (
+    Payload,
+    feedback_from_proto,
+    payload_from_proto,
+    payload_to_proto,
+)
+from seldon_core_tpu.engine.service import PredictionService
+from seldon_core_tpu.proto import prediction_pb2 as pb
+from seldon_core_tpu.proto.grpc_defs import SERVER_OPTIONS, add_service, unary_guard
+
+log = logging.getLogger(__name__)
+
+
+class SeldonGrpc:
+    def __init__(self, service: PredictionService):
+        self.service = service
+
+    @unary_guard
+    async def Predict(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
+        out = await self.service.predict(payload_from_proto(request))
+        msg = payload_to_proto(out)
+        msg.status.code = 200
+        msg.status.status = pb.Status.SUCCESS
+        return msg
+
+    @unary_guard
+    async def SendFeedback(self, request: pb.Feedback, context) -> pb.SeldonMessage:
+        await self.service.send_feedback(feedback_from_proto(request))
+        return payload_to_proto(Payload())
+
+
+async def start_engine_grpc(service: PredictionService, port: int) -> grpc.aio.Server:
+    server = grpc.aio.server(options=SERVER_OPTIONS)
+    handler = SeldonGrpc(service)
+    add_service(server, "Seldon", {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback})
+    bound = server.add_insecure_port(f"[::]:{port}")
+    await server.start()
+    server.bound_port = bound  # real port when asked for :0 (tests)
+    log.info("engine gRPC (Seldon service) on :%d", bound)
+    return server

@@ -84,6 +84,8 @@ class PredictionService:
         )
 
     async def close(self) -> None:
+        if self.walker is not None:
+            await self.walker.aclose()
         await self.transports.close()
 
     def _on_feedback(self, unit_name: str, fb: FeedbackPayload) -> None:

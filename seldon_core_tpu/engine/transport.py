@@ -105,6 +105,7 @@ class TransportManager:
     def __init__(self, timeout_s: float = 5.0):
         self.timeout_s = timeout_s
         self._session: aiohttp.ClientSession | None = None
+        self._channels = None  # lazy ChannelCache (grpc import deferred)
 
     async def start(self) -> None:
         if self._session is None:
@@ -116,6 +117,9 @@ class TransportManager:
         if self._session is not None:
             await self._session.close()
             self._session = None
+        if self._channels is not None:
+            await self._channels.close()
+            self._channels = None
 
     def client_factory(self, spec: PredictiveUnitSpec) -> NodeClient:
         from seldon_core_tpu.graph.walker import default_client_factory
@@ -125,7 +129,9 @@ class TransportManager:
                 raise RuntimeError("TransportManager.start() not called")
             return RestNodeClient(spec, self._session, self.timeout_s)
         if spec.endpoint.type == TransportType.GRPC:
-            from seldon_core_tpu.engine.grpc_transport import GrpcNodeClient
+            from seldon_core_tpu.engine.grpc_transport import ChannelCache, GrpcNodeClient
 
-            return GrpcNodeClient(spec, self.timeout_s)
+            if self._channels is None:
+                self._channels = ChannelCache()
+            return GrpcNodeClient(spec, self._channels, self.timeout_s)
         return default_client_factory(spec)

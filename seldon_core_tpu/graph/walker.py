@@ -236,6 +236,22 @@ class GraphWalker:
         children = [self._build(c) for c in spec.children]
         return _NodeState(spec, client, children)
 
+    async def aclose(self) -> None:
+        """Close components that hold resources (e.g. JAX_MODEL units own a
+        batching queue + runner threads)."""
+
+        async def _close(node: _NodeState) -> None:
+            comp = getattr(node.client, "component", None)
+            closer = getattr(comp, "close", None)
+            if closer is not None:
+                res = closer()
+                if asyncio.iscoroutine(res):
+                    await res
+            for child in node.children:
+                await _close(child)
+
+        await _close(self.root)
+
     # -- prediction walk --------------------------------------------------
 
     async def predict(self, payload: Payload) -> Payload:

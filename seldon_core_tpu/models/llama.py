@@ -258,12 +258,16 @@ def decode_step(params: dict, token: jax.Array, cache: dict, cfg: Config) -> tup
         k = _rope(k, positions, cfg.rope_theta)
         layer_k = jax.lax.dynamic_update_slice(layer_k, k.astype(layer_k.dtype), (0, pos, 0, 0))
         layer_v = jax.lax.dynamic_update_slice(layer_v, v.astype(layer_v.dtype), (0, pos, 0, 0))
-        kk = _gqa_repeat(layer_k, cfg.n_heads)
-        vv = _gqa_repeat(layer_v, cfg.n_heads)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, kk) * scale
-        s = jnp.where(valid[None, None, None, :], s, jnp.finfo(s.dtype).min)
+        # grouped-query attention against the *un-repeated* cache: repeating
+        # kv to n_heads here would multiply cache reads by the group size
+        # every decode step, defeating GQA's bandwidth savings
+        groups = cfg.n_heads // cfg.n_kv_heads
+        qg = q.reshape(q.shape[0], 1, cfg.n_kv_heads, groups, cfg.head_dim)
+        s = jnp.einsum("bqkgd,bskd->bkgqs", qg, layer_k) * scale
+        s = jnp.where(valid[None, None, None, None, :], s, jnp.finfo(s.dtype).min)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bkhd->bqhd", p, vv)
+        o = jnp.einsum("bkgqs,bskd->bqkgd", p, layer_v)
+        o = o.reshape(o.shape[0], 1, cfg.n_heads, cfg.head_dim)
         x = x + jnp.einsum("blhd,hde->ble", o, lp["wo"])
         h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
         mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]

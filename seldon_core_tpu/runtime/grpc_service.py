@@ -1,0 +1,138 @@
+"""Model-microservice gRPC server.
+
+The gRPC twin of :mod:`seldon_core_tpu.runtime.server`: wraps one user
+component behind the per-type services plus ``Generic`` (reference:
+wrappers/python/model_microservice.py:92-125, router_microservice.py:93-125,
+transformer_microservice.py:101-133).  Errors come back as a
+``SeldonMessage`` with ``status.status = FAILURE`` rather than transport
+errors, matching the REST surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+import grpc
+import numpy as np
+
+from seldon_core_tpu.contract import (
+    Payload,
+    feedback_from_proto,
+    payload_from_proto,
+    payload_to_proto,
+)
+from seldon_core_tpu.graph.spec import PredictiveUnitSpec, UnitType
+from seldon_core_tpu.graph.walker import LocalClient
+from seldon_core_tpu.proto import prediction_pb2 as pb
+from seldon_core_tpu.proto.grpc_defs import (
+    SERVER_OPTIONS,
+    add_service,
+    failure_message,
+    unary_guard,
+)
+
+log = logging.getLogger(__name__)
+
+
+class ComponentGrpc:
+    """All unary handlers for one wrapped component."""
+
+    def __init__(self, component: Any, name: str = "model", service_type: str = "MODEL"):
+        self.component = component
+        self.name = name
+        self.service_type = service_type
+        self._model_client = LocalClient(
+            PredictiveUnitSpec(name=name, type=UnitType.MODEL), component
+        )
+        self._transformer_client = LocalClient(
+            PredictiveUnitSpec(name=name, type=UnitType.TRANSFORMER), component
+        )
+
+    # -- handlers (shared across the typed services and Generic) -----------
+
+    @unary_guard
+    async def Predict(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
+        out = await self._model_client.transform_input(payload_from_proto(request))
+        return payload_to_proto(out)
+
+    @unary_guard
+    async def TransformInput(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
+        out = await self._transformer_client.transform_input(payload_from_proto(request))
+        return payload_to_proto(out)
+
+    @unary_guard
+    async def TransformOutput(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
+        out = await self._transformer_client.transform_output(payload_from_proto(request))
+        return payload_to_proto(out)
+
+    @unary_guard
+    async def Route(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
+        payload = payload_from_proto(request)
+        branch = await self._model_client.route(payload)
+        # routing returned as a 1x1 ndarray, like the reference router
+        # runtime (wrappers/python/router_microservice.py:28-56)
+        return payload_to_proto(payload.with_array(np.array([[branch]]), names=[]))
+
+    @unary_guard
+    async def Aggregate(self, request: pb.SeldonMessageList, context) -> pb.SeldonMessage:
+        payloads = [payload_from_proto(m) for m in request.seldonMessages]
+        if not payloads:
+            return failure_message("seldonMessages list is empty", 400)
+        return payload_to_proto(await self._model_client.aggregate(payloads))
+
+    @unary_guard
+    async def SendFeedback(self, request: pb.Feedback, context) -> pb.SeldonMessage:
+        fb = feedback_from_proto(request)
+        routing = None
+        if fb.response is not None:
+            routing = fb.response.meta.routing.get(self.name)
+        await self._model_client.send_feedback(
+            fb, int(routing) if routing is not None else None
+        )
+        return payload_to_proto(Payload())
+
+
+def register(server: Any, handler: ComponentGrpc) -> None:
+    """Register the per-type services + Generic, all backed by ``handler``."""
+    add_service(server, "Model", {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback})
+    add_service(server, "Router", {"Route": handler.Route, "SendFeedback": handler.SendFeedback})
+    add_service(server, "Transformer", {"TransformInput": handler.TransformInput})
+    add_service(server, "OutputTransformer", {"TransformOutput": handler.TransformOutput})
+    add_service(server, "Combiner", {"Aggregate": handler.Aggregate})
+    add_service(
+        server,
+        "Generic",
+        {
+            "TransformInput": handler.Predict
+            if handler.service_type == "MODEL"
+            else handler.TransformInput,
+            "TransformOutput": handler.TransformOutput,
+            "Route": handler.Route,
+            "Aggregate": handler.Aggregate,
+            "SendFeedback": handler.SendFeedback,
+        },
+    )
+
+
+async def start_grpc(
+    component: Any, port: int, name: str = "model", service_type: str = "MODEL"
+) -> grpc.aio.Server:
+    server = grpc.aio.server(options=SERVER_OPTIONS)
+    register(server, ComponentGrpc(component, name=name, service_type=service_type))
+    bound = server.add_insecure_port(f"[::]:{port}")
+    await server.start()
+    server.bound_port = bound  # real port when asked for :0 (tests)
+    log.info("microservice gRPC server on :%d (%s %s)", bound, name, service_type)
+    return server
+
+
+def serve_grpc(component: Any, port: int, name: str = "model", service_type: str = "MODEL") -> None:
+    """Blocking entry used by the microservice CLI."""
+
+    async def main() -> None:
+        server = await start_grpc(component, port, name=name, service_type=service_type)
+        await server.wait_for_termination()
+
+    asyncio.run(main())

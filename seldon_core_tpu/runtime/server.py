@@ -113,6 +113,10 @@ class MicroserviceApp:
             return _error_response(400, str(e))
         except GraphUnitError as e:
             return _error_response(500, str(e), status=500)
+        except Exception as e:  # user code may raise anything; keep the
+            # wire contract (FAILURE status) instead of a bare 500
+            log.exception("unhandled component error")
+            return _error_response(500, f"{type(e).__name__}: {e}", status=500)
 
     async def predict(self, request: web.Request) -> web.Response:
         return await self._transform(request, self._model_client, "input")
@@ -135,6 +139,10 @@ class MicroserviceApp:
             return _error_response(400, str(e))
         except GraphUnitError as e:
             return _error_response(500, str(e), status=500)
+        except Exception as e:  # user code may raise anything; keep the
+            # wire contract (FAILURE status) instead of a bare 500
+            log.exception("unhandled component error")
+            return _error_response(500, f"{type(e).__name__}: {e}", status=500)
 
     async def aggregate(self, request: web.Request) -> web.Response:
         try:
@@ -149,6 +157,10 @@ class MicroserviceApp:
             return _error_response(400, str(e))
         except GraphUnitError as e:
             return _error_response(500, str(e), status=500)
+        except Exception as e:  # user code may raise anything; keep the
+            # wire contract (FAILURE status) instead of a bare 500
+            log.exception("unhandled component error")
+            return _error_response(500, f"{type(e).__name__}: {e}", status=500)
 
     async def send_feedback(self, request: web.Request) -> web.Response:
         try:
@@ -163,6 +175,9 @@ class MicroserviceApp:
             return web.json_response(payload_to_dict(Payload()))
         except CodecError as e:
             return _error_response(400, str(e))
+        except Exception as e:
+            log.exception("unhandled component error")
+            return _error_response(500, f"{type(e).__name__}: {e}", status=500)
 
     async def ping(self, request: web.Request) -> web.Response:
         return web.Response(text="pong")
