@@ -9,6 +9,7 @@ from seldon_core_tpu.contract.payload import (
 )
 from seldon_core_tpu.contract.codec import (
     CodecError,
+    failure_status_dict,
     feedback_from_dict,
     feedback_from_proto,
     feedback_to_dict,
@@ -34,6 +35,7 @@ __all__ = [
     "Metric",
     "Payload",
     "CodecError",
+    "failure_status_dict",
     "ParameterError",
     "payload_from_dict",
     "payload_from_json",

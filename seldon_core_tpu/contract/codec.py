@@ -51,6 +51,14 @@ class CodecError(ValueError):
 _METRIC_TYPES = {"COUNTER", "GAUGE", "TIMER"}
 
 
+def failure_status_dict(code: int, reason: str) -> dict[str, Any]:
+    """The wire-level FAILURE envelope shared by engine and gateway REST
+    errors (reference error taxonomy: engine/.../exception/APIException.java)."""
+    return {
+        "status": {"code": code, "info": reason, "reason": reason, "status": "FAILURE"}
+    }
+
+
 def meta_from_dict(d: dict[str, Any] | None) -> Meta:
     d = d or {}
     metrics = []

@@ -23,6 +23,7 @@ from typing import Any
 from aiohttp import web
 
 from seldon_core_tpu.contract import (
+    failure_status_dict,
     CodecError,
     feedback_from_dict,
     payload_from_dict,
@@ -36,9 +37,7 @@ log = logging.getLogger(__name__)
 
 
 def _status_body(code: int, reason: str) -> dict[str, Any]:
-    return {
-        "status": {"code": code, "info": reason, "reason": reason, "status": "FAILURE"}
-    }
+    return failure_status_dict(code, reason)
 
 
 class EngineApp:

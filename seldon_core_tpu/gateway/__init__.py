@@ -1,0 +1,28 @@
+"""External API gateway ("apife").
+
+The reference's api-frontend is a Spring Boot OAuth2 gateway: per-deployment
+client-credentials auth with tokens in Redis, a CRD watcher feeding an
+oauth_key -> DeploymentSpec store, REST/gRPC proxying to each deployment's
+engine by service name, a Kafka request/response tap, and ingress metrics
+(reference: api-frontend/ — SURVEY.md §2.4).
+
+This gateway keeps the same surface with TPU-era mechanics:
+
+* :mod:`store`    deployment registry + change listeners (fed by the
+                  operator's watch or a JSON file poll; no k8s client needed
+                  in-process)
+* :mod:`auth`     client-credentials token service (in-memory TTL store —
+                  the Redis token store collapses into the process; multi-
+                  replica gateways would plug a shared store here)
+* :mod:`app`      aiohttp ingress: /oauth/token, /api/v0.1/predictions,
+                  /api/v0.1/feedback, health, prometheus
+* :mod:`grpc_gateway`  gRPC Seldon service proxy with per-deployment
+                  channels and oauth_token metadata auth
+* :mod:`tap`      request/response firehose (JSONL sink standing in for the
+                  reference's Kafka producer; same payload pairing)
+"""
+
+from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
+from seldon_core_tpu.gateway.auth import AuthError, TokenStore
+
+__all__ = ["DeploymentRecord", "DeploymentStore", "AuthError", "TokenStore"]

@@ -1,0 +1,278 @@
+"""Gateway ingress service (REST).
+
+Endpoint-for-endpoint with the reference apife (reference:
+api-frontend/.../api/rest/RestClientController.java:126-198): OAuth token
+issuance, authenticated prediction/feedback proxying to the target
+deployment's engine by service name, request/response tap, reward counters,
+ingress metrics, and the pause/drain dance.
+
+Like the reference, the gateway *validates* the payload parses but forwards
+the raw JSON body untouched — the engine owns canonicalization (reference
+forwards the raw string too, RestClientController.java:136-144).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import base64
+import json
+import logging
+import os
+import time
+from typing import Any
+
+import aiohttp
+from aiohttp import web
+
+from seldon_core_tpu.contract import failure_status_dict
+from seldon_core_tpu.gateway.auth import AuthError, TokenStore, verify_secret
+from seldon_core_tpu.gateway.store import (
+    DeploymentRecord,
+    DeploymentStore,
+    load_store_from_env,
+)
+from seldon_core_tpu.gateway.tap import RequestResponseTap, tap_from_env
+from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS, MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+
+def _error(status: int, reason: str) -> web.Response:
+    return web.json_response(failure_status_dict(status, reason), status=status)
+
+
+class GatewayApp:
+    def __init__(
+        self,
+        store: DeploymentStore,
+        tokens: TokenStore | None = None,
+        tap: RequestResponseTap | None = None,
+        metrics: MetricsRegistry | None = None,
+        timeout_s: float = 10.0,
+    ):
+        self.store = store
+        self.tokens = tokens or TokenStore()
+        self.tap = tap or tap_from_env()
+        self.metrics = metrics or DEFAULT_METRICS
+        self.timeout = aiohttp.ClientTimeout(total=timeout_s)
+        self._session: aiohttp.ClientSession | None = None
+        self._paused = False
+        # removed deployments lose their live tokens immediately
+        store.add_listener(self._on_deployment_event)
+
+    def _on_deployment_event(self, event: str, rec: DeploymentRecord) -> None:
+        if event == "removed":
+            self.tokens.revoke_for_key(rec.oauth_key)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=512, keepalive_timeout=30)
+            )
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+        await self.tap.close()
+
+    def build(self) -> web.Application:
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        r = app.router
+        r.add_post("/oauth/token", self.oauth_token)
+        r.add_post("/api/v0.1/predictions", self.predictions)
+        r.add_post("/api/v0.1/feedback", self.feedback)
+        r.add_get("/ping", self.ping)
+        r.add_get("/ready", self.ready)
+        r.add_post("/pause", self.pause)
+        r.add_post("/unpause", self.unpause)
+        r.add_get("/prometheus", self.prometheus)
+
+        async def _startup(app_: web.Application) -> None:
+            await self.start()
+
+        async def _cleanup(app_: web.Application) -> None:
+            await self.close()
+
+        app.on_startup.append(_startup)
+        app.on_cleanup.append(_cleanup)
+        return app
+
+    # -- auth --------------------------------------------------------------
+
+    async def oauth_token(self, request: web.Request) -> web.Response:
+        """client_credentials grant; credentials via HTTP basic auth or form
+        fields (both accepted by the reference's Spring endpoint)."""
+        client_id = client_secret = ""
+        auth = request.headers.get("Authorization", "")
+        if auth.startswith("Basic "):
+            try:
+                decoded = base64.b64decode(auth[6:]).decode()
+                client_id, _, client_secret = decoded.partition(":")
+            except Exception:
+                return _error(400, "malformed basic auth header")
+        if not client_id:
+            form = await request.post()
+            client_id = str(form.get("client_id", ""))
+            client_secret = str(form.get("client_secret", ""))
+        rec = self.store.get(client_id)
+        # a deployment without a secret is unreachable through the gateway —
+        # empty==empty must not grant tokens
+        if rec is None or not rec.oauth_secret or not verify_secret(
+            rec.oauth_secret, client_secret
+        ):
+            return _error(401, "invalid client credentials")
+        token, expires_in = self.tokens.issue(rec.oauth_key)
+        return web.json_response(
+            {"access_token": token, "token_type": "bearer", "expires_in": int(expires_in)}
+        )
+
+    def _principal(self, request: web.Request) -> DeploymentRecord:
+        auth = request.headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            raise AuthError("missing bearer token")
+        key = self.tokens.principal(auth[7:])
+        rec = self.store.get(key)
+        if rec is None:
+            raise AuthError("deployment no longer exists", 404)
+        return rec
+
+    # -- data plane --------------------------------------------------------
+
+    async def _forward(self, rec: DeploymentRecord, path: str, raw: bytes) -> tuple[int, bytes]:
+        assert self._session is not None, "GatewayApp.start() not called"
+        async with self._session.post(
+            rec.rest_base + path,
+            data=raw,
+            headers={"Content-Type": "application/json"},
+            timeout=self.timeout,
+        ) as resp:
+            return resp.status, await resp.read()
+
+    async def _ingress(self, request: web.Request, path: str, service: str) -> web.Response:
+        if self._paused:
+            return _error(503, "gateway is paused")
+        start = time.perf_counter()
+        principal = "anonymous"
+        code = 200
+        try:
+            rec = self._principal(request)
+            principal = rec.oauth_key
+            raw = await request.read()
+            try:
+                body = json.loads(raw)  # validate only; forward untouched
+            except json.JSONDecodeError as e:
+                code = 400
+                return _error(400, f"invalid JSON: {e}")
+            try:
+                code, reply = await self._forward(rec, path, raw)
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                code = 503
+                return _error(503, f"engine unreachable for {rec.name}: {e}")
+            if service == "predictions":
+                await self._tap_pair(rec, body, reply)
+            else:
+                self._record_reward(rec, body)
+            return web.Response(body=reply, status=code, content_type="application/json")
+        except AuthError as e:
+            code = e.status
+            return _error(e.status, str(e))
+        finally:
+            self.metrics.ingress_requests.labels(
+                principal,
+                principal,
+                service,
+                "POST",
+                str(code),
+            ).observe(time.perf_counter() - start)
+
+    async def predictions(self, request: web.Request) -> web.Response:
+        return await self._ingress(request, "/api/v0.1/predictions", "predictions")
+
+    async def feedback(self, request: web.Request) -> web.Response:
+        return await self._ingress(request, "/api/v0.1/feedback", "feedback")
+
+    async def _tap_pair(self, rec: DeploymentRecord, body: Any, reply: bytes) -> None:
+        try:
+            reply_obj = json.loads(reply)
+        except json.JSONDecodeError:
+            reply_obj = {"raw": reply.decode(errors="replace")}
+        puid = ""
+        if isinstance(reply_obj, dict):
+            puid = (reply_obj.get("meta") or {}).get("puid", "")
+        await self.tap.publish(rec.oauth_key, puid, body, reply_obj)
+
+    def _record_reward(self, rec: DeploymentRecord, body: Any) -> None:
+        """Reward counters at the gateway, like the reference's apife
+        (reference: RestClientController.java:187-189).  Metrics must never
+        fail a request the engine already processed."""
+        try:
+            reward = body.get("reward", 0.0) if isinstance(body, dict) else 0.0
+            reward = float(reward) if isinstance(reward, (int, float)) else 0.0
+            self.metrics.feedback.labels(rec.name, rec.name, "gateway").inc()
+            if reward > 0:  # prometheus counters cannot decrease
+                self.metrics.feedback_reward.labels(rec.name, rec.name, "gateway").inc(reward)
+        except Exception:
+            log.exception("reward metric recording failed")
+
+    # -- ops ---------------------------------------------------------------
+
+    async def ping(self, request: web.Request) -> web.Response:
+        return web.Response(text="pong")
+
+    async def ready(self, request: web.Request) -> web.Response:
+        if self._paused:
+            return web.Response(text="paused", status=503)
+        return web.Response(text="ready")
+
+    async def pause(self, request: web.Request) -> web.Response:
+        self._paused = True
+        return web.Response(text="paused")
+
+    async def unpause(self, request: web.Request) -> web.Response:
+        self._paused = False
+        return web.Response(text="unpaused")
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.expose(), content_type="text/plain")
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="seldon-core-tpu API gateway")
+    parser.add_argument("--port", type=int, default=int(os.environ.get("GATEWAY_PORT", "8080")))
+    parser.add_argument("--grpc-port", type=int, default=int(os.environ.get("GATEWAY_GRPC_PORT", "5000")))
+    parser.add_argument("--deployments", default="", help="JSON file of deployment records")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    store = DeploymentStore()
+    load_store_from_env(store)
+    if args.deployments:
+        store.load_file(args.deployments)
+
+    gateway = GatewayApp(store)
+    app = gateway.build()
+
+    async def _start_grpc(app_: web.Application) -> None:
+        try:
+            from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
+
+            app_["grpc_server"] = await start_gateway_grpc(gateway, args.grpc_port)
+        except Exception as e:  # pragma: no cover - grpc optional at boot
+            log.warning("gateway gRPC not started: %s", e)
+
+    async def _stop_grpc(app_: web.Application) -> None:
+        server = app_.get("grpc_server")
+        if server is not None:
+            await server.stop(grace=2.0)
+
+    app.on_startup.append(_start_grpc)
+    app.on_cleanup.append(_stop_grpc)
+    web.run_app(app, port=args.port, access_log=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Gateway gRPC ingress: the external ``Seldon`` service proxy.
+
+The reference's apife gRPC server authenticates via an ``oauth_token``
+metadata header checked against the token store, resolves the principal's
+deployment, and proxies Predict/SendFeedback over a per-deployment channel
+built at deployment-add time (reference:
+api-frontend/.../grpc/SeldonGrpcServer.java:46-120,
+grpc/HeaderServerInterceptor.java:39-66, grpc/SeldonService.java:45-63).
+
+Same design: channels live in a cache keyed by deployment, built on first
+use and dropped when the deployment is removed.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import grpc
+
+from seldon_core_tpu.gateway.auth import AuthError
+from seldon_core_tpu.gateway.store import DeploymentRecord
+from seldon_core_tpu.proto import prediction_pb2 as pb
+from seldon_core_tpu.proto.grpc_defs import (
+    SERVER_OPTIONS,
+    Stub,
+    add_service,
+    failure_message,
+)
+
+log = logging.getLogger(__name__)
+
+OAUTH_METADATA_KEY = "oauth_token"
+
+
+class GatewayGrpc:
+    """Seldon service handlers proxying to per-deployment engine channels."""
+
+    def __init__(self, gateway, loop=None):
+        import asyncio
+
+        self.gateway = gateway  # GatewayApp (store + tokens)
+        self._channels: dict[str, grpc.aio.Channel] = {}
+        # the serving loop, captured at construction: store events may fire
+        # from operator/poller threads and must hop back here to close
+        # loop-bound channels
+        self._loop = loop or asyncio.get_event_loop()
+        gateway.store.add_listener(self._on_deployment_event)
+
+    def _on_deployment_event(self, event: str, rec: DeploymentRecord) -> None:
+        if event in ("removed", "updated"):
+            ch = self._channels.pop(rec.oauth_key, None)
+            if ch is not None:
+                self._loop.call_soon_threadsafe(
+                    lambda c=ch: self._loop.create_task(c.close())
+                )
+
+    def _resolve(self, context) -> DeploymentRecord:
+        md = dict(context.invocation_metadata() or [])
+        token = md.get(OAUTH_METADATA_KEY, "")
+        if not token:
+            raise AuthError("missing oauth_token metadata")
+        key = self.gateway.tokens.principal(token)
+        rec = self.gateway.store.get(key)
+        if rec is None:
+            raise AuthError("deployment no longer exists", 404)
+        return rec
+
+    def _stub(self, rec: DeploymentRecord) -> Stub:
+        ch = self._channels.get(rec.oauth_key)
+        if ch is None:
+            ch = grpc.aio.insecure_channel(rec.grpc_target, options=SERVER_OPTIONS)
+            self._channels[rec.oauth_key] = ch
+        return Stub(ch, "Seldon")
+
+    async def Predict(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
+        try:
+            rec = self._resolve(context)
+            return await self._stub(rec).Predict(request, timeout=self.gateway.timeout.total)
+        except AuthError as e:
+            return failure_message(str(e), e.status)
+        except grpc.aio.AioRpcError as e:
+            return failure_message(f"engine unreachable: {e.code().name}", 503)
+
+    async def SendFeedback(self, request: pb.Feedback, context) -> pb.SeldonMessage:
+        try:
+            rec = self._resolve(context)
+            return await self._stub(rec).SendFeedback(request, timeout=self.gateway.timeout.total)
+        except AuthError as e:
+            return failure_message(str(e), e.status)
+        except grpc.aio.AioRpcError as e:
+            return failure_message(f"engine unreachable: {e.code().name}", 503)
+
+    async def close(self) -> None:
+        for ch in self._channels.values():
+            await ch.close()
+        self._channels.clear()
+
+
+async def start_gateway_grpc(gateway, port: int) -> grpc.aio.Server:
+    import asyncio
+
+    server = grpc.aio.server(options=SERVER_OPTIONS)
+    handler = GatewayGrpc(gateway, loop=asyncio.get_running_loop())
+    add_service(server, "Seldon", {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback})
+    bound = server.add_insecure_port(f"[::]:{port}")
+    await server.start()
+    server.bound_port = bound
+    server.gateway_handler = handler  # for lifecycle access
+    log.info("gateway gRPC (Seldon proxy) on :%d", bound)
+    return server

@@ -1,0 +1,142 @@
+"""Deployment registry.
+
+oauth_key -> deployment record, with add/update/remove listeners — the
+reference's DeploymentStore + DeploymentWatcher pair (reference:
+api-frontend/.../deployments/DeploymentStore.java:33-84,
+k8s/DeploymentWatcher.java:80-93).  Sources: programmatic (operator invokes
+directly in-process), or a polled JSON file for standalone runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+from typing import Any, Callable
+
+log = logging.getLogger(__name__)
+
+DEFAULT_ENGINE_REST_PORT = 8000
+DEFAULT_ENGINE_GRPC_PORT = 5001
+
+
+@dataclasses.dataclass
+class DeploymentRecord:
+    """What the gateway needs to route to one SeldonDeployment."""
+
+    name: str
+    oauth_key: str
+    oauth_secret: str
+    engine_host: str = ""  # defaults to the deployment's service name
+    engine_rest_port: int = DEFAULT_ENGINE_REST_PORT
+    engine_grpc_port: int = DEFAULT_ENGINE_GRPC_PORT
+    annotations: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def rest_base(self) -> str:
+        host = self.engine_host or self.name
+        return f"http://{host}:{self.engine_rest_port}"
+
+    @property
+    def grpc_target(self) -> str:
+        host = self.engine_host or self.name
+        return f"{host}:{self.engine_grpc_port}"
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "DeploymentRecord":
+        return cls(
+            name=d["name"],
+            oauth_key=d.get("oauth_key", d["name"]),
+            oauth_secret=d.get("oauth_secret", ""),
+            engine_host=d.get("engine_host", ""),
+            engine_rest_port=int(d.get("engine_rest_port", DEFAULT_ENGINE_REST_PORT)),
+            engine_grpc_port=int(d.get("engine_grpc_port", DEFAULT_ENGINE_GRPC_PORT)),
+            annotations=dict(d.get("annotations", {})),
+        )
+
+
+Listener = Callable[[str, DeploymentRecord], None]  # event, record
+
+
+class DeploymentStore:
+    """Thread-safe oauth_key -> record map with change listeners."""
+
+    def __init__(self):
+        self._by_key: dict[str, DeploymentRecord] = {}
+        self._lock = threading.Lock()
+        self._listeners: list[Listener] = []
+
+    def add_listener(self, fn: Listener) -> None:
+        self._listeners.append(fn)
+
+    def _emit(self, event: str, rec: DeploymentRecord) -> None:
+        for fn in self._listeners:
+            try:
+                fn(event, rec)
+            except Exception:  # listeners must not break the control path
+                log.exception("deployment listener failed")
+
+    def put(self, rec: DeploymentRecord) -> None:
+        with self._lock:
+            existing = self._by_key.get(rec.oauth_key)
+            self._by_key[rec.oauth_key] = rec
+        self._emit("updated" if existing else "added", rec)
+
+    def remove(self, oauth_key: str) -> None:
+        with self._lock:
+            rec = self._by_key.pop(oauth_key, None)
+        if rec is not None:
+            self._emit("removed", rec)
+
+    def get(self, oauth_key: str) -> DeploymentRecord | None:
+        with self._lock:
+            return self._by_key.get(oauth_key)
+
+    def list(self) -> list[DeploymentRecord]:
+        with self._lock:
+            return list(self._by_key.values())
+
+    # -- file source -------------------------------------------------------
+
+    def load_file(self, path: str) -> int:
+        """Replace contents from a JSON file ``[{name, oauth_key, ...}]``.
+        Returns the number of deployments loaded; removes absent ones."""
+        with open(path) as f:
+            raw = json.load(f)
+        records = [DeploymentRecord.from_dict(d) for d in raw]
+        new_keys = {r.oauth_key for r in records}
+        for rec in self.list():
+            if rec.oauth_key not in new_keys:
+                self.remove(rec.oauth_key)
+        for rec in records:
+            existing = self.get(rec.oauth_key)
+            if existing != rec:
+                self.put(rec)
+        return len(records)
+
+
+def load_store_from_env(store: DeploymentStore, environ: dict | None = None) -> None:
+    """Standalone bootstrap: ``GATEWAY_DEPLOYMENTS`` (JSON or path) and/or
+    ``TEST_CLIENT_KEY``/``TEST_CLIENT_SECRET`` creating a localhost
+    deployment (reference: AuthorizationServerConfiguration.java:80-95's
+    TEST_CLIENT_KEY fake deployment)."""
+    env = environ if environ is not None else os.environ
+    raw = env.get("GATEWAY_DEPLOYMENTS", "")
+    if raw:
+        if os.path.exists(raw):
+            store.load_file(raw)
+        else:
+            for d in json.loads(raw):
+                store.put(DeploymentRecord.from_dict(d))
+    test_key = env.get("TEST_CLIENT_KEY", "")
+    if test_key:
+        store.put(
+            DeploymentRecord(
+                name="test-deployment",
+                oauth_key=test_key,
+                oauth_secret=env.get("TEST_CLIENT_SECRET", "secret"),
+                engine_host="127.0.0.1",
+            )
+        )

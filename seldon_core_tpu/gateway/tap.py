@@ -1,0 +1,106 @@
+"""Request/response firehose tap.
+
+The reference publishes every prediction request+response pair to Kafka
+(topic = client id, key = puid, value = RequestResponse proto; 20ms max
+block so serving never stalls — reference:
+api-frontend/.../kafka/KafkaRequestResponseProducer.java:33-76).
+
+Same contract here as a pluggable async sink; the built-in implementation
+appends JSONL to a per-deployment file (one line per pair, puid-keyed).
+A Kafka producer drops in behind the same interface where a broker exists.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Any, Protocol
+
+log = logging.getLogger(__name__)
+
+
+class RequestResponseTap(Protocol):
+    async def publish(self, client_id: str, puid: str, request: Any, response: Any) -> None: ...
+
+    async def close(self) -> None: ...
+
+
+class NullTap:
+    async def publish(self, client_id: str, puid: str, request: Any, response: Any) -> None:
+        return None
+
+    async def close(self) -> None:
+        return None
+
+
+class JsonlTap:
+    """Append request/response pairs to ``{dir}/{client_id}.jsonl``.
+
+    Writes go through a bounded queue drained by a background task — a slow
+    disk must not stall serving (the reference bounds Kafka blocking at 20ms
+    for the same reason; here publish never blocks: the pair is dropped when
+    the queue is full, and drops are counted).
+    """
+
+    def __init__(self, directory: str, max_queue: int = 4096):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
+        self._task: asyncio.Task | None = None
+        self.dropped = 0
+
+    def _ensure_running(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._drain())
+
+    async def publish(self, client_id: str, puid: str, request: Any, response: Any) -> None:
+        self._ensure_running()
+        line = {
+            "ts": time.time(),
+            "puid": puid,
+            "client": client_id,
+            "request": request,
+            "response": response,
+        }
+        try:
+            self._queue.put_nowait((client_id, line))
+        except asyncio.QueueFull:
+            self.dropped += 1
+
+    def _write(self, client_id: str, line: dict) -> None:
+        path = os.path.join(self.directory, f"{client_id}.jsonl")
+        with open(path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+
+    async def _drain(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            client_id, line = await self._queue.get()
+            try:
+                # serialize+write off the event loop: a slow disk must not
+                # stall auth/predictions/health on the serving loop
+                await loop.run_in_executor(None, self._write, client_id, line)
+            except OSError:
+                self.dropped += 1
+                log.exception("tap write failed")
+
+    async def close(self) -> None:
+        if self._task is not None:
+            while not self._queue.empty():
+                await asyncio.sleep(0.01)
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+
+def tap_from_env(environ: dict | None = None) -> RequestResponseTap:
+    env = environ if environ is not None else os.environ
+    directory = env.get("GATEWAY_TAP_DIR", "")
+    if directory:
+        return JsonlTap(directory)
+    return NullTap()

@@ -1,0 +1,260 @@
+"""Gateway tests: token issuance/validation, authenticated proxying to a
+live in-process engine, feedback reward counters, tap output, pause/drain,
+and the gRPC Seldon proxy."""
+
+import asyncio
+import json
+
+import grpc
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu.engine.app import EngineApp
+from seldon_core_tpu.engine.grpc_app import start_engine_grpc
+from seldon_core_tpu.engine.service import PredictionService
+from seldon_core_tpu.gateway.app import GatewayApp
+from seldon_core_tpu.gateway.auth import AuthError, TokenStore
+from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
+from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
+from seldon_core_tpu.gateway.tap import JsonlTap
+from seldon_core_tpu.graph.spec import PredictorSpec
+from seldon_core_tpu.proto.grpc_defs import Stub
+from seldon_core_tpu.contract import Payload, payload_to_proto, payload_from_proto
+
+run = asyncio.run
+
+SIMPLE = {"name": "p", "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}
+
+
+class TestTokenStore:
+    def test_issue_and_validate(self):
+        ts = TokenStore(ttl_s=100.0, clock=lambda: 0.0)
+        token, exp = ts.issue("dep-key")
+        assert ts.principal(token) == "dep-key" and exp == 100.0
+
+    def test_expired_token_rejected(self):
+        now = [0.0]
+        ts = TokenStore(ttl_s=10.0, clock=lambda: now[0])
+        token, _ = ts.issue("k")
+        now[0] = 11.0
+        with pytest.raises(AuthError):
+            ts.principal(token)
+
+    def test_revoke_for_key(self):
+        ts = TokenStore()
+        token, _ = ts.issue("k")
+        ts.revoke_for_key("k")
+        with pytest.raises(AuthError):
+            ts.principal(token)
+
+
+class TestDeploymentStore:
+    def test_put_get_remove_events(self):
+        store = DeploymentStore()
+        events = []
+        store.add_listener(lambda e, r: events.append((e, r.name)))
+        rec = DeploymentRecord(name="d", oauth_key="k", oauth_secret="s")
+        store.put(rec)
+        store.put(DeploymentRecord(name="d", oauth_key="k", oauth_secret="s2"))
+        store.remove("k")
+        assert events == [("added", "d"), ("updated", "d"), ("removed", "d")]
+        assert store.get("k") is None
+
+    def test_load_file_sync(self, tmp_path):
+        p = tmp_path / "deps.json"
+        p.write_text(json.dumps([{"name": "a", "oauth_key": "ka", "oauth_secret": "sa"}]))
+        store = DeploymentStore()
+        assert store.load_file(str(p)) == 1
+        p.write_text(json.dumps([{"name": "b", "oauth_key": "kb", "oauth_secret": "sb"}]))
+        store.load_file(str(p))
+        assert store.get("ka") is None and store.get("kb").name == "b"
+
+
+async def _engine_client(spec=SIMPLE) -> TestClient:
+    service = PredictionService(PredictorSpec.model_validate(spec))
+    await service.start()
+    app = EngineApp(service).build()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def _gateway_client(engine_port: int, tap=None) -> tuple[TestClient, GatewayApp, str]:
+    store = DeploymentStore()
+    store.put(
+        DeploymentRecord(
+            name="dep",
+            oauth_key="key1",
+            oauth_secret="sec1",
+            engine_host="127.0.0.1",
+            engine_rest_port=engine_port,
+        )
+    )
+    gw = GatewayApp(store, tap=tap)
+    client = TestClient(TestServer(gw.build()))
+    await client.start_server()
+    resp = await client.post(
+        "/oauth/token", data={"client_id": "key1", "client_secret": "sec1"}
+    )
+    token = (await resp.json())["access_token"]
+    return client, gw, token
+
+
+class TestGatewayRest:
+    def test_end_to_end_predict(self, tmp_path):
+        async def go():
+            engine = await _engine_client()
+            port = engine.server.port
+            tap = JsonlTap(str(tmp_path / "tap"))
+            gw, gwapp, token = await _gateway_client(port, tap=tap)
+            resp = await gw.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1.0, 2.0]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            body = await resp.json()
+            # let the tap drain
+            await asyncio.sleep(0.05)
+            await gwapp.close()
+            await gw.close()
+            await engine.close()
+            tap_file = tmp_path / "tap" / "key1.jsonl"
+            tapped = json.loads(tap_file.read_text().splitlines()[0]) if tap_file.exists() else None
+            return resp.status, body, tapped
+
+        status, body, tapped = run(go())
+        assert status == 200
+        np.testing.assert_allclose(body["data"]["ndarray"], [[0.1, 0.9, 0.5]])
+        assert tapped is not None and tapped["puid"] == body["meta"]["puid"]
+
+    def test_auth_rejected(self):
+        async def go():
+            engine = await _engine_client()
+            gw, gwapp, _ = await _gateway_client(engine.server.port)
+            r1 = await gw.post("/api/v0.1/predictions", json={})
+            r2 = await gw.post(
+                "/api/v0.1/predictions", json={}, headers={"Authorization": "Bearer junk"}
+            )
+            r3 = await gw.post(
+                "/oauth/token", data={"client_id": "key1", "client_secret": "WRONG"}
+            )
+            await gwapp.close()
+            await gw.close()
+            await engine.close()
+            return r1.status, r2.status, r3.status
+
+        assert run(go()) == (401, 401, 401)
+
+    def test_secretless_deployment_cannot_auth(self):
+        """A record without a secret must not grant tokens (empty==empty)."""
+
+        async def go():
+            store = DeploymentStore()
+            store.put(DeploymentRecord(name="d", oauth_key="k", oauth_secret=""))
+            gw = GatewayApp(store)
+            client = TestClient(TestServer(gw.build()))
+            await client.start_server()
+            r = await client.post("/oauth/token", data={"client_id": "k", "client_secret": ""})
+            await gw.close()
+            await client.close()
+            return r.status
+
+        assert run(go()) == 401
+
+    def test_feedback_counts_reward(self):
+        async def go():
+            engine = await _engine_client()
+            gw, gwapp, token = await _gateway_client(engine.server.port)
+            resp = await gw.post(
+                "/api/v0.1/feedback",
+                json={"reward": 1.5, "response": {"meta": {"routing": {}}}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            metrics = gwapp.metrics.expose().decode()
+            await gwapp.close()
+            await gw.close()
+            await engine.close()
+            return resp.status, metrics
+
+        status, metrics = run(go())
+        assert status == 200
+        assert 'seldon_api_model_feedback_reward_total{deployment_name="dep"' in metrics
+
+    def test_pause_drains(self):
+        async def go():
+            engine = await _engine_client()
+            gw, gwapp, token = await _gateway_client(engine.server.port)
+            await gw.post("/pause")
+            r = await gw.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1.0]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            ready = await gw.get("/ready")
+            await gw.post("/unpause")
+            ready2 = await gw.get("/ready")
+            await gwapp.close()
+            await gw.close()
+            await engine.close()
+            return r.status, ready.status, ready2.status
+
+        assert run(go()) == (503, 503, 200)
+
+    def test_engine_down_returns_503(self):
+        async def go():
+            gw, gwapp, token = await _gateway_client(1)  # nothing listens on :1
+            r = await gw.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1.0]]}},
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            await gwapp.close()
+            await gw.close()
+            return r.status
+
+        assert run(go()) == 503
+
+
+class TestGatewayGrpc:
+    def test_grpc_proxy_predict(self):
+        async def go():
+            svc = PredictionService(PredictorSpec.model_validate(SIMPLE))
+            await svc.start()
+            engine_grpc = await start_engine_grpc(svc, 0)
+
+            store = DeploymentStore()
+            store.put(
+                DeploymentRecord(
+                    name="dep",
+                    oauth_key="key1",
+                    oauth_secret="sec1",
+                    engine_host="127.0.0.1",
+                    engine_grpc_port=engine_grpc.bound_port,
+                )
+            )
+            gwapp = GatewayApp(store)
+            token, _ = gwapp.tokens.issue("key1")
+            gw_grpc = await start_gateway_grpc(gwapp, 0)
+
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{gw_grpc.bound_port}") as ch:
+                stub = Stub(ch, "Seldon")
+                req = payload_to_proto(Payload.from_array(np.array([[1.0, 2.0]])))
+                good = await stub.Predict(req, metadata=(("oauth_token", token),))
+                bad = await stub.Predict(req, metadata=(("oauth_token", "junk"),))
+            await gw_grpc.gateway_handler.close()
+            await gw_grpc.stop(None)
+            await engine_grpc.stop(None)
+            await svc.close()
+            await gwapp.close()
+            return good, bad
+
+        good, bad = run(go())
+        from seldon_core_tpu.proto import prediction_pb2 as pb
+
+        assert good.status.status == pb.Status.SUCCESS
+        np.testing.assert_allclose(
+            payload_from_proto(good).array, [[0.1, 0.9, 0.5]]
+        )
+        assert bad.status.status == pb.Status.FAILURE
