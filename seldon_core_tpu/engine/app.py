@@ -54,7 +54,11 @@ class EngineApp:
             r.add_post(f"{prefix}/feedback", self.feedback)
         r.add_get("/ping", self.ping)
         r.add_get("/ready", self.ready)
+        # POST is what the operator's preStop hook sends (curl -X POST);
+        # GET kept for hand-driving
+        r.add_post("/pause", self.pause)
         r.add_get("/pause", self.pause)
+        r.add_post("/unpause", self.unpause)
         r.add_get("/unpause", self.unpause)
         r.add_get("/prometheus", self.prometheus)
         app.on_startup.append(self._startup)

@@ -1,0 +1,30 @@
+"""Kubernetes operator: SeldonDeployment -> running TPU serving pods.
+
+The reference operator (cluster-manager/, Java Spring) watches the
+``seldondeployments`` CRD, defaults+validates each resource, and emits an
+engine Deployment per predictor plus per-component Deployments and Services,
+with status writeback and orphan GC (reference:
+SeldonDeploymentOperatorImpl.java, SeldonDeploymentControllerImpl.java,
+SeldonDeploymentWatcher.java — SURVEY.md §2.3, §3.3).
+
+Same reconcile contract here, restructured:
+
+* :mod:`crd`        SeldonDeployment schema (pydantic; pod templates stay
+                    schema-flexible dicts)
+* :mod:`defaulting` defaulting (port assignment, env injection, endpoint
+                    rewrite, TPU resource hints) + validation
+* :mod:`resources`  desired-state generation (engine + component
+                    Deployments, Services, name hashing)
+* :mod:`kube`       minimal k8s API client protocol + an in-process fake
+                    (the reference had NO way to test its controller without
+                    a cluster; the fake closes that gap)
+* :mod:`controller` reconcile: diff desired vs. owned, create/update/delete,
+                    FAILED parking, status writeback
+* :mod:`watcher`    watch loop with resourceVersion tracking and 410 resets
+"""
+
+from seldon_core_tpu.operator.crd import SeldonDeployment
+from seldon_core_tpu.operator.controller import Controller
+from seldon_core_tpu.operator.kube import FakeKube
+
+__all__ = ["SeldonDeployment", "Controller", "FakeKube"]

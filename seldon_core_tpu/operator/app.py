@@ -1,0 +1,54 @@
+"""Operator entry point.
+
+    python -m seldon_core_tpu.operator.app [--kube-url http://127.0.0.1:8001]
+
+In-cluster by default (service-account config); ``--kube-url`` points at a
+`kubectl proxy` for development.  Creates the CRD on startup then runs the
+watch/reconcile loops until signalled.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+
+from seldon_core_tpu.operator.controller import Controller
+from seldon_core_tpu.operator.kube_http import HttpKube
+from seldon_core_tpu.operator.resources import ENGINE_IMAGE_DEFAULT
+from seldon_core_tpu.operator.watcher import OperatorLoop
+
+log = logging.getLogger(__name__)
+
+
+async def run(kube_url: str | None, namespace: str, engine_image: str) -> None:
+    kube = HttpKube(kube_url)
+    await kube.ensure_crd()
+    controller = Controller(kube, engine_image=engine_image)
+    loop = OperatorLoop(kube, controller, namespace=namespace)
+    await loop.start()
+    stop = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        asyncio.get_running_loop().add_signal_handler(sig, stop.set)
+    log.info("operator running (namespace=%s)", namespace)
+    await stop.wait()
+    await loop.stop()
+    await kube.close()
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="seldon-core-tpu operator")
+    parser.add_argument("--kube-url", default=os.environ.get("KUBE_URL") or None)
+    parser.add_argument("--namespace", default=os.environ.get("SELDON_NAMESPACE", "default"))
+    parser.add_argument(
+        "--engine-image", default=os.environ.get("ENGINE_CONTAINER_IMAGE", ENGINE_IMAGE_DEFAULT)
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(run(args.kube_url, args.namespace, args.engine_image))
+
+
+if __name__ == "__main__":
+    main()

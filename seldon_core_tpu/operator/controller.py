@@ -1,0 +1,238 @@
+"""Reconciliation: desired state -> cluster state.
+
+The reference's reconcile contract, kept exactly (reference:
+SeldonDeploymentControllerImpl.java:260-310):
+
+1. skip if the CR previously FAILED (parked until the spec changes,
+   :263-267) or the spec is unchanged since the cached reconcile (:270-271)
+2. defaulting -> validate -> cache
+3. create-or-update owned Deployments; delete orphans (owned objects not in
+   the desired set, selected by the seldon-deployment-id label, :209-243)
+4. same for Services
+5. on validation/creation failure: status.state=FAILED with description
+6. push the defaulted CR back when defaulting changed the spec (:286-290)
+
+Status writeback (replicas available per predictor) mirrors the reference's
+second watcher (DeploymentWatcher.java:60-144 +
+SeldonDeploymentStatusUpdateImpl.java:49-85).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from seldon_core_tpu.operator.crd import (
+    LABEL_DEPLOYMENT_ID,
+    DeploymentStatus,
+    PredictorStatus,
+    SeldonDeployment,
+)
+from seldon_core_tpu.operator.defaulting import ValidationError, defaulting, validate
+from seldon_core_tpu.operator.kube import KubeApi, NotFound
+from seldon_core_tpu.operator.names import engine_deployment_name
+from seldon_core_tpu.operator.resources import ENGINE_IMAGE_DEFAULT, create_resources
+
+log = logging.getLogger(__name__)
+
+CR_KIND = "SeldonDeployment"
+
+
+class Controller:
+    def __init__(self, kube: KubeApi, engine_image: str = ENGINE_IMAGE_DEFAULT):
+        self.kube = kube
+        self.engine_image = engine_image
+        self._spec_cache: dict[str, str] = {}  # name -> spec signature
+        self._failed: dict[str, str] = {}  # name -> failed spec signature
+
+    # -- reconcile ---------------------------------------------------------
+
+    async def reconcile(self, mldep: SeldonDeployment) -> None:
+        name = mldep.metadata.name
+        ns = mldep.metadata.namespace
+        signature = mldep.spec_signature()
+
+        if self._failed.get(name) == signature:
+            log.debug("skipping FAILED deployment %s (spec unchanged)", name)
+            return
+        if self._spec_cache.get(name) == signature:
+            log.debug("skipping unchanged deployment %s", name)
+            return
+
+        try:
+            defaulted = defaulting(mldep)
+            validate(defaulted)
+            deployments, services = create_resources(defaulted, self.engine_image)
+            uid = mldep.metadata.uid
+            await self._apply(ns, name, "Deployment", deployments, owner_uid=uid)
+            await self._apply(ns, name, "Service", services, owner_uid=uid)
+        except ValidationError as e:
+            log.warning("deployment %s failed validation: %s", name, e)
+            self._failed[name] = signature
+            await self._write_status(
+                mldep, DeploymentStatus(state="FAILED", description=str(e))
+            )
+            return
+        except Exception as e:
+            # transient (API hiccup, conflict, network): surface in status
+            # but do NOT park — the next event or resync retries; only
+            # validation failures park (reference parks everything,
+            # :263-267, which is a known scar)
+            log.exception("reconcile of %s failed; will retry", name)
+            await self._write_status(
+                mldep,
+                DeploymentStatus(state="Creating", description=f"retrying: {type(e).__name__}: {e}"),
+            )
+            return
+
+        self._failed.pop(name, None)
+        self._spec_cache[name] = signature
+        # push the defaulted spec back when defaulting changed it
+        if defaulted.spec_signature() != signature:
+            defaulted.status = mldep.status
+            try:
+                await self.kube.update(CR_KIND, ns, defaulted.to_dict())
+                self._spec_cache[name] = defaulted.spec_signature()
+            except NotFound:
+                pass
+        await self._refresh_status(defaulted)
+
+    async def _apply(
+        self,
+        ns: str,
+        owner: str,
+        kind: str,
+        desired: list[dict[str, Any]],
+        owner_uid: str = "",
+    ) -> None:
+        desired_names = {d["metadata"]["name"] for d in desired}
+        for obj in desired:
+            obj["metadata"].setdefault("labels", {})[LABEL_DEPLOYMENT_ID] = owner
+            if owner_uid:
+                # kube GC cleans these up even if the operator misses the
+                # CR deletion (down, watch gap)
+                obj["metadata"]["ownerReferences"] = [
+                    {
+                        "apiVersion": "machinelearning.seldon.io/v1alpha2",
+                        "kind": "SeldonDeployment",
+                        "name": owner,
+                        "uid": owner_uid,
+                        "controller": True,
+                        "blockOwnerDeletion": False,
+                    }
+                ]
+            try:
+                existing = await self.kube.get(kind, ns, obj["metadata"]["name"])
+            except NotFound:
+                await self.kube.create(kind, ns, obj)
+                continue
+            if self._spec_differs(existing, obj):
+                merged = dict(existing)
+                merged["spec"] = obj["spec"]
+                merged["metadata"] = {**existing.get("metadata", {}), **obj["metadata"]}
+                await self.kube.update(kind, ns, merged)
+        # orphan GC: owned objects no longer desired
+        owned = await self.kube.list(kind, ns, {LABEL_DEPLOYMENT_ID: owner})
+        for obj in owned:
+            if obj["metadata"]["name"] not in desired_names:
+                try:
+                    await self.kube.delete(kind, ns, obj["metadata"]["name"])
+                except NotFound:
+                    pass
+
+    @staticmethod
+    def _spec_differs(existing: dict[str, Any], desired: dict[str, Any]) -> bool:
+        import json
+
+        return json.dumps(existing.get("spec"), sort_keys=True) != json.dumps(
+            desired.get("spec"), sort_keys=True
+        )
+
+    # -- delete ------------------------------------------------------------
+
+    async def delete(self, mldep: SeldonDeployment) -> None:
+        """CR deleted: remove every owned object (the reference leans on
+        ownerReferences GC; the fake has no GC, so deletion is explicit)."""
+        name = mldep.metadata.name
+        ns = mldep.metadata.namespace
+        self._spec_cache.pop(name, None)
+        self._failed.pop(name, None)
+        for kind in ("Deployment", "Service"):
+            for obj in await self.kube.list(kind, ns, {LABEL_DEPLOYMENT_ID: name}):
+                try:
+                    await self.kube.delete(kind, ns, obj["metadata"]["name"])
+                except NotFound:
+                    pass
+
+    # -- status ------------------------------------------------------------
+
+    async def _write_status(self, mldep: SeldonDeployment, status: DeploymentStatus) -> None:
+        try:
+            await self.kube.update_status(
+                CR_KIND, mldep.metadata.namespace, mldep.metadata.name, status.model_dump()
+            )
+        except NotFound:
+            pass
+
+    async def _refresh_status(self, mldep: SeldonDeployment) -> None:
+        """Recompute predictorStatus from owned engine Deployments."""
+        ns = mldep.metadata.namespace
+        statuses = []
+        available_all = True
+        for predictor in mldep.spec.predictors:
+            eng = engine_deployment_name(mldep.metadata.name, predictor.name)
+            try:
+                obj = await self.kube.get("Deployment", ns, eng)
+            except NotFound:
+                available_all = False
+                statuses.append(PredictorStatus(name=predictor.name, replicas=predictor.replicas))
+                continue
+            avail = int(obj.get("status", {}).get("availableReplicas", 0))
+            statuses.append(
+                PredictorStatus(
+                    name=predictor.name,
+                    replicas=predictor.replicas,
+                    replicasAvailable=avail,
+                )
+            )
+            if avail < predictor.replicas:
+                available_all = False
+        await self._write_status(
+            mldep,
+            DeploymentStatus(
+                state="Available" if available_all else "Creating",
+                predictorStatus=statuses,
+            ),
+        )
+
+    async def sweep_orphans(self, namespace: str) -> int:
+        """Delete owned objects whose CR no longer exists — covers deletions
+        missed while the operator was down (ownerReferences also cover this
+        on a real cluster; the sweep makes it deterministic and testable)."""
+        live = {
+            cr["metadata"]["name"] for cr in await self.kube.list(CR_KIND, namespace)
+        }
+        removed = 0
+        for kind in ("Deployment", "Service"):
+            for obj in await self.kube.list(kind, namespace):
+                owner = obj.get("metadata", {}).get("labels", {}).get(LABEL_DEPLOYMENT_ID)
+                if owner and owner not in live:
+                    try:
+                        await self.kube.delete(kind, namespace, obj["metadata"]["name"])
+                        removed += 1
+                    except NotFound:
+                        pass
+        return removed
+
+    async def on_deployment_event(self, obj: dict[str, Any]) -> None:
+        """A k8s Deployment changed: refresh the owning CR's status
+        (the reference's DeploymentWatcher feed)."""
+        owner = obj.get("metadata", {}).get("labels", {}).get(LABEL_DEPLOYMENT_ID)
+        if not owner:
+            return
+        ns = obj.get("metadata", {}).get("namespace", "default")
+        try:
+            raw = await self.kube.get(CR_KIND, ns, owner)
+        except NotFound:
+            return
+        await self._refresh_status(SeldonDeployment.from_dict(raw))

@@ -1,0 +1,98 @@
+"""SeldonDeployment custom-resource schema.
+
+Mirrors the reference CRD (reference: proto/seldon_deployment.proto:10-130,
+cluster-manager/src/main/resources/crd.json): a deployment holds predictors;
+each predictor holds an inference graph plus the pod templates
+("componentSpecs") that run its model containers.  Pod templates are
+schema-flexible dicts — the operator reads/writes only the fields it owns,
+everything else passes through to k8s untouched.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional
+
+from pydantic import BaseModel, Field
+
+from seldon_core_tpu.graph.spec import PredictiveUnitSpec
+
+API_VERSION = "machinelearning.seldon.io/v1alpha2"
+KIND = "SeldonDeployment"
+CRD_GROUP = "machinelearning.seldon.io"
+CRD_PLURAL = "seldondeployments"
+
+# label the operator stamps on everything it owns (reference:
+# SeldonDeploymentOperatorImpl.java labels seldon-deployment-id)
+LABEL_DEPLOYMENT_ID = "seldon-deployment-id"
+LABEL_SELDON_TYPE = "seldon-type"
+
+
+class PredictorDef(BaseModel):
+    """One predictor: graph + pod templates + replicas
+    (reference: proto/seldon_deployment.proto:40-54)."""
+
+    name: str
+    graph: PredictiveUnitSpec
+    componentSpecs: list[dict[str, Any]] = Field(default_factory=list)
+    replicas: int = 1
+    annotations: dict[str, str] = Field(default_factory=dict)
+    labels: dict[str, str] = Field(default_factory=dict)
+    engineResources: dict[str, Any] = Field(default_factory=dict)
+
+
+class DeploymentDef(BaseModel):
+    """spec of the custom resource
+    (reference: proto/seldon_deployment.proto:19-33)."""
+
+    name: str
+    predictors: list[PredictorDef] = Field(default_factory=list)
+    oauth_key: str = ""
+    oauth_secret: str = ""
+    annotations: dict[str, str] = Field(default_factory=dict)
+
+
+class PredictorStatus(BaseModel):
+    name: str
+    replicas: int = 0
+    replicasAvailable: int = 0
+
+
+class DeploymentStatus(BaseModel):
+    state: str = ""  # "" | "Available" | "Creating" | "FAILED"
+    description: str = ""
+    predictorStatus: list[PredictorStatus] = Field(default_factory=list)
+
+
+class ObjectMeta(BaseModel):
+    name: str
+    namespace: str = "default"
+    labels: dict[str, str] = Field(default_factory=dict)
+    annotations: dict[str, str] = Field(default_factory=dict)
+    resourceVersion: str = ""
+    uid: str = ""
+
+
+class SeldonDeployment(BaseModel):
+    apiVersion: str = API_VERSION
+    kind: str = KIND
+    metadata: ObjectMeta
+    spec: DeploymentDef
+    status: Optional[DeploymentStatus] = None
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "SeldonDeployment":
+        return cls.model_validate(d)
+
+    def to_dict(self) -> dict[str, Any]:
+        return self.model_dump(exclude_none=True)
+
+    def deep_copy(self) -> "SeldonDeployment":
+        return copy.deepcopy(self)
+
+    def spec_signature(self) -> str:
+        """Canonical spec encoding for no-op reconcile suppression
+        (reference: SeldonDeploymentCacheImpl compares cached protos)."""
+        import json
+
+        return json.dumps(self.spec.model_dump(), sort_keys=True)

@@ -1,0 +1,163 @@
+"""Defaulting and validation of SeldonDeployments.
+
+The reference's exact contract (reference:
+SeldonDeploymentOperatorImpl.java:346-387 defaulting, :432-441 validation):
+
+defaulting
+  * every graph unit whose name matches a container in a componentSpec gets
+    a service port assigned from a base (one port per distinct container),
+    env injection (PREDICTIVE_UNIT_SERVICE_PORT, PREDICTIVE_UNIT_PARAMETERS,
+    PREDICTIVE_UNIT_ID, PREDICTOR_ID, SELDON_DEPLOYMENT_ID), TCP probes, and
+    its Endpoint rewritten to {host: <svc name>, port, type}
+  * units with no matching container keep LOCAL endpoints — the TPU-native
+    in-process path (no reference analogue: there every unit is a pod)
+  * containers requesting ``google.com/tpu`` resources get TPU scheduling
+    hints (nodeSelector for the accelerator type annotation)
+
+validation
+  * every unit must have an implementation, a type, or explicit methods
+  * a MODEL unit without a built-in implementation must name a container
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from seldon_core_tpu.graph.spec import (
+    Endpoint,
+    Implementation,
+    PredictiveUnitSpec,
+    TransportType,
+)
+from seldon_core_tpu.graph.units import has_builtin
+from seldon_core_tpu.operator.crd import PredictorDef, SeldonDeployment
+from seldon_core_tpu.operator.names import service_name
+
+PU_PORT_BASE = 9000
+ENV_SERVICE_PORT = "PREDICTIVE_UNIT_SERVICE_PORT"
+ENV_PARAMETERS = "PREDICTIVE_UNIT_PARAMETERS"
+ENV_UNIT_ID = "PREDICTIVE_UNIT_ID"
+ENV_PREDICTOR_ID = "PREDICTOR_ID"
+ENV_DEPLOYMENT_ID = "SELDON_DEPLOYMENT_ID"
+TPU_RESOURCE = "google.com/tpu"
+TPU_ACCELERATOR_ANNOTATION = "seldon.io/tpu-accelerator"
+TPU_NODE_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+
+
+class ValidationError(Exception):
+    pass
+
+
+def _containers(predictor: PredictorDef):
+    for spec_idx, cspec in enumerate(predictor.componentSpecs):
+        for c in cspec.get("spec", {}).get("containers", []):
+            yield spec_idx, c
+
+
+def _set_env(container: dict[str, Any], name: str, value: str) -> None:
+    env = container.setdefault("env", [])
+    for e in env:
+        if e.get("name") == name:
+            e["value"] = value
+            return
+    env.append({"name": name, "value": value})
+
+
+def defaulting(mldep: SeldonDeployment) -> SeldonDeployment:
+    """Returns a defaulted deep copy; the input is untouched
+    (the controller pushes the defaulted spec back to k8s only when changed,
+    reference: SeldonDeploymentControllerImpl.java:286-290)."""
+    out = mldep.deep_copy()
+    dep_name = out.metadata.name
+    for predictor in out.spec.predictors:
+        unit_names = {u.name for u in predictor.graph.iter_nodes()}
+        port_by_container: dict[str, int] = {}
+        next_port = PU_PORT_BASE
+        # assign ports + env per distinct graph-unit container; sidecars
+        # (containers that are not graph units) pass through untouched
+        for _, container in _containers(predictor):
+            cname = container.get("name", "")
+            if cname not in unit_names:
+                continue
+            if cname not in port_by_container:
+                port_by_container[cname] = next_port
+                next_port += 1
+            port = port_by_container[cname]
+            _set_env(container, ENV_SERVICE_PORT, str(port))
+            _set_env(container, ENV_PREDICTOR_ID, predictor.name)
+            _set_env(container, ENV_DEPLOYMENT_ID, dep_name)
+            ports = container.setdefault("ports", [])
+            if not any(p.get("containerPort") == port for p in ports):
+                ports.append({"containerPort": port, "name": "http", "protocol": "TCP"})
+            # TCP readiness/liveness unless user supplied their own
+            probe = {"tcpSocket": {"port": port}, "initialDelaySeconds": 10, "periodSeconds": 5}
+            container.setdefault("readinessProbe", dict(probe))
+            container.setdefault("livenessProbe", dict(probe))
+            # graceful drain window before SIGTERM
+            container.setdefault("lifecycle", {}).setdefault(
+                "preStop", {"exec": {"command": ["/bin/sh", "-c", "sleep 5"]}}
+            )
+        # second pass: per-unit wiring (endpoint rewrite + unit env)
+        for unit in predictor.graph.iter_nodes():
+            if unit.name in port_by_container:
+                port = port_by_container[unit.name]
+                unit.endpoint = Endpoint(
+                    service_host=service_name(dep_name, predictor.name, unit.name),
+                    service_port=port,
+                    type=unit.endpoint.type
+                    if unit.endpoint.type != TransportType.LOCAL
+                    else TransportType.REST,
+                )
+                for _, container in _containers(predictor):
+                    if container.get("name") == unit.name:
+                        _set_env(container, ENV_UNIT_ID, unit.name)
+                        _set_env(
+                            container,
+                            ENV_PARAMETERS,
+                            json.dumps([p.model_dump() for p in unit.parameters]),
+                        )
+        # TPU node selector on any pod spec with a TPU-requesting container
+        for cspec in predictor.componentSpecs:
+            pod_spec = cspec.get("spec", {})
+            wants_tpu = any(
+                TPU_RESOURCE in c.get("resources", {}).get("limits", {})
+                for c in pod_spec.get("containers", [])
+            )
+            accel = predictor.annotations.get(
+                TPU_ACCELERATOR_ANNOTATION,
+                out.spec.annotations.get(TPU_ACCELERATOR_ANNOTATION, ""),
+            )
+            if wants_tpu and accel:
+                pod_spec.setdefault("nodeSelector", {}).setdefault(
+                    TPU_NODE_SELECTOR, accel
+                )
+    return out
+
+
+def validate(mldep: SeldonDeployment) -> None:
+    """Raises ValidationError; mirrors the reference's two rules
+    (reference: SeldonDeploymentOperatorImpl.java:432-441)."""
+    if not mldep.spec.predictors:
+        raise ValidationError("deployment has no predictors")
+    for predictor in mldep.spec.predictors:
+        container_names = {
+            c.get("name", "") for _, c in _containers(predictor)
+        }
+        for unit in predictor.graph.iter_nodes():
+            has_impl = unit.implementation != Implementation.UNKNOWN_IMPLEMENTATION
+            if not (has_impl or unit.type is not None or unit.methods is not None):
+                raise ValidationError(
+                    f"unit {unit.name!r} needs an implementation, type, or methods"
+                )
+            needs_container = (
+                unit.type is not None
+                and unit.type.value == "MODEL"
+                and not (has_impl and has_builtin(unit.implementation))
+                and unit.endpoint.type == TransportType.LOCAL
+            )
+            if needs_container and unit.name not in container_names:
+                raise ValidationError(
+                    f"MODEL unit {unit.name!r} has no implementation and no "
+                    f"matching container in componentSpecs"
+                )

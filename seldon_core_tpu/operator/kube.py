@@ -1,0 +1,189 @@
+"""Minimal Kubernetes API surface + in-process fake.
+
+The controller needs five verbs over three kinds (SeldonDeployment CRs,
+Deployments, Services): get/list/create/update/delete, plus a CR watch.
+``KubeApi`` is that protocol; :class:`FakeKube` implements it in-memory with
+resourceVersion bookkeeping and watch queues so the entire reconcile loop is
+testable without a cluster — the reference's biggest test gap (its
+controller IO was untested, SURVEY.md §4).
+
+A real-cluster binding implements the same protocol over the API server's
+REST endpoints (service-account token + CA bundle in-pod).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import itertools
+from typing import Any, AsyncIterator, Protocol
+
+
+class Conflict(Exception):
+    pass
+
+
+class NotFound(Exception):
+    pass
+
+
+class Gone(Exception):
+    """Watch resourceVersion too old (HTTP 410) — restart from a list."""
+
+
+class KubeApi(Protocol):
+    async def get(self, kind: str, namespace: str, name: str) -> dict[str, Any]: ...
+
+    async def list(
+        self, kind: str, namespace: str, label_selector: dict[str, str] | None = None
+    ) -> list[dict[str, Any]]: ...
+
+    async def create(self, kind: str, namespace: str, obj: dict[str, Any]) -> dict[str, Any]: ...
+
+    async def update(self, kind: str, namespace: str, obj: dict[str, Any]) -> dict[str, Any]: ...
+
+    async def delete(self, kind: str, namespace: str, name: str) -> None: ...
+
+    async def update_status(
+        self, kind: str, namespace: str, name: str, status: dict[str, Any]
+    ) -> dict[str, Any]: ...
+
+    def watch(
+        self, kind: str, namespace: str, resource_version: str | None = None
+    ) -> AsyncIterator[tuple[str, dict[str, Any]]]: ...
+
+
+class FakeKube:
+    """In-memory KubeApi with watches and resourceVersions."""
+
+    def __init__(self, gone_after: int = 1000):
+        self._objects: dict[tuple[str, str, str], dict[str, Any]] = {}
+        self._rv = itertools.count(1)
+        self._watchers: list[tuple[str, str, asyncio.Queue]] = []
+        self._history: list[tuple[int, str, str, str, dict[str, Any]]] = []
+        self.gone_after = gone_after  # events older than this window are Gone
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _key(kind: str, namespace: str, name: str) -> tuple[str, str, str]:
+        return (kind, namespace, name)
+
+    def _stamp(self, obj: dict[str, Any]) -> dict[str, Any]:
+        obj = copy.deepcopy(obj)
+        obj.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
+        return obj
+
+    def _emit(self, event: str, kind: str, obj: dict[str, Any]) -> None:
+        ns = obj.get("metadata", {}).get("namespace", "default")
+        rv = int(obj["metadata"]["resourceVersion"])
+        self._history.append((rv, event, kind, ns, copy.deepcopy(obj)))
+        for wkind, wns, queue in self._watchers:
+            if wkind == kind and wns in (ns, ""):
+                queue.put_nowait((event, copy.deepcopy(obj)))
+
+    # -- protocol ----------------------------------------------------------
+
+    async def get(self, kind: str, namespace: str, name: str) -> dict[str, Any]:
+        try:
+            return copy.deepcopy(self._objects[self._key(kind, namespace, name)])
+        except KeyError:
+            raise NotFound(f"{kind}/{namespace}/{name}") from None
+
+    async def list(self, kind, namespace, label_selector=None) -> list[dict[str, Any]]:
+        out = []
+        for (k, ns, _), obj in self._objects.items():
+            if k != kind or (namespace and ns != namespace):
+                continue
+            if label_selector:
+                labels = obj.get("metadata", {}).get("labels", {})
+                if any(labels.get(lk) != lv for lk, lv in label_selector.items()):
+                    continue
+            out.append(copy.deepcopy(obj))
+        return out
+
+    async def create(self, kind, namespace, obj) -> dict[str, Any]:
+        name = obj["metadata"]["name"]
+        key = self._key(kind, namespace, name)
+        if key in self._objects:
+            raise Conflict(f"{kind}/{namespace}/{name} exists")
+        obj = self._stamp(obj)
+        obj["metadata"].setdefault("namespace", namespace)
+        if not obj["metadata"].get("uid"):  # "" counts as unset
+            obj["metadata"]["uid"] = f"uid-{kind}-{namespace}-{name}"
+        self._objects[key] = obj
+        self._emit("ADDED", kind, obj)
+        return copy.deepcopy(obj)
+
+    async def update(self, kind, namespace, obj) -> dict[str, Any]:
+        name = obj["metadata"]["name"]
+        key = self._key(kind, namespace, name)
+        if key not in self._objects:
+            raise NotFound(f"{kind}/{namespace}/{name}")
+        obj = self._stamp(obj)
+        obj["metadata"].setdefault("namespace", namespace)
+        self._objects[key] = obj
+        self._emit("MODIFIED", kind, obj)
+        return copy.deepcopy(obj)
+
+    async def delete(self, kind, namespace, name) -> None:
+        key = self._key(kind, namespace, name)
+        obj = self._objects.pop(key, None)
+        if obj is None:
+            raise NotFound(f"{kind}/{namespace}/{name}")
+        self._emit("DELETED", kind, obj)
+
+    async def update_status(self, kind, namespace, name, status) -> dict[str, Any]:
+        """Status subresource write: touches .status only, like a real API
+        server with ``subresources: {status: {}}`` enabled."""
+        key = self._key(kind, namespace, name)
+        if key not in self._objects:
+            raise NotFound(f"{kind}/{namespace}/{name}")
+        obj = copy.deepcopy(self._objects[key])
+        obj["status"] = copy.deepcopy(status)
+        obj = self._stamp(obj)
+        self._objects[key] = obj
+        self._emit("MODIFIED", kind, obj)
+        return copy.deepcopy(obj)
+
+    async def watch(
+        self, kind: str, namespace: str, resource_version: str | None = None
+    ) -> AsyncIterator[tuple[str, dict[str, Any]]]:
+        """Replays history after ``resource_version`` then streams live
+        events.  Raises :class:`Gone` when the requested version has aged
+        out, mimicking the 410 the reference's watcher must survive
+        (reference: SeldonDeploymentWatcher.java:113-117)."""
+        since = int(resource_version) if resource_version else 0
+        current = next(self._rv) - 1  # peek
+        self._rv = itertools.count(current + 1)
+        if since and current - since > self.gone_after:
+            raise Gone(f"resourceVersion {since} too old")
+        queue: asyncio.Queue = asyncio.Queue()
+        entry = (kind, namespace, queue)
+        self._watchers.append(entry)
+        try:
+            for rv, event, k, ns, obj in list(self._history):
+                if k == kind and ns == namespace and rv > since:
+                    yield event, copy.deepcopy(obj)
+            while True:
+                event, obj = await queue.get()
+                yield event, obj
+        finally:
+            self._watchers.remove(entry)
+
+    # -- test conveniences -------------------------------------------------
+
+    def object_names(self, kind: str) -> set[str]:
+        return {name for (k, _, name) in self._objects if k == kind}
+
+    def set_available_replicas(self, namespace: str, name: str, available: int) -> None:
+        """Simulate kubelet progress on a Deployment (drives status
+        writeback like the reference's second watcher,
+        DeploymentWatcher.java:60-144)."""
+        key = self._key("Deployment", namespace, name)
+        obj = self._objects[key]
+        obj.setdefault("status", {})["availableReplicas"] = available
+        obj["status"]["replicas"] = obj.get("spec", {}).get("replicas", 1)
+        obj = self._stamp(obj)
+        self._objects[key] = obj
+        self._emit("MODIFIED", "Deployment", obj)

@@ -1,0 +1,195 @@
+"""KubeApi over the Kubernetes API server's REST endpoints.
+
+In-cluster config (service-account token + CA bundle, like every operator
+pod) or an explicit host for `kubectl proxy` during development — the
+reference shipped a kubectl-proxy sidecar for exactly this
+(reference: kubectl-proxy/).  Watches are the API server's chunked
+JSON-lines streams; 410 responses surface as :class:`Gone` so the watch
+loop relists (reference: SeldonDeploymentWatcher.java:113-117).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, AsyncIterator
+
+import httpx
+
+from seldon_core_tpu.operator.crd import CRD_GROUP, CRD_PLURAL
+from seldon_core_tpu.operator.kube import Conflict, Gone, NotFound
+
+log = logging.getLogger(__name__)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+_KIND_PATHS = {
+    "Deployment": ("/apis/apps/v1", "deployments"),
+    "Service": ("/api/v1", "services"),
+    "SeldonDeployment": (f"/apis/{CRD_GROUP}/v1alpha2", CRD_PLURAL),
+}
+
+
+def in_cluster_config() -> tuple[str, dict[str, str], str | None]:
+    """-> (base_url, headers, verify) from the pod's service account."""
+    host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    token_path = os.path.join(SA_DIR, "token")
+    ca_path = os.path.join(SA_DIR, "ca.crt")
+    headers = {}
+    if os.path.exists(token_path):
+        with open(token_path) as f:
+            headers["Authorization"] = f"Bearer {f.read().strip()}"
+    verify = ca_path if os.path.exists(ca_path) else None
+    return f"https://{host}:{port}", headers, verify
+
+
+class HttpKube:
+    """KubeApi over httpx.  ``base_url`` default: in-cluster; pass
+    ``http://127.0.0.1:8001`` for `kubectl proxy`."""
+
+    def __init__(self, base_url: str | None = None, timeout_s: float = 30.0):
+        if base_url is None:
+            base_url, headers, verify = in_cluster_config()
+        else:
+            headers, verify = {}, None
+        self._client = httpx.AsyncClient(
+            base_url=base_url,
+            headers=headers,
+            verify=verify if verify is not None else True,
+            timeout=timeout_s,
+        )
+
+    async def close(self) -> None:
+        await self._client.aclose()
+
+    @staticmethod
+    def _path(kind: str, namespace: str, name: str | None = None) -> str:
+        prefix, plural = _KIND_PATHS[kind]
+        path = f"{prefix}/namespaces/{namespace}/{plural}"
+        return f"{path}/{name}" if name else path
+
+    @staticmethod
+    def _raise(resp: httpx.Response, what: str) -> None:
+        if resp.status_code == 404:
+            raise NotFound(what)
+        if resp.status_code == 409:
+            raise Conflict(what)
+        if resp.status_code == 410:
+            raise Gone(what)
+        resp.raise_for_status()
+
+    # -- protocol ----------------------------------------------------------
+
+    async def get(self, kind: str, namespace: str, name: str) -> dict[str, Any]:
+        resp = await self._client.get(self._path(kind, namespace, name))
+        self._raise(resp, f"{kind}/{namespace}/{name}")
+        return resp.json()
+
+    async def list(self, kind, namespace, label_selector=None) -> list[dict[str, Any]]:
+        params = {}
+        if label_selector:
+            params["labelSelector"] = ",".join(f"{k}={v}" for k, v in label_selector.items())
+        resp = await self._client.get(self._path(kind, namespace), params=params)
+        self._raise(resp, f"{kind}/{namespace}")
+        return resp.json().get("items", [])
+
+    async def create(self, kind, namespace, obj) -> dict[str, Any]:
+        resp = await self._client.post(self._path(kind, namespace), json=obj)
+        self._raise(resp, f"{kind}/{namespace}/{obj['metadata']['name']}")
+        return resp.json()
+
+    async def update(self, kind, namespace, obj) -> dict[str, Any]:
+        name = obj["metadata"]["name"]
+        resp = await self._client.put(self._path(kind, namespace, name), json=obj)
+        self._raise(resp, f"{kind}/{namespace}/{name}")
+        return resp.json()
+
+    async def delete(self, kind, namespace, name) -> None:
+        resp = await self._client.delete(self._path(kind, namespace, name))
+        self._raise(resp, f"{kind}/{namespace}/{name}")
+
+    async def update_status(self, kind, namespace, name, status) -> dict[str, Any]:
+        """PUT to the status subresource (plain updates silently drop
+        .status once the CRD enables ``subresources: {status: {}}``)."""
+        current = await self.get(kind, namespace, name)
+        current["status"] = status
+        resp = await self._client.put(
+            self._path(kind, namespace, name) + "/status", json=current
+        )
+        self._raise(resp, f"{kind}/{namespace}/{name}/status")
+        return resp.json()
+
+    async def watch(
+        self, kind: str, namespace: str, resource_version: str | None = None
+    ) -> AsyncIterator[tuple[str, dict[str, Any]]]:
+        params: dict[str, Any] = {"watch": "true"}
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        async with self._client.stream(
+            "GET", self._path(kind, namespace), params=params, timeout=None
+        ) as resp:
+            if resp.status_code == 410:
+                raise Gone(f"{kind} watch at {resource_version}")
+            resp.raise_for_status()
+            async for line in resp.aiter_lines():
+                if not line.strip():
+                    continue
+                event = json.loads(line)
+                if event.get("type") == "ERROR":
+                    code = event.get("object", {}).get("code")
+                    if code == 410:
+                        raise Gone(f"{kind} watch expired")
+                    log.warning("watch error event: %s", event)
+                    continue
+                yield event["type"], event["object"]
+
+    # -- bootstrap ---------------------------------------------------------
+
+    async def ensure_crd(self) -> None:
+        """Create the seldondeployments CRD if absent; tolerate 409/403
+        (reference: CRDCreator.java:29-51)."""
+        crd = crd_manifest()
+        resp = await self._client.post(
+            "/apis/apiextensions.k8s.io/v1/customresourcedefinitions", json=crd
+        )
+        if resp.status_code in (200, 201, 409):
+            return
+        if resp.status_code == 403:
+            log.warning("no permission to create CRD; assuming it exists")
+            return
+        resp.raise_for_status()
+
+
+def crd_manifest() -> dict[str, Any]:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{CRD_PLURAL}.{CRD_GROUP}"},
+        "spec": {
+            "group": CRD_GROUP,
+            "names": {
+                "kind": "SeldonDeployment",
+                "listKind": "SeldonDeploymentList",
+                "plural": CRD_PLURAL,
+                "singular": "seldondeployment",
+                "shortNames": ["sdep"],
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": "v1alpha2",
+                    "served": True,
+                    "storage": True,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        }
+                    },
+                    "subresources": {"status": {}},
+                }
+            ],
+        },
+    }

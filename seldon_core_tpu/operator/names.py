@@ -1,0 +1,32 @@
+"""Deterministic k8s object naming with the 63-char hash fallback
+(reference: SeldonDeploymentOperatorImpl.java:331-342 — names longer than
+the k8s label limit get md5-hashed)."""
+
+from __future__ import annotations
+
+import hashlib
+
+K8S_NAME_MAX = 63
+
+
+def _clip(name: str) -> str:
+    if len(name) <= K8S_NAME_MAX:
+        return name
+    digest = hashlib.md5(name.encode()).hexdigest()[:10]
+    return f"{name[: K8S_NAME_MAX - 11]}-{digest}"
+
+
+def engine_deployment_name(dep: str, predictor: str) -> str:
+    return _clip(f"{dep}-{predictor}-engine")
+
+
+def component_deployment_name(dep: str, predictor: str, spec_idx: int) -> str:
+    return _clip(f"{dep}-{predictor}-{spec_idx}")
+
+
+def service_name(dep: str, predictor: str, container: str) -> str:
+    return _clip(f"{dep}-{predictor}-{container}")
+
+
+def deployment_service_name(dep: str) -> str:
+    return _clip(dep)

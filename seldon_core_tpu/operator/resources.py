@@ -1,0 +1,252 @@
+"""Desired-state generation: SeldonDeployment -> k8s Deployments + Services.
+
+The reference emits, per predictor: one engine Deployment (graph spec passed
+base64 in ``ENGINE_PREDICTOR``, prometheus scrape annotations, readiness on
+the admin port, preStop pause+drain), one Deployment per componentSpec, one
+ClusterIP Service per distinct graph container, and one deployment-wide
+Service pointing at the engine (reference:
+SeldonDeploymentOperatorImpl.java:520-666, :98-144 engine container,
+:195-292 container update, :465-484 ambassador annotations).
+
+All objects carry the ``seldon-deployment-id`` label the controller uses for
+ownership and orphan GC.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any
+
+from seldon_core_tpu.operator.crd import (
+    LABEL_DEPLOYMENT_ID,
+    LABEL_SELDON_TYPE,
+    PredictorDef,
+    SeldonDeployment,
+)
+from seldon_core_tpu.operator.names import (
+    component_deployment_name,
+    deployment_service_name,
+    engine_deployment_name,
+    service_name,
+)
+
+ENGINE_IMAGE_DEFAULT = "seldon-core-tpu/engine:latest"
+ENGINE_REST_PORT = 8000
+ENGINE_GRPC_PORT = 5001
+# health/drain/metrics are served on the REST port (the reference used a
+# second Tomcat "admin" connector on 8082; this engine has one listener)
+ENGINE_ADMIN_PORT = ENGINE_REST_PORT
+
+
+def engine_container(mldep: SeldonDeployment, predictor: PredictorDef, image: str) -> dict[str, Any]:
+    predictor_json = json.dumps(
+        predictor.model_dump(exclude={"componentSpecs"}), sort_keys=True
+    )
+    return {
+        "name": "seldon-container-engine",
+        "image": image,
+        "env": [
+            {
+                "name": "ENGINE_PREDICTOR",
+                "value": base64.b64encode(predictor_json.encode()).decode(),
+            },
+            {"name": "SELDON_DEPLOYMENT_ID", "value": mldep.metadata.name},
+            {"name": "ENGINE_SERVER_PORT", "value": str(ENGINE_REST_PORT)},
+            {"name": "ENGINE_SERVER_GRPC_PORT", "value": str(ENGINE_GRPC_PORT)},
+        ],
+        "ports": [
+            {"containerPort": ENGINE_REST_PORT, "name": "rest", "protocol": "TCP"},
+            {"containerPort": ENGINE_GRPC_PORT, "name": "grpc", "protocol": "TCP"},
+        ],
+        "readinessProbe": {
+            "httpGet": {"path": "/ready", "port": ENGINE_ADMIN_PORT},
+            "initialDelaySeconds": 10,
+            "periodSeconds": 5,
+            "failureThreshold": 3,
+        },
+        "livenessProbe": {
+            "httpGet": {"path": "/ping", "port": ENGINE_ADMIN_PORT},
+            "initialDelaySeconds": 10,
+            "periodSeconds": 5,
+        },
+        "lifecycle": {
+            "preStop": {
+                "exec": {
+                    "command": [
+                        "/bin/sh",
+                        "-c",
+                        f"curl -s -X POST localhost:{ENGINE_ADMIN_PORT}/pause && sleep 5",
+                    ]
+                }
+            }
+        },
+        "resources": predictor.engineResources or {"requests": {"cpu": "0.1"}},
+    }
+
+
+def _labels(mldep: SeldonDeployment, extra: dict[str, str] | None = None) -> dict[str, str]:
+    labels = {LABEL_DEPLOYMENT_ID: mldep.metadata.name, "app": "seldon"}
+    if extra:
+        labels.update(extra)
+    return labels
+
+
+def _deployment(
+    name: str,
+    namespace: str,
+    labels: dict[str, str],
+    pod_labels: dict[str, str],
+    pod_spec: dict[str, Any],
+    replicas: int,
+    annotations: dict[str, str] | None = None,
+) -> dict[str, Any]:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": dict(labels),
+        },
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app.kubernetes.io/name": name}},
+            "strategy": {
+                "rollingUpdate": {"maxUnavailable": "10%"},
+                "type": "RollingUpdate",
+            },
+            "template": {
+                "metadata": {
+                    "labels": {**pod_labels, "app.kubernetes.io/name": name},
+                    "annotations": annotations or {},
+                },
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
+def create_resources(
+    mldep: SeldonDeployment, engine_image: str = ENGINE_IMAGE_DEFAULT
+) -> tuple[list[dict[str, Any]], list[dict[str, Any]]]:
+    """-> (deployments, services) — the full desired state for one CR."""
+    ns = mldep.metadata.namespace
+    deployments: list[dict[str, Any]] = []
+    services: list[dict[str, Any]] = []
+
+    for predictor in mldep.spec.predictors:
+        # engine deployment (the per-predictor orchestrator pod)
+        eng_name = engine_deployment_name(mldep.metadata.name, predictor.name)
+        eng_labels = _labels(mldep, {LABEL_SELDON_TYPE: "engine"})
+        deployments.append(
+            _deployment(
+                eng_name,
+                ns,
+                eng_labels,
+                {**_labels(mldep), "seldon-app": deployment_service_name(mldep.metadata.name)},
+                {
+                    "containers": [engine_container(mldep, predictor, engine_image)],
+                    "terminationGracePeriodSeconds": 20,
+                },
+                predictor.replicas,
+                annotations={
+                    "prometheus.io/scrape": "true",
+                    "prometheus.io/path": "/prometheus",
+                    "prometheus.io/port": str(ENGINE_ADMIN_PORT),
+                },
+            )
+        )
+
+        # component deployments (user model pods)
+        for idx, cspec in enumerate(predictor.componentSpecs):
+            cname = component_deployment_name(mldep.metadata.name, predictor.name, idx)
+            pod_spec = cspec.get("spec", {})
+            metadata = cspec.get("metadata", {})
+            pod_labels = {
+                **_labels(mldep),
+                **metadata.get("labels", {}),
+                # selector value is the (deployment,predictor,container)-unique
+                # service name: a container called "classifier" in another
+                # SeldonDeployment must not match this Service
+                **{
+                    f"seldon-app-svc-{c.get('name', '')}": service_name(
+                        mldep.metadata.name, predictor.name, c.get("name", "")
+                    )
+                    for c in pod_spec.get("containers", [])
+                },
+            }
+            deployments.append(
+                _deployment(
+                    cname,
+                    ns,
+                    _labels(mldep, {LABEL_SELDON_TYPE: "deployment"}),
+                    pod_labels,
+                    pod_spec,
+                    predictor.replicas,
+                    annotations=metadata.get("annotations", {}),
+                )
+            )
+            # one ClusterIP service per distinct container
+            for c in pod_spec.get("containers", []):
+                container_name = c.get("name", "")
+                port = None
+                for e in c.get("env", []):
+                    if e.get("name") == "PREDICTIVE_UNIT_SERVICE_PORT":
+                        port = int(e["value"])
+                if port is None:
+                    continue
+                svc = service_name(mldep.metadata.name, predictor.name, container_name)
+                services.append(
+                    {
+                        "apiVersion": "v1",
+                        "kind": "Service",
+                        "metadata": {
+                            "name": svc,
+                            "namespace": ns,
+                            "labels": _labels(mldep),
+                        },
+                        "spec": {
+                            "type": "ClusterIP",
+                            "selector": {f"seldon-app-svc-{container_name}": svc},
+                            "ports": [
+                                {"port": port, "targetPort": port, "protocol": "TCP"}
+                            ],
+                        },
+                    }
+                )
+
+    # deployment-wide service -> engine pods (what the gateway resolves by
+    # name; carries the ambassador routing annotations like the reference)
+    dep_svc = deployment_service_name(mldep.metadata.name)
+    services.append(
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": dep_svc,
+                "namespace": ns,
+                "labels": _labels(mldep),
+                "annotations": {
+                    "getambassador.io/config": json.dumps(
+                        {
+                            "apiVersion": "ambassador/v0",
+                            "kind": "Mapping",
+                            "name": f"seldon_{mldep.metadata.name}_rest_mapping",
+                            "prefix": f"/seldon/{mldep.metadata.name}/",
+                            "service": f"{dep_svc}:{ENGINE_REST_PORT}",
+                        }
+                    )
+                },
+            },
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {"seldon-app": dep_svc},
+                "ports": [
+                    {"port": ENGINE_REST_PORT, "targetPort": ENGINE_REST_PORT, "name": "rest"},
+                    {"port": ENGINE_GRPC_PORT, "targetPort": ENGINE_GRPC_PORT, "name": "grpc"},
+                ],
+            },
+        }
+    )
+    return deployments, services

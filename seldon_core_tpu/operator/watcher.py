@@ -1,0 +1,117 @@
+"""Watch loop: CR events -> controller, with resourceVersion bookkeeping.
+
+The reference wraps a k8s watch in a 5s poll loop, tracks the highest
+resourceVersion processed, and resets the version on 410-gone events
+(reference: SeldonDeploymentWatcher.java:69-85, 89-154, 158-171).  Here the
+loop is a long-lived task per kind; Gone triggers a fresh list+watch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from seldon_core_tpu.operator.controller import CR_KIND, Controller
+from seldon_core_tpu.operator.crd import LABEL_SELDON_TYPE, SeldonDeployment
+from seldon_core_tpu.operator.kube import Gone, KubeApi
+
+log = logging.getLogger(__name__)
+
+
+class OperatorLoop:
+    def __init__(
+        self,
+        kube: KubeApi,
+        controller: Controller,
+        namespace: str = "default",
+        resync_s: float = 30.0,
+    ):
+        self.kube = kube
+        self.controller = controller
+        self.namespace = namespace
+        self.resync_s = resync_s
+        self._tasks: list[asyncio.Task] = []
+        self.resource_version: str = ""
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._watch_crs()),
+            loop.create_task(self._watch_deployments()),
+            loop.create_task(self._resync()),
+        ]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+
+    # -- loops -------------------------------------------------------------
+
+    async def _watch_crs(self) -> None:
+        while True:
+            try:
+                # fresh list first: reconcile what already exists
+                for raw in await self.kube.list(CR_KIND, self.namespace):
+                    await self._dispatch("MODIFIED", raw)
+                    self._note_rv(raw)
+                async for event, raw in self.kube.watch(
+                    CR_KIND, self.namespace, self.resource_version or None
+                ):
+                    await self._dispatch(event, raw)
+                    self._note_rv(raw)
+            except Gone:
+                log.info("CR watch resourceVersion gone; relisting")
+                self.resource_version = ""
+                continue
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("CR watch failed; retrying")
+                await asyncio.sleep(1.0)
+
+    async def _watch_deployments(self) -> None:
+        while True:
+            try:
+                async for event, raw in self.kube.watch("Deployment", self.namespace):
+                    labels = raw.get("metadata", {}).get("labels", {})
+                    if labels.get(LABEL_SELDON_TYPE) in ("deployment", "engine"):
+                        await self.controller.on_deployment_event(raw)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("Deployment watch failed; retrying")
+                await asyncio.sleep(1.0)
+
+    async def _resync(self) -> None:
+        """Periodic full relist: retries transiently-failed reconciles and
+        sweeps objects orphaned while the operator was down."""
+        while True:
+            await asyncio.sleep(self.resync_s)
+            try:
+                for raw in await self.kube.list(CR_KIND, self.namespace):
+                    await self._dispatch("MODIFIED", raw)
+                await self.controller.sweep_orphans(self.namespace)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("resync failed; retrying next period")
+
+    # -- helpers -----------------------------------------------------------
+
+    def _note_rv(self, raw: dict) -> None:
+        rv = raw.get("metadata", {}).get("resourceVersion", "")
+        if rv:
+            self.resource_version = rv
+
+    async def _dispatch(self, event: str, raw: dict) -> None:
+        try:
+            mldep = SeldonDeployment.from_dict(raw)
+        except Exception:
+            log.exception("malformed SeldonDeployment %s", raw.get("metadata", {}).get("name"))
+            return
+        if event == "DELETED":
+            await self.controller.delete(mldep)
+        else:
+            await self.controller.reconcile(mldep)

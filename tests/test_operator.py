@@ -1,0 +1,352 @@
+"""Operator tests: defaulting/validation (mirrors the reference's
+SeldonDeploymentDefaultingTest/ValidationTest), resource generation, and the
+full reconcile loop against the in-process fake k8s API — including orphan
+GC, FAILED parking, status writeback, and the watch loop."""
+
+import asyncio
+import base64
+import json
+
+import pytest
+
+from seldon_core_tpu.operator.controller import CR_KIND, Controller
+from seldon_core_tpu.operator.crd import SeldonDeployment
+from seldon_core_tpu.operator.defaulting import ValidationError, defaulting, validate
+from seldon_core_tpu.operator.kube import FakeKube, NotFound
+from seldon_core_tpu.operator.resources import create_resources
+from seldon_core_tpu.operator.watcher import OperatorLoop
+
+run = asyncio.run
+
+
+def mk_cr(name="mydep", graph=None, containers=("classifier",), replicas=1):
+    graph = graph or {"name": "classifier", "type": "MODEL"}
+    return SeldonDeployment.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {
+                "name": name,
+                "oauth_key": "k",
+                "oauth_secret": "s",
+                "predictors": [
+                    {
+                        "name": "p1",
+                        "replicas": replicas,
+                        "graph": graph,
+                        "componentSpecs": [
+                            {
+                                "spec": {
+                                    "containers": [
+                                        {"name": c, "image": f"user/{c}:1"}
+                                        for c in containers
+                                    ]
+                                }
+                            }
+                        ],
+                    }
+                ],
+            },
+        }
+    )
+
+
+class TestDefaulting:
+    def test_ports_env_endpoint(self):
+        out = defaulting(mk_cr())
+        pred = out.spec.predictors[0]
+        c = pred.componentSpecs[0]["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["PREDICTIVE_UNIT_SERVICE_PORT"] == "9000"
+        assert env["PREDICTIVE_UNIT_ID"] == "classifier"
+        assert env["PREDICTOR_ID"] == "p1" and env["SELDON_DEPLOYMENT_ID"] == "mydep"
+        assert c["readinessProbe"]["tcpSocket"]["port"] == 9000
+        unit = pred.graph
+        assert unit.endpoint.service_host == "mydep-p1-classifier"
+        assert unit.endpoint.service_port == 9000
+        assert unit.endpoint.type.value == "REST"
+
+    def test_distinct_containers_distinct_ports(self):
+        cr = mk_cr(
+            graph={
+                "name": "a",
+                "type": "MODEL",
+                "children": [{"name": "b", "type": "MODEL"}],
+            },
+            containers=("a", "b"),
+        )
+        out = defaulting(cr)
+        env_by = {}
+        for c in out.spec.predictors[0].componentSpecs[0]["spec"]["containers"]:
+            env_by[c["name"]] = {e["name"]: e["value"] for e in c["env"]}
+        assert env_by["a"]["PREDICTIVE_UNIT_SERVICE_PORT"] == "9000"
+        assert env_by["b"]["PREDICTIVE_UNIT_SERVICE_PORT"] == "9001"
+
+    def test_builtin_unit_keeps_local_endpoint(self):
+        cr = mk_cr(graph={"name": "sm", "type": "MODEL", "implementation": "SIMPLE_MODEL"})
+        out = defaulting(cr)
+        assert out.spec.predictors[0].graph.endpoint.type.value == "LOCAL"
+
+    def test_tpu_node_selector(self):
+        cr = mk_cr()
+        cr.spec.annotations["seldon.io/tpu-accelerator"] = "tpu-v5-lite-podslice"
+        cr.spec.predictors[0].componentSpecs[0]["spec"]["containers"][0]["resources"] = {
+            "limits": {"google.com/tpu": "8"}
+        }
+        out = defaulting(cr)
+        pod_spec = out.spec.predictors[0].componentSpecs[0]["spec"]
+        assert pod_spec["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == (
+            "tpu-v5-lite-podslice"
+        )
+
+    def test_input_not_mutated(self):
+        cr = mk_cr()
+        defaulting(cr)
+        c = cr.spec.predictors[0].componentSpecs[0]["spec"]["containers"][0]
+        assert "env" not in c
+
+
+class TestValidation:
+    def test_valid_after_defaulting(self):
+        validate(defaulting(mk_cr()))
+
+    def test_model_without_container_or_impl_rejected(self):
+        cr = mk_cr(graph={"name": "ghost", "type": "MODEL"}, containers=("other",))
+        with pytest.raises(ValidationError):
+            validate(defaulting(cr))
+
+    def test_unit_without_anything_rejected(self):
+        cr = mk_cr(graph={"name": "x"})
+        with pytest.raises(ValidationError):
+            validate(defaulting(cr))
+
+    def test_no_predictors_rejected(self):
+        cr = mk_cr()
+        cr.spec.predictors = []
+        with pytest.raises(ValidationError):
+            validate(cr)
+
+
+class TestResources:
+    def test_engine_deployment_and_services(self):
+        out = defaulting(mk_cr())
+        deployments, services = create_resources(out)
+        names = {d["metadata"]["name"] for d in deployments}
+        assert names == {"mydep-p1-engine", "mydep-p1-0"}
+        svc_names = {s["metadata"]["name"] for s in services}
+        assert svc_names == {"mydep-p1-classifier", "mydep"}
+        # engine env round-trips to the engine's PredictorSpec loader
+        engine = next(d for d in deployments if "engine" in d["metadata"]["name"])
+        env = {
+            e["name"]: e["value"]
+            for e in engine["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        decoded = json.loads(base64.b64decode(env["ENGINE_PREDICTOR"]))
+        assert decoded["graph"]["endpoint"]["service_host"] == "mydep-p1-classifier"
+
+    def test_long_names_hashed(self):
+        cr = mk_cr(name="x" * 80)
+        out = defaulting(cr)
+        deployments, services = create_resources(out)
+        for obj in deployments + services:
+            assert len(obj["metadata"]["name"]) <= 63
+
+
+class TestController:
+    def test_create_update_orphan_gc(self):
+        async def go():
+            kube = FakeKube()
+            ctl = Controller(kube)
+            cr = mk_cr()
+            await kube.create(CR_KIND, "default", cr.to_dict())
+            await ctl.reconcile(cr)
+            created = kube.object_names("Deployment")
+            # change the graph: drop the container-based model for a builtin
+            cr2 = mk_cr(graph={"name": "sm", "type": "MODEL", "implementation": "SIMPLE_MODEL"})
+            cr2.spec.predictors[0].componentSpecs = []
+            await ctl.reconcile(cr2)
+            after = kube.object_names("Deployment")
+            svcs = kube.object_names("Service")
+            return created, after, svcs
+
+        created, after, svcs = run(go())
+        assert created == {"mydep-p1-engine", "mydep-p1-0"}
+        assert after == {"mydep-p1-engine"}  # component deployment GC'd
+        assert svcs == {"mydep"}  # per-container service GC'd
+
+    def test_failed_parking_until_spec_changes(self):
+        async def go():
+            kube = FakeKube()
+            ctl = Controller(kube)
+            bad = mk_cr(graph={"name": "ghost", "type": "MODEL"}, containers=("other",))
+            await kube.create(CR_KIND, "default", bad.to_dict())
+            await ctl.reconcile(bad)
+            st1 = (await kube.get(CR_KIND, "default", "mydep")).get("status", {})
+            await ctl.reconcile(bad)  # parked: no further work, still FAILED
+            good = mk_cr()
+            await ctl.reconcile(good)
+            st2 = (await kube.get(CR_KIND, "default", "mydep")).get("status", {})
+            return st1, st2, kube.object_names("Deployment")
+
+        st1, st2, deps = run(go())
+        assert st1["state"] == "FAILED"
+        assert st2["state"] in ("Creating", "Available")
+        assert "mydep-p1-engine" in deps
+
+    def test_status_writeback_on_replica_progress(self):
+        async def go():
+            kube = FakeKube()
+            ctl = Controller(kube)
+            cr = mk_cr()
+            await kube.create(CR_KIND, "default", cr.to_dict())
+            await ctl.reconcile(cr)
+            st0 = (await kube.get(CR_KIND, "default", "mydep"))["status"]
+            kube.set_available_replicas("default", "mydep-p1-engine", 1)
+            eng = await kube.get("Deployment", "default", "mydep-p1-engine")
+            await ctl.on_deployment_event(eng)
+            st1 = (await kube.get(CR_KIND, "default", "mydep"))["status"]
+            return st0, st1
+
+        st0, st1 = run(go())
+        assert st0["state"] == "Creating"
+        assert st1["state"] == "Available"
+        assert st1["predictorStatus"][0]["replicasAvailable"] == 1
+
+    def test_delete_removes_owned_objects(self):
+        async def go():
+            kube = FakeKube()
+            ctl = Controller(kube)
+            cr = mk_cr()
+            await kube.create(CR_KIND, "default", cr.to_dict())
+            await ctl.reconcile(cr)
+            await ctl.delete(cr)
+            return kube.object_names("Deployment"), kube.object_names("Service")
+
+        deps, svcs = run(go())
+        assert deps == set() and svcs == set()
+
+
+class TestReviewRegressions:
+    def test_sidecar_containers_untouched(self):
+        """Containers that are not graph units get no port/env/probe and no
+        Service (a log-shipper sidecar must not be probed on a dead port)."""
+        cr = mk_cr(containers=("classifier", "log-shipper"))
+        out = defaulting(cr)
+        containers = out.spec.predictors[0].componentSpecs[0]["spec"]["containers"]
+        sidecar = next(c for c in containers if c["name"] == "log-shipper")
+        assert "env" not in sidecar and "readinessProbe" not in sidecar
+        _, services = create_resources(out)
+        assert {s["metadata"]["name"] for s in services} == {"mydep-p1-classifier", "mydep"}
+
+    def test_service_selector_unique_per_deployment(self):
+        """Same container name in two deployments must not cross-match."""
+        a = create_resources(defaulting(mk_cr(name="depa")))
+        b = create_resources(defaulting(mk_cr(name="depb")))
+        sa = next(s for s in a[1] if "classifier" in s["metadata"]["name"])
+        sb = next(s for s in b[1] if "classifier" in s["metadata"]["name"])
+        assert sa["spec"]["selector"] != sb["spec"]["selector"]
+
+    def test_owner_references_set(self):
+        async def go():
+            kube = FakeKube()
+            ctl = Controller(kube)
+            created = await kube.create(CR_KIND, "default", mk_cr().to_dict())
+            await ctl.reconcile(SeldonDeployment.from_dict(created))
+            eng = await kube.get("Deployment", "default", "mydep-p1-engine")
+            return eng["metadata"].get("ownerReferences", [])
+
+        refs = run(go())
+        assert refs and refs[0]["kind"] == "SeldonDeployment" and refs[0]["uid"]
+
+    def test_transient_error_retries_not_parked(self):
+        class FlakyKube(FakeKube):
+            def __init__(self):
+                super().__init__()
+                self.fail_once = True
+
+            async def create(self, kind, namespace, obj):
+                if self.fail_once and kind == "Deployment":
+                    self.fail_once = False
+                    raise RuntimeError("api server hiccup")
+                return await super().create(kind, namespace, obj)
+
+        async def go():
+            kube = FlakyKube()
+            ctl = Controller(kube)
+            cr = mk_cr()
+            await kube.create(CR_KIND, "default", cr.to_dict())
+            await ctl.reconcile(cr)
+            st1 = (await kube.get(CR_KIND, "default", "mydep")).get("status", {})
+            await ctl.reconcile(cr)  # same spec retries (not parked)
+            return st1, kube.object_names("Deployment")
+
+        st1, deps = run(go())
+        assert st1["state"] == "Creating" and "retrying" in st1["description"]
+        assert "mydep-p1-engine" in deps
+
+    def test_sweep_orphans_after_missed_delete(self):
+        async def go():
+            kube = FakeKube()
+            ctl = Controller(kube)
+            cr = mk_cr()
+            await kube.create(CR_KIND, "default", cr.to_dict())
+            await ctl.reconcile(cr)
+            # CR vanishes while "operator is down" (no DELETED dispatch)
+            await kube.delete(CR_KIND, "default", "mydep")
+            removed = await ctl.sweep_orphans("default")
+            return removed, kube.object_names("Deployment"), kube.object_names("Service")
+
+        removed, deps, svcs = run(go())
+        # engine + component Deployments, per-container + deployment Services
+        assert removed == 4 and deps == set() and svcs == set()
+
+    def test_engine_probes_on_rest_port(self):
+        deployments, _ = create_resources(defaulting(mk_cr()))
+        engine = next(d for d in deployments if "engine" in d["metadata"]["name"])
+        c = engine["spec"]["template"]["spec"]["containers"][0]
+        assert c["readinessProbe"]["httpGet"]["port"] == 8000
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["SELDON_DEPLOYMENT_ID"] == "mydep"
+
+
+class TestOperatorLoop:
+    def test_watch_reconciles_new_cr(self):
+        async def go():
+            kube = FakeKube()
+            ctl = Controller(kube)
+            op = OperatorLoop(kube, ctl)
+            await op.start()
+            await asyncio.sleep(0.05)
+            await kube.create(CR_KIND, "default", mk_cr().to_dict())
+            for _ in range(100):
+                await asyncio.sleep(0.01)
+                if "mydep-p1-engine" in kube.object_names("Deployment"):
+                    break
+            names = kube.object_names("Deployment")
+            await op.stop()
+            return names
+
+        names = run(go())
+        assert "mydep-p1-engine" in names
+
+    def test_watch_handles_delete(self):
+        async def go():
+            kube = FakeKube()
+            ctl = Controller(kube)
+            op = OperatorLoop(kube, ctl)
+            await op.start()
+            await asyncio.sleep(0.05)
+            await kube.create(CR_KIND, "default", mk_cr().to_dict())
+            for _ in range(100):
+                await asyncio.sleep(0.01)
+                if "mydep-p1-engine" in kube.object_names("Deployment"):
+                    break
+            await kube.delete(CR_KIND, "default", "mydep")
+            for _ in range(100):
+                await asyncio.sleep(0.01)
+                if not kube.object_names("Deployment"):
+                    break
+            names = kube.object_names("Deployment")
+            await op.stop()
+            return names
+
+        assert run(go()) == set()
