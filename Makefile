@@ -8,7 +8,8 @@ proto:
 	       seldon_core_tpu/proto/prediction.proto
 
 native:
-	$(MAKE) -C seldon_core_tpu/native
+	mkdir -p seldon_core_tpu/_native
+	g++ -O3 -march=native -shared -fPIC -o seldon_core_tpu/_native/libsctcodec.so csrc/codec.cpp
 
 test:
 	$(PYTHON) -m pytest tests/ -x -q

@@ -1,0 +1,202 @@
+// Native tensor codec for the REST hot path.
+//
+// The reference's per-request cost was dominated by proto<->JSON
+// re-serialization at every hop in JVM code (reference: the vendored
+// 1806-line pb/JsonFormat.java in engine/ and api-frontend/).  Here the
+// equivalent native plane is small and sharp: parse and format dense
+// numeric JSON arrays (the `ndarray`/`tensor.values` payloads) without
+// touching Python objects.
+//
+// Exposed C ABI (loaded via ctypes from seldon_core_tpu/contract/native.py):
+//   sct_parse_dense   JSON numeric array (1-D / rectangular 2-D) -> doubles
+//   sct_format_dense  doubles -> shortest-round-trip JSON array text
+//   sct_b64_encode / sct_b64_decode  raw tensor payload framing
+//
+// Build: make native  (g++ -O3 -shared -fPIC)
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// parsing
+// ---------------------------------------------------------------------------
+
+// Parse a JSON array of numbers, or a rectangular array of arrays of
+// numbers, starting at buf[0] == '['.  Writes up to `cap` doubles into
+// `out`, shape into shape[0..1] and *ndim (1 or 2).
+//
+// Returns: >=0 -> number of doubles parsed
+//          -1  -> malformed / unsupported content (caller falls back)
+//          -2  -> cap too small
+//          -3  -> ragged rows
+// Also writes the number of input bytes consumed to *consumed, so callers
+// can splice the remainder of the enclosing JSON document.
+long long sct_parse_dense(const char* buf, size_t len, double* out, size_t cap,
+                          long long* shape, int* ndim, size_t* consumed) {
+    size_t i = 0;
+    size_t n = 0;
+    long long rows = 0, cols = -1, cur_cols = 0;
+    int depth = 0, seen_inner = 0;
+
+    while (i < len && isspace((unsigned char)buf[i])) i++;
+    if (i >= len || buf[i] != '[') return -1;
+
+    for (; i < len; i++) {
+        char c = buf[i];
+        if (isspace((unsigned char)c) || c == ',') continue;
+        if (c == '[') {
+            depth++;
+            if (depth > 2) return -1;
+            if (depth == 2) { seen_inner = 1; cur_cols = 0; }
+            continue;
+        }
+        if (c == ']') {
+            if (depth == 2) {
+                rows++;
+                if (cols < 0) cols = cur_cols;
+                else if (cols != cur_cols) return -3;
+            }
+            depth--;
+            if (depth == 0) { i++; break; }
+            continue;
+        }
+        if (depth == 0) return -1;
+        // a value: number, or null/NaN-ish tokens we map to NaN
+        if (c == '-' || c == '+' || isdigit((unsigned char)c)) {
+            char* end = nullptr;
+            double v = strtod(buf + i, &end);
+            if (end == buf + i) return -1;
+            if (n >= cap) return -2;
+            out[n++] = v;
+            if (depth == 2) cur_cols++;
+            i = (size_t)(end - buf) - 1;
+            continue;
+        }
+        if (c == 'n' && i + 4 <= len && memcmp(buf + i, "null", 4) == 0) {
+            if (n >= cap) return -2;
+            out[n++] = NAN;
+            if (depth == 2) cur_cols++;
+            i += 3;
+            continue;
+        }
+        return -1;  // strings, objects, bools, nesting >2: not dense numeric
+    }
+    if (depth != 0) return -1;
+    if (consumed) *consumed = i;
+    if (seen_inner) {
+        *ndim = 2;
+        shape[0] = rows;
+        shape[1] = cols < 0 ? 0 : cols;
+    } else {
+        *ndim = 1;
+        shape[0] = (long long)n;
+        shape[1] = 0;
+    }
+    return (long long)n;
+}
+
+// ---------------------------------------------------------------------------
+// formatting
+// ---------------------------------------------------------------------------
+
+// Shortest round-trip float: try precision 15, 16, 17 until strtod gives
+// the same bits back (the standard grisu-fallback trick).
+static int fmt_double(double v, char* out, size_t cap) {
+    if (std::isnan(v)) return snprintf(out, cap, "null");
+    if (std::isinf(v)) return snprintf(out, cap, v > 0 ? "1e999" : "-1e999");
+    if (v == (long long)v && v > -1e15 && v < 1e15) {
+        // integral fast path: "3.0" -> "3.0" keeps JSON float-ness
+        return snprintf(out, cap, "%.1f", v);
+    }
+    for (int prec = 15; prec <= 17; prec++) {
+        int w = snprintf(out, cap, "%.*g", prec, v);
+        if (w < 0 || (size_t)w >= cap) return -1;
+        if (strtod(out, nullptr) == v) return w;
+    }
+    return snprintf(out, cap, "%.17g", v);
+}
+
+// Format doubles as a JSON array ("[...]" when rows<0, else "[[...],...]").
+// Returns bytes written, or -1 if cap is too small.
+long long sct_format_dense(const double* data, long long rows, long long cols,
+                           char* out, size_t cap) {
+    size_t pos = 0;
+    #define PUT(ch) do { if (pos + 1 >= cap) return -1; out[pos++] = (ch); } while (0)
+    long long r_count = rows < 0 ? 1 : rows;
+    PUT('[');
+    for (long long r = 0; r < r_count; r++) {
+        if (r) PUT(',');
+        if (rows >= 0) PUT('[');
+        for (long long c = 0; c < cols; c++) {
+            if (c) PUT(',');
+            if (pos + 32 >= cap) return -1;
+            int w = fmt_double(data[r * cols + c], out + pos, cap - pos);
+            if (w < 0) return -1;
+            pos += (size_t)w;
+        }
+        if (rows >= 0) PUT(']');
+    }
+    PUT(']');
+    #undef PUT
+    out[pos] = '\0';
+    return (long long)pos;
+}
+
+// ---------------------------------------------------------------------------
+// base64 (raw tensor payloads)
+// ---------------------------------------------------------------------------
+
+static const char B64[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+long long sct_b64_encode(const uint8_t* in, size_t n, char* out, size_t cap) {
+    size_t need = ((n + 2) / 3) * 4;
+    if (cap < need + 1) return -1;
+    size_t o = 0;
+    for (size_t i = 0; i < n; i += 3) {
+        uint32_t v = (uint32_t)in[i] << 16;
+        if (i + 1 < n) v |= (uint32_t)in[i + 1] << 8;
+        if (i + 2 < n) v |= in[i + 2];
+        out[o++] = B64[(v >> 18) & 63];
+        out[o++] = B64[(v >> 12) & 63];
+        out[o++] = i + 1 < n ? B64[(v >> 6) & 63] : '=';
+        out[o++] = i + 2 < n ? B64[v & 63] : '=';
+    }
+    out[o] = '\0';
+    return (long long)o;
+}
+
+long long sct_b64_decode(const char* in, size_t n, uint8_t* out, size_t cap) {
+    static int8_t rev[256];
+    static int init = 0;
+    if (!init) {
+        memset(rev, -1, sizeof(rev));
+        for (int i = 0; i < 64; i++) rev[(int)B64[i]] = (int8_t)i;
+        init = 1;
+    }
+    size_t o = 0;
+    uint32_t acc = 0;
+    int bits = 0;
+    for (size_t i = 0; i < n; i++) {
+        char c = in[i];
+        if (c == '=' || isspace((unsigned char)c)) continue;
+        int8_t v = rev[(unsigned char)c];
+        if (v < 0) return -2;
+        acc = (acc << 6) | (uint32_t)v;
+        bits += 6;
+        if (bits >= 8) {
+            bits -= 8;
+            if (o >= cap) return -1;
+            out[o++] = (uint8_t)((acc >> bits) & 0xFF);
+        }
+    }
+    return (long long)o;
+}
+
+}  // extern "C"

@@ -22,6 +22,7 @@ from typing import Any
 
 import numpy as np
 
+from seldon_core_tpu.contract import native
 from seldon_core_tpu.contract.payload import (
     DataKind,
     FeedbackPayload,
@@ -192,7 +193,45 @@ def payload_to_dict(payload: Payload, include_meta: bool = True) -> dict[str, An
     return out
 
 
+# native fast path engages above this array-text size; below it the splice
+# bookkeeping costs more than json.loads saves
+_NATIVE_MIN_BYTES = 512
+
+
+def _native_from_json(raw: bytes) -> Payload | None:
+    """Hot-path decode: parse the ``ndarray`` numeric block with the C codec
+    and json-parse only the (small) remainder of the document."""
+    idx = raw.find(b'"ndarray"')
+    if idx < 0:
+        return None
+    start = raw.find(b"[", idx)
+    if start < 0:
+        return None
+    parsed = native.parse_dense(raw[start:])
+    if parsed is None:
+        return None
+    arr, consumed = parsed
+    if consumed < _NATIVE_MIN_BYTES:
+        return None
+    rest = raw[:start] + b"null" + raw[start + consumed :]
+    try:
+        msg = json.loads(rest)
+    except json.JSONDecodeError:
+        return None
+    data = msg.get("data")
+    if not isinstance(data, dict) or data.get("ndarray") is not None:
+        return None  # the spliced block wasn't data.ndarray after all
+    meta = meta_from_dict(msg.get("meta"))
+    return Payload(arr, list(data.get("names", [])), DataKind.NDARRAY, meta)
+
+
 def payload_from_json(raw: str | bytes) -> Payload:
+    if native.available():
+        raw_b = raw.encode() if isinstance(raw, str) else raw
+        if len(raw_b) >= _NATIVE_MIN_BYTES:
+            out = _native_from_json(raw_b)
+            if out is not None:
+                return out
     try:
         msg = json.loads(raw)
     except json.JSONDecodeError as e:
@@ -201,6 +240,28 @@ def payload_from_json(raw: str | bytes) -> Payload:
 
 
 def payload_to_json(payload: Payload) -> str:
+    if (
+        native.available()
+        and payload.kind in (DataKind.NDARRAY, DataKind.TENSOR)
+        and isinstance(payload.data, np.ndarray)
+        and payload.data.dtype.kind == "f"
+        and payload.data.size * 8 >= _NATIVE_MIN_BYTES
+    ):
+        if payload.kind == DataKind.TENSOR:
+            arr_json = native.format_dense(np.asarray(payload.data).ravel())
+            data_obj: dict[str, Any] = {
+                "names": payload.names,
+                "tensor": {"shape": list(payload.data.shape), "values": None},
+            }
+            hole = '"values":null'
+        else:
+            arr_json = native.format_dense(payload.data)
+            data_obj = {"names": payload.names, "ndarray": None}
+            hole = '"ndarray":null'
+        if arr_json is not None:
+            head = {"meta": meta_to_dict(payload.meta), "data": data_obj}
+            text = json.dumps(head, separators=(",", ":"))
+            return text.replace(hole, hole[: hole.index(":") + 1] + arr_json, 1)
     return json.dumps(payload_to_dict(payload), separators=(",", ":"))
 
 

@@ -1,0 +1,112 @@
+"""Native codec tests: parse/format round trips, equivalence of the fast
+JSON paths with the pure-Python decoder, and graceful fallback when the
+content is not dense numeric.  Skipped entirely when the .so isn't built
+(`make native`)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.contract import (
+    Payload,
+    payload_from_json,
+    payload_to_json,
+)
+from seldon_core_tpu.contract import native
+from seldon_core_tpu.contract.codec import payload_from_dict, payload_to_dict
+from seldon_core_tpu.contract.payload import DataKind
+
+pytestmark = pytest.mark.skipif(not native.available(), reason="native codec not built")
+
+
+class TestParseDense:
+    def test_2d(self):
+        arr, consumed = native.parse_dense(b"[[1,2.5],[3,4e2]]")
+        np.testing.assert_allclose(arr, [[1, 2.5], [3, 400.0]])
+        assert consumed == len(b"[[1,2.5],[3,4e2]]")
+
+    def test_1d(self):
+        arr, _ = native.parse_dense(b"[1,2,3]")
+        assert arr.shape == (3,)
+
+    def test_null_becomes_nan(self):
+        arr, _ = native.parse_dense(b"[[1,null]]")
+        assert np.isnan(arr[0, 1])
+
+    def test_strings_fall_back(self):
+        assert native.parse_dense(b'[["a","b"]]') is None
+
+    def test_ragged_falls_back(self):
+        assert native.parse_dense(b"[[1,2],[3]]") is None
+
+    def test_deep_nesting_falls_back(self):
+        assert native.parse_dense(b"[[[1]]]") is None
+
+    def test_consumed_stops_at_bracket(self):
+        arr, consumed = native.parse_dense(b'[[1,2]],"names":[]')
+        assert consumed == len(b"[[1,2]]")
+
+
+class TestFormatDense:
+    def test_round_trip_exact(self):
+        rng = np.random.default_rng(0)
+        arr = rng.normal(size=(8, 16)) * 10.0 ** rng.integers(-200, 200, size=(8, 16))
+        text = native.format_dense(arr)
+        back = np.asarray(json.loads(text))
+        np.testing.assert_array_equal(back, arr)  # bit-exact round trip
+
+    def test_nan_inf(self):
+        text = native.format_dense(np.array([np.nan, np.inf, -np.inf]))
+        assert json.loads(text)[0] is None
+
+    def test_integral_keeps_float_form(self):
+        assert native.format_dense(np.array([3.0])) == "[3.0]"
+
+
+def _big_payload_json(rows=64, cols=32):
+    rng = np.random.default_rng(1)
+    arr = rng.normal(size=(rows, cols))
+    body = {
+        "meta": {"puid": "p123", "tags": {"x": 1}},
+        "data": {"names": [f"f{i}" for i in range(cols)], "ndarray": arr.tolist()},
+    }
+    return json.dumps(body), arr
+
+
+class TestFastJsonPaths:
+    def test_from_json_matches_python_path(self):
+        raw, arr = _big_payload_json()
+        fast = payload_from_json(raw)
+        slow = payload_from_dict(json.loads(raw))
+        np.testing.assert_allclose(fast.array, slow.array)
+        assert fast.meta.puid == "p123" and fast.kind == DataKind.NDARRAY
+        assert fast.names == slow.names
+
+    def test_to_json_matches_python_path(self):
+        _, arr = _big_payload_json()
+        p = Payload.from_array(arr)
+        p.meta.puid = "q1"
+        fast = json.loads(payload_to_json(p))
+        slow = payload_to_dict(p)
+        np.testing.assert_allclose(fast["data"]["ndarray"], slow["data"]["ndarray"])
+        assert fast["meta"]["puid"] == "q1"
+
+    def test_tensor_kind_to_json(self):
+        arr = np.random.default_rng(2).normal(size=(16, 8))
+        p = Payload.from_array(arr, kind=DataKind.TENSOR)
+        out = json.loads(payload_to_json(p))
+        assert out["data"]["tensor"]["shape"] == [16, 8]
+        np.testing.assert_allclose(
+            np.asarray(out["data"]["tensor"]["values"]).reshape(16, 8), arr
+        )
+
+    def test_non_dense_content_falls_back(self):
+        body = {"data": {"ndarray": [["a", "b"]] * 200}}
+        out = payload_from_json(json.dumps(body))
+        assert out.kind == DataKind.NDARRAY
+        assert out.array.shape == (200, 2)
+
+    def test_small_payloads_use_python_path(self):
+        out = payload_from_json('{"data":{"ndarray":[[1.0,2.0]]}}')
+        np.testing.assert_allclose(out.array, [[1.0, 2.0]])
