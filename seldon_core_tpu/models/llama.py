@@ -319,12 +319,19 @@ def apply(params: dict, batch: jax.Array, cfg: Config) -> jax.Array:
     return jax.nn.softmax(logits[:, -1])
 
 
-def make_train_step(cfg: Config, optimizer: Any = None):
+def make_train_step(
+    cfg: Config,
+    optimizer: Any = None,
+    *,
+    mesh: Any = None,
+    seq_impl: str = "dense",
+):
     """Causal-LM training/fine-tuning step (cross-entropy over shifted
     tokens).  The reference's only 'learning' is bandit feedback counters
     (examples/routers/epsilon_greedy/EpsilonGreedy.py:42-60); here online
     fine-tuning is a first-class sharded step — also what the multi-chip
-    dry-run compiles.
+    dry-run compiles.  ``mesh``/``seq_impl`` select sequence-parallel
+    attention (ring/ulysses) for the forward pass.
     """
     import optax
 
@@ -332,7 +339,7 @@ def make_train_step(cfg: Config, optimizer: Any = None):
         optimizer = optax.adamw(1e-4)
 
     def loss_fn(params, tokens):
-        logits = forward(params, tokens, cfg)
+        logits = forward(params, tokens, cfg, mesh=mesh, seq_impl=seq_impl)
         targets = tokens[:, 1:]
         lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
         nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)
