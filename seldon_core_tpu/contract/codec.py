@@ -259,9 +259,22 @@ def payload_to_json(payload: Payload) -> str:
             data_obj = {"names": payload.names, "ndarray": None}
             hole = '"ndarray":null'
         if arr_json is not None:
-            head = {"meta": meta_to_dict(payload.meta), "data": data_obj}
-            text = json.dumps(head, separators=(",", ":"))
-            return text.replace(hole, hole[: hole.index(":") + 1] + arr_json, 1)
+            # Serialize meta and data separately so the splice hole is
+            # guaranteed to be inside the data object: a user meta tag
+            # literally keyed "ndarray"/"values" with a null value must never
+            # receive the array.  Within data_txt, splice the LAST occurrence:
+            # names serializes before the hole key (fixed dict order) and a
+            # wire client may have smuggled non-string names entries like
+            # {"ndarray": null} that would steal a first-occurrence replace.
+            meta_txt = json.dumps(meta_to_dict(payload.meta), separators=(",", ":"))
+            data_txt = json.dumps(data_obj, separators=(",", ":"))
+            i = data_txt.rfind(hole)
+            data_txt = (
+                data_txt[: i + hole.index(":") + 1]
+                + arr_json
+                + data_txt[i + len(hole) :]
+            )
+            return '{"meta":' + meta_txt + ',"data":' + data_txt + "}"
     return json.dumps(payload_to_dict(payload), separators=(",", ":"))
 
 

@@ -69,6 +69,13 @@ def parse_dense(fragment: bytes) -> tuple[np.ndarray, int] | None:
         return None
     arr = out[:n]
     if ndim.value == 2:
+        # The C parser can report a 2-D shape whose product disagrees with
+        # the value count for mixed-depth content like [1.0,[2.0],[3.0]]
+        # (scalars at depth 1 counted into n but not into rows*cols).  Such
+        # input is not a dense matrix — fall back to the Python decoder
+        # instead of raising from reshape.
+        if n != shape[0] * shape[1]:
+            return None
         arr = arr.reshape(shape[0], shape[1])
     return arr.copy(), consumed.value
 

@@ -157,10 +157,12 @@ class GatewayApp:
             return _error(503, "gateway is paused")
         start = time.perf_counter()
         principal = "anonymous"
+        deployment_name = "unknown"
         code = 200
         try:
             rec = self._principal(request)
             principal = rec.oauth_key
+            deployment_name = rec.name
             raw = await request.read()
             try:
                 body = json.loads(raw)  # validate only; forward untouched
@@ -183,7 +185,7 @@ class GatewayApp:
         finally:
             self.metrics.ingress_requests.labels(
                 principal,
-                principal,
+                deployment_name,
                 service,
                 "POST",
                 str(code),

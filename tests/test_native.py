@@ -43,6 +43,11 @@ class TestParseDense:
     def test_deep_nesting_falls_back(self):
         assert native.parse_dense(b"[[[1]]]") is None
 
+    def test_mixed_depth_falls_back(self):
+        # scalars at depth 1 mixed with inner rows: not a dense matrix; must
+        # fall back, not crash in reshape (n != rows*cols)
+        assert native.parse_dense(b"[1.0,[2.0,3.0],[4.0,5.0]]") is None
+
     def test_consumed_stops_at_bracket(self):
         arr, consumed = native.parse_dense(b'[[1,2]],"names":[]')
         assert consumed == len(b"[[1,2]]")
@@ -110,3 +115,43 @@ class TestFastJsonPaths:
     def test_small_payloads_use_python_path(self):
         out = payload_from_json('{"data":{"ndarray":[[1.0,2.0]]}}')
         np.testing.assert_allclose(out.array, [[1.0, 2.0]])
+
+    def test_mixed_depth_wire_input_does_not_crash(self):
+        # >=512-byte malformed ndarray body: the native parser must decline
+        # so the Python decoder handles it (object array), never ValueError
+        rows = ",".join("[2.0,3.0]" for _ in range(100))
+        raw = '{"data":{"ndarray":[1.0,%s]}}' % rows
+        assert len(raw) >= 512
+        out = payload_from_json(raw)
+        assert out.kind == DataKind.NDARRAY
+        assert out.array.dtype == object
+
+    def test_meta_tag_named_ndarray_does_not_steal_splice(self):
+        # a user meta tag literally keyed "ndarray" with null value must not
+        # receive the spliced array (meta serializes before data)
+        arr = np.random.default_rng(3).normal(size=(64, 16))
+        p = Payload.from_array(arr)
+        p.meta.tags["ndarray"] = None
+        out = json.loads(payload_to_json(p))
+        assert out["meta"]["tags"]["ndarray"] is None
+        np.testing.assert_allclose(out["data"]["ndarray"], arr.tolist())
+
+    def test_nonstring_names_entry_does_not_steal_splice(self):
+        # wire clients may smuggle arbitrary JSON into names; a names entry
+        # {"ndarray": null} must not receive the spliced array
+        arr = np.random.default_rng(5).normal(size=(64, 16))
+        p = Payload.from_array(arr)
+        p.names = [{"ndarray": None}]
+        out = json.loads(payload_to_json(p))
+        assert out["data"]["names"] == [{"ndarray": None}]
+        np.testing.assert_allclose(out["data"]["ndarray"], arr.tolist())
+
+    def test_meta_tag_named_values_does_not_steal_tensor_splice(self):
+        arr = np.random.default_rng(4).normal(size=(32, 16))
+        p = Payload.from_array(arr, kind=DataKind.TENSOR)
+        p.meta.tags["values"] = None
+        out = json.loads(payload_to_json(p))
+        assert out["meta"]["tags"]["values"] is None
+        np.testing.assert_allclose(
+            np.asarray(out["data"]["tensor"]["values"]).reshape(32, 16), arr
+        )
