@@ -14,6 +14,14 @@ wrappers/python/model_microservice.py:40-84).  Here the execution plane is:
 
 from seldon_core_tpu.executor.compiled import BucketSpec, CompiledModel
 from seldon_core_tpu.executor.batcher import BatchQueue
+from seldon_core_tpu.executor.checkpoint import load_params, save_params
 from seldon_core_tpu.executor.component import JaxModelComponent
 
-__all__ = ["BucketSpec", "CompiledModel", "BatchQueue", "JaxModelComponent"]
+__all__ = [
+    "BucketSpec",
+    "CompiledModel",
+    "BatchQueue",
+    "JaxModelComponent",
+    "load_params",
+    "save_params",
+]

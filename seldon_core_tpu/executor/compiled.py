@@ -173,3 +173,9 @@ class CompiledModel:
         """Lower (without executing) the largest bucket — for compile checks."""
         x = jax.ShapeDtypeStruct((self.buckets.max,) + tuple(feature_shape), dtype)
         return self._jitted.lower(self.params, x)
+
+    def save_checkpoint(self, path: str) -> int:
+        """Persist params (gathering sharded leaves); returns leaf count."""
+        from seldon_core_tpu.executor.checkpoint import save_params
+
+        return save_params(path, self.params)

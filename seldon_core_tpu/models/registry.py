@@ -100,11 +100,25 @@ def build_compiled(
     dtype: Any = None,
     buckets: BucketSpec = BucketSpec(),
     params: Any = None,
+    checkpoint: str | None = None,
     **overrides,
 ) -> CompiledModel:
     fam = get_family(family)
     if cfg is None:
         cfg = resolve_config(family, preset, **overrides)
+    elif overrides:
+        # an explicit cfg leaves nothing for overrides to apply to; silently
+        # dropping them would hide typo'd graph parameters
+        raise TypeError(
+            f"unknown JAX_MODEL parameters {sorted(overrides)} for family "
+            f"{family!r} (config fields: "
+            f"{sorted(f.name for f in dataclasses.fields(fam.config_cls))})"
+        )
+    if params is None and checkpoint is not None:
+        from seldon_core_tpu.executor.checkpoint import load_params
+
+        # host arrays; CompiledModel casts/shards them at construction
+        params = load_params(checkpoint)
     if params is None:
         params = fam.init_params(jax.random.PRNGKey(rng), cfg)
     apply_fn = lambda p, x: fam.apply(p, x, cfg)  # noqa: E731
@@ -122,19 +136,33 @@ def build_compiled(
 def build_component(
     family: str,
     *,
+    preset: str | None = None,
+    cfg: Any = None,
     class_names: list[str] | None = None,
     batching: bool = True,
     max_batch: int = 64,
     max_delay_ms: float = 2.0,
     **kwargs,
 ) -> JaxModelComponent:
-    model = build_compiled(family, **kwargs)
+    if cfg is None:
+        # resolve here (not inside build_compiled) so the warmup example can
+        # be derived from the same config
+        overrides = {
+            k: kwargs.pop(k)
+            for k in list(kwargs)
+            if k in {f.name for f in dataclasses.fields(get_family(family).config_cls)}
+        }
+        cfg = resolve_config(family, preset, **overrides)
+    # leftover kwargs must be real build_compiled options; anything unknown
+    # (e.g. a typo'd config field) fails loudly in build_compiled
+    model = build_compiled(family, preset=preset, cfg=cfg, **kwargs)
     return JaxModelComponent(
         model,
         class_names=class_names,
         batching=batching,
         max_batch=max_batch,
         max_delay_ms=max_delay_ms,
+        warmup_example=example_input(family, cfg, 1),
     )
 
 
