@@ -45,6 +45,13 @@ class EngineApp:
         self.service = service
         self.paused = False
         self.metrics = service.metrics
+        # readiness gates on warmup: every JAX unit's bucket ladder must be
+        # compiled before /ready flips true, so the first real request never
+        # pays an XLA compile (the reference's unwarmed engine shows a
+        # 5,071 ms max-latency spike, docs/benchmarking.md:42-45)
+        self.warmed = False
+        self._warmup_error: BaseException | None = None
+        self._warmup_task: asyncio.Task | None = None
 
     def build(self) -> web.Application:
         app = web.Application(client_max_size=256 * 1024 * 1024)
@@ -67,8 +74,27 @@ class EngineApp:
 
     async def _startup(self, app: web.Application) -> None:
         await self.service.start()
+        if os.environ.get("ENGINE_WARMUP", "1") == "0" or not self.service.warmable_units():
+            self.warmed = True
+        else:
+            # warm in the background so liveness (/ping) answers while the
+            # compiles run; /ready stays 503 until every bucket is compiled
+            self._warmup_task = asyncio.create_task(self._warm())
+
+    async def _warm(self) -> None:
+        try:
+            report = await self.service.warmup()
+            log.info("warmup complete: %s", report)
+            self.warmed = True
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:
+            self._warmup_error = e
+            log.exception("warmup failed; readiness stays false")
 
     async def _cleanup(self, app: web.Application) -> None:
+        if self._warmup_task is not None and not self._warmup_task.done():
+            self._warmup_task.cancel()
         await self.service.close()
 
     # -- handlers ---------------------------------------------------------
@@ -125,6 +151,12 @@ class EngineApp:
     async def ready(self, request: web.Request) -> web.Response:
         if self.paused:
             return web.Response(text="paused", status=503)
+        if not self.warmed:
+            if self._warmup_error is not None:
+                return web.Response(
+                    text=f"warmup failed: {self._warmup_error}", status=503
+                )
+            return web.Response(text="warming", status=503)
         return web.Response(text="ready")
 
     async def pause(self, request: web.Request) -> web.Response:
@@ -153,22 +185,38 @@ def main(argv: list[str] | None = None) -> None:
     engine = EngineApp(service)
     app = engine.build()
 
+    app.on_startup.append(make_grpc_startup(service, args.grpc_port))
+    app.on_cleanup.append(_grpc_cleanup)
+    web.run_app(app, port=args.port, access_log=None)
+
+
+def make_grpc_startup(service: PredictionService, grpc_port: int):
+    """aiohttp startup hook co-starting the gRPC server.
+
+    A gRPC boot failure FAILS the whole process (a gRPC-only client must not
+    see silent connection refusals from a pod that reports ready); set
+    ``ENGINE_GRPC_OPTIONAL=1`` to serve REST-only instead.
+    """
+
     async def _start_grpc(app_: web.Application) -> None:
         try:
             from seldon_core_tpu.engine.grpc_app import start_engine_grpc
 
-            app_["grpc_server"] = await start_engine_grpc(service, args.grpc_port)
-        except Exception as e:  # pragma: no cover - grpc optional at boot
-            log.warning("gRPC server not started: %s", e)
+            app_["grpc_server"] = await start_engine_grpc(service, grpc_port)
+        except Exception as e:
+            if os.environ.get("ENGINE_GRPC_OPTIONAL") == "1":
+                log.warning("gRPC server not started (optional): %s", e)
+                return
+            log.error("gRPC server failed to start on :%d: %s", grpc_port, e)
+            raise
 
-    async def _stop_grpc(app_: web.Application) -> None:
-        server = app_.get("grpc_server")
-        if server is not None:
-            await server.stop(grace=5)
+    return _start_grpc
 
-    app.on_startup.append(_start_grpc)
-    app.on_cleanup.append(_stop_grpc)
-    web.run_app(app, port=args.port, access_log=None)
+
+async def _grpc_cleanup(app_: web.Application) -> None:
+    server = app_.get("grpc_server")
+    if server is not None:
+        await server.stop(grace=5)
 
 
 if __name__ == "__main__":

@@ -20,7 +20,12 @@ from seldon_core_tpu.contract import (
 )
 from seldon_core_tpu.engine.service import PredictionService
 from seldon_core_tpu.proto import prediction_pb2 as pb
-from seldon_core_tpu.proto.grpc_defs import SERVER_OPTIONS, add_service, unary_guard
+from seldon_core_tpu.proto.grpc_defs import (
+    SERVER_OPTIONS,
+    add_service,
+    bind_insecure_port,
+    unary_guard,
+)
 
 log = logging.getLogger(__name__)
 
@@ -47,7 +52,7 @@ async def start_engine_grpc(service: PredictionService, port: int) -> grpc.aio.S
     server = grpc.aio.server(options=SERVER_OPTIONS)
     handler = SeldonGrpc(service)
     add_service(server, "Seldon", {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback})
-    bound = server.add_insecure_port(f"[::]:{port}")
+    bound = await bind_insecure_port(server, port)
     await server.start()
     server.bound_port = bound  # real port when asked for :0 (tests)
     log.info("engine gRPC (Seldon service) on :%d", bound)

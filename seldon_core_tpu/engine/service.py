@@ -73,6 +73,7 @@ class PredictionService:
         self.transports = TransportManager(timeout_s=transport_timeout_s)
         self._components = components or {}
         self.walker: GraphWalker | None = None
+        self.warmup_report: dict[str, int] | None = None
 
     async def start(self) -> None:
         await self.transports.start()
@@ -82,6 +83,16 @@ class PredictionService:
             client_factory=self.transports.client_factory,
             feedback_hook=self._on_feedback,
         )
+
+    def warmable_units(self) -> list[str]:
+        assert self.walker is not None, "PredictionService.start() not called"
+        return self.walker.warmable_units()
+
+    async def warmup(self) -> dict[str, int]:
+        """Compile every JAX unit's bucket ladder; readiness gates on this."""
+        assert self.walker is not None, "PredictionService.start() not called"
+        self.warmup_report = await self.walker.warmup()
+        return self.warmup_report
 
     async def close(self) -> None:
         if self.walker is not None:

@@ -27,8 +27,10 @@ class JaxModelComponent(SeldonComponent):
         batching: bool = True,
         max_batch: int = 64,
         max_delay_ms: float = 2.0,
+        warmup_example: np.ndarray | None = None,
     ):
         self.model = model
+        self.warmup_example = warmup_example
         if class_names is not None:
             self.class_names = class_names
         self._queue = (
@@ -36,6 +38,17 @@ class JaxModelComponent(SeldonComponent):
             if batching
             else None
         )
+
+    def warmup(self) -> int:
+        """Pre-compile every batch bucket; returns the program count.
+
+        Serving gates readiness on this (reference's unwarmed engine shows a
+        5,071 ms max-latency first-request spike, docs/benchmarking.md:42-45).
+        """
+        if self.warmup_example is None:
+            return 0
+        ex = np.asarray(self.warmup_example)
+        return self.model.warmup(ex.shape[1:], ex.dtype)
 
     async def predict(self, X: np.ndarray, names: list[str]) -> np.ndarray:
         if self._queue is not None:

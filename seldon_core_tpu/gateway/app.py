@@ -263,8 +263,14 @@ def main(argv: list[str] | None = None) -> None:
             from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
 
             app_["grpc_server"] = await start_gateway_grpc(gateway, args.grpc_port)
-        except Exception as e:  # pragma: no cover - grpc optional at boot
-            log.warning("gateway gRPC not started: %s", e)
+        except Exception as e:
+            # strict boot: a gRPC-only client must not see silent connection
+            # refusals from a gateway that reports ready
+            if os.environ.get("GATEWAY_GRPC_OPTIONAL") == "1":
+                log.warning("gateway gRPC not started (optional): %s", e)
+                return
+            log.error("gateway gRPC failed to start on :%d: %s", args.grpc_port, e)
+            raise
 
     async def _stop_grpc(app_: web.Application) -> None:
         server = app_.get("grpc_server")

@@ -24,6 +24,7 @@ from seldon_core_tpu.proto.grpc_defs import (
     SERVER_OPTIONS,
     Stub,
     add_service,
+    bind_insecure_port,
     failure_message,
 )
 
@@ -102,7 +103,7 @@ async def start_gateway_grpc(gateway, port: int) -> grpc.aio.Server:
     server = grpc.aio.server(options=SERVER_OPTIONS)
     handler = GatewayGrpc(gateway, loop=asyncio.get_running_loop())
     add_service(server, "Seldon", {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback})
-    bound = server.add_insecure_port(f"[::]:{port}")
+    bound = await bind_insecure_port(server, port)
     await server.start()
     server.bound_port = bound
     server.gateway_handler = handler  # for lifecycle access

@@ -236,6 +236,35 @@ class GraphWalker:
         children = [self._build(c) for c in spec.children]
         return _NodeState(spec, client, children)
 
+    def iter_components(self):
+        """Yield ``(unit_name, component)`` for every in-process node."""
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            comp = getattr(node.client, "component", None)
+            if comp is not None:
+                yield node.spec.name, comp
+            stack.extend(node.children)
+
+    def warmable_units(self) -> list[str]:
+        return [
+            name
+            for name, comp in self.iter_components()
+            if callable(getattr(comp, "warmup", None))
+        ]
+
+    async def warmup(self) -> dict[str, int]:
+        """Pre-compile every JAX unit's bucket ladder off the event loop;
+        returns unit name -> programs compiled.  Serving flips readiness only
+        after this completes (the reference warms nothing and eats a 5s
+        first-request compile spike, docs/benchmarking.md:42-45)."""
+        report: dict[str, int] = {}
+        for name, comp in self.iter_components():
+            fn = getattr(comp, "warmup", None)
+            if callable(fn):
+                report[name] = await asyncio.to_thread(fn)
+        return report
+
     async def aclose(self) -> None:
         """Close components that hold resources (e.g. JAX_MODEL units own a
         batching queue + runner threads)."""

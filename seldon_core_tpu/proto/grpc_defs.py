@@ -25,7 +25,24 @@ MAX_MSG = 256 * 1024 * 1024
 SERVER_OPTIONS = [
     ("grpc.max_receive_message_length", MAX_MSG),
     ("grpc.max_send_message_length", MAX_MSG),
+    # without this, two servers can silently share a port on Linux and a
+    # bind conflict at boot goes undetected (strict-boot contract)
+    ("grpc.so_reuseport", 0),
 ]
+
+
+async def bind_insecure_port(server: "grpc.aio.Server", port: int) -> int:
+    """Bind ``[::]:port``; raise (never return 0) on failure.
+
+    Newer grpcio raises from ``add_insecure_port`` itself; older versions
+    return 0.  Either way a failed bind must fail boot loudly — a gRPC-only
+    client must not see silent connection refusals from a ready pod.
+    """
+    bound = server.add_insecure_port(f"[::]:{port}")
+    if bound == 0:
+        await server.stop(0)
+        raise RuntimeError(f"could not bind gRPC port {port}")
+    return bound
 
 _SM = pb.SeldonMessage
 _FB = pb.Feedback

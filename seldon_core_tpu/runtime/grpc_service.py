@@ -29,6 +29,7 @@ from seldon_core_tpu.proto import prediction_pb2 as pb
 from seldon_core_tpu.proto.grpc_defs import (
     SERVER_OPTIONS,
     add_service,
+    bind_insecure_port,
     failure_message,
     unary_guard,
 )
@@ -121,7 +122,7 @@ async def start_grpc(
 ) -> grpc.aio.Server:
     server = grpc.aio.server(options=SERVER_OPTIONS)
     register(server, ComponentGrpc(component, name=name, service_type=service_type))
-    bound = server.add_insecure_port(f"[::]:{port}")
+    bound = await bind_insecure_port(server, port)
     await server.start()
     server.bound_port = bound  # real port when asked for :0 (tests)
     log.info("microservice gRPC server on :%d (%s %s)", bound, name, service_type)
@@ -129,10 +130,30 @@ async def start_grpc(
 
 
 def serve_grpc(component: Any, port: int, name: str = "model", service_type: str = "MODEL") -> None:
-    """Blocking entry used by the microservice CLI."""
+    """Blocking entry used by the microservice CLI.
+
+    SIGTERM/SIGINT trigger a graceful stop and a *normal* interpreter exit so
+    atexit hooks (the persistence final flush, runtime/persistence.py) run —
+    bare ``asyncio.run`` would die in the default SIGTERM handler and lose
+    up to a full persistence interval of state.
+    """
 
     async def main() -> None:
+        import signal
+
         server = await start_grpc(component, port, name=name, service_type=service_type)
-        await server.wait_for_termination()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except (NotImplementedError, RuntimeError):  # non-main thread
+                pass
+        stop_wait = asyncio.ensure_future(stop.wait())
+        term_wait = asyncio.ensure_future(server.wait_for_termination())
+        await asyncio.wait({stop_wait, term_wait}, return_when=asyncio.FIRST_COMPLETED)
+        stop_wait.cancel()
+        term_wait.cancel()
+        await server.stop(grace=5)
 
     asyncio.run(main())

@@ -160,6 +160,135 @@ class TestEngineRest:
         run(go())
 
 
+class TestWarmupReadiness:
+    """Readiness gates on XLA warmup (round-2 item #7): /ready stays 503
+    until every JAX unit's bucket ladder is compiled."""
+
+    JAX_PREDICTOR = {
+        "name": "warm",
+        "graph": {
+            "name": "m",
+            "type": "MODEL",
+            "implementation": "JAX_MODEL",
+            "parameters": [
+                {"name": "family", "value": "mlp", "type": "STRING"},
+                {"name": "preset", "value": "tiny", "type": "STRING"},
+            ],
+        },
+    }
+
+    def test_ready_flips_after_warmup(self):
+        async def go():
+            service = PredictionService(PredictorSpec.model_validate(self.JAX_PREDICTOR))
+            app = EngineApp(service).build()
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                deadline = asyncio.get_event_loop().time() + 120
+                status = None
+                while asyncio.get_event_loop().time() < deadline:
+                    status = (await client.get("/ready")).status
+                    if status == 200:
+                        break
+                    await asyncio.sleep(0.1)
+                assert status == 200, "never became ready"
+                # every bucket of the JAX unit was compiled before ready
+                assert service.warmup_report is not None
+                model = service.walker.root.client.component.model
+                assert service.warmup_report["m"] == len(model.buckets.sizes)
+                resp = await client.post(
+                    "/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[0.0] * 16]}},
+                )
+                assert resp.status == 200
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_simple_graph_ready_immediately(self):
+        async def go():
+            client = await _engine_client(default_predictor())
+            try:
+                # no JAX units -> warmed synchronously at startup
+                assert (await client.get("/ready")).status == 200
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_warmup_disabled_by_env(self, monkeypatch=None):
+        import os
+        import unittest.mock as mock
+
+        async def go():
+            with mock.patch.dict(os.environ, {"ENGINE_WARMUP": "0"}):
+                service = PredictionService(PredictorSpec.model_validate(self.JAX_PREDICTOR))
+                app = EngineApp(service).build()
+                client = TestClient(TestServer(app))
+                await client.start_server()
+                try:
+                    assert (await client.get("/ready")).status == 200
+                    assert service.warmup_report is None
+                finally:
+                    await client.close()
+
+        run(go())
+
+
+class TestStrictGrpcBoot:
+    def test_grpc_bind_conflict_fails_boot(self):
+        from seldon_core_tpu.engine.app import make_grpc_startup
+        from seldon_core_tpu.engine.grpc_app import start_engine_grpc
+
+        async def go():
+            service = PredictionService(default_predictor())
+            first = await start_engine_grpc(service, 0)
+            port = first.bound_port
+            try:
+                service2 = PredictionService(default_predictor())
+                app = EngineApp(service2).build()
+                app.on_startup.append(make_grpc_startup(service2, port))
+                client = TestClient(TestServer(app))
+                import pytest as _pytest
+
+                # grpc's own bind error or our bound==0 guard, depending on
+                # grpcio version — either way boot must fail loudly
+                with _pytest.raises(RuntimeError, match="bind"):
+                    await client.start_server()
+                await client.close()
+            finally:
+                await first.stop(grace=0)
+
+        run(go())
+
+    def test_grpc_optional_env_serves_rest_only(self):
+        import os
+        import unittest.mock as mock
+
+        from seldon_core_tpu.engine.app import make_grpc_startup
+        from seldon_core_tpu.engine.grpc_app import start_engine_grpc
+
+        async def go():
+            service = PredictionService(default_predictor())
+            first = await start_engine_grpc(service, 0)
+            port = first.bound_port
+            try:
+                with mock.patch.dict(os.environ, {"ENGINE_GRPC_OPTIONAL": "1"}):
+                    service2 = PredictionService(default_predictor())
+                    app = EngineApp(service2).build()
+                    app.on_startup.append(make_grpc_startup(service2, port))
+                    client = TestClient(TestServer(app))
+                    await client.start_server()
+                    resp = await client.post("/api/v0.1/predictions", json=REQ)
+                    assert resp.status == 200
+                    await client.close()
+            finally:
+                await first.stop(grace=0)
+
+        run(go())
+
+
 class TestCrossServiceGraph:
     """Engine orchestrating a remote REST microservice — process boundary #2
     of the reference hot path (SURVEY §3.1) exercised in-process."""
