@@ -1,0 +1,513 @@
+"""Generative serving: slot-based continuous batching over compiled
+prefill/decode programs.
+
+The reference has no generative path at all (its tensors are 2-D
+batch×features, reference: engine/.../predictors/AverageCombinerUnit.java:47-49);
+this is the TPU-native capability the BASELINE Llama configs require.
+
+Design (vLLM-style slots, XLA-flavored):
+
+* a persistent KV cache holds ``n_slots`` independent sequences
+  (``models/llama.py::init_slot_cache``), each with its own position;
+* **admission** prefills one request's prompt into a free slot — prompts are
+  right-padded to a power-of-two bucket so there is one compiled prefill
+  program per bucket, never per length;
+* **decode** advances ALL active slots one token per device step with a
+  single compiled program (static shapes, per-slot position masks) — new
+  requests join between steps without stalling in-flight ones;
+* sampling happens on device (``sample_tokens``): only ``(S,)`` token ids
+  cross the host boundary per step, never ``(S, vocab)`` logits.
+
+``GenerationScheduler`` is the asyncio front: ``submit(prompt) ->
+generated ids``; per-request ``max_new_tokens`` / ``temperature`` /
+``eos_id``.  ``GenerativeComponent`` adapts it to the graph-unit contract so
+an inference graph can contain a generative node (implementation
+``JAX_GENERATIVE``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import threading
+from typing import Any
+
+import jax
+import numpy as np
+
+from seldon_core_tpu.graph.units import GraphUnitError, SeldonComponent
+from seldon_core_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    ShardingRules,
+    shard_params,
+)
+
+log = logging.getLogger(__name__)
+
+
+def _prefill_buckets(max_seq: int, smallest: int = 16) -> tuple[int, ...]:
+    sizes = []
+    b = smallest
+    while b < max_seq:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_seq)
+    return tuple(sizes)
+
+
+class GenerativeModel:
+    """Compiled slot-cache generation engine for one decoder family.
+
+    Cache buffers are donated to each step, so calls must never interleave;
+    an internal lock serializes them (the scheduler already serializes its
+    own calls, but warmup may overlap traffic that arrives before /ready).
+
+    ``family_mod`` must expose ``init_slot_cache / prefill_slot /
+    decode_slots / sample_tokens`` (``models/llama.py`` does).
+    """
+
+    def __init__(
+        self,
+        cfg: Any,
+        params: Any,
+        *,
+        family_mod: Any = None,
+        n_slots: int = 4,
+        mesh: Any = None,
+        rules: ShardingRules = DEFAULT_RULES,
+        param_axes: Any = None,
+        dtype: Any = None,
+        seq_impl: str = "dense",
+        name: str = "generative",
+    ):
+        if family_mod is None:
+            from seldon_core_tpu.models import llama as family_mod
+        if int(n_slots) < 1:
+            # a zero-slot scheduler would park every request forever
+            raise GraphUnitError(f"n_slots must be >= 1, got {n_slots}")
+        self.family = family_mod
+        self.cfg = cfg
+        self.n_slots = int(n_slots)
+        self.name = name
+        self.mesh = mesh
+
+        if dtype is not None:
+            import jax.numpy as jnp
+
+            def _cast(p):
+                dt = getattr(p, "dtype", None) or np.asarray(p).dtype
+                return p.astype(dtype) if jnp.issubdtype(dt, jnp.floating) else p
+
+            params = jax.tree.map(_cast, params)
+        if mesh is not None:
+            if param_axes is not None:
+                params = shard_params(params, mesh, param_axes, rules)
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                params = jax.device_put(params, NamedSharding(mesh, P()))
+        else:
+            params = jax.device_put(params)
+        self.params = params
+
+        cache_dtype = dtype if dtype is not None else np.float32
+        cache = family_mod.init_slot_cache(cfg, self.n_slots, dtype=cache_dtype)
+        if mesh is not None:
+            # KV heads ride the tp axis like the attention weights; slots and
+            # sequence stay local (decode is latency-, not FLOP-bound)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            kv_sh = NamedSharding(mesh, P(None, None, None, "tp", None))
+            cache = {
+                "k": jax.device_put(cache["k"], kv_sh),
+                "v": jax.device_put(cache["v"], kv_sh),
+                "pos": jax.device_put(cache["pos"], NamedSharding(mesh, P())),
+            }
+        self._cache = cache
+        self.prefill_buckets = _prefill_buckets(cfg.max_seq)
+
+        fam = family_mod
+
+        def _prefill(params, tokens, length, slot, temperature, seed, cache):
+            logits, cache = fam.prefill_slot(
+                params, tokens, length, slot, cache, cfg, mesh=mesh, seq_impl=seq_impl
+            )
+            key = jax.random.PRNGKey(seed)
+            tok = fam.sample_tokens(logits[None], temperature[None], key)[0]
+            return tok, cache
+
+        def _decode(params, tokens, active, temperature, seed, cache):
+            logits, cache = fam.decode_slots(params, tokens, cache, active, cfg)
+            key = jax.random.PRNGKey(seed)
+            toks = fam.sample_tokens(logits, temperature, key)
+            return toks, cache
+
+        # cache buffers are donated: each step reuses the previous buffers
+        # in place instead of holding two live copies of a multi-GB cache
+        self._prefill = jax.jit(_prefill, donate_argnums=(6,))
+        self._decode = jax.jit(_decode, donate_argnums=(5,))
+
+        # observability
+        self.steps = 0
+        self.prefills = 0
+        # RLock: warmup calls admit/step under the same lock
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------ ops
+
+    def fit_bucket(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        raise GraphUnitError(
+            f"prompt length {n} exceeds max_seq {self.cfg.max_seq}"
+        )
+
+    def admit(self, slot: int, prompt: np.ndarray, temperature: float, seed: int) -> int:
+        """Prefill ``prompt`` (1-D int ids) into ``slot``; returns the first
+        sampled token."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        L = prompt.shape[0]
+        if L < 1:
+            raise GraphUnitError("empty prompt")
+        bucket = self.fit_bucket(L)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :L] = prompt
+        with self._lock:
+            tok, self._cache = self._prefill(
+                self.params,
+                padded,
+                np.int32(L),
+                np.int32(slot),
+                np.float32(temperature),
+                np.int32(seed),
+                self._cache,
+            )
+            self.prefills += 1
+        return int(tok)
+
+    def step(
+        self,
+        tokens: np.ndarray,
+        active: np.ndarray,
+        temperature: np.ndarray,
+        seed: int,
+    ) -> np.ndarray:
+        """One decode step for all slots -> next token per slot (S,)."""
+        with self._lock:
+            toks, self._cache = self._decode(
+                self.params,
+                np.asarray(tokens, np.int32),
+                np.asarray(active, bool),
+                np.asarray(temperature, np.float32),
+                np.int32(seed),
+                self._cache,
+            )
+            self.steps += 1
+        return np.asarray(jax.device_get(toks))
+
+    def warmup(self) -> int:
+        """Compile the decode program and every prefill bucket.
+
+        Held under the model lock end-to-end: traffic that sneaks in before
+        readiness flips serializes against the warmup compiles instead of
+        racing the donated cache buffers.  If any request already touched the
+        cache (traffic hit an unready pod directly), warmup no-ops — it works
+        through slot 0 and a position reset, which would corrupt an in-flight
+        generation; the programs compile organically in that case.
+        """
+        with self._lock:
+            if self.prefills or self.steps:
+                return 0
+            n = 0
+            for b in self.prefill_buckets:
+                self.admit(0, np.ones(b, np.int32), 0.0, 0)
+                n += 1
+            self.step(
+                np.zeros(self.n_slots, np.int32),
+                np.zeros(self.n_slots, bool),
+                np.zeros(self.n_slots, np.float32),
+                0,
+            )
+            n += 1
+            # warmup wrote garbage into slot 0 and advanced nothing real
+            self.reset()
+            return n
+
+    def reset(self) -> None:
+        """Zero every slot position (cache contents become unreachable)."""
+        with self._lock:
+            zero = jax.device_put(
+                np.zeros(self.n_slots, np.int32), self._cache["pos"].sharding
+            )
+            self._cache = {**self._cache, "pos": zero}
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float
+    eos_id: int | None
+    future: asyncio.Future
+    out: list[int] = dataclasses.field(default_factory=list)
+
+
+class GenerationScheduler:
+    """Continuous-batching front: admits requests into free slots while
+    decode steps keep running for in-flight ones."""
+
+    def __init__(self, model: GenerativeModel):
+        self.model = model
+        self._queue: asyncio.Queue[_Request] = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+        self._closed = False
+        self._seed = 0
+
+    def _next_seed(self) -> int:
+        self._seed = (self._seed + 1) % (2**31 - 1)
+        return self._seed
+
+    async def submit(
+        self,
+        prompt: np.ndarray,
+        *,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        eos_id: int | None = None,
+    ) -> np.ndarray:
+        """Generate up to ``max_new_tokens`` ids for a 1-D prompt."""
+        if self._closed:
+            raise RuntimeError("GenerationScheduler is closed")
+        prompt = np.asarray(prompt, np.int32).ravel()
+        if prompt.size < 1:
+            raise GraphUnitError("empty prompt")
+        if prompt.size >= self.model.cfg.max_seq:
+            raise GraphUnitError(
+                f"prompt length {prompt.size} must be < max_seq "
+                f"{self.model.cfg.max_seq}"
+            )
+        if max_new_tokens < 1:
+            return np.zeros(0, np.int32)
+        # the cache cannot grow past max_seq
+        max_new_tokens = min(
+            int(max_new_tokens), self.model.cfg.max_seq - int(prompt.size)
+        )
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put(
+            _Request(prompt, max_new_tokens, float(temperature), eos_id, fut)
+        )
+        return await fut
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        err = RuntimeError("GenerationScheduler closed")
+        while not self._queue.empty():
+            req = self._queue.get_nowait()
+            if not req.future.done():
+                req.future.set_exception(err)
+
+    # ---------------------------------------------------------------- loop
+
+    def _complete(self, req: _Request) -> None:
+        if not req.future.done():
+            req.future.set_result(np.asarray(req.out, np.int32))
+
+    def _token_done(self, req: _Request, tok: int) -> bool:
+        req.out.append(tok)
+        if req.eos_id is not None and tok == req.eos_id:
+            return True
+        return len(req.out) >= req.max_new_tokens
+
+    async def _run(self) -> None:
+        S = self.model.n_slots
+        slots: list[_Request | None] = [None] * S
+        cur = np.zeros(S, np.int32)
+        temps = np.zeros(S, np.float32)
+        active = np.zeros(S, bool)
+        try:
+            while True:
+                if not active.any():
+                    # fully idle: park on the queue
+                    first = await self._queue.get()
+                    await self._admit(first, slots, cur, temps, active)
+                # admit whatever else is waiting into remaining free slots
+                while not self._queue.empty() and not active.all():
+                    await self._admit(
+                        self._queue.get_nowait(), slots, cur, temps, active
+                    )
+                if not active.any():
+                    continue
+                seed = self._next_seed()
+                try:
+                    toks = await asyncio.to_thread(
+                        self.model.step, cur, active, temps, seed
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    # a failed device step poisons every in-flight request
+                    for i in range(S):
+                        if slots[i] is not None and not slots[i].future.done():
+                            slots[i].future.set_exception(exc)
+                        slots[i] = None
+                    active[:] = False
+                    continue
+                for i in range(S):
+                    if not active[i]:
+                        continue
+                    req = slots[i]
+                    tok = int(toks[i])
+                    cur[i] = tok
+                    if self._token_done(req, tok):
+                        self._complete(req)
+                        slots[i] = None
+                        active[i] = False
+        except asyncio.CancelledError:
+            err = RuntimeError("GenerationScheduler closed")
+            for req in slots:
+                if req is not None and not req.future.done():
+                    req.future.set_exception(err)
+            raise
+
+    async def _admit(self, req, slots, cur, temps, active) -> None:
+        slot = next(i for i in range(len(slots)) if not active[i])
+        try:
+            tok = await asyncio.to_thread(
+                self.model.admit, slot, req.prompt, req.temperature, self._next_seed()
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            if not req.future.done():
+                req.future.set_exception(exc)
+            return
+        if self._token_done(req, int(tok)):
+            self._complete(req)
+            return
+        slots[slot] = req
+        cur[slot] = tok
+        temps[slot] = req.temperature
+        active[slot] = True
+
+
+PAD_ID = -1  # right-pad for ragged generated rows in dense responses
+
+
+class GenerativeComponent(SeldonComponent):
+    """Graph unit serving a generative decoder.
+
+    Wire contract (MODEL unit, ``predict``):
+
+    * ``data.ndarray`` (B, L) int token ids -> (B, <=max_new) generated ids,
+      rows right-padded with ``-1`` where EOS ended a row early;
+    * ``strData`` JSON ``{"tokens": [[...], ...] | [...],
+      "max_new_tokens": N, "temperature": t, "eos_id": e}`` ->
+      ``strData`` JSON ``{"tokens": [[...], ...]}`` — per-request options.
+    """
+
+    def __init__(
+        self,
+        model: GenerativeModel,
+        *,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        eos_id: int | None = None,
+    ):
+        self.model = model
+        self.scheduler = GenerationScheduler(model)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+
+    def warmup(self) -> int:
+        return self.model.warmup()
+
+    async def close(self) -> None:
+        await self.scheduler.close()
+
+    def metrics(self) -> list[dict[str, Any]]:
+        return [
+            {"key": f"{self.model.name}_decode_steps", "type": "GAUGE", "value": self.model.steps},
+            {"key": f"{self.model.name}_prefills", "type": "GAUGE", "value": self.model.prefills},
+        ]
+
+    async def _generate_rows(
+        self,
+        rows: list[np.ndarray],
+        max_new_tokens: int,
+        temperature: float,
+        eos_id: int | None,
+    ) -> list[np.ndarray]:
+        return list(
+            await asyncio.gather(
+                *(
+                    self.scheduler.submit(
+                        row,
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature,
+                        eos_id=eos_id,
+                    )
+                    for row in rows
+                )
+            )
+        )
+
+    @staticmethod
+    def _pad_rows(outs: list[np.ndarray]) -> np.ndarray:
+        width = max((o.size for o in outs), default=0)
+        dense = np.full((len(outs), width), PAD_ID, np.int32)
+        for i, o in enumerate(outs):
+            dense[i, : o.size] = o
+        return dense
+
+    async def predict(self, X: np.ndarray, names: list[str]) -> np.ndarray:
+        X = np.atleast_2d(np.asarray(X))
+        if not np.issubdtype(X.dtype, np.integer):
+            if not np.all(np.equal(np.mod(X, 1), 0)):
+                raise GraphUnitError("generative input must be integer token ids")
+            X = X.astype(np.int32)
+        outs = await self._generate_rows(
+            [row for row in X], self.max_new_tokens, self.temperature, self.eos_id
+        )
+        return self._pad_rows(outs)
+
+    async def predict_raw(self, p):
+        from seldon_core_tpu.contract.payload import DataKind, Payload
+
+        if p.kind != DataKind.STRING:
+            arr = await self.predict(p.array, p.names)
+            return p.with_array(arr, names=[])
+        try:
+            body = json.loads(p.data)
+            tokens = body["tokens"]
+        except (json.JSONDecodeError, TypeError, KeyError) as e:
+            raise GraphUnitError(f"bad generative request: {e}") from e
+        single = bool(tokens) and not isinstance(tokens[0], (list, tuple))
+        rows = [np.asarray(tokens, np.int32)] if single else [
+            np.asarray(r, np.int32) for r in tokens
+        ]
+        eos = body.get("eos_id", self.eos_id)
+        outs = await self._generate_rows(
+            rows,
+            int(body.get("max_new_tokens", self.max_new_tokens)),
+            float(body.get("temperature", self.temperature)),
+            int(eos) if eos is not None else None,
+        )
+        result = [o.tolist() for o in outs]
+        return Payload(
+            json.dumps({"tokens": result[0] if single else result}),
+            [],
+            DataKind.STRING,
+            p.meta,
+        )

@@ -37,6 +37,7 @@ class Implementation(str, enum.Enum):
     THOMPSON_SAMPLING = "THOMPSON_SAMPLING"
     MAHALANOBIS_OUTLIER = "MAHALANOBIS_OUTLIER"
     JAX_MODEL = "JAX_MODEL"
+    JAX_GENERATIVE = "JAX_GENERATIVE"
 
 
 class Method(str, enum.Enum):

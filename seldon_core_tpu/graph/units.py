@@ -227,18 +227,30 @@ _BUILTINS: dict[Implementation, Callable[..., Any]] = {
     Implementation.THOMPSON_SAMPLING: ThompsonSampling,
     Implementation.MAHALANOBIS_OUTLIER: MahalanobisOutlier,
     Implementation.JAX_MODEL: lambda **p: _jax_model(p),
+    Implementation.JAX_GENERATIVE: lambda **p: _jax_generative(p),
 }
+
+
+def _parse_dtype(raw: Any, impl_name: str) -> Any:
+    """Map a graph-parameter dtype string to a JAX dtype (None = keep)."""
+    import jax.numpy as jnp
+
+    dtypes = {"bfloat16": jnp.bfloat16, "float16": jnp.float16, "float32": None, None: None}
+    if raw not in dtypes:
+        raise GraphUnitError(
+            f"{impl_name} dtype must be one of "
+            f"{sorted(k for k in dtypes if k)}, got {raw!r}"
+        )
+    return dtypes[raw]
 
 
 def _jax_model(parameters: dict[str, Any]) -> Any:
     """JAX_MODEL implementation: compile a model-zoo family on device.
 
     Graph parameters: ``family`` (required), ``preset``, ``dtype``
-    ("bfloat16"/"float32"), ``max_batch``, ``max_delay_ms``, plus any
-    model-config field override (e.g. ``n_classes``).
+    ("bfloat16"/"float16"/"float32"), ``max_batch``, ``max_delay_ms``, plus
+    any model-config field override (e.g. ``n_classes``).
     """
-    import jax.numpy as jnp
-
     from seldon_core_tpu.models import registry as model_registry
 
     params = dict(parameters)
@@ -246,13 +258,31 @@ def _jax_model(parameters: dict[str, Any]) -> Any:
         family = params.pop("family")
     except KeyError:
         raise GraphUnitError("JAX_MODEL requires a 'family' parameter") from None
-    dtypes = {"bfloat16": jnp.bfloat16, "float32": None, None: None}
-    raw_dtype = params.pop("dtype", None)
-    if raw_dtype not in dtypes:
-        raise GraphUnitError(
-            f"JAX_MODEL dtype must be one of {sorted(k for k in dtypes if k)}, got {raw_dtype!r}"
+    dtype = _parse_dtype(params.pop("dtype", None), "JAX_MODEL")
+    try:
+        return model_registry.build_component(family, dtype=dtype, **params)
+    except (KeyError, TypeError) as e:
+        raise GraphUnitError(str(e)) from e
+
+
+def _jax_generative(parameters: dict[str, Any]) -> Any:
+    """JAX_GENERATIVE implementation: continuous-batching token generation.
+
+    Graph parameters: ``family`` (default "llama"), ``preset``, ``n_slots``,
+    ``max_new_tokens``, ``temperature``, ``eos_id``, ``dtype``,
+    ``checkpoint``, ``seq_impl``, plus model-config overrides.
+    """
+    from seldon_core_tpu.models import registry as model_registry
+
+    params = dict(parameters)
+    family = params.pop("family", "llama")
+    dtype = _parse_dtype(params.pop("dtype", None), "JAX_GENERATIVE")
+    try:
+        return model_registry.build_generative_component(
+            family, dtype=dtype, **params
         )
-    return model_registry.build_component(family, dtype=dtypes[raw_dtype], **params)
+    except (KeyError, TypeError) as e:
+        raise GraphUnitError(str(e)) from e
 
 
 def create_builtin(impl: Implementation, parameters: dict[str, Any]) -> Any:

@@ -183,12 +183,7 @@ def forward(
     ``seq_impl`` in {"dense", "ring", "ulysses"}: with a mesh whose ``sp`` > 1
     the attention runs sequence-parallel over ICI.
     """
-    if seq_impl == "dense" or mesh is None:
-        attn_fn = _dense_causal_attention
-    else:
-        def attn_fn(q, k, v):
-            return ring_self_attention(mesh, q, k, v, causal=True, impl=seq_impl)
-
+    attn_fn = _select_attn(mesh, seq_impl)
     x = params["tok_emb"][tokens]
     positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
 
@@ -211,72 +206,199 @@ CACHE_LOGICAL_AXES = {"k": ("layers", "batch", None, "kv_heads", "head_dim"),
                       "pos": None}
 
 
-def prefill(params: dict, tokens: jax.Array, cfg: Config, cache: dict) -> tuple[jax.Array, dict]:
+def _select_attn(mesh: Mesh | None, seq_impl: str):
+    if seq_impl == "dense" or mesh is None:
+        return _dense_causal_attention
+
+    def attn_fn(q, k, v):
+        return ring_self_attention(mesh, q, k, v, causal=True, impl=seq_impl)
+
+    return attn_fn
+
+
+def prefill(
+    params: dict,
+    tokens: jax.Array,
+    cfg: Config,
+    cache: dict,
+    *,
+    mesh: Mesh | None = None,
+    seq_impl: str = "dense",
+) -> tuple[jax.Array, dict]:
     """Run the prompt through the model, filling the KV cache.
 
     Returns ``(last_logits (B, V), cache)``.  ``tokens`` may be shorter than
-    ``max_seq``; the cache records the true length in ``pos``.
+    ``max_seq``; the cache records the true length in ``pos``.  Long prompts
+    can route attention through ring/Ulysses sequence parallelism over the
+    mesh's ``sp`` axis (``seq_impl`` in {"dense", "ring", "ulysses"}).
     """
-    x = params["tok_emb"][tokens]
-    L = tokens.shape[1]
-    positions = jnp.broadcast_to(jnp.arange(L), tokens.shape)
-
-    def body(x, lp):
-        x, (k, v) = _layer(x, lp, cfg, positions, _dense_causal_attention)
-        return x, (k, v)
-
-    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    x, ks, vs = _prefill_core(params, tokens, cfg, _select_attn(mesh, seq_impl))
     cache = {
         "k": jax.lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)),
         "v": jax.lax.dynamic_update_slice(cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)),
-        "pos": jnp.asarray(L, jnp.int32),
+        "pos": jnp.asarray(tokens.shape[1], jnp.int32),
     }
     x = _rmsnorm(x[:, -1], params["ln_f"], cfg.norm_eps)
     return x @ params["head"], cache
 
 
+def _prefill_core(params, tokens, cfg: Config, attn_fn):
+    """Embed + layer scan shared by :func:`prefill` and :func:`prefill_slot`.
+    Returns ``(hidden (B, L, E), ks, vs (layers, B, L, kv, hd))``."""
+    x = params["tok_emb"][tokens]
+    positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+
+    def body(x, lp):
+        x, (k, v) = _layer(x, lp, cfg, positions, attn_fn)
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    return x, ks, vs
+
+
 def decode_step(params: dict, token: jax.Array, cache: dict, cfg: Config) -> tuple[jax.Array, dict]:
     """One generation step: ``token (B,) int32`` -> ``(logits (B, V), cache)``.
 
-    Static shapes throughout — attends over the full ``max_seq`` cache with a
-    position mask, so one compiled program serves every step.
+    The single-sequence special case of :func:`decode_slots`: every batch row
+    shares one position (``cache["pos"]`` scalar), all rows active.
     """
-    pos = cache["pos"]
-    x = params["tok_emb"][token][:, None]  # (B, 1, E)
-    positions = jnp.full((x.shape[0], 1), pos, jnp.int32)
+    B = token.shape[0]
+    slot_cache = {
+        "k": cache["k"],
+        "v": cache["v"],
+        "pos": jnp.full((B,), cache["pos"], jnp.int32),
+    }
+    logits, slot_cache = decode_slots(
+        params, token, slot_cache, jnp.ones((B,), bool), cfg
+    )
+    return logits, {"k": slot_cache["k"], "v": slot_cache["v"], "pos": cache["pos"] + 1}
+
+
+# ---------------------------------------------------------------------------
+# slot-based primitives for continuous-batching serving
+# ---------------------------------------------------------------------------
+#
+# A *slot* is one row of a persistent multi-sequence KV cache.  The serving
+# scheduler (executor/generation.py) admits a request by prefilling its
+# prompt into a free slot while decode steps keep running for every other
+# slot — continuous batching with zero dynamic shapes: one compiled decode
+# program serves every step of every mix of requests.
+
+def init_slot_cache(cfg: Config, n_slots: int, dtype=jnp.float32) -> dict:
+    """Per-slot KV cache: ``pos`` is a vector — each slot has its own write
+    position, unlike :func:`init_cache`'s single-sequence scalar."""
+    shape = (cfg.n_layers, n_slots, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "pos": jnp.zeros((n_slots,), jnp.int32),
+    }
+
+
+def prefill_slot(
+    params: dict,
+    tokens: jax.Array,
+    length: jax.Array,
+    slot: jax.Array,
+    cache: dict,
+    cfg: Config,
+    *,
+    mesh: Mesh | None = None,
+    seq_impl: str = "dense",
+) -> tuple[jax.Array, dict]:
+    """Prefill ONE request's prompt into cache slot ``slot``.
+
+    ``tokens`` is ``(1, Lpad)`` right-padded to a bucket length; ``length``
+    is the true prompt length (traced, so one compiled program per bucket).
+    Returns ``(last_logits (V,), cache)``.  Correctness under padding: pad
+    positions only feed pad *queries* (causal mask), the returned logits are
+    taken at ``length - 1``, and decode's validity mask never reaches pad
+    cache rows before they are overwritten.
+    """
+    x, ks, vs = _prefill_core(params, tokens, cfg, _select_attn(mesh, seq_impl))
+    # ks: (layers, 1, Lp, kv, hd) -> write rows [0, Lp) of this slot
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], ks.astype(cache["k"].dtype), (0, slot, 0, 0, 0)
+        ),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], vs.astype(cache["v"].dtype), (0, slot, 0, 0, 0)
+        ),
+        "pos": cache["pos"].at[slot].set(length),
+    }
+    h = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
+    h = _rmsnorm(h, params["ln_f"], cfg.norm_eps)
+    return h @ params["head"], cache
+
+
+def decode_slots(
+    params: dict,
+    tokens: jax.Array,
+    cache: dict,
+    active: jax.Array,
+    cfg: Config,
+) -> tuple[jax.Array, dict]:
+    """One decode step for EVERY slot: ``tokens (S,)`` -> ``(logits (S, V),
+    cache)``; only ``active`` slots advance their position.
+
+    Inactive slots still flow through the math (their outputs are ignored and
+    their cache writes land at a frozen position that the next prefill
+    overwrites) — the cost of a fixed shape is far below a recompile.
+    """
+    pos = cache["pos"]  # (S,)
+    S = tokens.shape[0]
+    x = params["tok_emb"][tokens][:, None]  # (S, 1, E)
+    positions = pos[:, None]
     scale = 1.0 / math.sqrt(cfg.head_dim)
-    valid = jnp.arange(cfg.max_seq) <= pos  # cache rows written so far + self
+    valid = jnp.arange(cfg.max_seq)[None, :] <= pos[:, None]  # (S, max_seq)
+    slot_idx = jnp.arange(S)
 
     def body(carry, inputs):
         x = carry
-        lp, layer_k, layer_v = inputs
+        lp, layer_k, layer_v = inputs  # layer_k: (S, max_seq, kv, hd)
         h = _rmsnorm(x, lp["ln_att"], cfg.norm_eps)
         q = jnp.einsum("ble,ehd->blhd", h, lp["wq"])
         k = jnp.einsum("ble,ehd->blhd", h, lp["wk"])
         v = jnp.einsum("ble,ehd->blhd", h, lp["wv"])
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        layer_k = jax.lax.dynamic_update_slice(layer_k, k.astype(layer_k.dtype), (0, pos, 0, 0))
-        layer_v = jax.lax.dynamic_update_slice(layer_v, v.astype(layer_v.dtype), (0, pos, 0, 0))
+        # per-slot scatter: each slot writes its own position (one shared
+        # scalar would force all slots to the same length)
+        layer_k = layer_k.at[slot_idx, pos].set(k[:, 0].astype(layer_k.dtype))
+        layer_v = layer_v.at[slot_idx, pos].set(v[:, 0].astype(layer_v.dtype))
         # grouped-query attention against the *un-repeated* cache: repeating
         # kv to n_heads here would multiply cache reads by the group size
         # every decode step, defeating GQA's bandwidth savings
         groups = cfg.n_heads // cfg.n_kv_heads
-        qg = q.reshape(q.shape[0], 1, cfg.n_kv_heads, groups, cfg.head_dim)
+        qg = q.reshape(S, 1, cfg.n_kv_heads, groups, cfg.head_dim)
         s = jnp.einsum("bqkgd,bskd->bkgqs", qg, layer_k) * scale
-        s = jnp.where(valid[None, None, None, None, :], s, jnp.finfo(s.dtype).min)
+        s = jnp.where(valid[:, None, None, None, :], s, jnp.finfo(s.dtype).min)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bkgqs,bskd->bqkgd", p, layer_v)
-        o = o.reshape(o.shape[0], 1, cfg.n_heads, cfg.head_dim)
+        o = o.reshape(S, 1, cfg.n_heads, cfg.head_dim)
         x = x + jnp.einsum("blhd,hde->ble", o, lp["wo"])
         h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
         mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
         return x + mlp, (layer_k, layer_v)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
-    cache = {"k": new_k, "v": new_v, "pos": pos + 1}
+    cache = {
+        "k": new_k,
+        "v": new_v,
+        "pos": jnp.where(active, pos + 1, pos),
+    }
     x = _rmsnorm(x[:, 0], params["ln_f"], cfg.norm_eps)
     return x @ params["head"], cache
+
+
+def sample_tokens(
+    logits: jax.Array, temperature: jax.Array, key: jax.Array
+) -> jax.Array:
+    """Per-row sampling: ``temperature (S,)`` <= 0 means greedy."""
+    greedy = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, logits.astype(jnp.float32) / temp, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 
 
 def generate(

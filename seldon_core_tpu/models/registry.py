@@ -90,6 +90,18 @@ def resolve_config(family: str, preset: str | None = None, **overrides) -> Any:
     return cfg
 
 
+def _resolve_params(fam: Family, cfg: Any, params: Any, checkpoint: str | None, rng: int):
+    """Explicit params > checkpoint load > fresh init (host arrays either
+    way; the compiled wrapper casts/shards them at construction)."""
+    if params is not None:
+        return params
+    if checkpoint is not None:
+        from seldon_core_tpu.executor.checkpoint import load_params
+
+        return load_params(checkpoint)
+    return fam.init_params(jax.random.PRNGKey(rng), cfg)
+
+
 def build_compiled(
     family: str,
     *,
@@ -114,13 +126,7 @@ def build_compiled(
             f"{family!r} (config fields: "
             f"{sorted(f.name for f in dataclasses.fields(fam.config_cls))})"
         )
-    if params is None and checkpoint is not None:
-        from seldon_core_tpu.executor.checkpoint import load_params
-
-        # host arrays; CompiledModel casts/shards them at construction
-        params = load_params(checkpoint)
-    if params is None:
-        params = fam.init_params(jax.random.PRNGKey(rng), cfg)
+    params = _resolve_params(fam, cfg, params, checkpoint, rng)
     apply_fn = lambda p, x: fam.apply(p, x, cfg)  # noqa: E731
     return CompiledModel(
         apply_fn,
@@ -168,3 +174,69 @@ def build_component(
 
 def example_input(family: str, cfg: Any, batch: int = 1) -> np.ndarray:
     return get_family(family).example_input(cfg, batch)
+
+
+# families exposing the slot-cache generative contract
+# (init_slot_cache / prefill_slot / decode_slots / sample_tokens)
+GENERATIVE_FAMILIES: dict[str, Any] = {"llama": llama}
+
+
+def build_generative_component(
+    family: str = "llama",
+    *,
+    preset: str | None = None,
+    cfg: Any = None,
+    n_slots: int = 4,
+    mesh: Mesh | None = None,
+    rng: int = 0,
+    dtype: Any = None,
+    checkpoint: str | None = None,
+    params: Any = None,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    eos_id: int | None = None,
+    seq_impl: str = "dense",
+    **overrides,
+):
+    """Build a continuous-batching generative graph unit (JAX_GENERATIVE)."""
+    from seldon_core_tpu.executor.generation import (
+        GenerativeComponent,
+        GenerativeModel,
+    )
+
+    try:
+        mod = GENERATIVE_FAMILIES[family]
+    except KeyError:
+        raise KeyError(
+            f"family {family!r} has no generative contract; "
+            f"have {sorted(GENERATIVE_FAMILIES)}"
+        ) from None
+    if seq_impl not in ("dense", "ring", "ulysses"):
+        # eagerly: a typo would otherwise surface as an opaque KeyError
+        # inside jit tracing at warmup
+        raise TypeError(
+            f"seq_impl must be one of dense/ring/ulysses, got {seq_impl!r}"
+        )
+    fam = get_family(family)
+    if cfg is None:
+        cfg = resolve_config(family, preset, **overrides)
+    elif overrides:
+        raise TypeError(f"unknown generative parameters {sorted(overrides)}")
+    params = _resolve_params(fam, cfg, params, checkpoint, rng)
+    model = GenerativeModel(
+        cfg,
+        params,
+        family_mod=mod,
+        n_slots=n_slots,
+        mesh=mesh,
+        param_axes=fam.param_logical_axes(params) if mesh is not None else None,
+        dtype=dtype,
+        seq_impl=seq_impl,
+        name=f"{family}:{preset or 'default'}",
+    )
+    return GenerativeComponent(
+        model,
+        max_new_tokens=max_new_tokens,
+        temperature=temperature,
+        eos_id=eos_id,
+    )

@@ -1,0 +1,326 @@
+"""Generative serving tests: slot-cache correctness vs. the reference
+generation loop, continuous-batching admission, EOS/limits, the graph-unit
+wire contract, and ring-attention prefill.
+
+The reference has no generative path (2-D batch×features tensors only,
+reference: engine/.../predictors/AverageCombinerUnit.java:47-49) — this suite
+guards the TPU build's own flagship capability.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.executor.generation import (
+    GenerationScheduler,
+    GenerativeComponent,
+    GenerativeModel,
+)
+from seldon_core_tpu.graph.units import GraphUnitError
+from seldon_core_tpu.models import llama
+
+run = asyncio.run
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    cfg = llama.Config.tiny(max_seq=64)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def reference_generate(cfg, params, prompt, max_new):
+    """The single-sequence scan loop (models/llama.py::generate), greedy."""
+    out = llama.generate(
+        params, np.asarray(prompt, np.int32)[None], cfg, max_new_tokens=max_new
+    )
+    return np.asarray(out)[0]
+
+
+class TestSlotPrimitives:
+    def test_slot_path_matches_reference_loop(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(cfg, params, n_slots=2)
+        prompt = np.array([5, 9, 2, 17, 3], np.int32)
+        max_new = 8
+        expect = reference_generate(cfg, params, prompt, max_new)
+
+        toks = [model.admit(0, prompt, 0.0, seed=1)]
+        cur = np.zeros(2, np.int32)
+        active = np.zeros(2, bool)
+        temps = np.zeros(2, np.float32)
+        cur[0], active[0] = toks[0], True
+        while len(toks) < max_new:
+            step = model.step(cur, active, temps, seed=len(toks))
+            toks.append(int(step[0]))
+            cur[0] = step[0]
+        np.testing.assert_array_equal(np.asarray(toks, np.int32), expect)
+
+    def test_two_slots_interleaved_match_isolated(self, tiny):
+        """Slot 1 admitted mid-flight must not perturb slot 0's stream."""
+        cfg, params = tiny
+        model = GenerativeModel(cfg, params, n_slots=2)
+        p0 = np.array([5, 9, 2, 17, 3], np.int32)
+        p1 = np.array([30, 7], np.int32)
+        e0 = reference_generate(cfg, params, p0, 6)
+        e1 = reference_generate(cfg, params, p1, 4)
+
+        cur = np.zeros(2, np.int32)
+        active = np.zeros(2, bool)
+        temps = np.zeros(2, np.float32)
+        out0 = [model.admit(0, p0, 0.0, seed=1)]
+        cur[0], active[0] = out0[0], True
+        # two solo steps for slot 0, then slot 1 joins
+        for s in range(2):
+            step = model.step(cur, active, temps, seed=s)
+            out0.append(int(step[0]))
+            cur[0] = step[0]
+        out1 = [model.admit(1, p1, 0.0, seed=2)]
+        cur[1], active[1] = out1[0], True
+        for s in range(3):
+            step = model.step(cur, active, temps, seed=10 + s)
+            out0.append(int(step[0]))
+            out1.append(int(step[1]))
+            cur = step.copy()
+        np.testing.assert_array_equal(np.asarray(out0), e0)
+        np.testing.assert_array_equal(np.asarray(out1), e1)
+
+    def test_slot_reuse_after_completion(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(cfg, params, n_slots=1)
+        p = np.array([4, 4, 8], np.int32)
+        expect = reference_generate(cfg, params, p, 3)
+        for _ in range(2):  # second tenancy over a dirty cache must match
+            toks = [model.admit(0, p, 0.0, seed=3)]
+            cur = np.array([toks[0]], np.int32)
+            active = np.array([True])
+            temps = np.zeros(1, np.float32)
+            for s in range(2):
+                step = model.step(cur, active, temps, seed=s)
+                toks.append(int(step[0]))
+                cur[0] = step[0]
+            np.testing.assert_array_equal(np.asarray(toks), expect)
+
+    def test_warmup_compiles_and_resets(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(cfg, params, n_slots=2)
+        n = model.warmup()
+        assert n == len(model.prefill_buckets) + 1
+        assert np.all(np.asarray(model._cache["pos"]) == 0)
+
+
+class TestScheduler:
+    def test_concurrent_requests_match_sequential(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(cfg, params, n_slots=2)
+        prompts = [
+            np.array([5, 9, 2, 17, 3], np.int32),
+            np.array([30, 7], np.int32),
+            np.array([1, 2, 3, 4], np.int32),  # 3rd waits for a free slot
+        ]
+        expects = [reference_generate(cfg, params, p, 6) for p in prompts]
+
+        async def go():
+            sched = GenerationScheduler(model)
+            try:
+                outs = await asyncio.gather(
+                    *(sched.submit(p, max_new_tokens=6) for p in prompts)
+                )
+            finally:
+                await sched.close()
+            return outs
+
+        outs = run(go())
+        for out, exp in zip(outs, expects):
+            np.testing.assert_array_equal(out, exp)
+
+    def test_eos_stops_early(self, tiny):
+        cfg, params = tiny
+        prompt = np.array([5, 9, 2, 17, 3], np.int32)
+        ref = reference_generate(cfg, params, prompt, 6)
+        # pick an EOS token at its FIRST occurrence in the stream
+        stop_at = next(
+            i for i in range(1, len(ref)) if ref[i] not in ref[:i]
+        )
+        eos = int(ref[stop_at])
+        model = GenerativeModel(cfg, params, n_slots=1)
+
+        async def go():
+            sched = GenerationScheduler(model)
+            try:
+                return await sched.submit(prompt, max_new_tokens=6, eos_id=eos)
+            finally:
+                await sched.close()
+
+        out = run(go())
+        np.testing.assert_array_equal(out, ref[: stop_at + 1])
+
+    def test_prompt_too_long_rejected(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(cfg, params, n_slots=1)
+
+        async def go():
+            sched = GenerationScheduler(model)
+            try:
+                with pytest.raises(GraphUnitError, match="max_seq"):
+                    await sched.submit(np.ones(cfg.max_seq, np.int32))
+            finally:
+                await sched.close()
+
+        run(go())
+
+    def test_max_new_clamped_to_cache(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(cfg, params, n_slots=1)
+        prompt = np.ones(cfg.max_seq - 3, np.int32)
+
+        async def go():
+            sched = GenerationScheduler(model)
+            try:
+                return await sched.submit(prompt, max_new_tokens=1000)
+            finally:
+                await sched.close()
+
+        out = run(go())
+        assert out.size == 3  # max_seq - prompt
+
+
+class TestComponent:
+    def test_ndarray_contract(self, tiny):
+        cfg, params = tiny
+        comp = GenerativeComponent(
+            GenerativeModel(cfg, params, n_slots=2), max_new_tokens=4
+        )
+
+        async def go():
+            X = np.array([[5, 9, 2, 17, 3], [30, 7, 0, 0, 0]], np.float64)
+            try:
+                return await comp.predict(X, [])
+            finally:
+                await comp.close()
+
+        out = run(go())
+        assert out.shape == (2, 4) and out.dtype == np.int32
+
+    def test_strdata_contract(self, tiny):
+        from seldon_core_tpu.contract.payload import DataKind, Payload
+
+        cfg, params = tiny
+        comp = GenerativeComponent(
+            GenerativeModel(cfg, params, n_slots=2), max_new_tokens=4
+        )
+        expect = reference_generate(cfg, params, np.array([5, 9, 2], np.int32), 2)
+
+        async def go():
+            p = Payload(
+                json.dumps({"tokens": [5, 9, 2], "max_new_tokens": 2}),
+                [],
+                DataKind.STRING,
+            )
+            try:
+                return await comp.predict_raw(p)
+            finally:
+                await comp.close()
+
+        out = run(go())
+        body = json.loads(out.data)
+        np.testing.assert_array_equal(np.asarray(body["tokens"]), expect)
+
+    def test_non_integer_input_rejected(self, tiny):
+        cfg, params = tiny
+        comp = GenerativeComponent(GenerativeModel(cfg, params, n_slots=1))
+
+        async def go():
+            try:
+                with pytest.raises(GraphUnitError, match="integer"):
+                    await comp.predict(np.array([[0.5, 1.2]]), [])
+            finally:
+                await comp.close()
+
+        run(go())
+
+
+class TestEngineE2E:
+    """Token generation through the engine's REST surface — the round-2
+    acceptance test for generative serving."""
+
+    PREDICTOR = {
+        "name": "llm",
+        "graph": {
+            "name": "gen",
+            "type": "MODEL",
+            "implementation": "JAX_GENERATIVE",
+            "parameters": [
+                {"name": "family", "value": "llama", "type": "STRING"},
+                {"name": "preset", "value": "tiny", "type": "STRING"},
+                {"name": "n_slots", "value": "2", "type": "INT"},
+                {"name": "max_new_tokens", "value": "4", "type": "INT"},
+            ],
+        },
+    }
+
+    def test_generate_over_rest(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.engine.app import EngineApp
+        from seldon_core_tpu.engine.service import PredictionService
+        from seldon_core_tpu.graph.spec import PredictorSpec
+
+        async def go():
+            service = PredictionService(
+                PredictorSpec.model_validate(self.PREDICTOR)
+            )
+            app = EngineApp(service).build()
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[5, 9, 2, 17, 3]]}},
+                )
+                assert resp.status == 200, await resp.text()
+                body = await resp.json()
+                out = np.asarray(body["data"]["ndarray"])
+                assert out.shape == (1, 4)
+                assert np.issubdtype(out.dtype, np.integer)
+                # strData contract through the same wire
+                resp = await client.post(
+                    "/api/v0.1/predictions",
+                    json={"strData": json.dumps({"tokens": [5, 9, 2], "max_new_tokens": 2})},
+                )
+                assert resp.status == 200, await resp.text()
+                body = await resp.json()
+                assert len(json.loads(body["strData"])["tokens"]) == 2
+            finally:
+                await client.close()
+
+        run(go())
+
+
+class TestRingPrefill:
+    def test_ring_prefill_matches_dense(self, tiny):
+        """Long-prompt prefill through ring sequence parallelism must agree
+        with dense attention (round-1 weakness: prefill hardcoded dense)."""
+        import jax
+
+        from seldon_core_tpu.parallel import best_mesh
+
+        cfg, params = tiny
+        mesh = best_mesh(8, tp=1, sp=8)
+        tokens = np.arange(64, dtype=np.int32)[None, :] % cfg.vocab_size
+        cache_d = llama.init_cache(cfg, 1)
+        cache_r = llama.init_cache(cfg, 1)
+        logits_d, cd = llama.prefill(params, tokens, cfg, cache_d)
+        logits_r, cr = llama.prefill(
+            params, tokens, cfg, cache_r, mesh=mesh, seq_impl="ring"
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_d), np.asarray(logits_r), rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(cd["k"]), np.asarray(cr["k"]), rtol=2e-4, atol=2e-4
+        )
