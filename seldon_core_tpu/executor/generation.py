@@ -284,6 +284,14 @@ class GenerationScheduler:
         prompt = np.asarray(prompt, np.int32).ravel()
         if prompt.size < 1:
             raise GraphUnitError("empty prompt")
+        vocab = self.model.cfg.vocab_size
+        if prompt.min() < 0 or prompt.max() >= vocab:
+            # JAX gather would silently clamp out-of-range ids into arbitrary
+            # embedding rows — garbage generations with status 200
+            raise GraphUnitError(
+                f"token ids must be in [0, {vocab}); got "
+                f"[{int(prompt.min())}, {int(prompt.max())}]"
+            )
         if prompt.size >= self.model.cfg.max_seq:
             raise GraphUnitError(
                 f"prompt length {prompt.size} must be < max_seq "
@@ -356,7 +364,11 @@ class GenerationScheduler:
                 except asyncio.CancelledError:
                     raise
                 except Exception as exc:
-                    # a failed device step poisons every in-flight request
+                    # a failed device step poisons every in-flight request;
+                    # log it too — clients see the error, operators need it
+                    # in the pod logs
+                    log.exception("decode step failed; failing %d in-flight requests",
+                                  int(active.sum()))
                     for i in range(S):
                         if slots[i] is not None and not slots[i].future.done():
                             slots[i].future.set_exception(exc)
@@ -389,6 +401,8 @@ class GenerationScheduler:
         except asyncio.CancelledError:
             raise
         except Exception as exc:
+            if not isinstance(exc, GraphUnitError):
+                log.exception("prefill admission failed")
             if not req.future.done():
                 req.future.set_exception(exc)
             return
@@ -477,8 +491,15 @@ class GenerativeComponent(SeldonComponent):
             if not np.all(np.equal(np.mod(X, 1), 0)):
                 raise GraphUnitError("generative input must be integer token ids")
             X = X.astype(np.int32)
+        # rows of a dense batch may carry our own PAD_ID right-padding
+        # (e.g. a previous response fed back): strip it per row
+        rows = []
+        for row in X:
+            row = np.asarray(row, np.int32)
+            keep = row != PAD_ID
+            rows.append(row[: int(keep.cumsum().argmax()) + 1] if keep.any() else row)
         outs = await self._generate_rows(
-            [row for row in X], self.max_new_tokens, self.temperature, self.eos_id
+            rows, self.max_new_tokens, self.temperature, self.eos_id
         )
         return self._pad_rows(outs)
 
@@ -491,12 +512,14 @@ class GenerativeComponent(SeldonComponent):
         try:
             body = json.loads(p.data)
             tokens = body["tokens"]
-        except (json.JSONDecodeError, TypeError, KeyError) as e:
+            if not isinstance(tokens, (list, tuple)):
+                raise TypeError("'tokens' must be a list")
+            single = bool(tokens) and not isinstance(tokens[0], (list, tuple))
+            rows = [np.asarray(tokens, np.int32)] if single else [
+                np.asarray(r, np.int32) for r in tokens
+            ]
+        except (json.JSONDecodeError, TypeError, KeyError, ValueError) as e:
             raise GraphUnitError(f"bad generative request: {e}") from e
-        single = bool(tokens) and not isinstance(tokens[0], (list, tuple))
-        rows = [np.asarray(tokens, np.int32)] if single else [
-            np.asarray(r, np.int32) for r in tokens
-        ]
         eos = body.get("eos_id", self.eos_id)
         outs = await self._generate_rows(
             rows,

@@ -1,0 +1,30 @@
+"""Contract-based testing tools.
+
+The pre-deploy story of the reference (reference:
+wrappers/testing/tester.py:42-105 — random batches generated from a
+``contract.json``, POSTed at a locally-running wrapped model) and the
+post-deploy story (reference: util/api_tester/api-tester.py:44-61 — same
+generator through the gateway with OAuth), rebuilt as a library + two CLIs:
+
+    sct-tester      contract.json host port   # microservice (REST/gRPC)
+    sct-api-tester  contract.json host port --oauth-key k --oauth-secret s
+
+Improvements over the reference: seeded generators (reproducible batches),
+response validation against the contract's ``targets``, latency stats, and a
+process exit code that reflects failures (the reference always exits 0).
+"""
+
+from seldon_core_tpu.testing.contract import Contract, FeatureDef
+from seldon_core_tpu.testing.tester import (
+    ApiTester,
+    MicroserviceTester,
+    TestReport,
+)
+
+__all__ = [
+    "Contract",
+    "FeatureDef",
+    "MicroserviceTester",
+    "ApiTester",
+    "TestReport",
+]

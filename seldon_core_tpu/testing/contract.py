@@ -1,0 +1,146 @@
+"""``contract.json`` schema + random batch generation.
+
+Wire-compatible with the reference's contract format (reference:
+wrappers/testing/tester.py:42-66, unfold :107-134): features/targets are
+lists of ``{name, ftype: continuous|categorical, dtype: FLOAT|INT, range,
+shape, values, repeat}``.  Range bounds may be the string ``"inf"`` for
+unbounded sides; unbounded draws are normal, one-sided draws lognormal,
+bounded draws uniform — the same distribution family the reference uses, but
+from a *seeded* generator so test batches are reproducible.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Literal, Optional
+
+import numpy as np
+from pydantic import BaseModel, Field
+
+
+class FeatureDef(BaseModel):
+    name: str
+    ftype: Literal["continuous", "categorical"] = "continuous"
+    dtype: Literal["FLOAT", "INT"] = "FLOAT"
+    range: Optional[list[Any]] = None  # [lo, hi]; "inf" = unbounded side
+    shape: Optional[list[int]] = None  # per-row shape; default [1]
+    values: Optional[list[Any]] = None  # categorical choices
+    repeat: int = 0  # expand into N copies (name1..nameN)
+
+    @property
+    def width(self) -> int:
+        """Columns this feature contributes per row."""
+        return int(math.prod(self.shape)) if self.shape else 1
+
+
+class Contract(BaseModel):
+    features: list[FeatureDef]
+    targets: list[FeatureDef] = Field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str) -> "Contract":
+        with open(path) as f:
+            return cls.model_validate(json.load(f))
+
+    def unfold(self) -> "Contract":
+        """Expand ``repeat`` features into numbered copies (reference:
+        tester.py:107-134)."""
+
+        def expand(defs: list[FeatureDef]) -> list[FeatureDef]:
+            out = []
+            for d in defs:
+                if d.repeat:
+                    for i in range(d.repeat):
+                        out.append(d.model_copy(update={"name": f"{d.name}{i + 1}", "repeat": 0}))
+                else:
+                    out.append(d)
+            return out
+
+        return Contract(features=expand(self.features), targets=expand(self.targets))
+
+    def feature_names(self) -> list[str]:
+        return [f.name for f in self.features]
+
+    @property
+    def n_feature_columns(self) -> int:
+        return sum(f.width for f in self.features)
+
+    @property
+    def n_target_columns(self) -> int:
+        return sum(t.width for t in self.targets)
+
+    # ------------------------------------------------------------ generation
+
+    def generate_batch(self, n: int, rng: np.random.Generator) -> np.ndarray:
+        """(n, n_feature_columns) random batch honouring each feature's
+        distribution.  Mixed categorical strings force an object array."""
+        cols = []
+        all_numeric = True
+        for f in self.features:
+            if f.ftype == "continuous":
+                shape = (n, f.width)
+                batch = _gen_continuous(f.range, shape, rng)
+                batch = np.around(batch, decimals=3)
+                if f.dtype == "INT":
+                    batch = np.floor(batch + 0.5)
+            else:
+                values = f.values or [0]
+                batch = np.asarray(values, dtype=object)[
+                    rng.integers(len(values), size=(n, f.width))
+                ]
+                if not all(isinstance(v, (int, float)) for v in values):
+                    all_numeric = False
+            cols.append(batch)
+        if all_numeric:
+            return np.concatenate([np.asarray(c, np.float64) for c in cols], axis=1)
+        out = np.empty((n, self.n_feature_columns), dtype=object)
+        return np.concatenate(cols, axis=1, out=out)
+
+    # ------------------------------------------------------------ validation
+
+    def validate_response(self, body: dict[str, Any], batch_rows: int) -> list[str]:
+        """Check a SeldonMessage response against the contract's targets.
+        Returns a list of problems (empty = valid).  The reference tester
+        prints responses without checking them at all."""
+        problems: list[str] = []
+        status = body.get("status", {})
+        if status and status.get("status") not in (None, "SUCCESS"):
+            problems.append(f"response status {status.get('status')}: {status.get('reason', '')}")
+            return problems
+        data = body.get("data")
+        if data is None:
+            if "strData" in body or "binData" in body:
+                return problems  # non-tensor responses aren't shape-checked
+            problems.append("response has no data")
+            return problems
+        if "tensor" in data:
+            shape = data["tensor"].get("shape", [])
+            rows = shape[0] if shape else 0
+            width = shape[1] if len(shape) > 1 else 1
+        elif "ndarray" in data:
+            arr = np.asarray(data["ndarray"], dtype=object)
+            rows = arr.shape[0] if arr.ndim >= 1 else 0
+            width = arr.shape[1] if arr.ndim >= 2 else 1
+        else:
+            problems.append("response data has neither tensor nor ndarray")
+            return problems
+        if rows != batch_rows:
+            problems.append(f"response rows {rows} != request rows {batch_rows}")
+        if self.targets and width != self.n_target_columns:
+            problems.append(
+                f"response width {width} != contract targets {self.n_target_columns}"
+            )
+        return problems
+
+
+def _gen_continuous(rng_def: list | None, shape: tuple, rng: np.random.Generator) -> np.ndarray:
+    lo, hi = (rng_def or ["inf", "inf"])[:2]
+    lo_inf, hi_inf = lo == "inf", hi == "inf"
+    if lo_inf and hi_inf:
+        return rng.normal(size=shape)
+    if lo_inf:
+        return float(hi) - rng.lognormal(size=shape)
+    if hi_inf:
+        return float(lo) + rng.lognormal(size=shape)
+    return rng.uniform(float(lo), float(hi), size=shape)

@@ -1,0 +1,289 @@
+"""Contract testers: local microservice (pre-deploy) and gateway API
+(post-deploy).
+
+Reference counterparts: wrappers/testing/tester.py:137-200 (REST form-POST /
+gRPC Model.Predict at a wrapped model) and util/api_tester/api-tester.py:
+133-196 (OAuth client-credentials token, then authenticated predictions
+through the gateway).  Differences by design: asyncio + pooled connections,
+seeded generation, target validation, latency percentiles, and non-zero
+exit codes on failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import sys
+import time
+
+import numpy as np
+
+from seldon_core_tpu.testing.contract import Contract
+
+
+@dataclasses.dataclass
+class TestReport:
+    requests: int = 0
+    failures: list[str] = dataclasses.field(default_factory=list)
+    latencies_ms: list[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.requests > 0 and not self.failures
+
+    def summary(self) -> dict:
+        lat = np.asarray(self.latencies_ms) if self.latencies_ms else np.zeros(1)
+        return {
+            "requests": self.requests,
+            "failures": len(self.failures),
+            "ok": self.ok,
+            "p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p95_ms": round(float(np.percentile(lat, 95)), 3),
+        }
+
+
+def _rest_request(batch: np.ndarray, names: list[str], tensor: bool) -> dict:
+    if tensor:
+        data = {
+            "names": names,
+            "tensor": {"shape": list(batch.shape), "values": batch.ravel().tolist()},
+        }
+    else:
+        data = {"names": names, "ndarray": batch.tolist()}
+    return {"meta": {}, "data": data}
+
+
+class MicroserviceTester:
+    """Random-batch tester for a locally-running wrapped model."""
+
+    def __init__(
+        self,
+        contract: Contract,
+        host: str,
+        port: int,
+        *,
+        tensor: bool = False,
+        grpc: bool = False,
+        endpoint: str = "predict",
+        seed: int = 0,
+        show: bool = False,
+    ):
+        self.contract = contract.unfold()
+        self.host, self.port = host, port
+        self.tensor, self.grpc = tensor, grpc
+        self.endpoint = endpoint
+        self.rng = np.random.default_rng(seed)
+        self.show = show
+
+    async def run(self, n_requests: int = 1, batch_size: int = 1) -> TestReport:
+        report = TestReport()
+        send = self._send_grpc if self.grpc else self._send_rest
+        for _ in range(n_requests):
+            batch = self.contract.generate_batch(batch_size, self.rng)
+            t0 = time.perf_counter()
+            try:
+                body = await send(batch)
+            except Exception as e:
+                report.requests += 1
+                report.failures.append(f"{type(e).__name__}: {e}")
+                continue
+            report.latencies_ms.append((time.perf_counter() - t0) * 1000.0)
+            report.requests += 1
+            if self.show:
+                print(json.dumps(body)[:2000])
+            report.failures.extend(
+                self.contract.validate_response(body, batch.shape[0])
+            )
+        return report
+
+    async def _send_rest(self, batch: np.ndarray) -> dict:
+        import aiohttp
+
+        req = _rest_request(batch, self.contract.feature_names(), self.tensor)
+        url = f"http://{self.host}:{self.port}/{self.endpoint}"
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, json=req) as resp:
+                return await resp.json()
+
+    async def _send_grpc(self, batch: np.ndarray) -> dict:
+        import grpc
+        from google.protobuf import json_format
+
+        from seldon_core_tpu.contract import Payload, payload_to_proto
+        from seldon_core_tpu.contract.payload import DataKind
+        from seldon_core_tpu.proto.grpc_defs import Stub
+
+        kind = DataKind.TENSOR if self.tensor else DataKind.NDARRAY
+        msg = payload_to_proto(
+            Payload.from_array(batch, names=self.contract.feature_names(), kind=kind)
+        )
+        async with grpc.aio.insecure_channel(f"{self.host}:{self.port}") as ch:
+            reply = await Stub(ch, "Model").Predict(msg, timeout=30.0)
+        return json_format.MessageToDict(reply)
+
+
+class ApiTester:
+    """Deployed-API tester: OAuth token + authenticated predictions/feedback
+    through the gateway (REST or gRPC)."""
+
+    def __init__(
+        self,
+        contract: Contract,
+        host: str,
+        port: int,
+        oauth_key: str,
+        oauth_secret: str,
+        *,
+        tensor: bool = False,
+        grpc: bool = False,
+        grpc_port: int | None = None,
+        endpoint: str = "predictions",
+        seed: int = 0,
+        show: bool = False,
+    ):
+        self.contract = contract.unfold()
+        self.host, self.port = host, port
+        self.oauth_key, self.oauth_secret = oauth_key, oauth_secret
+        self.tensor, self.grpc = tensor, grpc
+        self.grpc_port = grpc_port or port
+        self.endpoint = endpoint
+        self.rng = np.random.default_rng(seed)
+        self.show = show
+
+    async def get_token(self) -> str:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"http://{self.host}:{self.port}/oauth/token",
+                data={"grant_type": "client_credentials"},
+                auth=aiohttp.BasicAuth(self.oauth_key, self.oauth_secret),
+            ) as resp:
+                body = await resp.json()
+                if "access_token" not in body:
+                    raise RuntimeError(f"token request failed: {body}")
+                return body["access_token"]
+
+    def _request_body(self, batch: np.ndarray) -> dict:
+        req = _rest_request(batch, self.contract.feature_names(), self.tensor)
+        if self.endpoint == "feedback":
+            return {"request": req, "reward": 1.0}
+        return req
+
+    async def run(self, n_requests: int = 1, batch_size: int = 1) -> TestReport:
+        report = TestReport()
+        token = await self.get_token()
+        send = self._send_grpc if self.grpc else self._send_rest
+        for _ in range(n_requests):
+            batch = self.contract.generate_batch(batch_size, self.rng)
+            t0 = time.perf_counter()
+            try:
+                body = await send(batch, token)
+            except Exception as e:
+                report.requests += 1
+                report.failures.append(f"{type(e).__name__}: {e}")
+                continue
+            report.latencies_ms.append((time.perf_counter() - t0) * 1000.0)
+            report.requests += 1
+            if self.show:
+                print(json.dumps(body)[:2000])
+            if self.endpoint == "predictions":
+                report.failures.extend(
+                    self.contract.validate_response(body, batch.shape[0])
+                )
+            elif body.get("status", {}).get("status") not in (None, "SUCCESS"):
+                report.failures.append(f"feedback failed: {body.get('status')}")
+        return report
+
+    async def _send_rest(self, batch: np.ndarray, token: str) -> dict:
+        import aiohttp
+
+        url = f"http://{self.host}:{self.port}/api/v0.1/{self.endpoint}"
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                url,
+                json=self._request_body(batch),
+                headers={"Authorization": f"Bearer {token}"},
+            ) as resp:
+                return await resp.json()
+
+    async def _send_grpc(self, batch: np.ndarray, token: str) -> dict:
+        import grpc
+        from google.protobuf import json_format
+
+        from seldon_core_tpu.contract import Payload, payload_to_proto
+        from seldon_core_tpu.contract.payload import DataKind
+        from seldon_core_tpu.gateway.grpc_gateway import OAUTH_METADATA_KEY
+        from seldon_core_tpu.proto.grpc_defs import Stub
+
+        kind = DataKind.TENSOR if self.tensor else DataKind.NDARRAY
+        msg = payload_to_proto(
+            Payload.from_array(batch, names=self.contract.feature_names(), kind=kind)
+        )
+        metadata = ((OAUTH_METADATA_KEY, token),)
+        async with grpc.aio.insecure_channel(f"{self.host}:{self.grpc_port}") as ch:
+            reply = await Stub(ch, "Seldon").Predict(msg, timeout=30.0, metadata=metadata)
+        return json_format.MessageToDict(reply)
+
+
+# ---------------------------------------------------------------------------
+# CLIs
+# ---------------------------------------------------------------------------
+
+def _common_args(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("contract", help="contract.json path")
+    parser.add_argument("host")
+    parser.add_argument("port", type=int)
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    parser.add_argument("-n", "--n-requests", type=int, default=1)
+    parser.add_argument("--grpc", action="store_true")
+    parser.add_argument("-t", "--tensor", action="store_true")
+    parser.add_argument("-p", "--prnt", action="store_true", help="print responses")
+    parser.add_argument("--seed", type=int, default=0)
+
+
+def _finish(report: TestReport) -> None:
+    print(json.dumps(report.summary()))
+    for f in report.failures[:20]:
+        print(f"FAIL: {f}", file=sys.stderr)
+    sys.exit(0 if report.ok else 1)
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="contract-based microservice tester")
+    _common_args(parser)
+    parser.add_argument(
+        "--endpoint", default="predict", help="microservice endpoint (predict, ...)"
+    )
+    args = parser.parse_args(argv)
+    tester = MicroserviceTester(
+        Contract.load(args.contract), args.host, args.port,
+        tensor=args.tensor, grpc=args.grpc, endpoint=args.endpoint,
+        seed=args.seed, show=args.prnt,
+    )
+    _finish(asyncio.run(tester.run(args.n_requests, args.batch_size)))
+
+
+def main_api(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="deployed-API tester (gateway)")
+    _common_args(parser)
+    parser.add_argument("--oauth-key", required=True)
+    parser.add_argument("--oauth-secret", required=True)
+    parser.add_argument("--grpc-port", type=int, default=None)
+    parser.add_argument(
+        "--endpoint", default="predictions", choices=["predictions", "feedback"]
+    )
+    args = parser.parse_args(argv)
+    tester = ApiTester(
+        Contract.load(args.contract), args.host, args.port,
+        args.oauth_key, args.oauth_secret,
+        tensor=args.tensor, grpc=args.grpc, grpc_port=args.grpc_port,
+        endpoint=args.endpoint, seed=args.seed, show=args.prnt,
+    )
+    _finish(asyncio.run(tester.run(args.n_requests, args.batch_size)))
+
+
+if __name__ == "__main__":
+    main()

@@ -230,6 +230,53 @@ class TestComponent:
         body = json.loads(out.data)
         np.testing.assert_array_equal(np.asarray(body["tokens"]), expect)
 
+    def test_out_of_vocab_ids_rejected(self, tiny):
+        cfg, params = tiny
+        comp = GenerativeComponent(GenerativeModel(cfg, params, n_slots=1))
+
+        async def go():
+            try:
+                with pytest.raises(GraphUnitError, match="token ids"):
+                    await comp.predict(np.array([[1, cfg.vocab_size + 5]]), [])
+            finally:
+                await comp.close()
+
+        run(go())
+
+    def test_trailing_pad_stripped_from_dense_rows(self, tiny):
+        cfg, params = tiny
+        comp = GenerativeComponent(
+            GenerativeModel(cfg, params, n_slots=2), max_new_tokens=3
+        )
+        expect = reference_generate(cfg, params, np.array([5, 9, 2], np.int32), 3)
+
+        async def go():
+            # a previous response row fed back: right-padded with -1
+            X = np.array([[5, 9, 2, -1, -1]], np.int32)
+            try:
+                return await comp.predict(X, [])
+            finally:
+                await comp.close()
+
+        out = run(go())
+        np.testing.assert_array_equal(out[0], expect)
+
+    def test_malformed_strdata_is_unit_error(self, tiny):
+        from seldon_core_tpu.contract.payload import DataKind, Payload
+
+        cfg, params = tiny
+        comp = GenerativeComponent(GenerativeModel(cfg, params, n_slots=1))
+
+        async def go():
+            try:
+                for bad in ('{"tokens": 5}', '{"tokens": "abc"}', "{}", "not json"):
+                    with pytest.raises(GraphUnitError, match="bad generative"):
+                        await comp.predict_raw(Payload(bad, [], DataKind.STRING))
+            finally:
+                await comp.close()
+
+        run(go())
+
     def test_non_integer_input_rejected(self, tiny):
         cfg, params = tiny
         comp = GenerativeComponent(GenerativeModel(cfg, params, n_slots=1))
