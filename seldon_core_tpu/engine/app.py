@@ -175,22 +175,56 @@ def main(argv: list[str] | None = None) -> None:
     parser = argparse.ArgumentParser(description="seldon-core-tpu engine")
     parser.add_argument("--port", type=int, default=int(os.environ.get("ENGINE_SERVER_PORT", "8000")))
     parser.add_argument("--grpc-port", type=int, default=int(os.environ.get("ENGINE_SERVER_GRPC_PORT", "5001")))
+    parser.add_argument(
+        "--workers",
+        type=int,
+        default=int(os.environ.get("ENGINE_WORKERS", "1")),
+        help="worker processes sharing the ports via SO_REUSEPORT. Use >1 "
+        "only for CPU-bound graphs (stubs, routing): a JAX_MODEL graph "
+        "owns the TPU chip and must stay at 1 (batching provides its "
+        "concurrency)",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
+    if args.workers > 1:
+        # The reference engine is a multithreaded JVM on 16 cores
+        # (docs/benchmarking.md:19-36); the Python equivalent of that CPU
+        # budget is processes, kernel-balanced across a shared port.
+        import multiprocessing
+
+        procs = [
+            multiprocessing.Process(
+                target=_serve, args=(args.port, args.grpc_port, True), daemon=False
+            )
+            for _ in range(args.workers - 1)
+        ]
+        for p in procs:
+            p.start()
+        try:
+            _serve(args.port, args.grpc_port, True)
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(timeout=10)
+    else:
+        _serve(args.port, args.grpc_port, False)
+
+
+def _serve(port: int, grpc_port: int, reuse_port: bool) -> None:
     predictor = load_predictor_spec()
     service = PredictionService(
         predictor, deployment_name=os.environ.get("SELDON_DEPLOYMENT_ID", "")
     )
     engine = EngineApp(service)
     app = engine.build()
-
-    app.on_startup.append(make_grpc_startup(service, args.grpc_port))
+    app.on_startup.append(make_grpc_startup(service, grpc_port, reuse_port=reuse_port))
     app.on_cleanup.append(_grpc_cleanup)
-    web.run_app(app, port=args.port, access_log=None)
+    web.run_app(app, port=port, access_log=None, reuse_port=reuse_port or None)
 
 
-def make_grpc_startup(service: PredictionService, grpc_port: int):
+def make_grpc_startup(service: PredictionService, grpc_port: int, reuse_port: bool = False):
     """aiohttp startup hook co-starting the gRPC server.
 
     A gRPC boot failure FAILS the whole process (a gRPC-only client must not
@@ -202,7 +236,9 @@ def make_grpc_startup(service: PredictionService, grpc_port: int):
         try:
             from seldon_core_tpu.engine.grpc_app import start_engine_grpc
 
-            app_["grpc_server"] = await start_engine_grpc(service, grpc_port)
+            app_["grpc_server"] = await start_engine_grpc(
+                service, grpc_port, reuse_port=reuse_port
+            )
         except Exception as e:
             if os.environ.get("ENGINE_GRPC_OPTIONAL") == "1":
                 log.warning("gRPC server not started (optional): %s", e)

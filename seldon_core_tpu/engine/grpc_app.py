@@ -48,8 +48,18 @@ class SeldonGrpc:
         return payload_to_proto(Payload())
 
 
-async def start_engine_grpc(service: PredictionService, port: int) -> grpc.aio.Server:
-    server = grpc.aio.server(options=SERVER_OPTIONS)
+async def start_engine_grpc(
+    service: PredictionService, port: int, *, reuse_port: bool = False
+) -> grpc.aio.Server:
+    options = SERVER_OPTIONS
+    if reuse_port:
+        # multi-worker engine: the kernel balances the shared port across
+        # worker processes (SERVER_OPTIONS disables reuse by default so
+        # single-server bind conflicts fail loudly)
+        options = [
+            (k, 1 if k == "grpc.so_reuseport" else v) for k, v in SERVER_OPTIONS
+        ]
+    server = grpc.aio.server(options=options)
     handler = SeldonGrpc(service)
     add_service(server, "Seldon", {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback})
     bound = await bind_insecure_port(server, port)

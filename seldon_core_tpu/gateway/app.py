@@ -26,7 +26,12 @@ import aiohttp
 from aiohttp import web
 
 from seldon_core_tpu.contract import failure_status_dict
-from seldon_core_tpu.gateway.auth import AuthError, TokenStore, verify_secret
+from seldon_core_tpu.gateway.auth import (
+    AuthError,
+    TokenStore,
+    token_store_from_env,
+    verify_secret,
+)
 from seldon_core_tpu.gateway.store import (
     DeploymentRecord,
     DeploymentStore,
@@ -52,7 +57,9 @@ class GatewayApp:
         timeout_s: float = 10.0,
     ):
         self.store = store
-        self.tokens = tokens or TokenStore()
+        # env-selected shared store (GATEWAY_TOKEN_STORE) so N replicas
+        # accept each other's tokens, like the reference's Redis token store
+        self.tokens = tokens or token_store_from_env()
         self.tap = tap or tap_from_env()
         self.metrics = metrics or DEFAULT_METRICS
         self.timeout = aiohttp.ClientTimeout(total=timeout_s)
@@ -247,6 +254,13 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--port", type=int, default=int(os.environ.get("GATEWAY_PORT", "8080")))
     parser.add_argument("--grpc-port", type=int, default=int(os.environ.get("GATEWAY_GRPC_PORT", "5000")))
     parser.add_argument("--deployments", default="", help="JSON file of deployment records")
+    parser.add_argument(
+        "--watch",
+        action="store_true",
+        default=os.environ.get("GATEWAY_WATCH") == "1",
+        help="watch SeldonDeployment CRs on the cluster API "
+        "(GATEWAY_KUBE_URL overrides the in-cluster endpoint)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -257,6 +271,26 @@ def main(argv: list[str] | None = None) -> None:
 
     gateway = GatewayApp(store)
     app = gateway.build()
+
+    if args.watch:
+        from seldon_core_tpu.gateway.watch import GatewayWatcher
+        from seldon_core_tpu.operator.kube_http import HttpKube
+
+        async def _start_watch(app_: web.Application) -> None:
+            kube = HttpKube(os.environ.get("GATEWAY_KUBE_URL") or None)
+            watcher = GatewayWatcher(
+                kube, store, namespace=os.environ.get("GATEWAY_NAMESPACE", "default")
+            )
+            await watcher.start()
+            app_["gateway_watcher"] = watcher
+
+        async def _stop_watch(app_: web.Application) -> None:
+            watcher = app_.get("gateway_watcher")
+            if watcher is not None:
+                await watcher.stop()
+
+        app.on_startup.append(_start_watch)
+        app.on_cleanup.append(_stop_watch)
 
     async def _start_grpc(app_: web.Application) -> None:
         try:

@@ -72,6 +72,77 @@ class TokenStore:
         return len(dead)
 
 
+class SharedTokenStore:
+    """Token store backed by a shared :class:`~seldon_core_tpu.runtime.
+    persistence.StateStore`, so N gateway replicas accept each other's
+    tokens — the role Redis plays for the reference's apife (reference:
+    AuthorizationServerConfiguration.java:64-67 RedisTokenStore).
+
+    Record layout: ``token_<token>`` -> JSON ``{key, expiry, issued}``
+    (wall-clock epoch seconds — replicas don't share a monotonic clock).
+    Revocation is O(1) without key scans: ``revoked_<oauth_key>`` holds an
+    epoch; tokens issued at or before it are dead.
+    """
+
+    def __init__(self, store, ttl_s: float = 43200.0, clock=time.time):
+        self.store = store
+        self.ttl_s = ttl_s
+        self._clock = clock
+
+    def issue(self, oauth_key: str) -> tuple[str, float]:
+        import json
+
+        token = secrets.token_urlsafe(32)
+        now = self._clock()
+        self.store.set(
+            f"token_{token}",
+            json.dumps(
+                {"key": oauth_key, "expiry": now + self.ttl_s, "issued": now}
+            ).encode(),
+        )
+        return token, self.ttl_s
+
+    def principal(self, token: str) -> str:
+        import json
+
+        raw = self.store.get(f"token_{token}")
+        if raw is None:
+            raise AuthError("invalid access token")
+        try:
+            rec = json.loads(raw)
+        except json.JSONDecodeError:
+            raise AuthError("invalid access token") from None
+        now = self._clock()
+        if now >= rec["expiry"]:
+            self.store.delete(f"token_{token}")
+            raise AuthError("token expired")
+        revoked = self.store.get(f"revoked_{rec['key']}")
+        if revoked is not None and rec["issued"] <= float(revoked):
+            raise AuthError("invalid access token")
+        return rec["key"]
+
+    def revoke_for_key(self, oauth_key: str) -> None:
+        self.store.set(f"revoked_{oauth_key}", str(self._clock()).encode())
+
+    def purge_expired(self) -> int:
+        return 0  # shared stores expire by read; Redis would use TTLs
+
+
+def token_store_from_env(environ: dict | None = None):
+    """``GATEWAY_TOKEN_STORE``: unset = in-process :class:`TokenStore`;
+    otherwise a ``PERSISTENCE_STORE``-style spec (``memory``,
+    ``redis://host``, ``file:<dir>``) for a :class:`SharedTokenStore`."""
+    import os
+
+    env = environ if environ is not None else os.environ
+    raw = env.get("GATEWAY_TOKEN_STORE", "")
+    if not raw:
+        return TokenStore()
+    from seldon_core_tpu.runtime.persistence import store_from_env
+
+    return SharedTokenStore(store_from_env({"PERSISTENCE_STORE": raw}))
+
+
 def verify_secret(expected: str, provided: str) -> bool:
     """Constant-time secret comparison."""
     return hmac.compare_digest(expected.encode(), provided.encode())

@@ -36,21 +36,19 @@ class NullTap:
         return None
 
 
-class JsonlTap:
-    """Append request/response pairs to ``{dir}/{client_id}.jsonl``.
+class QueuedTap:
+    """Base: publishes go through a bounded queue drained by a background
+    task — a slow sink must not stall serving (the reference bounds Kafka
+    blocking at 20ms for the same reason; here publish never blocks: the
+    pair is dropped when the queue is full, and drops are counted).
 
-    Writes go through a bounded queue drained by a background task — a slow
-    disk must not stall serving (the reference bounds Kafka blocking at 20ms
-    for the same reason; here publish never blocks: the pair is dropped when
-    the queue is full, and drops are counted).
-    """
+    Subclasses implement ``_emit(client_id, line)`` (async, may block)."""
 
-    def __init__(self, directory: str, max_queue: int = 4096):
-        self.directory = directory
-        os.makedirs(directory, exist_ok=True)
+    def __init__(self, max_queue: int = 4096):
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
         self._task: asyncio.Task | None = None
         self.dropped = 0
+        self.published = 0
 
     def _ensure_running(self) -> None:
         if self._task is None or self._task.done():
@@ -70,22 +68,20 @@ class JsonlTap:
         except asyncio.QueueFull:
             self.dropped += 1
 
-    def _write(self, client_id: str, line: dict) -> None:
-        path = os.path.join(self.directory, f"{client_id}.jsonl")
-        with open(path, "a") as f:
-            f.write(json.dumps(line) + "\n")
+    async def _emit(self, client_id: str, line: dict) -> None:
+        raise NotImplementedError
 
     async def _drain(self) -> None:
-        loop = asyncio.get_running_loop()
         while True:
             client_id, line = await self._queue.get()
             try:
-                # serialize+write off the event loop: a slow disk must not
-                # stall auth/predictions/health on the serving loop
-                await loop.run_in_executor(None, self._write, client_id, line)
-            except OSError:
+                await self._emit(client_id, line)
+                self.published += 1
+            except asyncio.CancelledError:
+                raise
+            except Exception:
                 self.dropped += 1
-                log.exception("tap write failed")
+                log.exception("tap emit failed")
 
     async def close(self) -> None:
         if self._task is not None:
@@ -98,8 +94,97 @@ class JsonlTap:
                 pass
 
 
+class JsonlTap(QueuedTap):
+    """Append request/response pairs to ``{dir}/{client_id}.jsonl``."""
+
+    def __init__(self, directory: str, max_queue: int = 4096):
+        super().__init__(max_queue)
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _write(self, client_id: str, line: dict) -> None:
+        path = os.path.join(self.directory, f"{client_id}.jsonl")
+        with open(path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+
+    async def _emit(self, client_id: str, line: dict) -> None:
+        # serialize+write off the event loop: a slow disk must not stall
+        # auth/predictions/health on the serving loop
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._write, client_id, line
+        )
+
+
+class BrokerTap(QueuedTap):
+    """Durable tap: publish pairs to the tap broker (seldon_core_tpu/
+    taplog.py), topic = client id, key = puid — the reference's Kafka
+    layout (KafkaRequestResponseProducer.java:70-73) over the framework's
+    own log service.  Appends are bounded-block (default 20ms, like the
+    reference's ``max.block.ms``)."""
+
+    def __init__(self, host: str, port: int, max_queue: int = 4096, timeout_s: float = 0.02):
+        super().__init__(max_queue)
+        from seldon_core_tpu.taplog import TapBrokerClient
+
+        self.client = TapBrokerClient(host, port, timeout_s=timeout_s)
+
+    async def _emit(self, client_id: str, line: dict) -> None:
+        await self.client.append(client_id, line["puid"], line)
+
+    async def close(self) -> None:
+        await super().close()
+        await self.client.close()
+
+
+class KafkaTap(QueuedTap):
+    """Kafka producer behind the same protocol, for images that ship a
+    Kafka client library (none is baked into this one — the in-repo
+    :class:`BrokerTap` is the default durable path)."""
+
+    def __init__(self, bootstrap: str, max_queue: int = 4096):
+        super().__init__(max_queue)
+        try:
+            from confluent_kafka import Producer  # noqa: PLC0415
+        except ImportError as e:  # pragma: no cover - env without kafka
+            raise RuntimeError(
+                "KafkaTap requires the 'confluent_kafka' package; use "
+                "GATEWAY_TAP_BROKER=<host:port> (BrokerTap) instead"
+            ) from e
+        self._producer = Producer(
+            {"bootstrap.servers": bootstrap, "max.block.ms": 20}
+        )
+
+    async def _emit(self, client_id: str, line: dict) -> None:  # pragma: no cover
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None,
+            lambda: (
+                self._producer.produce(
+                    client_id,
+                    key=line["puid"].encode(),
+                    value=json.dumps(line).encode(),
+                ),
+                self._producer.poll(0),
+            ),
+        )
+
+    async def close(self) -> None:  # pragma: no cover
+        await super().close()
+        await asyncio.get_running_loop().run_in_executor(None, self._producer.flush, 2)
+
+
 def tap_from_env(environ: dict | None = None) -> RequestResponseTap:
+    """``GATEWAY_TAP_BROKER=host:port`` (durable broker) >
+    ``GATEWAY_TAP_KAFKA=bootstrap`` (needs a kafka client lib) >
+    ``GATEWAY_TAP_DIR=<dir>`` (local JSONL) > disabled."""
     env = environ if environ is not None else os.environ
+    broker = env.get("GATEWAY_TAP_BROKER", "")
+    if broker:
+        host, _, port = broker.partition(":")
+        return BrokerTap(host or "127.0.0.1", int(port or 7780))
+    kafka = env.get("GATEWAY_TAP_KAFKA", "")
+    if kafka:
+        return KafkaTap(kafka)
     directory = env.get("GATEWAY_TAP_DIR", "")
     if directory:
         return JsonlTap(directory)

@@ -1,0 +1,166 @@
+"""Gateway-side control-plane watch: CRs -> deployment registry.
+
+The reference's apife runs its own CRD watch and registers/removes OAuth
+clients + routes as deployments come and go (reference:
+api-frontend/.../k8s/DeploymentWatcher.java:80-93,123-179).  Here the
+gateway subscribes to the same ``KubeApi`` protocol the operator uses —
+the in-process fake for tests/embedded mode, the real API-server binding
+in-cluster — so applying a SeldonDeployment makes the gateway route to it
+with no file edits or restarts.
+
+Routing target: the deployment-wide ClusterIP Service the operator emits
+(operator/resources.py:221-249), reached by service name exactly like the
+reference's apife (InternalPredictionService.java:141-155).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
+from seldon_core_tpu.operator.kube import Gone, KubeApi
+from seldon_core_tpu.operator.names import deployment_service_name
+from seldon_core_tpu.operator.resources import ENGINE_GRPC_PORT, ENGINE_REST_PORT
+
+log = logging.getLogger(__name__)
+
+CR_KIND = "SeldonDeployment"
+_SOURCE_ANNOTATION = "seldon.io/gateway-source"  # marks watch-fed records
+
+
+class GatewayWatcher:
+    """list+watch SeldonDeployments; keep the registry in sync.
+
+    Same resourceVersion bookkeeping as the operator loop
+    (operator/watcher.py): Gone restarts from a fresh list; a periodic
+    resync garbage-collects watch-sourced records whose CR vanished while
+    the gateway was down.  Records loaded from env/file (standalone mode)
+    are never touched.
+    """
+
+    def __init__(
+        self,
+        kube: KubeApi,
+        store: DeploymentStore,
+        namespace: str = "default",
+        resync_s: float = 30.0,
+    ):
+        self.kube = kube
+        self.store = store
+        self.namespace = namespace
+        self.resync_s = resync_s
+        self.resource_version = ""
+        self._tasks: list[asyncio.Task] = []
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._watch()),
+            loop.create_task(self._resync()),
+        ]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+
+    # -- loops ---------------------------------------------------------------
+
+    async def _watch(self) -> None:
+        while True:
+            try:
+                listed = await self.kube.list(CR_KIND, self.namespace)
+                self._reconcile_full(listed)
+                for raw in listed:
+                    self._note_rv(raw)
+                async for event, raw in self.kube.watch(
+                    CR_KIND, self.namespace, self.resource_version or None
+                ):
+                    self._apply(event, raw)
+                    self._note_rv(raw)
+            except Gone:
+                log.info("gateway CR watch resourceVersion gone; relisting")
+                self.resource_version = ""
+                continue
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("gateway CR watch failed; retrying")
+                await asyncio.sleep(1.0)
+
+    async def _resync(self) -> None:
+        while True:
+            await asyncio.sleep(self.resync_s)
+            try:
+                self._reconcile_full(await self.kube.list(CR_KIND, self.namespace))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("gateway resync failed; retrying next period")
+
+    # -- registry maintenance -------------------------------------------------
+
+    def _note_rv(self, raw: dict) -> None:
+        rv = raw.get("metadata", {}).get("resourceVersion", "")
+        if rv:
+            self.resource_version = rv
+
+    def _record(self, raw: dict) -> DeploymentRecord | None:
+        meta = raw.get("metadata", {})
+        spec = raw.get("spec", {})
+        name = meta.get("name") or spec.get("name")
+        if not name:
+            return None
+        return DeploymentRecord(
+            name=name,
+            oauth_key=spec.get("oauth_key") or name,
+            oauth_secret=spec.get("oauth_secret", ""),
+            # the operator's deployment-wide Service: kube-dns resolves it
+            # in-cluster; the fake/embedded mode overrides via annotations
+            engine_host=meta.get("annotations", {}).get(
+                "seldon.io/engine-host", deployment_service_name(name)
+            ),
+            engine_rest_port=int(
+                meta.get("annotations", {}).get(
+                    "seldon.io/engine-rest-port", ENGINE_REST_PORT
+                )
+            ),
+            engine_grpc_port=int(
+                meta.get("annotations", {}).get(
+                    "seldon.io/engine-grpc-port", ENGINE_GRPC_PORT
+                )
+            ),
+            annotations={_SOURCE_ANNOTATION: "watch"},
+        )
+
+    def _apply(self, event: str, raw: dict) -> None:
+        rec = self._record(raw)
+        if rec is None:
+            return
+        if event == "DELETED":
+            existing = self.store.get(rec.oauth_key)
+            if existing is not None and _is_watch_sourced(existing):
+                self.store.remove(rec.oauth_key)
+            return
+        existing = self.store.get(rec.oauth_key)
+        if existing != rec:
+            self.store.put(rec)
+
+    def _reconcile_full(self, listed: list[dict]) -> None:
+        desired: dict[str, DeploymentRecord] = {}
+        for raw in listed:
+            rec = self._record(raw)
+            if rec is not None:
+                desired[rec.oauth_key] = rec
+        for rec in self.store.list():
+            if _is_watch_sourced(rec) and rec.oauth_key not in desired:
+                self.store.remove(rec.oauth_key)
+        for key, rec in desired.items():
+            if self.store.get(key) != rec:
+                self.store.put(rec)
+
+
+def _is_watch_sourced(rec: DeploymentRecord) -> bool:
+    return rec.annotations.get(_SOURCE_ANNOTATION) == "watch"

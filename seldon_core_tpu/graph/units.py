@@ -248,8 +248,10 @@ def _jax_model(parameters: dict[str, Any]) -> Any:
     """JAX_MODEL implementation: compile a model-zoo family on device.
 
     Graph parameters: ``family`` (required), ``preset``, ``dtype``
-    ("bfloat16"/"float16"/"float32"), ``max_batch``, ``max_delay_ms``, plus
-    any model-config field override (e.g. ``n_classes``).
+    ("bfloat16"/"float16"/"float32"), ``max_batch``, ``max_delay_ms``,
+    ``buckets`` (comma-separated batch ladder, e.g. "8,32" — big models
+    want few compiled programs), plus any model-config field override
+    (e.g. ``n_classes``).
     """
     from seldon_core_tpu.models import registry as model_registry
 
@@ -259,6 +261,19 @@ def _jax_model(parameters: dict[str, Any]) -> Any:
     except KeyError:
         raise GraphUnitError("JAX_MODEL requires a 'family' parameter") from None
     dtype = _parse_dtype(params.pop("dtype", None), "JAX_MODEL")
+    raw_buckets = params.pop("buckets", None)
+    if raw_buckets is not None:
+        from seldon_core_tpu.executor import BucketSpec
+
+        try:
+            sizes = tuple(sorted(int(s) for s in str(raw_buckets).split(",")))
+            if not sizes or any(s < 1 for s in sizes):
+                raise ValueError(sizes)
+        except ValueError:
+            raise GraphUnitError(
+                f"buckets must be comma-separated positive ints, got {raw_buckets!r}"
+            ) from None
+        params["buckets"] = BucketSpec(sizes)
     try:
         return model_registry.build_component(family, dtype=dtype, **params)
     except (KeyError, TypeError) as e:

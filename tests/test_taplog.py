@@ -1,0 +1,194 @@
+"""Durable tap broker tests: wire protocol, durability across restart,
+bounded-block publisher behavior, and the gateway integration — the
+round-2 'integration test with an embedded broker' criterion (reference
+analogue: KafkaRequestResponseProducer.java:33-76)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from seldon_core_tpu.gateway.tap import BrokerTap, tap_from_env
+from seldon_core_tpu.taplog import TapBrokerClient, TapBrokerServer
+
+run = asyncio.run
+
+
+class TestBrokerServer:
+    def test_append_fetch_roundtrip(self, tmp_path):
+        async def go():
+            server = TapBrokerServer(str(tmp_path), port=0)
+            await server.start()
+            client = TapBrokerClient("127.0.0.1", server.bound_port, timeout_s=2.0)
+            try:
+                o0 = await client.append("topicA", "p1", {"x": 1})
+                o1 = await client.append("topicA", "p2", {"x": 2})
+                assert (o0, o1) == (0, 1)
+                await client.append("topicB", "q", {"y": 3})
+                records = await client.fetch("topicA", offset=0)
+                assert [r["value"]["x"] for r in records] == [1, 2]
+                assert records[0]["key"] == "p1"
+                # offset paging
+                page = await client.fetch("topicA", offset=1)
+                assert [r["offset"] for r in page] == [1]
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())
+
+    def test_offsets_survive_restart(self, tmp_path):
+        async def go():
+            server = TapBrokerServer(str(tmp_path), port=0)
+            await server.start()
+            client = TapBrokerClient("127.0.0.1", server.bound_port, timeout_s=2.0)
+            await client.append("t", "k", {"n": 1})
+            await client.close()
+            await server.close()
+
+            server2 = TapBrokerServer(str(tmp_path), port=0)
+            await server2.start()
+            client2 = TapBrokerClient("127.0.0.1", server2.bound_port, timeout_s=2.0)
+            try:
+                off = await client2.append("t", "k", {"n": 2})
+                assert off == 1  # continues from the durable log
+                records = await client2.fetch("t")
+                assert [r["value"]["n"] for r in records] == [1, 2]
+            finally:
+                await client2.close()
+                await server2.close()
+
+        run(go())
+
+    def test_client_reconnects_after_broker_restart(self, tmp_path):
+        async def go():
+            server = TapBrokerServer(str(tmp_path), port=0)
+            await server.start()
+            port = server.bound_port
+            client = TapBrokerClient("127.0.0.1", port, timeout_s=2.0)
+            await client.append("t", "k", {"n": 1})
+            await server.close()
+            # broker comes back on the same port
+            server2 = TapBrokerServer(str(tmp_path), port=port)
+            await server2.start()
+            try:
+                off = await client.append("t", "k", {"n": 2})
+                assert off == 1
+            finally:
+                await client.close()
+                await server2.close()
+
+        run(go())
+
+    def test_ping_and_unknown_op(self, tmp_path):
+        async def go():
+            server = TapBrokerServer(str(tmp_path), port=0)
+            await server.start()
+            client = TapBrokerClient("127.0.0.1", server.bound_port, timeout_s=2.0)
+            try:
+                assert await client.ping()
+                with pytest.raises(RuntimeError, match="append failed"):
+                    await client.append("", "k", {"x": 1})  # missing topic
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())
+
+    def test_publish_to_dead_broker_does_not_block(self):
+        async def go():
+            # port 1: nothing listens; publish must return ~immediately
+            tap = BrokerTap("127.0.0.1", 1, timeout_s=0.02)
+            t0 = asyncio.get_event_loop().time()
+            for _ in range(20):
+                await tap.publish("c", "p", {"a": 1}, {"b": 2})
+            publish_cost = asyncio.get_event_loop().time() - t0
+            assert publish_cost < 0.5  # enqueue only, never blocked on TCP
+            await asyncio.sleep(0.3)  # let the drain task hit the timeouts
+            await tap.close()
+            assert tap.dropped > 0 and tap.published == 0
+
+        run(go())
+
+
+class TestGatewayBrokerTap:
+    def test_predictions_reach_the_broker(self, tmp_path):
+        from seldon_core_tpu.gateway.app import GatewayApp
+        from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
+        from seldon_core_tpu.utils.metrics import MetricsRegistry
+
+        async def go():
+            broker = TapBrokerServer(str(tmp_path), port=0)
+            await broker.start()
+
+            async def pred(req):
+                return web.json_response(
+                    {"meta": {"puid": "puid-1"}, "data": {"ndarray": [[1.0]]},
+                     "status": {"status": "SUCCESS"}}
+                )
+
+            eng = web.Application()
+            eng.router.add_post("/api/v0.1/predictions", pred)
+            eng_server = TestServer(eng)
+            await eng_server.start_server()
+
+            store = DeploymentStore()
+            store.put(DeploymentRecord(
+                name="dep", oauth_key="k", oauth_secret="s",
+                engine_host="127.0.0.1", engine_rest_port=eng_server.port,
+            ))
+            tap = BrokerTap("127.0.0.1", broker.bound_port, timeout_s=2.0)
+            gw = GatewayApp(store, tap=tap, metrics=MetricsRegistry())
+            gw_server = TestServer(gw.build())
+            await gw_server.start_server()
+            try:
+                import aiohttp
+
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(
+                        f"http://127.0.0.1:{gw_server.port}/oauth/token",
+                        data={"client_id": "k", "client_secret": "s"},
+                    ) as r:
+                        tok = (await r.json())["access_token"]
+                    async with s.post(
+                        f"http://127.0.0.1:{gw_server.port}/api/v0.1/predictions",
+                        data=json.dumps({"data": {"ndarray": [[1.0]]}}),
+                        headers={"Authorization": f"Bearer {tok}"},
+                    ) as r:
+                        assert r.status == 200
+
+                consumer = TapBrokerClient("127.0.0.1", broker.bound_port, timeout_s=2.0)
+                deadline = asyncio.get_event_loop().time() + 5
+                records = []
+                while asyncio.get_event_loop().time() < deadline:
+                    records = await consumer.fetch("k")
+                    if records:
+                        break
+                    await asyncio.sleep(0.05)
+                await consumer.close()
+                assert records, "pair never reached the broker"
+                pair = records[0]["value"]
+                assert pair["puid"] == "puid-1"
+                assert pair["response"]["data"]["ndarray"] == [[1.0]]
+            finally:
+                await gw_server.close()
+                await eng_server.close()
+                await broker.close()
+
+        run(go())
+
+    def test_tap_from_env_selects_broker(self, tmp_path):
+        async def go():
+            server = TapBrokerServer(str(tmp_path), port=0)
+            await server.start()
+            tap = tap_from_env({"GATEWAY_TAP_BROKER": f"127.0.0.1:{server.bound_port}"})
+            try:
+                assert isinstance(tap, BrokerTap)
+            finally:
+                await tap.close()
+                await server.close()
+
+        run(go())
