@@ -8,10 +8,12 @@ param pytree as one atomic artifact, gathering sharded ``jax.Array`` leaves
 from device and re-sharding on load onto any mesh — the serving-side
 equivalent of an Orbax param checkpoint, with zero extra dependencies.
 
-Format: a single ``.npz`` holding ``arr_0..arr_N`` plus a pickled container
-skeleton (the pytree with leaves replaced by ``None``) and a dtype manifest.
-bfloat16 is stored as its uint16 bit pattern (numpy can't serialize it
-natively).  Writes are atomic (tmp + rename).
+Format: a single ``.npz`` holding ``arr_0..arr_N`` plus a JSON-encoded
+container skeleton (the pytree with leaves replaced by ``None``; dicts,
+lists, tuples and flax FrozenDicts are supported — no pickle, so loading a
+checkpoint from an untrusted source cannot execute code) and a dtype
+manifest.  bfloat16 is stored as its uint16 bit pattern (numpy can't
+serialize it natively).  Writes are atomic (tmp + rename).
 
 Multi-host note: ``jax.device_get`` gathers only addressable shards; on a
 multi-host slice each host must save to a shared filesystem from process 0
@@ -24,7 +26,6 @@ from __future__ import annotations
 
 import json
 import os
-import pickle
 import tempfile
 from typing import Any
 
@@ -39,11 +40,58 @@ from seldon_core_tpu.parallel.sharding import (
 
 _SKELETON_KEY = "__skeleton__"
 _MANIFEST_KEY = "__manifest__"
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
 
 
 def _is_none(x: Any) -> bool:
     return x is None
+
+
+def _encode_skeleton(node: Any) -> Any:
+    """Pytree container structure → JSON-safe value.  ``None`` marks a leaf
+    slot.  Pickle is deliberately avoided: a checkpoint must never be able to
+    execute code at load time."""
+    if node is None:
+        return None
+    if isinstance(node, dict) and type(node) is dict:
+        return {"t": "dict", "items": {str(k): _encode_skeleton(v) for k, v in node.items()}}
+    if isinstance(node, tuple):
+        return {"t": "tuple", "items": [_encode_skeleton(v) for v in node]}
+    if isinstance(node, list):
+        return {"t": "list", "items": [_encode_skeleton(v) for v in node]}
+    try:
+        from flax.core import FrozenDict
+
+        if isinstance(node, FrozenDict):
+            return {
+                "t": "frozendict",
+                "items": {str(k): _encode_skeleton(v) for k, v in node.items()},
+            }
+    except ImportError:
+        pass
+    raise TypeError(
+        f"checkpoint skeleton contains unsupported container {type(node)!r}; "
+        "supported: dict, list, tuple, flax FrozenDict"
+    )
+
+
+def _decode_skeleton(node: Any) -> Any:
+    if node is None:
+        return None
+    kind = node["t"]
+    if kind == "dict":
+        return {k: _decode_skeleton(v) for k, v in node["items"].items()}
+    if kind == "tuple":
+        return tuple(_decode_skeleton(v) for v in node["items"])
+    if kind == "list":
+        return [_decode_skeleton(v) for v in node["items"]]
+    if kind == "frozendict":
+        from flax.core import FrozenDict
+
+        return FrozenDict(
+            {k: _decode_skeleton(v) for k, v in node["items"].items()}
+        )
+    raise ValueError(f"unknown skeleton node kind {kind!r}")
 
 
 def save_params(path: str, params: Any) -> int:
@@ -71,7 +119,9 @@ def save_params(path: str, params: Any) -> int:
         arrays[f"arr_{i}"] = arr
         manifest.append(entry)
 
-    arrays[_SKELETON_KEY] = np.frombuffer(pickle.dumps(skeleton), dtype=np.uint8)
+    arrays[_SKELETON_KEY] = np.frombuffer(
+        json.dumps(_encode_skeleton(skeleton)).encode(), dtype=np.uint8
+    )
     arrays[_MANIFEST_KEY] = np.frombuffer(
         json.dumps({"version": _FORMAT_VERSION, "leaves": manifest}).encode(),
         dtype=np.uint8,
@@ -107,7 +157,7 @@ def load_params(
     (``CompiledModel`` then shards them at construction).
     """
     with np.load(path, allow_pickle=False) as z:
-        skeleton = pickle.loads(z[_SKELETON_KEY].tobytes())
+        skeleton = _decode_skeleton(json.loads(z[_SKELETON_KEY].tobytes().decode()))
         manifest = json.loads(z[_MANIFEST_KEY].tobytes().decode())
         if manifest.get("version") != _FORMAT_VERSION:
             raise ValueError(

@@ -31,6 +31,7 @@ import asyncio
 import dataclasses
 import json
 import logging
+import os
 import threading
 from typing import Any
 
@@ -264,7 +265,9 @@ class GenerationScheduler:
         self._queue: asyncio.Queue[_Request] = asyncio.Queue()
         self._task: asyncio.Task | None = None
         self._closed = False
-        self._seed = 0
+        # Random base so temperature>0 sampling differs across restarts and
+        # replicas; within one process the sequence stays deterministic.
+        self._seed = int.from_bytes(os.urandom(4), "little")
 
     def _next_seed(self) -> int:
         self._seed = (self._seed + 1) % (2**31 - 1)

@@ -108,6 +108,16 @@ class GatewayWatcher:
             self.resource_version = rv
 
     def _record(self, raw: dict) -> DeploymentRecord | None:
+        # One malformed CR (e.g. a non-numeric port annotation) must not abort
+        # the whole watch/reconcile iteration and stall every OTHER deployment.
+        try:
+            return self._record_unchecked(raw)
+        except (ValueError, TypeError, KeyError, AttributeError):
+            name = raw.get("metadata", {}).get("name", "<unnamed>")
+            log.exception("skipping malformed SeldonDeployment CR %r", name)
+            return None
+
+    def _record_unchecked(self, raw: dict) -> DeploymentRecord | None:
         meta = raw.get("metadata", {})
         spec = raw.get("spec", {})
         name = meta.get("name") or spec.get("name")

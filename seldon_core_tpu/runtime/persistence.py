@@ -89,6 +89,18 @@ class FileStateStore:
         # 0700: snapshots are unpickled on restore — other local users must
         # not be able to plant files here
         os.makedirs(root, mode=0o700, exist_ok=True)
+        # makedirs(exist_ok=True) is a no-op on a pre-existing directory, so
+        # an attacker who pre-created it (e.g. under the predictable /tmp
+        # default) could own it or leave it group/world-writable and plant
+        # snapshots that restore() unpickles.  Refuse such a directory.
+        st = os.stat(root)
+        if st.st_uid != os.getuid():
+            raise PermissionError(
+                f"state dir {root!r} is owned by uid {st.st_uid}, not us "
+                f"({os.getuid()}); refusing to unpickle snapshots from it"
+            )
+        if st.st_mode & 0o022:
+            os.chmod(root, st.st_mode & ~0o022)
 
     def _path(self, key: str) -> str:
         safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in key)

@@ -159,7 +159,14 @@ class TapBrokerServer:
                         continue
                     if len(records) >= limit:
                         break
-                    records.append(json.loads(line))
+                    try:
+                        records.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        # The trailing line may be a torn partial flush (read
+                        # happens outside the append lock); stop there rather
+                        # than failing the consumer connection — the completed
+                        # line will be served on the next fetch.
+                        break
         return {"ok": True, "records": records}
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:

@@ -49,6 +49,16 @@ class TestStores:
         assert b.get("k") == b"v"
         assert c.get("k") is None
 
+    def test_file_store_tightens_writable_preexisting_dir(self, tmp_path):
+        # A pre-created group/world-writable state dir would let other local
+        # users plant pickles that restore() executes; the store must clear
+        # those bits (and refuse foreign-owned dirs outright).
+        root = tmp_path / "state"
+        root.mkdir(mode=0o777)
+        os.chmod(root, 0o777)  # mkdir mode is masked by umask; force it
+        P.FileStateStore(str(root))
+        assert os.stat(root).st_mode & 0o022 == 0
+
     def test_store_from_env(self, tmp_path):
         assert isinstance(P.store_from_env({"PERSISTENCE_STORE": "memory"}), P.MemoryStateStore)
         s = P.store_from_env({"PERSISTENCE_STORE": f"file:{tmp_path}"})

@@ -63,6 +63,26 @@ class TestBrokerServer:
 
         run(go())
 
+    def test_fetch_tolerates_torn_trailing_line(self, tmp_path):
+        async def go():
+            server = TapBrokerServer(str(tmp_path), port=0)
+            await server.start()
+            client = TapBrokerClient("127.0.0.1", server.bound_port, timeout_s=2.0)
+            try:
+                await client.append("t", "k", {"n": 1})
+                # simulate a partially-flushed append racing the fetch
+                with open(tmp_path / "t.log", "ab") as f:
+                    f.write(b'{"offset": 1, "key": "k", "va')
+                records = await client.fetch("t")
+                assert [r["value"]["n"] for r in records] == [1]
+                # the connection survives for subsequent ops
+                assert await client.fetch("t", offset=0) == records
+            finally:
+                await client.close()
+                await server.close()
+
+        run(go())
+
     def test_client_reconnects_after_broker_restart(self, tmp_path):
         async def go():
             server = TapBrokerServer(str(tmp_path), port=0)
