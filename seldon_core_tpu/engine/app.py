@@ -41,10 +41,16 @@ def _status_body(code: int, reason: str) -> dict[str, Any]:
 
 
 class EngineApp:
-    def __init__(self, service: PredictionService):
+    def __init__(self, service: PredictionService, mesh_worker: bool = False):
         self.service = service
         self.paused = False
         self.metrics = service.metrics
+        # Non-coordinator host of a multi-host slice: joins the mesh and
+        # executes SPMD steps under the coordinator's direction (see
+        # executor/multihost.py follower loop) but never serves ingress —
+        # /ready stays 503 so the deployment-wide Service routes to the
+        # coordinator pod only.
+        self.mesh_worker = mesh_worker
         # readiness gates on warmup: every JAX unit's bucket ladder must be
         # compiled before /ready flips true, so the first real request never
         # pays an XLA compile (the reference's unwarmed engine shows a
@@ -74,6 +80,28 @@ class EngineApp:
 
     async def _startup(self, app: web.Application) -> None:
         await self.service.start()
+        if self.mesh_worker:
+            # worker host of a multi-host slice: the same units (and hence
+            # the same registered SPMD step fns) were just built; execute the
+            # coordinator's broadcast steps on a thread for the pod's whole
+            # life.  Warmup arrives as broadcast steps from the coordinator's
+            # warmup pass — running it locally too would double-issue
+            # collectives and wedge the slice.
+            from seldon_core_tpu.executor.multihost import get_driver
+
+            driver = get_driver()
+            if driver is not None:
+                import threading
+
+                threading.Thread(
+                    target=driver.follower_loop, daemon=True, name="sct-mh-follower"
+                ).start()
+            return
+        from seldon_core_tpu.executor.multihost import get_driver
+
+        driver = get_driver()
+        if driver is not None:
+            driver.start_heartbeat()
         if os.environ.get("ENGINE_WARMUP", "1") == "0" or not self.service.warmable_units():
             self.warmed = True
         else:
@@ -149,6 +177,8 @@ class EngineApp:
         return web.Response(text="pong")
 
     async def ready(self, request: web.Request) -> web.Response:
+        if self.mesh_worker:
+            return web.Response(text="mesh-worker", status=503)
         if self.paused:
             return web.Response(text="paused", status=503)
         if not self.warmed:
@@ -213,11 +243,22 @@ def main(argv: list[str] | None = None) -> None:
 
 
 def _serve(port: int, grpc_port: int, reuse_port: bool) -> None:
+    # join the slice mesh BEFORE anything touches the jax backend: the
+    # distributed runtime must exist when the TPU client initializes
+    from seldon_core_tpu.parallel.distributed import maybe_initialize
+
+    mesh_cfg = maybe_initialize()
+    if mesh_cfg is not None:
+        from seldon_core_tpu.executor.multihost import init_driver
+
+        init_driver(mesh_cfg.is_coordinator)
     predictor = load_predictor_spec()
     service = PredictionService(
         predictor, deployment_name=os.environ.get("SELDON_DEPLOYMENT_ID", "")
     )
-    engine = EngineApp(service)
+    engine = EngineApp(
+        service, mesh_worker=mesh_cfg is not None and not mesh_cfg.is_coordinator
+    )
     app = engine.build()
     app.on_startup.append(make_grpc_startup(service, grpc_port, reuse_port=reuse_port))
     app.on_cleanup.append(_grpc_cleanup)

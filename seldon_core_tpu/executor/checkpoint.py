@@ -54,8 +54,22 @@ def _encode_skeleton(node: Any) -> Any:
     if node is None:
         return None
     if isinstance(node, dict) and type(node) is dict:
-        return {"t": "dict", "items": {str(k): _encode_skeleton(v) for k, v in node.items()}}
+        # str(k) coercion would silently corrupt int-keyed trees at load
+        # time (params[0] -> params["0"]); fail at SAVE time instead
+        bad = [k for k in node if not isinstance(k, str)]
+        if bad:
+            raise TypeError(
+                f"checkpoint dict keys must be str; got {bad[:3]!r} — "
+                "JSON skeletons cannot round-trip non-string keys"
+            )
+        return {"t": "dict", "items": {k: _encode_skeleton(v) for k, v in node.items()}}
     if isinstance(node, tuple):
+        if hasattr(node, "_fields"):  # namedtuple: would flatten to tuple
+            raise TypeError(
+                f"checkpoint skeleton contains namedtuple {type(node).__name__}; "
+                "convert to dict/tuple before save_params (a JSON skeleton "
+                "cannot reconstruct the class)"
+            )
         return {"t": "tuple", "items": [_encode_skeleton(v) for v in node]}
     if isinstance(node, list):
         return {"t": "list", "items": [_encode_skeleton(v) for v in node]}
@@ -63,9 +77,14 @@ def _encode_skeleton(node: Any) -> Any:
         from flax.core import FrozenDict
 
         if isinstance(node, FrozenDict):
+            bad = [k for k in node.keys() if not isinstance(k, str)]
+            if bad:
+                raise TypeError(
+                    f"checkpoint FrozenDict keys must be str; got {bad[:3]!r}"
+                )
             return {
                 "t": "frozendict",
-                "items": {str(k): _encode_skeleton(v) for k, v in node.items()},
+                "items": {k: _encode_skeleton(v) for k, v in node.items()},
             }
     except ImportError:
         pass

@@ -73,9 +73,27 @@ class CompiledModel:
         buckets: BucketSpec = BucketSpec(),
         dtype: Any = None,
         name: str = "model",
+        driver: "Any | None" = None,
     ):
         self.name = name
         self.mesh = mesh
+        # multi-host slice: the mesh holds devices of other processes, so
+        # every step must be SPMD-coordinated through the MultihostDriver
+        # (executor/multihost.py) and outputs replicated so the coordinator
+        # can read the full batch result locally
+        self._multihost = mesh is not None and any(
+            d.process_index != jax.process_index() for d in mesh.devices.flat
+        )
+        if self._multihost and driver is None:
+            from seldon_core_tpu.executor.multihost import get_driver
+
+            driver = get_driver()  # engine boot initializes the process driver
+        if self._multihost and driver is None:
+            raise ValueError(
+                f"model {name!r}: mesh spans processes but no MultihostDriver "
+                "exists — steps would deadlock on the first cross-host collective"
+            )
+        self.driver = driver
         if mesh is not None:
             # the batch axis shards over (dp, fsdp): every device step must be
             # divisible by that product, so round the bucket ladder up to it
@@ -98,12 +116,26 @@ class CompiledModel:
             else:
                 params = jax.device_put(params, NamedSharding(mesh, P()))
             self._in_sharding = NamedSharding(mesh, rules.spec(("batch",)))
-            self._jitted = jax.jit(apply_fn)
+            out_shardings = NamedSharding(mesh, P()) if self._multihost else None
+            self._jitted = jax.jit(apply_fn, out_shardings=out_shardings)
         else:
             params = jax.device_put(params)
             self._in_sharding = None
             self._jitted = jax.jit(apply_fn)
         self.params = params
+        if self.driver is not None:
+            # register_unique suffixes a per-driver sequence number:
+            # construction order is deterministic from the shared graph spec,
+            # so keys line up across hosts even when two units share a
+            # family:preset name
+            self._step_key = self.driver.register_unique(
+                f"model:{name}", self._exec_step
+            )
+
+    def _exec_step(self, payload: dict) -> jax.Array:
+        """The symmetric SPMD step body — runs on every process of the
+        slice (coordinator inline via lead(), workers via follower_loop)."""
+        return self._jitted(self.params, self._place(payload["batch"]))
 
     # ----------------------------------------------------------------- calls
     def _pad(self, batch: np.ndarray) -> tuple[np.ndarray, int]:
@@ -136,6 +168,17 @@ class CompiledModel:
                 f"dispatch batch {batch.shape[0]} exceeds max bucket {self.buckets.max}"
             )
         padded, n = self._pad(batch)
+        if self.driver is not None:
+            if not self.driver.is_coordinator:
+                # a stray request reaching a worker pod (port-forward, curl,
+                # misrouted Service) must NOT issue collectives out of band
+                # with the coordinator's broadcast order — that wedges the
+                # whole slice until restart
+                raise RuntimeError(
+                    f"model {self.name!r}: dispatch on a mesh-worker process; "
+                    "only the slice coordinator serves requests"
+                )
+            return self.driver.lead(self._step_key, {"batch": padded}), n
         return self._jitted(self.params, self._place(padded)), n
 
     def fetch(self, out: jax.Array, n: int) -> np.ndarray:
@@ -166,7 +209,11 @@ class CompiledModel:
         """
         for b in self.buckets.sizes:
             x = np.zeros((b,) + tuple(feature_shape), dtype=dtype)
-            jax.block_until_ready(self._jitted(self.params, self._place(x)))
+            # warm through the dispatch path so multi-host slices compile
+            # each bucket on every process (workers get the same steps via
+            # the follower broadcast)
+            out, _ = self.dispatch(x)
+            jax.block_until_ready(out)
         return len(self.buckets.sizes)
 
     def aot_lower(self, feature_shape: tuple[int, ...], dtype: Any = np.float32):

@@ -88,6 +88,18 @@ class GenerativeModel:
         if int(n_slots) < 1:
             # a zero-slot scheduler would park every request forever
             raise GraphUnitError(f"n_slots must be >= 1, got {n_slots}")
+        if mesh is not None and any(
+            d.process_index != jax.process_index() for d in mesh.devices.flat
+        ):
+            # the decode loop's admit/step calls are not yet routed through
+            # the MultihostDriver — spanning hosts would deadlock on the
+            # first collective.  Shard generative models within one host
+            # (tp<=chips_per_host); multi-host generative is tracked work.
+            raise GraphUnitError(
+                f"generative model {name!r}: mesh spans processes; "
+                "JAX_GENERATIVE is single-host for now (use tp/sp within "
+                "one host's chips)"
+            )
         self.family = family_mod
         self.cfg = cfg
         self.n_slots = int(n_slots)

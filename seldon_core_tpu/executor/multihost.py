@@ -1,0 +1,211 @@
+"""SPMD step coordination across the hosts of a multi-host slice.
+
+In multi-controller JAX every process of a slice must issue the *same*
+program in the *same* order for cross-host collectives to complete — but
+only the coordinator pod receives ingress traffic (engine/app.py
+mesh_worker).  This module closes that gap with a broadcast-driven
+follower protocol:
+
+- every process registers the same step functions under the same keys
+  (construction is deterministic from the shared graph spec, so each host
+  builds identical CompiledModels);
+- the coordinator serializes each step's control message (key + payload)
+  and broadcasts it with ``multihost_utils.broadcast_one_to_all`` — itself
+  a collective every process participates in;
+- workers sit in :meth:`follower_loop`, decode each broadcast, and invoke
+  the registered function with the same operands, so the jitted call's
+  collectives line up across hosts;
+- an idle coordinator broadcasts NOOP heartbeats so workers never sit in a
+  collective long enough to hit the runtime's barrier timeout.
+
+The reference has no analogue — no model there ever spans processes
+(reference: SURVEY.md §2.7: replica Deployments behind a Service are the
+only scale-out).
+
+Wire format: a fixed 64 KiB header buffer (op + pickled metadata + inline
+payload when it fits), optionally followed by a second broadcast of the
+payload rounded up to 1 MiB granularity — bounded distinct shapes keep the
+number of compiled broadcast programs small.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+HEADER_BYTES = 64 * 1024
+CHUNK_BYTES = 1024 * 1024  # payload broadcasts round up to this granularity
+
+_OP_NOOP = 0
+_OP_STEP = 1
+_OP_EXIT = 2
+
+
+def _encode_header(op: int, meta: bytes, inline: bool) -> np.ndarray:
+    buf = np.zeros(HEADER_BYTES, dtype=np.uint8)
+    buf[0] = op
+    buf[1] = 1 if inline else 0
+    buf[2:10] = np.frombuffer(np.uint64(len(meta)).tobytes(), dtype=np.uint8)
+    if inline:
+        buf[10 : 10 + len(meta)] = np.frombuffer(meta, dtype=np.uint8)
+    return buf
+
+
+def _decode_header(buf: np.ndarray) -> tuple[int, int, bytes | None]:
+    op = int(buf[0])
+    inline = bool(buf[1])
+    size = int(np.frombuffer(buf[2:10].tobytes(), dtype=np.uint64)[0])
+    if inline:
+        return op, size, buf[10 : 10 + size].tobytes()
+    return op, size, None
+
+
+_driver: "MultihostDriver | None" = None
+
+
+def init_driver(is_coordinator: bool, heartbeat_s: float = 10.0) -> "MultihostDriver":
+    """Create the process-wide driver (engine boot, right after
+    jax.distributed initialization).  Idempotent."""
+    global _driver
+    if _driver is None:
+        _driver = MultihostDriver(is_coordinator, heartbeat_s=heartbeat_s)
+    return _driver
+
+
+def get_driver() -> "MultihostDriver | None":
+    """The process-wide driver, or None outside a multi-host slice."""
+    return _driver
+
+
+class MultihostDriver:
+    """Lead/follow protocol for SPMD steps over a multi-host slice.
+
+    One driver per process.  The coordinator calls :meth:`lead`; worker
+    processes run :meth:`follower_loop` (usually on a daemon thread started
+    by the engine boot).  ``register`` must be called identically on every
+    process before the first step.
+    """
+
+    def __init__(self, is_coordinator: bool, heartbeat_s: float = 10.0):
+        self.is_coordinator = is_coordinator
+        self.heartbeat_s = heartbeat_s
+        self._fns: dict[str, Callable[[Any], Any]] = {}
+        self._lock = threading.Lock()  # serializes broadcast order
+        self._stop = threading.Event()
+        self._last_step = time.monotonic()
+        self._hb_thread: threading.Thread | None = None
+
+    # -- registry ----------------------------------------------------------
+
+    def register(self, key: str, fn: Callable[[Any], Any]) -> None:
+        if key in self._fns:
+            raise ValueError(f"step fn {key!r} already registered")
+        self._fns[key] = fn
+
+    def register_unique(self, base: str, fn: Callable[[Any], Any]) -> str:
+        """Register under ``base#<seq>`` and return the key.  Deterministic
+        across processes as long as registration order is (it is: every host
+        builds the same units from the same graph spec in the same order)."""
+        key = f"{base}#{len(self._fns)}"
+        self.register(key, fn)
+        return key
+
+    # -- broadcast plumbing ------------------------------------------------
+
+    @staticmethod
+    def _broadcast(buf: np.ndarray) -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.broadcast_one_to_all(buf))
+
+    def _send(self, op: int, meta: bytes = b"") -> None:
+        inline = len(meta) <= HEADER_BYTES - 10
+        self._broadcast(_encode_header(op, meta, inline))
+        if not inline:
+            padded = -(-len(meta) // CHUNK_BYTES) * CHUNK_BYTES
+            payload = np.zeros(padded, dtype=np.uint8)
+            payload[: len(meta)] = np.frombuffer(meta, dtype=np.uint8)
+            self._broadcast(payload)
+
+    def _recv(self) -> tuple[int, bytes]:
+        got = self._broadcast(np.zeros(HEADER_BYTES, dtype=np.uint8))
+        op, size, meta = _decode_header(got)
+        if meta is None:
+            padded = -(-size // CHUNK_BYTES) * CHUNK_BYTES
+            payload = self._broadcast(np.zeros(padded, dtype=np.uint8))
+            meta = payload[:size].tobytes()
+        return op, meta
+
+    # -- coordinator side --------------------------------------------------
+
+    def lead(self, key: str, payload: Any) -> Any:
+        """Broadcast one step and execute it locally; returns the local
+        result.  Serialized: broadcast order is the SPMD program order."""
+        if not self.is_coordinator:
+            raise RuntimeError("lead() called on a follower process")
+        fn = self._fns[key]
+        meta = pickle.dumps((key, payload), protocol=pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            self._send(_OP_STEP, meta)
+            self._last_step = time.monotonic()
+            return fn(payload)
+
+    def start_heartbeat(self) -> None:
+        """Keep idle workers out of collective-barrier timeouts."""
+        if not self.is_coordinator or self._hb_thread is not None:
+            return
+
+        def _beat() -> None:
+            while not self._stop.wait(self.heartbeat_s / 2):
+                with self._lock:
+                    if time.monotonic() - self._last_step >= self.heartbeat_s:
+                        self._send(_OP_NOOP)
+                        self._last_step = time.monotonic()
+
+        self._hb_thread = threading.Thread(target=_beat, daemon=True, name="sct-mh-heartbeat")
+        self._hb_thread.start()
+
+    def shutdown(self) -> None:
+        """Coordinator: release the followers and stop the heartbeat."""
+        self._stop.set()
+        if self.is_coordinator:
+            with self._lock:
+                self._send(_OP_EXIT)
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+
+    # -- worker side -------------------------------------------------------
+
+    def follower_loop(self) -> None:
+        """Execute broadcast steps until the coordinator sends EXIT.
+
+        Runs on a (daemon) thread on worker processes — the collectives
+        block, so this must not share the asyncio event loop serving
+        /ping.  Unknown keys and step exceptions are logged, not fatal:
+        the worker must stay in lockstep for subsequent collectives.
+        """
+        if self.is_coordinator:
+            raise RuntimeError("follower_loop() called on the coordinator")
+        while not self._stop.is_set():
+            op, meta = self._recv()
+            if op == _OP_EXIT:
+                return
+            if op == _OP_NOOP:
+                continue
+            try:
+                key, payload = pickle.loads(meta)
+                fn = self._fns.get(key)
+                if fn is None:
+                    log.error("multihost step for unregistered key %r", key)
+                    continue
+                fn(payload)
+            except Exception:
+                log.exception("multihost follower step failed")

@@ -244,14 +244,43 @@ def _parse_dtype(raw: Any, impl_name: str) -> Any:
     return dtypes[raw]
 
 
+def _parse_mesh(raw: Any, impl_name: str):
+    """Graph-parameter mesh request -> jax.sharding.Mesh.
+
+    ``"auto"`` picks a serving mesh over every visible device (all hosts of
+    the slice — the mesh spans processes on multi-host, and CompiledModel
+    coordinates steps through the MultihostDriver); ``"tp=4,fsdp=2"`` etc.
+    names an explicit MeshPlan factorization.
+    """
+    if raw is None:
+        return None
+    from seldon_core_tpu.parallel import MeshPlan, best_mesh, make_mesh
+
+    raw = str(raw).strip()
+    if raw in ("auto", "all"):
+        return best_mesh()
+    try:
+        axes = {}
+        for part in raw.split(","):
+            k, _, v = part.partition("=")
+            axes[k.strip()] = int(v)
+        return make_mesh(MeshPlan(**axes))
+    except (ValueError, TypeError) as e:
+        raise GraphUnitError(
+            f"{impl_name} mesh must be 'auto' or 'dp=..,fsdp=..,tp=..,sp=..', "
+            f"got {raw!r}: {e}"
+        ) from None
+
+
 def _jax_model(parameters: dict[str, Any]) -> Any:
     """JAX_MODEL implementation: compile a model-zoo family on device.
 
     Graph parameters: ``family`` (required), ``preset``, ``dtype``
     ("bfloat16"/"float16"/"float32"), ``max_batch``, ``max_delay_ms``,
     ``buckets`` (comma-separated batch ladder, e.g. "8,32" — big models
-    want few compiled programs), plus any model-config field override
-    (e.g. ``n_classes``).
+    want few compiled programs), ``mesh`` ("auto" or "tp=4,fsdp=2" — shards
+    params over the slice per the family's logical axes), plus any
+    model-config field override (e.g. ``n_classes``).
     """
     from seldon_core_tpu.models import registry as model_registry
 
@@ -261,6 +290,18 @@ def _jax_model(parameters: dict[str, Any]) -> Any:
     except KeyError:
         raise GraphUnitError("JAX_MODEL requires a 'family' parameter") from None
     dtype = _parse_dtype(params.pop("dtype", None), "JAX_MODEL")
+    mesh = _parse_mesh(params.pop("mesh", None), "JAX_MODEL")
+    if mesh is not None:
+        params["mesh"] = mesh
+    sharding = str(params.pop("sharding", "default")).strip()
+    if sharding == "fsdp":
+        from seldon_core_tpu.parallel.sharding import FSDP_RULES
+
+        params["rules"] = FSDP_RULES
+    elif sharding != "default":
+        raise GraphUnitError(
+            f"JAX_MODEL sharding must be 'default' or 'fsdp', got {sharding!r}"
+        )
     raw_buckets = params.pop("buckets", None)
     if raw_buckets is not None:
         from seldon_core_tpu.executor import BucketSpec
@@ -292,6 +333,9 @@ def _jax_generative(parameters: dict[str, Any]) -> Any:
     params = dict(parameters)
     family = params.pop("family", "llama")
     dtype = _parse_dtype(params.pop("dtype", None), "JAX_GENERATIVE")
+    mesh = _parse_mesh(params.pop("mesh", None), "JAX_GENERATIVE")
+    if mesh is not None:
+        params["mesh"] = mesh
     try:
         return model_registry.build_generative_component(
             family, dtype=dtype, **params

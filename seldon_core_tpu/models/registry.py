@@ -108,6 +108,7 @@ def build_compiled(
     preset: str | None = None,
     cfg: Any = None,
     mesh: Mesh | None = None,
+    rules: Any = None,
     rng: int = 0,
     dtype: Any = None,
     buckets: BucketSpec = BucketSpec(),
@@ -128,6 +129,7 @@ def build_compiled(
         )
     params = _resolve_params(fam, cfg, params, checkpoint, rng)
     apply_fn = lambda p, x: fam.apply(p, x, cfg)  # noqa: E731
+    extra = {} if rules is None else {"rules": rules}
     return CompiledModel(
         apply_fn,
         params,
@@ -136,6 +138,7 @@ def build_compiled(
         buckets=buckets,
         dtype=dtype,
         name=f"{family}:{preset or 'default'}",
+        **extra,
     )
 
 

@@ -38,6 +38,24 @@ log = logging.getLogger(__name__)
 CR_KIND = "SeldonDeployment"
 
 
+# Annotations recording what the operator last applied.  Comparing desired
+# hashes against them (instead of full-JSON spec compares) makes reconciles
+# immune to server-side defaulting (which would otherwise read as drift and,
+# for StatefulSets, roll every slice's pods on every operator restart) while
+# still catching REMOVED fields (the desired hash changes).
+ANNOTATION_SPEC_HASH = "seldon.io/spec-hash"
+ANNOTATION_TEMPLATE_HASH = "seldon.io/template-hash"
+
+
+def _hash_of(value: Any) -> str:
+    import hashlib
+    import json
+
+    return hashlib.sha256(
+        json.dumps(value, sort_keys=True, default=str).encode()
+    ).hexdigest()[:32]
+
+
 class Controller:
     def __init__(self, kube: KubeApi, engine_image: str = ENGINE_IMAGE_DEFAULT):
         self.kube = kube
@@ -62,9 +80,16 @@ class Controller:
         try:
             defaulted = defaulting(mldep)
             validate(defaulted)
-            deployments, services = create_resources(defaulted, self.engine_image)
+            workloads, services = create_resources(defaulted, self.engine_image)
             uid = mldep.metadata.uid
-            await self._apply(ns, name, "Deployment", deployments, owner_uid=uid)
+            for kind in ("Deployment", "StatefulSet"):
+                await self._apply(
+                    ns,
+                    name,
+                    kind,
+                    [w for w in workloads if w["kind"] == kind],
+                    owner_uid=uid,
+                )
             await self._apply(ns, name, "Service", services, owner_uid=uid)
         except ValidationError as e:
             log.warning("deployment %s failed validation: %s", name, e)
@@ -108,6 +133,11 @@ class Controller:
         desired_names = {d["metadata"]["name"] for d in desired}
         for obj in desired:
             obj["metadata"].setdefault("labels", {})[LABEL_DEPLOYMENT_ID] = owner
+            annotations = obj["metadata"].setdefault("annotations", {})
+            annotations[ANNOTATION_SPEC_HASH] = _hash_of(obj.get("spec"))
+            template = obj.get("spec", {}).get("template")
+            if template is not None:
+                annotations[ANNOTATION_TEMPLATE_HASH] = _hash_of(template)
             if owner_uid:
                 # kube GC cleans these up even if the operator misses the
                 # CR deletion (down, watch gap)
@@ -126,11 +156,23 @@ class Controller:
             except NotFound:
                 await self.kube.create(kind, ns, obj)
                 continue
-            if self._spec_differs(existing, obj):
+            existing_ann = existing.get("metadata", {}).get("annotations", {})
+            if existing_ann.get(ANNOTATION_SPEC_HASH) != annotations[ANNOTATION_SPEC_HASH]:
                 merged = dict(existing)
                 merged["spec"] = obj["spec"]
-                merged["metadata"] = {**existing.get("metadata", {}), **obj["metadata"]}
+                merged["metadata"] = {
+                    **existing.get("metadata", {}),
+                    **obj["metadata"],
+                    "annotations": {**existing_ann, **annotations},
+                }
                 await self.kube.update(kind, ns, merged)
+                # whole-slice restart ONLY for pod-template changes: a
+                # replicas-only scale keeps healthy slice pods running
+                # (OnDelete creates the new ordinals without a roll)
+                if kind == "StatefulSet" and existing_ann.get(
+                    ANNOTATION_TEMPLATE_HASH
+                ) != annotations.get(ANNOTATION_TEMPLATE_HASH):
+                    await self._roll_statefulset(ns, merged)
         # orphan GC: owned objects no longer desired
         owned = await self.kube.list(kind, ns, {LABEL_DEPLOYMENT_ID: owner})
         for obj in owned:
@@ -140,13 +182,20 @@ class Controller:
                 except NotFound:
                     pass
 
-    @staticmethod
-    def _spec_differs(existing: dict[str, Any], desired: dict[str, Any]) -> bool:
-        import json
-
-        return json.dumps(existing.get("spec"), sort_keys=True) != json.dumps(
-            desired.get("spec"), sort_keys=True
-        )
+    async def _roll_statefulset(self, ns: str, sts: dict[str, Any]) -> None:
+        """Multi-host slices use updateStrategy OnDelete (worker pods never
+        go Ready, so RollingUpdate would wedge on the first worker, and a
+        slice's compiled programs must match across hosts anyway): restart
+        the whole slice by deleting its pods; the StatefulSet recreates them
+        in parallel from the new template."""
+        selector = sts.get("spec", {}).get("selector", {}).get("matchLabels", {})
+        if not selector:
+            return
+        for pod in await self.kube.list("Pod", ns, selector):
+            try:
+                await self.kube.delete("Pod", ns, pod["metadata"]["name"])
+            except NotFound:
+                pass
 
     # -- delete ------------------------------------------------------------
 
@@ -157,7 +206,7 @@ class Controller:
         ns = mldep.metadata.namespace
         self._spec_cache.pop(name, None)
         self._failed.pop(name, None)
-        for kind in ("Deployment", "Service"):
+        for kind in ("Deployment", "StatefulSet", "Service"):
             for obj in await self.kube.list(kind, ns, {LABEL_DEPLOYMENT_ID: name}):
                 try:
                     await self.kube.delete(kind, ns, obj["metadata"]["name"])
@@ -181,21 +230,38 @@ class Controller:
         available_all = True
         for predictor in mldep.spec.predictors:
             eng = engine_deployment_name(mldep.metadata.name, predictor.name)
-            try:
-                obj = await self.kube.get("Deployment", ns, eng)
-            except NotFound:
+            obj = None
+            for kind in ("Deployment", "StatefulSet"):  # multi-host engines are StatefulSets
+                try:
+                    obj = await self.kube.get(kind, ns, eng)
+                    break
+                except NotFound:
+                    continue
+            if obj is None:
                 available_all = False
                 statuses.append(PredictorStatus(name=predictor.name, replicas=predictor.replicas))
                 continue
-            avail = int(obj.get("status", {}).get("availableReplicas", 0))
+            status = obj.get("status", {})
+            avail = int(
+                status.get("availableReplicas", status.get("readyReplicas", 0)) or 0
+            )
+            if obj.get("kind") == "StatefulSet":
+                # multi-host slice: only the per-slice coordinator pod ever
+                # reports /ready (workers stay 503 to keep themselves out of
+                # the ingress Service), and the coordinator cannot become
+                # ready until jax.distributed.initialize has connected every
+                # host — so "one ready pod per slice replica" == "slice up"
+                want = predictor.replicas
+            else:
+                want = int(obj.get("spec", {}).get("replicas", predictor.replicas))
             statuses.append(
                 PredictorStatus(
                     name=predictor.name,
-                    replicas=predictor.replicas,
+                    replicas=want,
                     replicasAvailable=avail,
                 )
             )
-            if avail < predictor.replicas:
+            if avail < want:
                 available_all = False
         await self._write_status(
             mldep,
@@ -213,7 +279,7 @@ class Controller:
             cr["metadata"]["name"] for cr in await self.kube.list(CR_KIND, namespace)
         }
         removed = 0
-        for kind in ("Deployment", "Service"):
+        for kind in ("Deployment", "StatefulSet", "Service"):
             for obj in await self.kube.list(kind, namespace):
                 owner = obj.get("metadata", {}).get("labels", {}).get(LABEL_DEPLOYMENT_ID)
                 if owner and owner not in live:

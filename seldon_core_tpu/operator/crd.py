@@ -16,6 +16,7 @@ from typing import Any, Optional
 from pydantic import BaseModel, Field
 
 from seldon_core_tpu.graph.spec import PredictiveUnitSpec
+from seldon_core_tpu.operator.tpu import TpuSpec
 
 API_VERSION = "machinelearning.seldon.io/v1alpha2"
 KIND = "SeldonDeployment"
@@ -39,6 +40,10 @@ class PredictorDef(BaseModel):
     annotations: dict[str, str] = Field(default_factory=dict)
     labels: dict[str, str] = Field(default_factory=dict)
     engineResources: dict[str, Any] = Field(default_factory=dict)
+    # TPU slice request for the engine pod (which hosts LOCAL JAX units);
+    # defaulted automatically when the graph holds JAX_MODEL/JAX_GENERATIVE
+    # units (operator/defaulting.py).  hosts > 1 emits a multi-host pod set.
+    tpu: Optional[TpuSpec] = None
 
 
 class DeploymentDef(BaseModel):

@@ -33,6 +33,11 @@ from seldon_core_tpu.graph.spec import (
 from seldon_core_tpu.graph.units import has_builtin
 from seldon_core_tpu.operator.crd import PredictorDef, SeldonDeployment
 from seldon_core_tpu.operator.names import service_name
+from seldon_core_tpu.operator.tpu import (
+    NODE_SELECTOR_ACCELERATOR as TPU_NODE_SELECTOR,
+    TPU_RESOURCE,
+    TpuSpec,
+)
 
 PU_PORT_BASE = 9000
 ENV_SERVICE_PORT = "PREDICTIVE_UNIT_SERVICE_PORT"
@@ -40,9 +45,21 @@ ENV_PARAMETERS = "PREDICTIVE_UNIT_PARAMETERS"
 ENV_UNIT_ID = "PREDICTIVE_UNIT_ID"
 ENV_PREDICTOR_ID = "PREDICTOR_ID"
 ENV_DEPLOYMENT_ID = "SELDON_DEPLOYMENT_ID"
-TPU_RESOURCE = "google.com/tpu"
 TPU_ACCELERATOR_ANNOTATION = "seldon.io/tpu-accelerator"
-TPU_NODE_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+
+# Graph units that run JAX programs in-process in the engine pod — their
+# presence makes the ENGINE pod the TPU consumer.
+JAX_IMPLEMENTATIONS = frozenset(
+    {Implementation.JAX_MODEL, Implementation.JAX_GENERATIVE}
+)
+
+
+def _graph_wants_tpu(predictor: PredictorDef) -> bool:
+    return any(
+        u.implementation in JAX_IMPLEMENTATIONS
+        and u.endpoint.type == TransportType.LOCAL
+        for u in predictor.graph.iter_nodes()
+    )
 
 
 class ValidationError(Exception):
@@ -117,9 +134,38 @@ def defaulting(mldep: SeldonDeployment) -> SeldonDeployment:
                             ENV_PARAMETERS,
                             json.dumps([p.model_dump() for p in unit.parameters]),
                         )
-        # TPU node selector on any pod spec with a TPU-requesting container
+        # TPU scheduling.  Engine-side: a graph holding LOCAL JAX units makes
+        # the engine pod the TPU consumer — default its slice request so the
+        # resource generator pins it to a TPU node pool.
+        if predictor.tpu is None and _graph_wants_tpu(predictor):
+            predictor.tpu = TpuSpec()
+        # Component-side: a componentSpec may carry its own `tpu` request
+        # (a user container running its own JAX/XLA program); the graph-unit
+        # containers in that pod get the device-plugin resource and the pod
+        # gets the node-pool selectors.
         for cspec in predictor.componentSpecs:
             pod_spec = cspec.get("spec", {})
+            tpu_req = cspec.get("tpu")
+            if tpu_req is not None:
+                tpu = tpu_req if isinstance(tpu_req, TpuSpec) else TpuSpec.model_validate(tpu_req)
+                cspec["tpu"] = tpu.model_dump()
+                containers = pod_spec.get("containers", [])
+                unit_containers = [
+                    c for c in containers if c.get("name", "") in unit_names
+                ]
+                # exactly ONE container gets the device-plugin resource:
+                # granting the per-host chip count to several containers
+                # would over-request the node and leave the pod Pending
+                # forever.  First graph-unit container wins; a pod with no
+                # unit container (user sidecar running its own XLA program)
+                # grants the first container — pinning the pod without
+                # granting chips would strand a TPU node.
+                target = (unit_containers or containers)[:1]
+                for c in target:
+                    tpu.apply_to_container(c)
+                tpu.apply_to_pod(pod_spec)
+            # legacy annotation path: user set google.com/tpu limits by hand
+            # plus the accelerator annotation
             wants_tpu = any(
                 TPU_RESOURCE in c.get("resources", {}).get("limits", {})
                 for c in pod_spec.get("containers", [])

@@ -176,14 +176,16 @@ class FakeKube:
     def object_names(self, kind: str) -> set[str]:
         return {name for (k, _, name) in self._objects if k == kind}
 
-    def set_available_replicas(self, namespace: str, name: str, available: int) -> None:
-        """Simulate kubelet progress on a Deployment (drives status
+    def set_available_replicas(
+        self, namespace: str, name: str, available: int, kind: str = "Deployment"
+    ) -> None:
+        """Simulate kubelet progress on a workload (drives status
         writeback like the reference's second watcher,
         DeploymentWatcher.java:60-144)."""
-        key = self._key("Deployment", namespace, name)
+        key = self._key(kind, namespace, name)
         obj = self._objects[key]
         obj.setdefault("status", {})["availableReplicas"] = available
         obj["status"]["replicas"] = obj.get("spec", {}).get("replicas", 1)
         obj = self._stamp(obj)
         self._objects[key] = obj
-        self._emit("MODIFIED", "Deployment", obj)
+        self._emit("MODIFIED", kind, obj)

@@ -26,6 +26,8 @@ SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 _KIND_PATHS = {
     "Deployment": ("/apis/apps/v1", "deployments"),
+    "StatefulSet": ("/apis/apps/v1", "statefulsets"),
+    "Pod": ("/api/v1", "pods"),
     "Service": ("/api/v1", "services"),
     "SeldonDeployment": (f"/apis/{CRD_GROUP}/v1alpha2", CRD_PLURAL),
 }

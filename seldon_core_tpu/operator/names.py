@@ -30,3 +30,9 @@ def service_name(dep: str, predictor: str, container: str) -> str:
 
 def deployment_service_name(dep: str) -> str:
     return _clip(dep)
+
+
+def mesh_service_name(dep: str, predictor: str) -> str:
+    """Headless Service giving multi-host engine pods stable DNS for the
+    JAX distributed coordinator (parallel/distributed.py)."""
+    return _clip(f"{dep}-{predictor}-mesh")

@@ -15,6 +15,7 @@ ownership and orphan GC.
 from __future__ import annotations
 
 import base64
+import copy
 import json
 from typing import Any
 
@@ -28,8 +29,10 @@ from seldon_core_tpu.operator.names import (
     component_deployment_name,
     deployment_service_name,
     engine_deployment_name,
+    mesh_service_name,
     service_name,
 )
+from seldon_core_tpu.operator.tpu import TpuSpec
 
 ENGINE_IMAGE_DEFAULT = "seldon-core-tpu/engine:latest"
 ENGINE_REST_PORT = 8000
@@ -38,12 +41,26 @@ ENGINE_GRPC_PORT = 5001
 # second Tomcat "admin" connector on 8082; this engine has one listener)
 ENGINE_ADMIN_PORT = ENGINE_REST_PORT
 
+# Multi-host mesh boot contract: one pod per TPU host; the coordinator is
+# the slice's ordinal-0 pod, reachable by stable DNS through the headless
+# mesh Service.  Shared (jax-free) source: utils/mesh_contract.py.
+from seldon_core_tpu.utils.mesh_contract import (  # noqa: E402
+    DEFAULT_COORDINATOR_PORT as COORDINATOR_PORT,
+    ENV_COORDINATOR_PORT,
+    ENV_MESH_SERVICE,
+    ENV_NUM_PROCESSES,
+    ENV_POD_NAME,
+)
+
 
 def engine_container(mldep: SeldonDeployment, predictor: PredictorDef, image: str) -> dict[str, Any]:
+    # replicas excluded: the engine doesn't use it at runtime, and baking it
+    # into the pod env would turn a scale-only change into a template change
+    # (which rolls every pod of a multi-host slice)
     predictor_json = json.dumps(
-        predictor.model_dump(exclude={"componentSpecs"}), sort_keys=True
+        predictor.model_dump(exclude={"componentSpecs", "replicas"}), sort_keys=True
     )
-    return {
+    container = {
         "name": "seldon-container-engine",
         "image": image,
         "env": [
@@ -65,6 +82,17 @@ def engine_container(mldep: SeldonDeployment, predictor: PredictorDef, image: st
             "periodSeconds": 5,
             "failureThreshold": 3,
         },
+        # startupProbe holds liveness off while the engine blocks in
+        # jax.distributed.initialize (multi-host mesh formation can wait
+        # minutes for node-pool autoscaling) or in first-boot XLA warmup;
+        # without it the kubelet kills the pod after ~25s of unreachable
+        # /ping and a staggered CrashLoopBackOff can keep the mesh from
+        # ever forming
+        "startupProbe": {
+            "httpGet": {"path": "/ping", "port": ENGINE_ADMIN_PORT},
+            "periodSeconds": 10,
+            "failureThreshold": 90,
+        },
         "livenessProbe": {
             "httpGet": {"path": "/ping", "port": ENGINE_ADMIN_PORT},
             "initialDelaySeconds": 10,
@@ -81,8 +109,40 @@ def engine_container(mldep: SeldonDeployment, predictor: PredictorDef, image: st
                 }
             }
         },
-        "resources": predictor.engineResources or {"requests": {"cpu": "0.1"}},
+        # deep-copied: apply_to_container mutates, and aliasing the CR's
+        # engineResources dict would leak TPU limits into the spec writeback
+        # (changing ENGINE_PREDICTOR between operator runs -> spurious rolls)
+        "resources": copy.deepcopy(predictor.engineResources)
+        or {"requests": {"cpu": "0.1"}},
     }
+    if predictor.tpu is not None:
+        # the engine pod hosts the LOCAL JAX units, so it is the TPU
+        # consumer: device-plugin resource on the container (defaulting.py
+        # sets predictor.tpu whenever the graph holds JAX units)
+        predictor.tpu.apply_to_container(container)
+        if predictor.tpu.hosts > 1:
+            container["env"].extend(
+                [
+                    {"name": ENV_NUM_PROCESSES, "value": str(predictor.tpu.hosts)},
+                    {
+                        "name": ENV_MESH_SERVICE,
+                        "value": mesh_service_name(mldep.metadata.name, predictor.name),
+                    },
+                    {"name": ENV_COORDINATOR_PORT, "value": str(COORDINATOR_PORT)},
+                    {
+                        "name": ENV_POD_NAME,
+                        "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}},
+                    },
+                ]
+            )
+            container["ports"].append(
+                {
+                    "containerPort": COORDINATOR_PORT,
+                    "name": "coordinator",
+                    "protocol": "TCP",
+                }
+            )
+    return container
 
 
 def _labels(mldep: SeldonDeployment, extra: dict[str, str] | None = None) -> dict[str, str]:
@@ -127,10 +187,54 @@ def _deployment(
     }
 
 
+def _statefulset(
+    name: str,
+    namespace: str,
+    labels: dict[str, str],
+    pod_labels: dict[str, str],
+    pod_spec: dict[str, Any],
+    replicas: int,
+    service_name: str,
+    annotations: dict[str, str] | None = None,
+) -> dict[str, Any]:
+    """Multi-host engine slices are StatefulSets: stable pod ordinals give
+    each TPU host its JAX process id, and the headless Service gives the
+    ordinal-0 coordinator a stable DNS name (parallel/distributed.py)."""
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": dict(labels),
+        },
+        "spec": {
+            "replicas": replicas,
+            "serviceName": service_name,
+            "podManagementPolicy": "Parallel",  # all hosts must boot to form the mesh
+            # RollingUpdate would wedge: worker pods never report Ready (by
+            # design — see engine/app.py mesh_worker), and a slice's XLA
+            # programs must match across hosts anyway, so updates are
+            # whole-slice restarts: the controller deletes the slice's pods
+            # after pushing a changed spec (Controller._roll_statefulset)
+            "updateStrategy": {"type": "OnDelete"},
+            "selector": {"matchLabels": {"app.kubernetes.io/name": name}},
+            "template": {
+                "metadata": {
+                    "labels": {**pod_labels, "app.kubernetes.io/name": name},
+                    "annotations": annotations or {},
+                },
+                "spec": pod_spec,
+            },
+        },
+    }
+
+
 def create_resources(
     mldep: SeldonDeployment, engine_image: str = ENGINE_IMAGE_DEFAULT
 ) -> tuple[list[dict[str, Any]], list[dict[str, Any]]]:
-    """-> (deployments, services) — the full desired state for one CR."""
+    """-> (workloads, services) — the full desired state for one CR.
+    Workloads are Deployments, plus StatefulSets for multi-host slices."""
     ns = mldep.metadata.namespace
     deployments: list[dict[str, Any]] = []
     services: list[dict[str, Any]] = []
@@ -139,24 +243,75 @@ def create_resources(
         # engine deployment (the per-predictor orchestrator pod)
         eng_name = engine_deployment_name(mldep.metadata.name, predictor.name)
         eng_labels = _labels(mldep, {LABEL_SELDON_TYPE: "engine"})
-        deployments.append(
-            _deployment(
-                eng_name,
-                ns,
-                eng_labels,
-                {**_labels(mldep), "seldon-app": deployment_service_name(mldep.metadata.name)},
-                {
-                    "containers": [engine_container(mldep, predictor, engine_image)],
-                    "terminationGracePeriodSeconds": 20,
-                },
-                predictor.replicas,
-                annotations={
-                    "prometheus.io/scrape": "true",
-                    "prometheus.io/path": "/prometheus",
-                    "prometheus.io/port": str(ENGINE_ADMIN_PORT),
-                },
+        eng_pod_labels = {
+            **_labels(mldep),
+            "seldon-app": deployment_service_name(mldep.metadata.name),
+        }
+        eng_pod_spec = {
+            "containers": [engine_container(mldep, predictor, engine_image)],
+            "terminationGracePeriodSeconds": 20,
+        }
+        eng_annotations = {
+            "prometheus.io/scrape": "true",
+            "prometheus.io/path": "/prometheus",
+            "prometheus.io/port": str(ENGINE_ADMIN_PORT),
+        }
+        if predictor.tpu is not None:
+            predictor.tpu.apply_to_pod(eng_pod_spec)
+        if predictor.tpu is not None and predictor.tpu.hosts > 1:
+            # one pod per TPU host; ordinal // hosts = slice replica group,
+            # ordinal % hosts = JAX process id within the slice.  Ingress
+            # readiness is only reported by process 0 of each slice (the
+            # engine boot contract), so the deployment-wide Service routes
+            # to coordinators only.
+            mesh_svc = mesh_service_name(mldep.metadata.name, predictor.name)
+            deployments.append(
+                _statefulset(
+                    eng_name,
+                    ns,
+                    eng_labels,
+                    eng_pod_labels,
+                    eng_pod_spec,
+                    predictor.replicas * predictor.tpu.hosts,
+                    mesh_svc,
+                    annotations=eng_annotations,
+                )
             )
-        )
+            services.append(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Service",
+                    "metadata": {
+                        "name": mesh_svc,
+                        "namespace": ns,
+                        "labels": _labels(mldep),
+                    },
+                    "spec": {
+                        "clusterIP": "None",  # headless: per-pod DNS records
+                        "publishNotReadyAddresses": True,  # pods need DNS before the mesh is up
+                        "selector": {"app.kubernetes.io/name": eng_name},
+                        "ports": [
+                            {
+                                "port": COORDINATOR_PORT,
+                                "targetPort": COORDINATOR_PORT,
+                                "name": "coordinator",
+                            }
+                        ],
+                    },
+                }
+            )
+        else:
+            deployments.append(
+                _deployment(
+                    eng_name,
+                    ns,
+                    eng_labels,
+                    eng_pod_labels,
+                    eng_pod_spec,
+                    predictor.replicas,
+                    annotations=eng_annotations,
+                )
+            )
 
         # component deployments (user model pods)
         for idx, cspec in enumerate(predictor.componentSpecs):

@@ -1,0 +1,126 @@
+"""TPU scheduling: the operator's knowledge of Cloud TPU node pools.
+
+The reference schedules every pod as a generic CPU pod — its only resource
+logic is engine cpu/memory injection (reference:
+SeldonDeploymentOperatorImpl.java:98-144 engine resources, :195-292
+container update).  This framework is TPU-native: a predictor whose graph
+holds JAX units, or a componentSpec that asks for TPU, must land on a GKE
+Cloud TPU node pool.  That takes three things on the emitted pod:
+
+1. ``resources.limits["google.com/tpu"]`` on the container — the device
+   plugin resource GKE uses to mount TPU chips;
+2. nodeSelectors ``cloud.google.com/gke-tpu-accelerator`` (node pool
+   accelerator type) and ``cloud.google.com/gke-tpu-topology`` (chip
+   topology) so the scheduler picks the right pool;
+3. for multi-host slices, one pod per TPU host with a stable identity and
+   a headless Service so the hosts can form a JAX distributed mesh over
+   DCN (see parallel/distributed.py for the boot-side contract).
+
+``TpuSpec`` is the user-facing request: ``{accelerator, topology, chips,
+hosts}`` with everything derivable defaulted.  Topology "AxB[xC]" gives the
+chip count; host count follows the v5e/v5p slice shapes (≤8 chips fit one
+host; larger slices are 4 chips per host on v5e, 8 on v4/v5p).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from pydantic import BaseModel, model_validator
+
+TPU_RESOURCE = "google.com/tpu"
+NODE_SELECTOR_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+NODE_SELECTOR_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+DEFAULT_ACCELERATOR = "tpu-v5-lite-podslice"
+DEFAULT_TOPOLOGY = "2x4"  # v5e-8, the BASELINE.md target slice
+
+# chips per host for multi-host slices, by accelerator family.  Single-host
+# slices (chips <= 8) always co-locate on one host.
+_MULTI_HOST_CHIPS_PER_HOST = {
+    "tpu-v5-lite-podslice": 4,  # v5e multi-host: 4 chips/VM
+    "tpu-v5p-slice": 8,
+    "tpu-v4-podslice": 8,
+}
+
+
+def topology_chips(topology: str) -> int:
+    """``"2x4"`` -> 8; ``"4x4x4"`` -> 64."""
+    try:
+        dims = [int(d) for d in topology.lower().split("x")]
+    except ValueError:
+        raise ValueError(f"malformed TPU topology {topology!r}") from None
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"malformed TPU topology {topology!r}")
+    return math.prod(dims)
+
+
+class TpuSpec(BaseModel):
+    """A TPU slice request on a predictor or componentSpec.
+
+    ``chips`` and ``hosts`` are derived from ``topology`` when omitted, so
+    ``tpu: {}`` means one v5e-8 host and ``tpu: {topology: "4x4"}`` means a
+    16-chip, 4-host v5e slice.
+    """
+
+    accelerator: str = DEFAULT_ACCELERATOR
+    topology: str = DEFAULT_TOPOLOGY
+    chips: Optional[int] = None
+    hosts: Optional[int] = None
+
+    @model_validator(mode="after")
+    def _derive(self) -> "TpuSpec":
+        if self.chips is not None and "topology" not in self.model_fields_set:
+            # explicit chips with defaulted topology: derive the topology so
+            # the nodeSelector and the google.com/tpu request can't disagree
+            # (a 4-chip request pinned to a 2x4 pool is unschedulable)
+            known = {1: "1x1", 4: "2x2", 8: "2x4"}
+            if self.chips not in known:
+                raise ValueError(
+                    f"tpu.chips={self.chips} has no default topology; set "
+                    f"tpu.topology explicitly"
+                )
+            self.topology = known[self.chips]
+        n = topology_chips(self.topology)
+        if self.chips is None:
+            self.chips = n
+        elif self.chips != n:
+            raise ValueError(
+                f"tpu.chips={self.chips} contradicts topology "
+                f"{self.topology!r} ({n} chips)"
+            )
+        if self.hosts is None:
+            if self.chips <= 8:
+                self.hosts = 1
+            else:
+                per_host = _MULTI_HOST_CHIPS_PER_HOST.get(self.accelerator, 4)
+                if self.chips % per_host:
+                    raise ValueError(
+                        f"{self.chips} chips not divisible by {per_host} "
+                        f"chips/host for {self.accelerator}"
+                    )
+                self.hosts = self.chips // per_host
+        if self.chips % self.hosts:
+            raise ValueError(f"chips={self.chips} not divisible by hosts={self.hosts}")
+        return self
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.chips // self.hosts
+
+    def apply_to_container(self, container: dict) -> None:
+        """Set the TPU device-plugin resource (request == limit, as GKE
+        requires for extended resources) unless the user already did."""
+        resources = container.setdefault("resources", {})
+        limits = resources.setdefault("limits", {})
+        limits.setdefault(TPU_RESOURCE, str(self.chips_per_host))
+        resources.setdefault("requests", {}).setdefault(
+            TPU_RESOURCE, limits[TPU_RESOURCE]
+        )
+
+    def apply_to_pod(self, pod_spec: dict) -> None:
+        """Pin the pod to the matching GKE TPU node pool."""
+        sel = pod_spec.setdefault("nodeSelector", {})
+        sel.setdefault(NODE_SELECTOR_ACCELERATOR, self.accelerator)
+        sel.setdefault(NODE_SELECTOR_TOPOLOGY, self.topology)

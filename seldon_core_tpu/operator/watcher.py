@@ -37,7 +37,8 @@ class OperatorLoop:
         loop = asyncio.get_running_loop()
         self._tasks = [
             loop.create_task(self._watch_crs()),
-            loop.create_task(self._watch_deployments()),
+            loop.create_task(self._watch_workloads("Deployment")),
+            loop.create_task(self._watch_workloads("StatefulSet")),
             loop.create_task(self._resync()),
         ]
 
@@ -71,17 +72,19 @@ class OperatorLoop:
                 log.exception("CR watch failed; retrying")
                 await asyncio.sleep(1.0)
 
-    async def _watch_deployments(self) -> None:
+    async def _watch_workloads(self, kind: str) -> None:
+        """Status writeback feed: multi-host engines are StatefulSets, so
+        both workload kinds must drive on_deployment_event."""
         while True:
             try:
-                async for event, raw in self.kube.watch("Deployment", self.namespace):
+                async for event, raw in self.kube.watch(kind, self.namespace):
                     labels = raw.get("metadata", {}).get("labels", {})
                     if labels.get(LABEL_SELDON_TYPE) in ("deployment", "engine"):
                         await self.controller.on_deployment_event(raw)
             except asyncio.CancelledError:
                 raise
             except Exception:
-                log.exception("Deployment watch failed; retrying")
+                log.exception("%s watch failed; retrying", kind)
                 await asyncio.sleep(1.0)
 
     async def _resync(self) -> None:

@@ -18,6 +18,11 @@ XLA inserts the collectives (psum/all-gather/reduce-scatter/ppermute) from the
 sharding annotations; nothing here hand-writes NCCL-style calls.
 """
 
+from seldon_core_tpu.parallel.distributed import (
+    DistributedConfig,
+    config_from_env,
+    maybe_initialize,
+)
 from seldon_core_tpu.parallel.mesh import (
     MeshPlan,
     best_mesh,
@@ -31,6 +36,9 @@ from seldon_core_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "DistributedConfig",
+    "config_from_env",
+    "maybe_initialize",
     "MeshPlan",
     "best_mesh",
     "local_mesh",

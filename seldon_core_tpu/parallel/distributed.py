@@ -1,0 +1,126 @@
+"""Multi-host mesh formation over DCN: the JAX distributed runtime.
+
+The reference never spans a model across processes — its only scale-out is
+k8s replicas behind a Service (reference: SeldonDeploymentOperatorImpl.java
+:560-566; SURVEY §2.7).  Serving a model bigger than one TPU host requires
+every host of the slice to join one XLA program: `jax.distributed.initialize`
+connects the hosts' runtimes through a coordinator, after which
+`jax.devices()` is the *global* device list and a `Mesh` laid over it spans
+hosts — intra-host axes ride ICI, cross-host axes ride DCN.
+
+The operator emits the contract (operator/resources.py): a StatefulSet with
+one pod per TPU host plus a headless Service, and these env vars:
+
+- ``SCT_NUM_PROCESSES``  — hosts per slice replica;
+- ``SCT_MESH_SERVICE``   — headless Service name (stable per-pod DNS);
+- ``SCT_COORDINATOR_PORT``;
+- ``SCT_POD_NAME``       — this pod's name (downward API); its trailing
+  ordinal encodes both the slice replica group (ordinal // hosts) and this
+  host's process id within the slice (ordinal % hosts).
+
+Standalone/test runs can instead set ``SCT_COORDINATOR_ADDRESS`` and
+``SCT_PROCESS_ID`` explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+from seldon_core_tpu.utils.mesh_contract import (  # noqa: F401  (re-exported)
+    DEFAULT_COORDINATOR_PORT,
+    ENV_COORDINATOR_ADDRESS,
+    ENV_COORDINATOR_PORT,
+    ENV_MESH_SERVICE,
+    ENV_NUM_PROCESSES,
+    ENV_POD_NAME,
+    ENV_PROCESS_ID,
+)
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        """Process 0 serves ingress; workers join collectives only (their
+        /ready stays false so the deployment-wide Service skips them)."""
+        return self.process_id == 0
+
+
+def _pod_ordinal(pod_name: str) -> int:
+    try:
+        return int(pod_name.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        raise ValueError(
+            f"pod name {pod_name!r} has no trailing StatefulSet ordinal"
+        ) from None
+
+
+def config_from_env(environ: dict | None = None) -> DistributedConfig | None:
+    """None when the pod is single-host (no distributed env present)."""
+    env = environ if environ is not None else os.environ
+    raw_n = env.get(ENV_NUM_PROCESSES, "")
+    if not raw_n or int(raw_n) <= 1:
+        return None
+    n = int(raw_n)
+
+    explicit_addr = env.get(ENV_COORDINATOR_ADDRESS, "")
+    explicit_pid = env.get(ENV_PROCESS_ID, "")
+    if explicit_addr and explicit_pid:
+        return DistributedConfig(explicit_addr, n, int(explicit_pid))
+
+    pod_name = env.get(ENV_POD_NAME, "")
+    mesh_svc = env.get(ENV_MESH_SERVICE, "")
+    if not pod_name or not mesh_svc:
+        raise ValueError(
+            f"{ENV_NUM_PROCESSES}={n} but neither explicit "
+            f"({ENV_COORDINATOR_ADDRESS}+{ENV_PROCESS_ID}) nor pod "
+            f"({ENV_POD_NAME}+{ENV_MESH_SERVICE}) identity is set"
+        )
+    port = int(env.get(ENV_COORDINATOR_PORT, DEFAULT_COORDINATOR_PORT))
+    ordinal = _pod_ordinal(pod_name)
+    group = ordinal // n  # slice replica this host belongs to
+    process_id = ordinal % n
+    sts_base = pod_name.rsplit("-", 1)[0]
+    coordinator_pod = f"{sts_base}-{group * n}"
+    # headless-Service per-pod DNS: <pod>.<svc> resolves within the namespace
+    return DistributedConfig(f"{coordinator_pod}.{mesh_svc}:{port}", n, process_id)
+
+
+_initialized = False
+
+
+def maybe_initialize(environ: dict | None = None) -> DistributedConfig | None:
+    """Join the slice mesh if the operator asked for one; idempotent.
+
+    Called at engine boot before any jax API touches the backend (the
+    distributed runtime must exist before the TPU client initializes).
+    """
+    global _initialized
+    cfg = config_from_env(environ)
+    if cfg is None:
+        return None
+    if _initialized:
+        return cfg
+    import jax
+
+    log.info(
+        "joining distributed mesh: coordinator=%s process=%d/%d",
+        cfg.coordinator_address,
+        cfg.process_id,
+        cfg.num_processes,
+    )
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    _initialized = True
+    return cfg

@@ -96,12 +96,42 @@ class TapBrokerServer:
             if topic not in self._offsets:
                 count = 0
                 if os.path.exists(path):
+                    # crash recovery: a torn final record (no trailing
+                    # newline) was never acked — truncate it, or the next
+                    # append would concatenate onto it and leave one
+                    # permanently unparseable line mid-file that stalls
+                    # every consumer at that offset forever
+                    self._truncate_torn_tail(path)
                     with open(path, "rb") as existing:
                         count = sum(1 for _ in existing)
                 self._offsets[topic] = count
             f = open(path, "ab")
             self._files[topic] = f
         return f
+
+    @staticmethod
+    def _truncate_torn_tail(path: str) -> None:
+        with open(path, "rb+") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            if size == 0:
+                return
+            fh.seek(size - 1)
+            if fh.read(1) == b"\n":
+                return
+            # scan back to the last complete record boundary
+            pos = size - 1
+            chunk = 4096
+            while pos > 0:
+                read_from = max(0, pos - chunk)
+                fh.seek(read_from)
+                data = fh.read(pos - read_from)
+                nl = data.rfind(b"\n")
+                if nl != -1:
+                    fh.truncate(read_from + nl + 1)
+                    return
+                pos = read_from
+            fh.truncate(0)
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._handle, self.host, self.port)

@@ -1,0 +1,17 @@
+"""The operator↔engine multi-host boot contract: env names + port.
+
+Single source for both sides, deliberately jax-free: the operator's
+control-plane process must be able to emit the contract
+(operator/resources.py) without importing the JAX runtime, while the
+engine reads it at boot (parallel/distributed.py) before initializing the
+TPU client.
+"""
+
+ENV_NUM_PROCESSES = "SCT_NUM_PROCESSES"
+ENV_MESH_SERVICE = "SCT_MESH_SERVICE"
+ENV_COORDINATOR_PORT = "SCT_COORDINATOR_PORT"
+ENV_POD_NAME = "SCT_POD_NAME"
+ENV_COORDINATOR_ADDRESS = "SCT_COORDINATOR_ADDRESS"
+ENV_PROCESS_ID = "SCT_PROCESS_ID"
+
+DEFAULT_COORDINATOR_PORT = 8476

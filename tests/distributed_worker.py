@@ -1,0 +1,105 @@
+"""Subprocess worker for the multi-host DCN mesh test.
+
+Each invocation is one "TPU host": 4 virtual CPU devices, joining a
+2-process mesh through ``parallel.maybe_initialize`` exactly as an engine
+pod would (env contract from operator/resources.py).  The computation
+shards a matmul over a (dp=2, tp=4) mesh spanning both processes, so XLA
+must insert cross-process collectives; each process checks the global
+result against numpy.
+
+Run by tests/test_distributed.py — not a test module itself.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    ordinal = int(sys.argv[1])
+    port = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # the operator's StatefulSet env contract (operator/resources.py)
+    os.environ["SCT_NUM_PROCESSES"] = "2"
+    os.environ["SCT_MESH_SERVICE"] = "dep-p1-mesh"
+    os.environ["SCT_COORDINATOR_PORT"] = port
+    os.environ["SCT_POD_NAME"] = f"dep-p1-engine-{ordinal}"
+    # tests run on one machine: resolve the coordinator pod DNS to localhost
+    os.environ["SCT_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+    os.environ["SCT_PROCESS_ID"] = str(ordinal)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # tunnel plugin may re-pin TPU
+
+    from seldon_core_tpu.parallel import MeshPlan, make_mesh, maybe_initialize
+
+    cfg = maybe_initialize()
+    assert cfg is not None and cfg.num_processes == 2
+    assert cfg.process_id == ordinal
+    assert (ordinal == 0) == cfg.is_coordinator
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert len(jax.devices()) == 8, "mesh must span both processes"
+    assert jax.process_count() == 2
+
+    mesh = make_mesh(MeshPlan(dp=2, tp=4))
+    rng = np.random.default_rng(0)
+    x_np = rng.normal(size=(8, 16)).astype(np.float32)
+    w_np = rng.normal(size=(16, 32)).astype(np.float32)
+
+    x = jax.make_array_from_callback(
+        x_np.shape,
+        NamedSharding(mesh, P("dp", None)),
+        lambda idx: x_np[idx],
+    )
+    w = jax.make_array_from_callback(
+        w_np.shape,
+        NamedSharding(mesh, P(None, "tp")),
+        lambda idx: w_np[idx],
+    )
+
+    @jax.jit
+    def step(x, w):
+        return jax.nn.relu(x @ w).sum()
+
+    # the scalar output is fully replicated: every process sees the global
+    # value, proving the collectives crossed the process boundary
+    out = float(step(x, w))
+    expected = float(np.maximum(x_np @ w_np, 0.0).sum())
+    assert abs(out - expected) < 1e-2 * max(1.0, abs(expected)), (out, expected)
+    print(f"OK process={ordinal} out={out:.3f}")
+
+    # --- full serving path: CompiledModel + MultihostDriver lead/follow ---
+    # Both processes build the identical model over the shared mesh (exactly
+    # what two engine pods do from the same graph spec); the coordinator
+    # serves warmup + a request, the worker follows broadcast steps.
+    from seldon_core_tpu.executor.compiled import BucketSpec, CompiledModel
+    from seldon_core_tpu.executor.multihost import MultihostDriver
+
+    driver = MultihostDriver(is_coordinator=cfg.is_coordinator, heartbeat_s=2.0)
+    model = CompiledModel(
+        lambda p, b: jax.nn.relu(b @ p["w"]),
+        {"w": w_np},
+        mesh=mesh,
+        buckets=BucketSpec((4, 8)),
+        name="mh",
+        driver=driver,
+    )
+    if cfg.is_coordinator:
+        driver.start_heartbeat()
+        assert model.warmup((16,)) == 2
+        got = model(x_np[:5])  # odd size: pads up to bucket 8
+        want = np.maximum(x_np[:5] @ w_np, 0.0)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+        driver.shutdown()
+        print(f"OK-serving process={ordinal}")
+    else:
+        driver.follower_loop()
+        print(f"OK-serving process={ordinal}")
+
+
+if __name__ == "__main__":
+    main()

@@ -410,3 +410,82 @@ class TestCrossServiceGraph:
                 await client.close()
 
         run(go())
+
+
+class TestMeshServing:
+    """JAX units shard over a serving mesh from the `mesh`/`sharding` graph
+    parameters (VERDICT r2 #6: fsdp in the serving path, not just the
+    training dryrun).  8 virtual devices: dp=2 x fsdp=2 x tp=2."""
+
+    MESH_PREDICTOR = {
+        "name": "meshy",
+        "graph": {
+            "name": "m",
+            "type": "MODEL",
+            "implementation": "JAX_MODEL",
+            "parameters": [
+                {"name": "family", "value": "mlp", "type": "STRING"},
+                {"name": "preset", "value": "tiny", "type": "STRING"},
+                {"name": "mesh", "value": "dp=2,fsdp=2,tp=2", "type": "STRING"},
+                {"name": "sharding", "value": "fsdp", "type": "STRING"},
+            ],
+        },
+    }
+
+    def test_fsdp_tp_mesh_serving_matches_unsharded(self):
+        import jax
+
+        from seldon_core_tpu.models.registry import build_compiled
+
+        async def go():
+            service = PredictionService(PredictorSpec.model_validate(self.MESH_PREDICTOR))
+            app = EngineApp(service).build()
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                deadline = asyncio.get_event_loop().time() + 120
+                while asyncio.get_event_loop().time() < deadline:
+                    if (await client.get("/ready")).status == 200:
+                        break
+                    await asyncio.sleep(0.1)
+                model = service.walker.root.client.component.model
+                # params genuinely sharded: dense kernels are (embed->fsdp,
+                # mlp->tp) under FSDP_RULES
+                specs = {
+                    str(leaf.sharding.spec)
+                    for leaf in jax.tree.leaves(model.params)
+                }
+                assert any("fsdp" in s for s in specs), specs
+                assert any("tp" in s for s in specs), specs
+                rows = np.random.default_rng(3).normal(size=(3, 16)).tolist()
+                resp = await client.post(
+                    "/api/v0.1/predictions", json={"data": {"ndarray": rows}}
+                )
+                assert resp.status == 200
+                got = np.asarray((await resp.json())["data"]["ndarray"])
+                return got, rows
+            finally:
+                await client.close()
+
+        got, rows = run(go())
+        # same rng seed -> same init params: the sharded serving output must
+        # match a plain single-device model bit-for-bit-ish
+        ref = build_compiled("mlp", preset="tiny")(np.asarray(rows, np.float32))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    def test_bad_mesh_parameter_rejected(self):
+        from seldon_core_tpu.graph.units import GraphUnitError, create_builtin
+        from seldon_core_tpu.graph.spec import Implementation
+
+        import pytest as _pytest
+
+        with _pytest.raises(GraphUnitError, match="mesh"):
+            create_builtin(
+                Implementation.JAX_MODEL,
+                {"family": "mlp", "preset": "tiny", "mesh": "tp=banana"},
+            )
+        with _pytest.raises(GraphUnitError, match="sharding"):
+            create_builtin(
+                Implementation.JAX_MODEL,
+                {"family": "mlp", "preset": "tiny", "sharding": "nope"},
+            )

@@ -200,3 +200,28 @@ class TestJaxModelComponent:
         out = run(go())
         assert out.shape == (2, 3)
         assert comp.class_names == ["a", "b", "c"]
+
+
+class TestCheckpointSkeletonStrictness:
+    """JSON skeletons cannot represent non-string dict keys or namedtuple
+    classes; silently coercing them corrupts the tree at load time — the
+    save must fail loudly instead."""
+
+    def test_int_dict_keys_rejected_at_save(self, tmp_path):
+        import pytest as _pytest
+
+        from seldon_core_tpu.executor.checkpoint import save_params
+
+        with _pytest.raises(TypeError, match="keys must be str"):
+            save_params(str(tmp_path / "c.npz"), {0: np.zeros(2), 1: np.ones(2)})
+
+    def test_namedtuple_rejected_at_save(self, tmp_path):
+        import collections
+
+        import pytest as _pytest
+
+        from seldon_core_tpu.executor.checkpoint import save_params
+
+        PT = collections.namedtuple("PT", ["w"])
+        with _pytest.raises(TypeError, match="namedtuple"):
+            save_params(str(tmp_path / "c.npz"), PT(w=np.zeros(2)))

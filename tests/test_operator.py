@@ -350,3 +350,278 @@ class TestOperatorLoop:
             return names
 
         assert run(go()) == set()
+
+
+class TestTpuScheduling:
+    """The north star: JAX-unit graphs must land on TPU node pools
+    (VERDICT r2 #1).  Engine pods host LOCAL JAX units, so the engine gets
+    the google.com/tpu resource; componentSpecs opt in with a `tpu` key."""
+
+    @staticmethod
+    def jax_cr(tpu=None, replicas=1, name="jaxdep"):
+        cr = mk_cr(
+            name=name,
+            graph={"name": "m", "type": "MODEL", "implementation": "JAX_MODEL"},
+            replicas=replicas,
+        )
+        cr.spec.predictors[0].componentSpecs = []
+        if tpu is not None:
+            from seldon_core_tpu.operator.tpu import TpuSpec
+
+            cr.spec.predictors[0].tpu = TpuSpec.model_validate(tpu)
+        return cr
+
+    def test_jax_graph_defaults_tpu_slice(self):
+        out = defaulting(self.jax_cr())
+        tpu = out.spec.predictors[0].tpu
+        assert tpu is not None and tpu.chips == 8 and tpu.hosts == 1
+        deployments, _ = create_resources(out)
+        engine = next(d for d in deployments if "engine" in d["metadata"]["name"])
+        pod = engine["spec"]["template"]["spec"]
+        c = pod["containers"][0]
+        assert c["resources"]["limits"]["google.com/tpu"] == "8"
+        assert c["resources"]["requests"]["google.com/tpu"] == "8"
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == (
+            "tpu-v5-lite-podslice"
+        )
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+        assert engine["kind"] == "Deployment"  # single host: plain Deployment
+
+    def test_cpu_graph_gets_no_tpu_fields(self):
+        out = defaulting(mk_cr())
+        assert out.spec.predictors[0].tpu is None
+        deployments, services = create_resources(out)
+        raw = json.dumps(deployments + services)
+        assert "google.com/tpu" not in raw
+        assert "gke-tpu" not in raw
+
+    def test_component_spec_tpu_request(self):
+        cr = mk_cr()
+        cr.spec.predictors[0].componentSpecs[0]["tpu"] = {"topology": "2x2"}
+        out = defaulting(cr)
+        pod = out.spec.predictors[0].componentSpecs[0]["spec"]
+        c = pod["containers"][0]
+        assert c["resources"]["limits"]["google.com/tpu"] == "4"
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"
+
+    def test_multihost_emits_statefulset_and_mesh_service(self):
+        out = defaulting(self.jax_cr(tpu={"topology": "4x4"}))
+        tpu = out.spec.predictors[0].tpu
+        assert tpu.chips == 16 and tpu.hosts == 4 and tpu.chips_per_host == 4
+        workloads, services = create_resources(out)
+        sts = next(w for w in workloads if w["kind"] == "StatefulSet")
+        assert sts["spec"]["replicas"] == 4  # one pod per TPU host
+        assert sts["spec"]["podManagementPolicy"] == "Parallel"
+        mesh_svc = next(s for s in services if s["metadata"]["name"].endswith("-mesh"))
+        assert mesh_svc["spec"]["clusterIP"] == "None"
+        assert mesh_svc["spec"]["publishNotReadyAddresses"] is True
+        assert sts["spec"]["serviceName"] == mesh_svc["metadata"]["name"]
+        c = sts["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in c["env"]}
+        assert env["SCT_NUM_PROCESSES"] == "4"
+        assert env["SCT_MESH_SERVICE"] == mesh_svc["metadata"]["name"]
+        assert c["resources"]["limits"]["google.com/tpu"] == "4"
+        # pod identity flows from the downward API
+        pod_name_env = next(e for e in c["env"] if e["name"] == "SCT_POD_NAME")
+        assert pod_name_env["valueFrom"]["fieldRef"]["fieldPath"] == "metadata.name"
+
+    def test_multihost_replicas_scale_host_pods(self):
+        out = defaulting(self.jax_cr(tpu={"topology": "4x4"}, replicas=2))
+        workloads, _ = create_resources(out)
+        sts = next(w for w in workloads if w["kind"] == "StatefulSet")
+        assert sts["spec"]["replicas"] == 8  # 2 slice replicas x 4 hosts
+
+    def test_multihost_reconcile_e2e(self):
+        async def go():
+            kube = FakeKube()
+            ctl = Controller(kube)
+            cr = self.jax_cr(tpu={"topology": "4x4"})
+            await kube.create(CR_KIND, "default", cr.to_dict())
+            await ctl.reconcile(cr)
+            sts_names = kube.object_names("StatefulSet")
+            svc_names = kube.object_names("Service")
+            st0 = (await kube.get(CR_KIND, "default", "jaxdep")).get("status", {})
+            # only the slice coordinator ever reports ready (workers stay
+            # 503); one ready pod == the whole slice is up, because the
+            # coordinator can't be ready until all hosts joined the mesh
+            kube.set_available_replicas(
+                "default", "jaxdep-p1-engine", 1, kind="StatefulSet"
+            )
+            sts = await kube.get("StatefulSet", "default", "jaxdep-p1-engine")
+            await ctl.on_deployment_event(sts)
+            st1 = (await kube.get(CR_KIND, "default", "jaxdep")).get("status", {})
+            await ctl.delete(cr)
+            gone = kube.object_names("StatefulSet")
+            return sts_names, svc_names, st0, st1, gone
+
+        sts_names, svc_names, st0, st1, gone = run(go())
+        assert sts_names == {"jaxdep-p1-engine"}
+        assert "jaxdep-p1-mesh" in svc_names
+        assert st0["state"] == "Creating"
+        assert st1["state"] == "Available"
+        assert st1["predictorStatus"][0]["replicasAvailable"] == 1
+        assert gone == set()
+
+    def test_multihost_update_rolls_whole_slice(self):
+        """OnDelete strategy: a spec change must delete the slice's pods so
+        the StatefulSet recreates them together (worker pods never go Ready,
+        so RollingUpdate would wedge)."""
+
+        async def go():
+            kube = FakeKube()
+            ctl = Controller(kube)
+            cr = self.jax_cr(tpu={"topology": "4x4"})
+            await kube.create(CR_KIND, "default", cr.to_dict())
+            await ctl.reconcile(cr)
+            sts = await kube.get("StatefulSet", "default", "jaxdep-p1-engine")
+            assert sts["spec"]["updateStrategy"]["type"] == "OnDelete"
+            # simulate the kubelet's pods for the slice
+            sel = sts["spec"]["selector"]["matchLabels"]
+            for i in range(4):
+                await kube.create(
+                    "Pod",
+                    "default",
+                    {"metadata": {"name": f"jaxdep-p1-engine-{i}", "labels": dict(sel)}},
+                )
+            # spec change: bump the slice topology -> controller must update
+            # the STS and roll its pods
+            cr2 = self.jax_cr(tpu={"topology": "4x4", "hosts": 4})
+            cr2.spec.predictors[0].graph.parameters = []
+            cr2.spec.predictors[0].annotations["v"] = "2"
+            await ctl.reconcile(cr2)
+            return kube.object_names("Pod")
+
+        assert run(go()) == set()
+
+
+class TestTpuSpec:
+    def test_topology_chip_math(self):
+        from seldon_core_tpu.operator.tpu import TpuSpec, topology_chips
+
+        assert topology_chips("2x4") == 8
+        assert topology_chips("4x4x4") == 64
+        assert TpuSpec(topology="2x2").chips == 4
+        assert TpuSpec(topology="4x8").hosts == 8  # 32 chips / 4 per v5e host
+        assert TpuSpec(topology="2x4").chips_per_host == 8
+
+    def test_malformed_topology_rejected(self):
+        import pytest as _pytest
+
+        from seldon_core_tpu.operator.tpu import TpuSpec
+
+        with _pytest.raises(Exception):
+            TpuSpec(topology="banana")
+        with _pytest.raises(Exception):
+            TpuSpec(topology="0x4")
+
+
+class TestSpecHashReconcile:
+    """The operator compares what IT last applied (spec/template hash
+    annotations), so server-side defaulting never reads as drift, removed
+    fields do, and slice pods roll only on pod-template changes."""
+
+    def test_removed_field_still_reconciled(self):
+        """The old full-spec compare caught removals; the hash compare must
+        too: dropping engineResources limits has to produce an update."""
+
+        async def go():
+            kube = FakeKube()
+            ctl = Controller(kube)
+            cr = mk_cr()
+            cr.spec.predictors[0].engineResources = {"limits": {"memory": "4Gi"}}
+            await kube.create(CR_KIND, "default", cr.to_dict())
+            await ctl.reconcile(cr)
+            cr2 = mk_cr()  # limit removed
+            await ctl.reconcile(cr2)
+            eng = await kube.get("Deployment", "default", "mydep-p1-engine")
+            return eng["spec"]["template"]["spec"]["containers"][0]["resources"]
+
+        resources = run(go())
+        assert "limits" not in resources
+
+    def test_replicas_scale_does_not_roll_slice_pods(self):
+        """A replicas-only change updates the StatefulSet but must NOT
+        delete healthy slice pods (OnDelete adds new ordinals; only
+        template changes need a whole-slice restart)."""
+
+        async def go():
+            kube = FakeKube()
+            ctl = Controller(kube)
+            cr = TestTpuScheduling.jax_cr(tpu={"topology": "4x4"})
+            await kube.create(CR_KIND, "default", cr.to_dict())
+            await ctl.reconcile(cr)
+            sts = await kube.get("StatefulSet", "default", "jaxdep-p1-engine")
+            sel = sts["spec"]["selector"]["matchLabels"]
+            for i in range(4):
+                await kube.create(
+                    "Pod",
+                    "default",
+                    {"metadata": {"name": f"jaxdep-p1-engine-{i}", "labels": dict(sel)}},
+                )
+            cr2 = TestTpuScheduling.jax_cr(tpu={"topology": "4x4"}, replicas=2)
+            await ctl.reconcile(cr2)
+            sts2 = await kube.get("StatefulSet", "default", "jaxdep-p1-engine")
+            return sts2["spec"]["replicas"], kube.object_names("Pod")
+
+        replicas, pods = run(go())
+        assert replicas == 8  # scale applied
+        assert pods == {f"jaxdep-p1-engine-{i}" for i in range(4)}  # no roll
+
+    def test_operator_restart_does_not_roll_slice(self):
+        """Reconcile twice with a fresh controller (empty spec cache, like a
+        restart) against a kube whose stored objects carry server defaults:
+        no pod deletion may happen."""
+
+        async def go():
+            kube = FakeKube()
+            cr = TestTpuScheduling.jax_cr(tpu={"topology": "4x4"})
+            await kube.create(CR_KIND, "default", cr.to_dict())
+            await Controller(kube).reconcile(cr)
+            # server fills defaults on the stored StatefulSet
+            sts = await kube.get("StatefulSet", "default", "jaxdep-p1-engine")
+            sts["spec"]["revisionHistoryLimit"] = 10
+            sts["spec"]["template"]["spec"]["dnsPolicy"] = "ClusterFirst"
+            await kube.update("StatefulSet", "default", sts)
+            sel = sts["spec"]["selector"]["matchLabels"]
+            for i in range(4):
+                await kube.create(
+                    "Pod",
+                    "default",
+                    {"metadata": {"name": f"jaxdep-p1-engine-{i}", "labels": dict(sel)}},
+                )
+            # operator restart: new controller, same CR
+            await Controller(kube).reconcile(cr)
+            return kube.object_names("Pod")
+
+        assert run(go()) == {f"jaxdep-p1-engine-{i}" for i in range(4)}
+
+
+class TestTpuSpecConsistency:
+    def test_explicit_chips_derives_topology(self):
+        from seldon_core_tpu.operator.tpu import TpuSpec
+
+        assert TpuSpec(chips=4).topology == "2x2"
+        assert TpuSpec(chips=1).topology == "1x1"
+
+    def test_contradictory_chips_topology_rejected(self):
+        import pytest as _pytest
+
+        from seldon_core_tpu.operator.tpu import TpuSpec
+
+        with _pytest.raises(Exception, match="contradicts"):
+            TpuSpec(chips=4, topology="2x4")
+        with _pytest.raises(Exception, match="no default topology"):
+            TpuSpec(chips=6)
+
+    def test_component_tpu_without_unit_container_grants_devices(self):
+        """Pinning a pod to a TPU pool without granting chips strands the
+        node; the first container gets the devices as fallback."""
+        cr = mk_cr(containers=("sidecar-xla",))  # not a graph unit
+        cr.spec.predictors[0].graph = type(cr.spec.predictors[0].graph).from_dict(
+            {"name": "sm", "type": "MODEL", "implementation": "SIMPLE_MODEL"}
+        )
+        cr.spec.predictors[0].componentSpecs[0]["tpu"] = {"topology": "2x2"}
+        out = defaulting(cr)
+        pod = out.spec.predictors[0].componentSpecs[0]["spec"]
+        c = pod["containers"][0]
+        assert c["resources"]["limits"]["google.com/tpu"] == "4"

@@ -212,3 +212,38 @@ class TestGatewayBrokerTap:
                 await server.close()
 
         run(go())
+
+
+class TestTornTailRecovery:
+    def test_crash_torn_tail_truncated_on_reopen(self, tmp_path):
+        """A partial record left by a crash mid-write must be truncated on
+        reopen — otherwise the next append concatenates onto it, creating a
+        permanently unparseable line that stalls consumers forever."""
+        import asyncio
+        import json as _json
+
+        from seldon_core_tpu.taplog import TapBrokerServer
+
+        d = str(tmp_path)
+
+        async def go():
+            b1 = TapBrokerServer(directory=d, host="127.0.0.1", port=0)
+            await b1.start()
+            r = await b1._append({"topic": "t", "key": "k", "value": {"n": 1}})
+            assert r["ok"]
+            await b1.close()
+            # simulate a crash mid-write: torn partial record, no newline
+            with open(f"{d}/t.log", "ab") as f:
+                f.write(b'{"offset":1,"ts":123,"key":"k","va')
+            b2 = TapBrokerServer(directory=d, host="127.0.0.1", port=0)
+            await b2.start()
+            r2 = await b2._append({"topic": "t", "key": "k", "value": {"n": 2}})
+            fetched = await b2._fetch({"topic": "t", "offset": 0, "max": 10})
+            await b2.close()
+            return r2, fetched
+
+        r2, fetched = asyncio.run(go())
+        # torn record was never acked: its offset is reused by the new append
+        assert r2 == {"ok": True, "offset": 1}
+        values = [rec["value"] for rec in fetched["records"]]
+        assert values == [{"n": 1}, {"n": 2}]  # every line parseable
