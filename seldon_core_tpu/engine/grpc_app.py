@@ -50,6 +50,37 @@ class SeldonGrpc:
 
 async def start_engine_grpc(
     service: PredictionService, port: int, *, reuse_port: bool = False
+):
+    """Start the engine's Seldon gRPC service.
+
+    Default transport is the asyncio data plane (wire/h2grpc.py) — ~3×
+    the per-core throughput of grpcio, which is what lets engine gRPC
+    beat engine REST like the reference's Java engine does
+    (docs/benchmarking.md:53-63).  ``ENGINE_GRPC_IMPL=grpcio`` falls back
+    to the grpcio server (wire-compatible either way).
+    """
+    from seldon_core_tpu.proto.grpc_defs import raw_handlers, use_grpcio
+
+    handler = SeldonGrpc(service)
+    if use_grpcio():
+        return await _start_grpcio(handler, port, reuse_port)
+
+    from seldon_core_tpu.wire import FastGrpcServer
+
+    server = FastGrpcServer(
+        raw_handlers(
+            "Seldon",
+            {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback},
+        )
+    )
+    bound = await server.start(port, reuse_port=reuse_port)
+    server.bound_port = bound
+    log.info("engine gRPC (Seldon service, h2 data plane) on :%d", bound)
+    return server
+
+
+async def _start_grpcio(
+    handler: SeldonGrpc, port: int, reuse_port: bool
 ) -> grpc.aio.Server:
     options = SERVER_OPTIONS
     if reuse_port:
@@ -60,7 +91,6 @@ async def start_engine_grpc(
             (k, 1 if k == "grpc.so_reuseport" else v) for k, v in SERVER_OPTIONS
         ]
     server = grpc.aio.server(options=options)
-    handler = SeldonGrpc(service)
     add_service(server, "Seldon", {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback})
     bound = await bind_insecure_port(server, port)
     await server.start()

@@ -27,15 +27,23 @@ from seldon_core_tpu.proto.grpc_defs import SERVER_OPTIONS, Stub
 
 
 class ChannelCache:
-    """target -> grpc.aio channel; one multiplexed channel per endpoint."""
+    """target -> channel; one multiplexed connection per endpoint.  Fast
+    (wire/h2grpc.py) channels by default, grpc.aio via SCT_GRPC_IMPL."""
 
     def __init__(self):
-        self._channels: dict[str, grpc.aio.Channel] = {}
+        self._channels: dict[str, object] = {}
 
-    def get(self, target: str) -> grpc.aio.Channel:
+    def get(self, target: str):
+        from seldon_core_tpu.proto.grpc_defs import use_grpcio
+
         ch = self._channels.get(target)
         if ch is None:
-            ch = grpc.aio.insecure_channel(target, options=SERVER_OPTIONS)
+            if use_grpcio():
+                ch = grpc.aio.insecure_channel(target, options=SERVER_OPTIONS)
+            else:
+                from seldon_core_tpu.wire import FastGrpcChannel
+
+                ch = FastGrpcChannel(target)
             self._channels[target] = ch
         return ch
 
@@ -43,6 +51,14 @@ class ChannelCache:
         for ch in self._channels.values():
             await ch.close()
         self._channels.clear()
+
+
+def _stub(channel, service: str):
+    from seldon_core_tpu.wire import FastGrpcChannel, FastStub
+
+    if isinstance(channel, FastGrpcChannel):
+        return FastStub(channel, service)
+    return Stub(channel, service)
 
 
 class GrpcNodeClient:
@@ -54,20 +70,27 @@ class GrpcNodeClient:
         ep = spec.endpoint
         self.target = f"{ep.service_host}:{ep.service_port}"
         ch = channels.get(self.target)
-        self._model = Stub(ch, "Model")
-        self._router = Stub(ch, "Router")
-        self._transformer = Stub(ch, "Transformer")
-        self._output_transformer = Stub(ch, "OutputTransformer")
-        self._combiner = Stub(ch, "Combiner")
+        self._model = _stub(ch, "Model")
+        self._router = _stub(ch, "Router")
+        self._transformer = _stub(ch, "Transformer")
+        self._output_transformer = _stub(ch, "OutputTransformer")
+        self._combiner = _stub(ch, "Combiner")
 
     async def _call(self, method, request) -> Payload:
+        import asyncio
+
         from seldon_core_tpu.engine.transport import RemoteUnitError
+        from seldon_core_tpu.wire import GrpcCallError
 
         try:
             reply: pb.SeldonMessage = await method(request, timeout=self.timeout)
         except grpc.aio.AioRpcError as e:
             raise RemoteUnitError(
                 f"unit {self.spec.name!r} gRPC {self.target} unreachable: {e.code().name}"
+            ) from e
+        except (GrpcCallError, ConnectionError, asyncio.TimeoutError, OSError) as e:
+            raise RemoteUnitError(
+                f"unit {self.spec.name!r} gRPC {self.target} failed: {e}"
             ) from e
         if reply.HasField("status") and reply.status.status == pb.Status.FAILURE:
             raise RemoteUnitError(

@@ -126,6 +126,37 @@ def add_service(server: Any, service: str, handlers: dict[str, Callable]) -> Non
     )
 
 
+def use_grpcio() -> bool:
+    """Transport selector: the asyncio data plane (wire/h2grpc.py) is the
+    default; ``SCT_GRPC_IMPL=grpcio`` (or the engine-specific
+    ``ENGINE_GRPC_IMPL``) falls back to grpcio."""
+    import os
+
+    return (
+        os.environ.get("ENGINE_GRPC_IMPL") == "grpcio"
+        or os.environ.get("SCT_GRPC_IMPL") == "grpcio"
+    )
+
+
+def raw_handlers(service: str, handlers: dict[str, Callable]) -> dict[str, Callable]:
+    """Adapt proto-typed async handlers (``fn(msg, context)``) to the fast
+    server's path->bytes-handler table."""
+    out: dict[str, Callable] = {}
+    for method, fn in handlers.items():
+        req, _res = SERVICES[service][method]
+
+        def make(fn=fn, req=req):
+            async def raw(payload: bytes) -> bytes:
+                msg = req.FromString(payload)
+                reply = await fn(msg, None)
+                return reply.SerializeToString()
+
+            return raw
+
+        out[f"/{full_service_name(service)}/{method}"] = make()
+    return out
+
+
 class Stub:
     """Typed unary-unary stub over any channel: ``Stub(channel, "Model").Predict(msg)``."""
 

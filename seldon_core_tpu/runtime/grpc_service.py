@@ -96,16 +96,20 @@ class ComponentGrpc:
 
 
 def register(server: Any, handler: ComponentGrpc) -> None:
-    """Register the per-type services + Generic, all backed by ``handler``."""
-    add_service(server, "Model", {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback})
-    add_service(server, "Router", {"Route": handler.Route, "SendFeedback": handler.SendFeedback})
-    add_service(server, "Transformer", {"TransformInput": handler.TransformInput})
-    add_service(server, "OutputTransformer", {"TransformOutput": handler.TransformOutput})
-    add_service(server, "Combiner", {"Aggregate": handler.Aggregate})
-    add_service(
-        server,
-        "Generic",
-        {
+    """Register the per-type services + Generic on a grpcio server, from the
+    same table the fast server uses (single source of truth)."""
+    for service, table in _service_tables(handler).items():
+        add_service(server, service, table)
+
+
+def _service_tables(handler: ComponentGrpc) -> dict[str, dict[str, Any]]:
+    return {
+        "Model": {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback},
+        "Router": {"Route": handler.Route, "SendFeedback": handler.SendFeedback},
+        "Transformer": {"TransformInput": handler.TransformInput},
+        "OutputTransformer": {"TransformOutput": handler.TransformOutput},
+        "Combiner": {"Aggregate": handler.Aggregate},
+        "Generic": {
             "TransformInput": handler.Predict
             if handler.service_type == "MODEL"
             else handler.TransformInput,
@@ -114,18 +118,37 @@ def register(server: Any, handler: ComponentGrpc) -> None:
             "Aggregate": handler.Aggregate,
             "SendFeedback": handler.SendFeedback,
         },
-    )
+    }
 
 
 async def start_grpc(
     component: Any, port: int, name: str = "model", service_type: str = "MODEL"
-) -> grpc.aio.Server:
-    server = grpc.aio.server(options=SERVER_OPTIONS)
-    register(server, ComponentGrpc(component, name=name, service_type=service_type))
-    bound = await bind_insecure_port(server, port)
-    await server.start()
-    server.bound_port = bound  # real port when asked for :0 (tests)
-    log.info("microservice gRPC server on :%d (%s %s)", bound, name, service_type)
+):
+    """Start the microservice gRPC server — asyncio data plane by default
+    (see engine/grpc_app.py for why), grpcio via SCT_GRPC_IMPL=grpcio."""
+    from seldon_core_tpu.proto.grpc_defs import raw_handlers, use_grpcio
+
+    handler = ComponentGrpc(component, name=name, service_type=service_type)
+    if use_grpcio():
+        server = grpc.aio.server(options=SERVER_OPTIONS)
+        register(server, handler)
+        bound = await bind_insecure_port(server, port)
+        await server.start()
+        server.bound_port = bound  # real port when asked for :0 (tests)
+        log.info("microservice gRPC server on :%d (%s %s)", bound, name, service_type)
+        return server
+
+    from seldon_core_tpu.wire import FastGrpcServer
+
+    paths: dict[str, Any] = {}
+    for service, table in _service_tables(handler).items():
+        paths.update(raw_handlers(service, table))
+    server = FastGrpcServer(paths)
+    bound = await server.start(port)
+    server.bound_port = bound
+    log.info(
+        "microservice gRPC (h2 data plane) on :%d (%s %s)", bound, name, service_type
+    )
     return server
 
 

@@ -1,0 +1,14 @@
+"""Fast wire transports: HPACK + the asyncio gRPC unary data plane.
+
+See wire/h2grpc.py for motivation (grpcio's per-RPC CPU cost inverts the
+reference's gRPC-beats-REST property on small cores; this recovers it).
+"""
+
+from seldon_core_tpu.wire.h2grpc import (
+    FastGrpcChannel,
+    FastGrpcServer,
+    FastStub,
+    GrpcCallError,
+)
+
+__all__ = ["FastGrpcChannel", "FastGrpcServer", "FastStub", "GrpcCallError"]

@@ -1,0 +1,754 @@
+"""gRPC unary transport directly on asyncio — the engine's fast data plane.
+
+Why this exists: the Python ``grpcio`` stack costs ~270µs of CPU per unary
+RPC on one core (client+server), 1.8× the cost of the whole aiohttp REST
+path — which inverts the reference's gRPC-beats-REST economics
+(reference: docs/benchmarking.md:53-63, gRPC 2.3× REST on the Java
+engine).  gRPC's wire format is not inherently slow: after connection
+warmup a unary request is two small frames whose headers are mostly
+1-byte HPACK indexed fields.  Implementing just the unary slice of
+HTTP/2 (RFC 7540) + HPACK (wire/hpack.py) on asyncio recovers the
+protocol's intended cheapness while staying interoperable with standard
+grpc clients and servers (verified both directions in tests/test_wire.py).
+
+Scope: unary-unary calls, plaintext (h2c prior-knowledge, which is what
+grpc uses on insecure channels).  Implemented: connection preface,
+SETTINGS exchange/ack, HEADERS(+CONTINUATION), DATA, full HPACK decode,
+both directions of flow control (connection + stream windows, split on
+peer max-frame-size), PING reply, RST_STREAM, GOAWAY.  Not implemented:
+streaming RPCs, push, priorities (ignored — optional per spec), TLS.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Any, Awaitable, Callable
+
+from seldon_core_tpu.wire import hpack
+
+log = logging.getLogger(__name__)
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+# frame types (RFC 7540 §6)
+DATA = 0x0
+HEADERS = 0x1
+PRIORITY = 0x2
+RST_STREAM = 0x3
+SETTINGS = 0x4
+PUSH_PROMISE = 0x5
+PING = 0x6
+GOAWAY = 0x7
+WINDOW_UPDATE = 0x8
+CONTINUATION = 0x9
+
+# flags
+END_STREAM = 0x1
+ACK = 0x1
+END_HEADERS = 0x4
+PADDED = 0x8
+PRIORITY_FLAG = 0x20
+
+SETTINGS_HEADER_TABLE_SIZE = 0x1
+SETTINGS_MAX_CONCURRENT_STREAMS = 0x3
+SETTINGS_INITIAL_WINDOW_SIZE = 0x4
+SETTINGS_MAX_FRAME_SIZE = 0x5
+
+DEFAULT_WINDOW = 65535
+BIG_WINDOW = 16 * 1024 * 1024  # what we advertise for receives
+DEFAULT_MAX_FRAME = 16384
+
+_RAW_FRAME = -1  # send-queue marker: pre-framed bytes riding behind DATA
+
+GRPC_STATUS_OK = 0
+GRPC_STATUS_UNKNOWN = 2
+GRPC_STATUS_UNIMPLEMENTED = 12
+
+_pack_header = struct.Struct(">IBBI")  # we pack len into top 3 bytes manually
+
+
+def frame(ftype: int, flags: int, stream_id: int, payload: bytes = b"") -> bytes:
+    n = len(payload)
+    return (
+        bytes(((n >> 16) & 0xFF, (n >> 8) & 0xFF, n & 0xFF, ftype, flags))
+        + stream_id.to_bytes(4, "big")
+        + payload
+    )
+
+
+def settings_payload(pairs: dict[int, int]) -> bytes:
+    return b"".join(struct.pack(">HI", k, v) for k, v in pairs.items())
+
+
+class GrpcWireError(Exception):
+    """Connection-fatal protocol error."""
+
+
+class GrpcCallError(Exception):
+    """A call failed with a non-OK grpc-status."""
+
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"grpc-status {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+# ---------------------------------------------------------------------------
+# Shared connection machinery (frame parse + flow control)
+# ---------------------------------------------------------------------------
+
+class _Conn(asyncio.Protocol):
+    """Common HTTP/2 connection state for both server and client roles."""
+
+    is_server = False
+
+    def __init__(self) -> None:
+        self.transport: asyncio.Transport | None = None
+        self._buf = bytearray()
+        self._pos = 0
+        self._preface_left = len(PREFACE) if self.is_server else 0
+        self.decoder = hpack.Decoder()
+        # send-side flow control (peer-controlled)
+        self.out_window = DEFAULT_WINDOW
+        self.peer_initial_window = DEFAULT_WINDOW
+        self.peer_max_frame = DEFAULT_MAX_FRAME
+        self._stream_out: dict[int, int] = {}
+        self._send_queue: list[tuple[int, bytes, int]] = []  # (stream, data, flags)
+        # receive-side: replenish the connection window as we consume
+        self._recv_credit = 0
+        # continuation state: (stream_id, flags, blocks)
+        self._headers_in_flight: tuple[int, int, list[bytes]] | None = None
+        self.closed = asyncio.get_event_loop().create_future()
+
+    # -- transport events ---------------------------------------------------
+
+    def connection_made(self, transport: asyncio.BaseTransport) -> None:
+        self.transport = transport  # type: ignore[assignment]
+        transport.set_write_buffer_limits(high=4 * 1024 * 1024)  # type: ignore[attr-defined]
+        if not self.is_server:
+            self.transport.write(PREFACE)
+        self.transport.write(
+            frame(SETTINGS, 0, 0, settings_payload({
+                SETTINGS_HEADER_TABLE_SIZE: 4096,
+                SETTINGS_INITIAL_WINDOW_SIZE: BIG_WINDOW,
+                SETTINGS_MAX_FRAME_SIZE: DEFAULT_MAX_FRAME,
+            }))
+            + frame(WINDOW_UPDATE, 0, 0, struct.pack(">I", BIG_WINDOW - DEFAULT_WINDOW))
+        )
+
+    def connection_lost(self, exc: Exception | None) -> None:
+        if not self.closed.done():
+            self.closed.set_result(exc)
+        self._on_closed(exc)
+
+    def _on_closed(self, exc: Exception | None) -> None:  # overridden
+        pass
+
+    def data_received(self, data: bytes) -> None:
+        buf = self._buf
+        buf += data
+        pos = self._pos
+        try:
+            if self._preface_left:
+                take = min(self._preface_left, len(buf) - pos)
+                start = len(PREFACE) - self._preface_left
+                if bytes(buf[pos : pos + take]) != PREFACE[start : start + take]:
+                    raise GrpcWireError("bad connection preface")
+                self._preface_left -= take
+                pos += take
+            while len(buf) - pos >= 9:
+                length = (buf[pos] << 16) | (buf[pos + 1] << 8) | buf[pos + 2]
+                if len(buf) - pos < 9 + length:
+                    break
+                ftype = buf[pos + 3]
+                flags = buf[pos + 4]
+                stream_id = int.from_bytes(buf[pos + 5 : pos + 9], "big") & 0x7FFFFFFF
+                payload = bytes(buf[pos + 9 : pos + 9 + length])
+                pos += 9 + length
+                self._dispatch(ftype, flags, stream_id, payload)
+        except (GrpcWireError, hpack.HpackError, struct.error, IndexError, ValueError) as e:
+            # malformed frames (short WINDOW_UPDATE, bad padding, invalid
+            # huffman, ...) are peer protocol errors, not our crashes: a
+            # GOAWAY + close, never an unhandled exception on the transport
+            log.warning("h2 protocol error: %s", e)
+            self._pos = pos
+            if self.transport is not None:
+                self.transport.write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 1)))
+                self.transport.close()
+            return
+        # compact the buffer once consumed past 64KB to bound memory
+        if pos > 65536:
+            del buf[:pos]
+            pos = 0
+        self._pos = pos
+
+    # -- frame dispatch -----------------------------------------------------
+
+    def _dispatch(self, ftype: int, flags: int, stream_id: int, payload: bytes) -> None:
+        if self._headers_in_flight is not None and ftype != CONTINUATION:
+            raise GrpcWireError("expected CONTINUATION")
+        if ftype == DATA:
+            self._credit_recv(len(payload))
+            if flags & PADDED:
+                pad = payload[0]
+                payload = payload[1 : len(payload) - pad]
+            self._on_data(stream_id, payload, bool(flags & END_STREAM))
+        elif ftype == HEADERS:
+            block = payload
+            if flags & PADDED:
+                pad = block[0]
+                block = block[1 : len(block) - pad]
+            if flags & PRIORITY_FLAG:
+                block = block[5:]
+            if flags & END_HEADERS:
+                self._headers_done(stream_id, flags, [block])
+            else:
+                self._headers_in_flight = (stream_id, flags, [block])
+        elif ftype == CONTINUATION:
+            if self._headers_in_flight is None:
+                raise GrpcWireError("unexpected CONTINUATION")
+            sid, hflags, blocks = self._headers_in_flight
+            if sid != stream_id:
+                raise GrpcWireError("CONTINUATION on wrong stream")
+            blocks.append(payload)
+            if flags & END_HEADERS:
+                self._headers_in_flight = None
+                self._headers_done(sid, hflags, blocks)
+        elif ftype == SETTINGS:
+            if flags & ACK:
+                return
+            for off in range(0, len(payload) - 5, 6):
+                key, value = struct.unpack_from(">HI", payload, off)
+                if key == SETTINGS_INITIAL_WINDOW_SIZE:
+                    delta = value - self.peer_initial_window
+                    self.peer_initial_window = value
+                    for sid in self._stream_out:
+                        self._stream_out[sid] += delta
+                elif key == SETTINGS_MAX_FRAME_SIZE:
+                    self.peer_max_frame = value
+                # SETTINGS_HEADER_TABLE_SIZE constrains our ENCODER (RFC
+                # 7541 §4.2), which is stateless (never uses the dynamic
+                # table) and therefore always compliant; our DECODER's limit
+                # is the 4096 we advertised, not the peer's value
+            self.transport.write(frame(SETTINGS, ACK, 0))
+            self._pump_sends()
+        elif ftype == WINDOW_UPDATE:
+            (incr,) = struct.unpack(">I", payload)
+            incr &= 0x7FFFFFFF
+            if stream_id == 0:
+                self.out_window += incr
+            else:
+                self._stream_out[stream_id] = (
+                    self._stream_out.get(stream_id, self.peer_initial_window) + incr
+                )
+            self._pump_sends()
+        elif ftype == PING:
+            if not flags & ACK:
+                self.transport.write(frame(PING, ACK, 0, payload))
+        elif ftype == RST_STREAM:
+            self._on_rst(stream_id, struct.unpack(">I", payload)[0])
+        elif ftype == GOAWAY:
+            self._on_goaway(payload)
+        elif ftype == PUSH_PROMISE:
+            raise GrpcWireError("PUSH_PROMISE not supported")
+        # PRIORITY and unknown frame types: ignored (per spec)
+
+    def _headers_done(self, stream_id: int, flags: int, blocks: list[bytes]) -> None:
+        headers = self.decoder.decode(b"".join(blocks))
+        self._on_headers(stream_id, headers, bool(flags & END_STREAM))
+
+    # -- receive flow control ----------------------------------------------
+
+    def _credit_recv(self, n: int) -> None:
+        """Replenish the connection+stream windows we advertised.  Batched:
+        one WINDOW_UPDATE per ~1MB consumed, not per frame."""
+        self._recv_credit += n
+        if self._recv_credit >= 1024 * 1024:
+            self.transport.write(
+                frame(WINDOW_UPDATE, 0, 0, struct.pack(">I", self._recv_credit))
+            )
+            self._recv_credit = 0
+
+    def _stream_recv_credit(self, stream_id: int, n: int) -> None:
+        # per-stream windows: our INITIAL_WINDOW_SIZE is BIG_WINDOW; unary
+        # messages larger than that need explicit stream credit
+        if n > 0:
+            self.transport.write(
+                frame(WINDOW_UPDATE, 0, stream_id, struct.pack(">I", n))
+            )
+
+    # -- send path with flow control ---------------------------------------
+
+    def send_data(self, stream_id: int, data: bytes, end_stream: bool) -> None:
+        """DATA split on peer max-frame-size, honoring both windows; excess
+        queues until WINDOW_UPDATE."""
+        self._stream_out.setdefault(stream_id, self.peer_initial_window)
+        self._send_queue.append((stream_id, data, END_STREAM if end_stream else 0))
+        self._pump_sends()
+
+    def send_raw_after_data(self, stream_id: int, raw: bytes) -> None:
+        """Write ``raw`` (e.g. a trailers HEADERS frame) without overtaking
+        any DATA still queued for the stream on flow control."""
+        self._send_queue.append((stream_id, raw, _RAW_FRAME))
+        self._pump_sends()
+
+    def _pump_sends(self) -> None:
+        if not self._send_queue or self.transport is None:
+            return
+        out = []
+        queue = self._send_queue
+        self._send_queue = []
+        blocked: set[int] = set()  # streams with requeued data this pump
+        for stream_id, data, flags in queue:
+            if stream_id in blocked:
+                self._send_queue.append((stream_id, data, flags))
+                continue
+            if flags == _RAW_FRAME:
+                out.append(data)
+                continue
+            sent = 0
+            swin = self._stream_out.get(stream_id, self.peer_initial_window)
+            while sent < len(data) or (flags and sent == len(data) == 0):
+                budget = min(self.out_window, swin, self.peer_max_frame)
+                chunk = data[sent : sent + budget] if budget > 0 else b""
+                if len(data) > 0 and not chunk:
+                    break  # window exhausted; requeue remainder
+                last = sent + len(chunk) >= len(data)
+                out.append(
+                    frame(DATA, flags if last else 0, stream_id, chunk)
+                )
+                sent += len(chunk)
+                self.out_window -= len(chunk)
+                swin -= len(chunk)
+                if len(data) == 0:
+                    break
+            self._stream_out[stream_id] = swin
+            if sent < len(data):
+                blocked.add(stream_id)
+                self._send_queue.append((stream_id, data[sent:], flags))
+        if out:
+            self.transport.write(b"".join(out))
+
+    # -- role hooks ---------------------------------------------------------
+
+    def _on_headers(self, stream_id: int, headers, end: bool) -> None:
+        raise NotImplementedError
+
+    def _on_data(self, stream_id: int, data: bytes, end: bool) -> None:
+        raise NotImplementedError
+
+    def _on_rst(self, stream_id: int, code: int) -> None:
+        pass
+
+    def _on_goaway(self, payload: bytes) -> None:
+        if self.transport is not None:
+            self.transport.close()
+
+
+def grpc_frame(payload: bytes) -> bytes:
+    """gRPC message framing: 1-byte compressed flag + u32 length."""
+    return b"\x00" + len(payload).to_bytes(4, "big") + payload
+
+
+def parse_grpc_frames(buf: bytes) -> list[bytes]:
+    out = []
+    pos = 0
+    while pos + 5 <= len(buf):
+        if buf[pos] != 0:
+            raise GrpcCallError(GRPC_STATUS_UNKNOWN, "compressed messages unsupported")
+        n = int.from_bytes(buf[pos + 1 : pos + 5], "big")
+        if pos + 5 + n > len(buf):
+            raise GrpcCallError(GRPC_STATUS_UNKNOWN, "truncated gRPC frame")
+        out.append(buf[pos + 5 : pos + 5 + n])
+        pos += 5 + n
+    if pos != len(buf):
+        raise GrpcCallError(GRPC_STATUS_UNKNOWN, "trailing bytes after gRPC frame")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+Handler = Callable[[bytes], Awaitable[bytes]]
+
+# constant response header/trailer templates (stateless HPACK encode)
+_RESPONSE_HEADERS = hpack.encode_headers(
+    [(b":status", b"200"), (b"content-type", b"application/grpc")]
+)
+_TRAILERS_OK = hpack.encode_headers([(b"grpc-status", b"0")])
+
+
+class _ServerConn(_Conn):
+    is_server = True
+
+    def __init__(self, handlers: dict[bytes, Handler]):
+        super().__init__()
+        self.handlers = handlers
+        # stream -> [path, data buffer]
+        self._streams: dict[int, list[Any]] = {}
+        self._tasks: set[asyncio.Task] = set()
+
+    def _on_closed(self, exc: Exception | None) -> None:
+        for t in self._tasks:
+            t.cancel()
+        self._streams.clear()
+
+    def _on_headers(self, stream_id: int, headers, end: bool) -> None:
+        path = b""
+        for name, value in headers:
+            if name == b":path":
+                path = value
+                break
+        self._streams[stream_id] = [path, bytearray()]
+        if end:
+            self._finish_request(stream_id)
+
+    def _on_data(self, stream_id: int, data: bytes, end: bool) -> None:
+        st = self._streams.get(stream_id)
+        if st is None:
+            return
+        st[1] += data
+        if len(st[1]) > BIG_WINDOW // 2:
+            self._stream_recv_credit(stream_id, len(data))
+        if end:
+            self._finish_request(stream_id)
+
+    def _on_rst(self, stream_id: int, code: int) -> None:
+        self._streams.pop(stream_id, None)
+
+    def _finish_request(self, stream_id: int) -> None:
+        path, body = self._streams.pop(stream_id)
+        handler = self.handlers.get(path)
+        if handler is None:
+            self._send_error(stream_id, GRPC_STATUS_UNIMPLEMENTED, f"unknown method {path.decode()}")
+            return
+        try:
+            messages = parse_grpc_frames(bytes(body))
+            if len(messages) != 1:
+                raise GrpcCallError(GRPC_STATUS_UNKNOWN, "expected exactly one message")
+        except GrpcCallError as e:
+            self._send_error(stream_id, e.status, e.message)
+            return
+        task = asyncio.ensure_future(self._run(stream_id, handler, messages[0]))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _run(self, stream_id: int, handler: Handler, payload: bytes) -> None:
+        try:
+            response = await handler(payload)
+        except GrpcCallError as e:
+            self._send_error(stream_id, e.status, e.message)
+            return
+        except Exception as e:
+            log.exception("grpc handler failed")
+            self._send_error(stream_id, GRPC_STATUS_UNKNOWN, f"{type(e).__name__}: {e}")
+            return
+        if self.transport is None or self.transport.is_closing():
+            return
+        body = grpc_frame(response)
+        # headers + (windowed) data + trailers; the trailers ride the send
+        # queue so they can never overtake DATA parked on flow control
+        self.transport.write(frame(HEADERS, END_HEADERS, stream_id, _RESPONSE_HEADERS))
+        self.send_data(stream_id, body, end_stream=False)
+        self.send_raw_after_data(
+            stream_id, frame(HEADERS, END_HEADERS | END_STREAM, stream_id, _TRAILERS_OK)
+        )
+
+    def _send_error(self, stream_id: int, status: int, message: str) -> None:
+        if self.transport is None or self.transport.is_closing():
+            return
+        trailers = hpack.encode_headers(
+            [
+                (b":status", b"200"),
+                (b"content-type", b"application/grpc"),
+                (b"grpc-status", str(status).encode()),
+                (b"grpc-message", message.encode("utf-8", "replace")),
+            ]
+        )
+        self.transport.write(frame(HEADERS, END_HEADERS | END_STREAM, stream_id, trailers))
+
+
+def _dual_stack_socket(port: int, reuse_port: bool):
+    import socket
+
+    try:
+        sock = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+        sock.setsockopt(socket.IPPROTO_IPV6, socket.IPV6_V6ONLY, 0)
+        addr = ("::", port)
+    except OSError:  # IPv6-less host
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        addr = ("0.0.0.0", port)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    if reuse_port:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    try:
+        sock.bind(addr)
+    except BaseException:
+        sock.close()
+        raise
+    return sock
+
+
+class FastGrpcServer:
+    """Unary gRPC server on asyncio.  ``handlers`` maps full method paths
+    (``/seldon.protos.Seldon/Predict``) to ``async fn(bytes) -> bytes``."""
+
+    def __init__(self, handlers: dict[str, Handler]):
+        self.handlers = {k.encode(): v for k, v in handlers.items()}
+        self._server: asyncio.AbstractServer | None = None
+        self.bound_port = 0
+
+    def add_handler(self, path: str, fn: Handler) -> None:
+        self.handlers[path.encode()] = fn
+
+    async def start(
+        self, port: int, host: str | None = None, reuse_port: bool = False
+    ) -> int:
+        import socket
+
+        loop = asyncio.get_running_loop()
+        try:
+            if host is None:
+                # ONE dual-stack socket ([::] with V6ONLY off), like the
+                # grpcio server this replaces: an IPv6-only cluster must not
+                # get connection-refused from a ready pod.  (create_server
+                # with host=None would make one socket PER family — and with
+                # port=0 each would land on a DIFFERENT ephemeral port.)
+                sock = _dual_stack_socket(port, reuse_port)
+                self._server = await loop.create_server(
+                    lambda: _ServerConn(self.handlers), sock=sock
+                )
+            else:
+                self._server = await loop.create_server(
+                    lambda: _ServerConn(self.handlers),
+                    host,
+                    port,
+                    reuse_port=reuse_port or None,
+                )
+        except OSError as e:
+            # strict-boot contract: a gRPC-only client must never see silent
+            # connection refusals from a pod that reports ready
+            raise RuntimeError(f"could not bind gRPC port {port}: {e}") from e
+        self.bound_port = self._server.sockets[0].getsockname()[1]
+        return self.bound_port
+
+    async def stop(self, grace: float | None = None) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def wait_for_termination(self) -> None:
+        if self._server is not None:
+            await self._server.wait_closed()
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+class _ClientConn(_Conn):
+    is_server = False
+
+    def __init__(self, authority: str):
+        super().__init__()
+        self.authority = authority
+        self._next_stream = 1
+        self.drain_when_idle = False  # set when replaced due to exhaustion
+        # stream -> [future, headers, bytearray data]
+        self._calls: dict[int, list[Any]] = {}
+        self._path_templates: dict[tuple, bytes] = {}
+
+    def _on_closed(self, exc: Exception | None) -> None:
+        err = ConnectionError(f"h2 connection lost: {exc}")
+        for fut, _, _ in self._calls.values():
+            if not fut.done():
+                fut.set_exception(err)
+        self._calls.clear()
+
+    def _template(self, path: bytes, metadata: tuple = ()) -> bytes:
+        key = (path, metadata)
+        t = self._path_templates.get(key)
+        if t is None:
+            headers = [
+                (b":method", b"POST"),
+                (b":scheme", b"http"),
+                (b":path", path),
+                (b":authority", self.authority.encode()),
+                (b"content-type", b"application/grpc"),
+                (b"te", b"trailers"),
+            ]
+            headers.extend(
+                (k.encode() if isinstance(k, str) else k, v.encode() if isinstance(v, str) else v)
+                for k, v in metadata
+            )
+            t = hpack.encode_headers(headers)
+            self._path_templates[key] = t
+        return t
+
+    @property
+    def exhausted(self) -> bool:
+        """Stream IDs are 31-bit and never reused: a long-lived connection
+        must be cycled before the space runs out (the channel replaces an
+        exhausted connection and drains this one)."""
+        return self._next_stream >= 1 << 30
+
+    def maybe_drain_close(self) -> None:
+        if self.drain_when_idle and not self._calls and self.transport is not None:
+            self.transport.write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 0)))
+            self.transport.close()
+
+    def call(self, path: bytes, payload: bytes, metadata: tuple = ()) -> asyncio.Future:
+        if self.transport is None or self.transport.is_closing():
+            raise ConnectionError("h2 connection closed")
+        stream_id = self._next_stream
+        self._next_stream += 2
+        fut = asyncio.get_running_loop().create_future()
+        self._calls[stream_id] = [fut, None, bytearray()]
+        self.transport.write(
+            frame(HEADERS, END_HEADERS, stream_id, self._template(path, metadata))
+        )
+        self.send_data(stream_id, grpc_frame(payload), end_stream=True)
+        return fut
+
+    def _on_headers(self, stream_id: int, headers, end: bool) -> None:
+        call = self._calls.get(stream_id)
+        if call is None:
+            return
+        if call[1] is None:
+            call[1] = headers
+        else:
+            call[1] = call[1] + headers  # trailers appended
+        if end:
+            self._finish(stream_id)
+
+    def _on_data(self, stream_id: int, data: bytes, end: bool) -> None:
+        call = self._calls.get(stream_id)
+        if call is None:
+            return
+        call[2] += data
+        if len(call[2]) > BIG_WINDOW // 2:
+            self._stream_recv_credit(stream_id, len(data))
+        if end:
+            self._finish(stream_id)
+
+    def _on_rst(self, stream_id: int, code: int) -> None:
+        call = self._calls.pop(stream_id, None)
+        if call is not None and not call[0].done():
+            call[0].set_exception(
+                GrpcCallError(GRPC_STATUS_UNKNOWN, f"stream reset: h2 code {code}")
+            )
+
+    def _finish(self, stream_id: int) -> None:
+        fut, headers, body = self._calls.pop(stream_id)
+        self.maybe_drain_close()
+        if fut.done():
+            return
+        status = GRPC_STATUS_OK
+        message = ""
+        for name, value in headers or []:
+            if name == b"grpc-status":
+                status = int(value)
+            elif name == b"grpc-message":
+                message = value.decode("utf-8", "replace")
+        if status != GRPC_STATUS_OK:
+            fut.set_exception(GrpcCallError(status, message))
+            return
+        try:
+            messages = parse_grpc_frames(bytes(body))
+            if len(messages) != 1:
+                raise GrpcCallError(GRPC_STATUS_UNKNOWN, "expected one response message")
+            fut.set_result(messages[0])
+        except GrpcCallError as e:
+            fut.set_exception(e)
+
+
+class FastGrpcChannel:
+    """Pooled unary client: ``await channel.call("/pkg.Svc/Method", bytes)``.
+
+    One connection by default (HTTP/2 multiplexes); the connection is
+    (re)established lazily so the channel survives server restarts.
+    """
+
+    def __init__(self, target: str):
+        host, _, port = target.rpartition(":")
+        self.host = host.strip("[]") or "127.0.0.1"
+        self.port = int(port)
+        self.authority = target
+        self._conn: _ClientConn | None = None
+        self._connecting: asyncio.Lock = asyncio.Lock()
+
+    @staticmethod
+    def _usable(conn: _ClientConn | None) -> bool:
+        return (
+            conn is not None
+            and conn.transport is not None
+            and not conn.transport.is_closing()
+            and not conn.exhausted
+        )
+
+    async def _connection(self) -> _ClientConn:
+        conn = self._conn
+        if self._usable(conn):
+            return conn
+        async with self._connecting:
+            conn = self._conn
+            if self._usable(conn):
+                return conn
+            if conn is not None and conn.exhausted:
+                # cycle before the 31-bit stream-ID space runs out; the old
+                # connection finishes its in-flight calls then closes itself
+                conn.drain_when_idle = True
+                conn.maybe_drain_close()
+            loop = asyncio.get_running_loop()
+            _, conn = await loop.create_connection(
+                lambda: _ClientConn(self.authority), self.host, self.port
+            )
+            self._conn = conn
+            return conn
+
+    async def call(
+        self,
+        path: str | bytes,
+        payload: bytes,
+        timeout: float = 30.0,
+        metadata: tuple = (),
+    ) -> bytes:
+        conn = await self._connection()
+        path_b = path if isinstance(path, bytes) else path.encode()
+        fut = conn.call(path_b, payload, metadata)
+        return await asyncio.wait_for(fut, timeout)
+
+    async def close(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is not None and conn.transport is not None:
+            conn.transport.write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 0)))
+            conn.transport.close()
+
+
+class FastStub:
+    """Typed stub over FastGrpcChannel mirroring grpc_defs.Stub:
+    ``FastStub(channel, "Seldon").Predict(msg)`` with proto messages."""
+
+    def __init__(self, channel: FastGrpcChannel, service: str):
+        from seldon_core_tpu.proto.grpc_defs import SERVICES, full_service_name
+
+        for method, (req, res) in SERVICES[service].items():
+            path = f"/{full_service_name(service)}/{method}"
+
+            def make(path=path, res=res):
+                async def rpc(message, timeout: float = 30.0, metadata=None):
+                    raw = await channel.call(
+                        path,
+                        message.SerializeToString(),
+                        timeout,
+                        metadata=tuple(metadata) if metadata else (),
+                    )
+                    return res.FromString(raw)
+
+                return rpc
+
+            setattr(self, method, make())

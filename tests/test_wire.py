@@ -1,0 +1,292 @@
+"""The asyncio gRPC data plane (wire/): HPACK correctness and transport
+interop with standard grpcio in BOTH directions — the fast plane is only
+useful if ordinary gRPC clients/servers can't tell the difference."""
+
+import asyncio
+
+import grpc
+import numpy as np
+import pytest
+
+from seldon_core_tpu.contract import Payload, payload_to_proto
+from seldon_core_tpu.proto import prediction_pb2 as pb
+from seldon_core_tpu.proto.grpc_defs import Stub, add_service
+from seldon_core_tpu.wire import (
+    FastGrpcChannel,
+    FastGrpcServer,
+    FastStub,
+    GrpcCallError,
+)
+from seldon_core_tpu.wire import hpack
+
+run = asyncio.run
+
+
+# ---------------------------------------------------------------------------
+# HPACK
+# ---------------------------------------------------------------------------
+
+class TestHpack:
+    def test_huffman_round_trip(self):
+        for s in (b"", b"a", b"application/grpc", b"www.example.com", bytes(range(256))):
+            assert hpack.huffman_decode(hpack.huffman_encode(s)) == s
+
+    def test_int_codec_boundaries(self):
+        for value in (0, 1, 30, 31, 32, 127, 128, 255, 16383, 2**20):
+            enc = hpack.encode_int(value, 5)
+            got, pos = hpack.decode_int(enc, 0, 5)
+            assert got == value and pos == len(enc)
+
+    def test_static_and_literal_round_trip(self):
+        headers = [
+            (b":method", b"POST"),
+            (b":status", b"200"),
+            (b":path", b"/seldon.protos.Seldon/Predict"),
+            (b"grpc-status", b"0"),
+            (b"x-custom-header", b"some value"),
+        ]
+        assert hpack.Decoder().decode(hpack.encode_headers(headers)) == headers
+
+    def test_dynamic_table_indexing(self):
+        # literal-with-incremental-indexing then 1-byte indexed reference
+        block1 = bytes([0x40]) + hpack.encode_string(b"x-k") + hpack.encode_string(b"v1")
+        d = hpack.Decoder()
+        assert d.decode(block1) == [(b"x-k", b"v1")]
+        idx = len(hpack.STATIC_TABLE) + 1
+        block2 = hpack.encode_int(idx, 7, 0x80)
+        assert d.decode(block2) == [(b"x-k", b"v1")]
+
+    def test_dynamic_table_eviction(self):
+        d = hpack.Decoder(max_table_size=64)  # fits one small entry only
+        for i in range(3):
+            block = (
+                bytes([0x40])
+                + hpack.encode_string(f"k{i}".encode())
+                + hpack.encode_string(b"v")
+            )
+            d.decode(block)
+        assert len(d._dynamic) == 1  # older entries evicted
+
+    def test_table_size_update_over_limit_rejected(self):
+        d = hpack.Decoder(max_table_size=4096)
+        with pytest.raises(hpack.HpackError):
+            d.decode(hpack.encode_int(65536, 5, 0x20))
+
+
+# ---------------------------------------------------------------------------
+# transport interop
+# ---------------------------------------------------------------------------
+
+async def _echo(payload: bytes) -> bytes:
+    return payload
+
+
+def _msg(rows=1) -> bytes:
+    return payload_to_proto(
+        Payload.from_array(np.arange(rows * 3, dtype=np.float64).reshape(rows, 3))
+    ).SerializeToString()
+
+
+class TestFastServer:
+    def test_fast_client_fast_server(self):
+        async def go():
+            server = FastGrpcServer({"/seldon.protos.Seldon/Predict": _echo})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            wire = _msg()
+            outs = [await ch.call("/seldon.protos.Seldon/Predict", wire) for _ in range(20)]
+            await ch.close()
+            await server.stop()
+            return outs, wire
+
+        outs, wire = run(go())
+        assert all(o == wire for o in outs)
+
+    def test_grpcio_client_against_fast_server(self):
+        """A stock grpc.aio client (dynamic-table HPACK, default windows)
+        must work unmodified against the fast server."""
+
+        async def go():
+            server = FastGrpcServer({"/seldon.protos.Seldon/Predict": _echo})
+            port = await server.start(0, host="127.0.0.1")
+            msg = pb.SeldonMessage.FromString(_msg(2))
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                stub = Stub(ch, "Seldon")
+                outs = [await stub.Predict(msg) for _ in range(30)]
+            await server.stop()
+            return outs, msg
+
+        outs, msg = run(go())
+        assert all(o.SerializeToString() == msg.SerializeToString() for o in outs)
+
+    def test_fast_client_against_grpcio_server(self):
+        async def go():
+            gsrv = grpc.aio.server()
+
+            async def Predict(request, context):
+                return request
+
+            add_service(gsrv, "Seldon", {"Predict": Predict})
+            port = gsrv.add_insecure_port("127.0.0.1:0")
+            await gsrv.start()
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            wire = _msg()
+            outs = [await ch.call("/seldon.protos.Seldon/Predict", wire) for _ in range(30)]
+            await ch.close()
+            await gsrv.stop(0)
+            return outs, wire
+
+        outs, wire = run(go())
+        assert all(o == wire for o in outs)
+
+    def test_unknown_method_is_unimplemented(self):
+        async def go():
+            server = FastGrpcServer({"/a/B": _echo})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            try:
+                with pytest.raises(GrpcCallError) as e:
+                    await ch.call("/a/Nope", b"x")
+                return e.value.status
+            finally:
+                await ch.close()
+                await server.stop()
+
+        assert run(go()) == 12  # UNIMPLEMENTED
+
+    def test_handler_exception_surfaces_as_status(self):
+        async def boom(payload: bytes) -> bytes:
+            raise RuntimeError("kaboom")
+
+        async def go():
+            server = FastGrpcServer({"/a/B": boom})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            try:
+                with pytest.raises(GrpcCallError) as e:
+                    await ch.call("/a/B", b"x")
+                return e.value
+            finally:
+                await ch.close()
+                await server.stop()
+
+        err = run(go())
+        assert err.status == 2 and "kaboom" in err.message
+
+    @pytest.mark.slow
+    def test_flow_control_big_payloads_both_stacks(self):
+        """5MB echoes exceed every default window; DATA must be windowed and
+        trailers must not overtake queued DATA (a grpcio client advertises
+        only a 64KB initial window, forcing the server's send queue)."""
+        big = bytes(np.random.default_rng(0).integers(0, 256, 5_000_000, dtype=np.uint8))
+
+        async def go():
+            server = FastGrpcServer({"/big/Echo": _echo})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            fast = await ch.call("/big/Echo", big, timeout=60)
+            # interleave big and small to exercise per-stream ordering
+            mixed = await asyncio.gather(
+                *(ch.call("/big/Echo", big if i % 3 == 0 else b"s" * 10, timeout=60) for i in range(9))
+            )
+            await ch.close()
+            async with grpc.aio.insecure_channel(
+                f"127.0.0.1:{port}",
+                options=[("grpc.max_receive_message_length", 64 * 1024 * 1024)],
+            ) as gch:
+                rpc = gch.unary_unary("/big/Echo")
+                gout = await rpc(big, timeout=60)
+            await server.stop()
+            return fast, mixed, gout
+
+        fast, mixed, gout = run(go())
+        assert fast == big and gout == big
+        for i, o in enumerate(mixed):
+            assert o == (big if i % 3 == 0 else b"s" * 10)
+
+    def test_metadata_reaches_wire(self):
+        """Custom metadata (gateway OAuth tokens) must round-trip: a grpcio
+        server echoes the received metadata back through the response."""
+
+        async def go():
+            gsrv = grpc.aio.server()
+            seen = {}
+
+            async def Predict(request, context):
+                for k, v in context.invocation_metadata():
+                    seen[k] = v
+                return request
+
+            add_service(gsrv, "Seldon", {"Predict": Predict})
+            port = gsrv.add_insecure_port("127.0.0.1:0")
+            await gsrv.start()
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            await ch.call(
+                "/seldon.protos.Seldon/Predict",
+                _msg(),
+                metadata=(("oauth_token", "tok123"),),
+            )
+            await ch.close()
+            await gsrv.stop(0)
+            return seen
+
+        seen = run(go())
+        assert seen.get("oauth_token") == "tok123"
+
+    def test_fast_stub_typed_interface(self):
+        async def go():
+            server = FastGrpcServer({"/seldon.protos.Seldon/Predict": _echo})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            stub = FastStub(ch, "Seldon")
+            out = await stub.Predict(pb.SeldonMessage.FromString(_msg()))
+            await ch.close()
+            await server.stop()
+            return out
+
+        out = run(go())
+        assert out.SerializeToString() == _msg()
+
+    def test_malformed_frames_get_goaway_not_crash(self):
+        """Short WINDOW_UPDATE / bad padding must produce GOAWAY + close,
+        never an unhandled exception on the transport."""
+        from seldon_core_tpu.wire.h2grpc import PREFACE, frame, WINDOW_UPDATE
+
+        async def go():
+            server = FastGrpcServer({"/a/B": _echo})
+            port = await server.start(0, host="127.0.0.1")
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(PREFACE)
+            writer.write(frame(WINDOW_UPDATE, 0, 0, b"\x01"))  # short payload
+            await writer.drain()
+            # server must close the connection (after GOAWAY), not hang
+            data = await asyncio.wait_for(reader.read(), timeout=5)
+            writer.close()
+            # a well-formed connection still works afterwards
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            out = await ch.call("/a/B", b"ok")
+            await ch.close()
+            await server.stop()
+            return data, out
+
+        data, out = run(go())
+        assert out == b"ok"
+        assert data  # at least SETTINGS + GOAWAY came back before close
+
+    def test_stream_id_exhaustion_cycles_connection(self):
+        async def go():
+            server = FastGrpcServer({"/a/B": _echo})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            await ch.call("/a/B", b"1")
+            first_conn = ch._conn
+            first_conn._next_stream = 1 << 30  # simulate 30h of traffic
+            await ch.call("/a/B", b"2")
+            second_conn = ch._conn
+            out = await ch.call("/a/B", b"3")
+            await ch.close()
+            await server.stop()
+            return first_conn is not second_conn, out
+
+        cycled, out = run(go())
+        assert cycled and out == b"3"
