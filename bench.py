@@ -227,6 +227,10 @@ def stage_llm(detail: dict) -> None:
             {"name": "preset", "value": "tiny", "type": "STRING"},
             {"name": "n_slots", "value": "8", "type": "INT"},
             {"name": "max_new_tokens", "value": str(max_new), "type": "INT"},
+            # all 32 decode steps in one device dispatch: the old
+            # 1-token-per-round-trip loop paid ~100ms x 32 tokens of pure
+            # RTT per request on the tunnel-attached chip (r02 p50 4.46s)
+            {"name": "decode_block", "value": "32", "type": "INT"},
         ],
     }
     body = json.dumps(

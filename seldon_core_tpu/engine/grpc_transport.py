@@ -10,6 +10,8 @@ outlive the event loop that created them.
 
 from __future__ import annotations
 
+import asyncio
+
 import numpy as np
 import grpc
 
@@ -24,6 +26,7 @@ from seldon_core_tpu.graph.spec import PredictiveUnitSpec, UnitType
 from seldon_core_tpu.graph.walker import ROUTE_ALL
 from seldon_core_tpu.proto import prediction_pb2 as pb
 from seldon_core_tpu.proto.grpc_defs import SERVER_OPTIONS, Stub
+from seldon_core_tpu.wire import FastGrpcChannel, FastStub, GrpcCallError
 
 
 class ChannelCache:
@@ -41,8 +44,6 @@ class ChannelCache:
             if use_grpcio():
                 ch = grpc.aio.insecure_channel(target, options=SERVER_OPTIONS)
             else:
-                from seldon_core_tpu.wire import FastGrpcChannel
-
                 ch = FastGrpcChannel(target)
             self._channels[target] = ch
         return ch
@@ -54,8 +55,6 @@ class ChannelCache:
 
 
 def _stub(channel, service: str):
-    from seldon_core_tpu.wire import FastGrpcChannel, FastStub
-
     if isinstance(channel, FastGrpcChannel):
         return FastStub(channel, service)
     return Stub(channel, service)
@@ -77,10 +76,7 @@ class GrpcNodeClient:
         self._combiner = _stub(ch, "Combiner")
 
     async def _call(self, method, request) -> Payload:
-        import asyncio
-
         from seldon_core_tpu.engine.transport import RemoteUnitError
-        from seldon_core_tpu.wire import GrpcCallError
 
         try:
             reply: pb.SeldonMessage = await method(request, timeout=self.timeout)

@@ -82,6 +82,7 @@ class GenerativeModel:
         dtype: Any = None,
         seq_impl: str = "dense",
         name: str = "generative",
+        decode_block: int = 8,
     ):
         if family_mod is None:
             from seldon_core_tpu.models import llama as family_mod
@@ -105,6 +106,9 @@ class GenerativeModel:
         self.n_slots = int(n_slots)
         self.name = name
         self.mesh = mesh
+        # decode steps per device dispatch (the scheduler's block size);
+        # 1 disables the scan path entirely
+        self.decode_block = max(1, int(decode_block))
 
         if dtype is not None:
             import jax.numpy as jnp
@@ -157,10 +161,54 @@ class GenerativeModel:
             toks = fam.sample_tokens(logits, temperature, key)
             return toks, cache
 
+        def _decode_k(k):
+            """k decode steps in ONE device dispatch (lax.scan), with
+            per-slot eos/budget early exit ON DEVICE.  One host round trip
+            per k tokens instead of per token — the difference between 30
+            tok/s and real throughput when the chip sits behind a network
+            tunnel, and one dispatch overhead instead of k on local chips."""
+            from jax import lax
+            import jax.numpy as jnp
+
+            def fn(params, tokens, active, temperature, seed, eos, remaining, cache):
+                base_key = jax.random.PRNGKey(seed)
+
+                def body(carry, i):
+                    tokens, active, remaining, cache = carry
+
+                    def run(args):
+                        tokens, active, remaining, cache = args
+                        logits, cache2 = fam.decode_slots(
+                            params, tokens, cache, active, cfg
+                        )
+                        key = jax.random.fold_in(base_key, i)
+                        toks = fam.sample_tokens(logits, temperature, key)
+                        toks = jnp.where(active, toks, tokens)
+                        remaining2 = jnp.where(active, remaining - 1, remaining)
+                        done = (toks == eos) | (remaining2 <= 0)
+                        return toks, active & ~done, remaining2, cache2
+
+                    # all slots finished mid-block: skip the remaining
+                    # decode steps' FLOPs entirely
+                    tokens, active2, remaining, cache = lax.cond(
+                        active.any(), run, lambda a: a,
+                        (tokens, active, remaining, cache),
+                    )
+                    return (tokens, active2, remaining, cache), (tokens, active)
+
+                (tokens, active, remaining, cache), (toks_seq, act_seq) = lax.scan(
+                    body, (tokens, active, remaining, cache), jnp.arange(k)
+                )
+                return toks_seq, act_seq, cache
+
+            return fn
+
         # cache buffers are donated: each step reuses the previous buffers
         # in place instead of holding two live copies of a multi-GB cache
         self._prefill = jax.jit(_prefill, donate_argnums=(6,))
         self._decode = jax.jit(_decode, donate_argnums=(5,))
+        self._decode_k_factory = _decode_k
+        self._decode_k_jit: dict[int, Any] = {}
 
         # observability
         self.steps = 0
@@ -178,9 +226,12 @@ class GenerativeModel:
             f"prompt length {n} exceeds max_seq {self.cfg.max_seq}"
         )
 
-    def admit(self, slot: int, prompt: np.ndarray, temperature: float, seed: int) -> int:
-        """Prefill ``prompt`` (1-D int ids) into ``slot``; returns the first
-        sampled token."""
+    def admit_dispatch(self, slot: int, prompt: np.ndarray, temperature: float, seed: int):
+        """Enqueue one prefill WITHOUT fetching its sampled token (a device
+        array is returned).  Several admissions dispatched back-to-back cost
+        ONE host round trip when their tokens are fetched together —
+        serializing fetch-per-admit costs one RTT each on a tunnel-attached
+        chip."""
         prompt = np.asarray(prompt, np.int32).ravel()
         L = prompt.shape[0]
         if L < 1:
@@ -199,7 +250,12 @@ class GenerativeModel:
                 self._cache,
             )
             self.prefills += 1
-        return int(tok)
+        return tok
+
+    def admit(self, slot: int, prompt: np.ndarray, temperature: float, seed: int) -> int:
+        """Prefill ``prompt`` (1-D int ids) into ``slot``; returns the first
+        sampled token."""
+        return int(self.admit_dispatch(slot, prompt, temperature, seed))
 
     def step(
         self,
@@ -220,6 +276,39 @@ class GenerativeModel:
             )
             self.steps += 1
         return np.asarray(jax.device_get(toks))
+
+    def step_k(
+        self,
+        tokens: np.ndarray,
+        active: np.ndarray,
+        temperature: np.ndarray,
+        seed: int,
+        eos: np.ndarray,
+        remaining: np.ndarray,
+        k: int,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """``k`` decode steps in one dispatch -> ``(k, S)`` sampled tokens
+        plus the ``(k, S)`` was-active-at-step mask that says which of them
+        are real.  ``eos`` is per-slot (-1 = none), ``remaining`` the
+        per-slot token budget — both enforced on device so a slot stops
+        consuming cache the step it finishes."""
+        fn = self._decode_k_jit.get(k)
+        if fn is None:
+            fn = jax.jit(self._decode_k_factory(k), donate_argnums=(7,))
+            self._decode_k_jit[k] = fn
+        with self._lock:
+            toks_seq, act_seq, self._cache = fn(
+                self.params,
+                np.asarray(tokens, np.int32),
+                np.asarray(active, bool),
+                np.asarray(temperature, np.float32),
+                np.int32(seed),
+                np.asarray(eos, np.int32),
+                np.asarray(remaining, np.int32),
+                self._cache,
+            )
+            self.steps += k
+        return np.asarray(jax.device_get(toks_seq)), np.asarray(jax.device_get(act_seq))
 
     def warmup(self) -> int:
         """Compile the decode program and every prefill bucket.
@@ -245,6 +334,17 @@ class GenerativeModel:
                 0,
             )
             n += 1
+            if self.decode_block > 1:
+                self.step_k(
+                    np.zeros(self.n_slots, np.int32),
+                    np.zeros(self.n_slots, bool),
+                    np.zeros(self.n_slots, np.float32),
+                    0,
+                    np.full(self.n_slots, -1, np.int32),
+                    np.zeros(self.n_slots, np.int32),
+                    self.decode_block,
+                )
+                n += 1
             # warmup wrote garbage into slot 0 and advanced nothing real
             self.reset()
             return n
@@ -360,22 +460,56 @@ class GenerationScheduler:
         active = np.zeros(S, bool)
         try:
             while True:
+                batch: list[_Request] = []
                 if not active.any():
                     # fully idle: park on the queue
-                    first = await self._queue.get()
-                    await self._admit(first, slots, cur, temps, active)
-                # admit whatever else is waiting into remaining free slots
-                while not self._queue.empty() and not active.all():
-                    await self._admit(
-                        self._queue.get_nowait(), slots, cur, temps, active
-                    )
+                    batch.append(await self._queue.get())
+                # admit whatever else is waiting into remaining free slots;
+                # all prefills dispatch back-to-back and their first tokens
+                # are fetched in ONE device round trip
+                while (
+                    not self._queue.empty()
+                    and int(active.sum()) + len(batch) < S
+                ):
+                    batch.append(self._queue.get_nowait())
+                if batch:
+                    await self._admit_batch(batch, slots, cur, temps, active)
                 if not active.any():
                     continue
                 seed = self._next_seed()
+                k = self.model.decode_block
                 try:
-                    toks = await asyncio.to_thread(
-                        self.model.step, cur, active, temps, seed
-                    )
+                    if k <= 1:
+                        toks = await asyncio.to_thread(
+                            self.model.step, cur, active, temps, seed
+                        )
+                        toks_seq = toks[None]
+                        act_seq = active.copy()[None]
+                    else:
+                        # one dispatch yields up to k tokens per slot; the
+                        # device enforces per-slot eos + budget so finished
+                        # slots stop touching the cache mid-block
+                        eos = np.array(
+                            [
+                                slots[i].eos_id
+                                if slots[i] is not None and slots[i].eos_id is not None
+                                else -1
+                                for i in range(S)
+                            ],
+                            np.int32,
+                        )
+                        remaining = np.array(
+                            [
+                                max(0, slots[i].max_new_tokens - len(slots[i].out))
+                                if slots[i] is not None
+                                else 0
+                                for i in range(S)
+                            ],
+                            np.int32,
+                        )
+                        toks_seq, act_seq = await asyncio.to_thread(
+                            self.model.step_k, cur, active, temps, seed, eos, remaining, k
+                        )
                 except asyncio.CancelledError:
                     raise
                 except Exception as exc:
@@ -390,16 +524,17 @@ class GenerationScheduler:
                         slots[i] = None
                     active[:] = False
                     continue
-                for i in range(S):
-                    if not active[i]:
-                        continue
-                    req = slots[i]
-                    tok = int(toks[i])
-                    cur[i] = tok
-                    if self._token_done(req, tok):
-                        self._complete(req)
-                        slots[i] = None
-                        active[i] = False
+                for step_i in range(toks_seq.shape[0]):
+                    for i in range(S):
+                        if not act_seq[step_i, i] or slots[i] is None:
+                            continue
+                        req = slots[i]
+                        tok = int(toks_seq[step_i, i])
+                        cur[i] = tok
+                        if self._token_done(req, tok):
+                            self._complete(req)
+                            slots[i] = None
+                            active[i] = False
         except asyncio.CancelledError:
             err = RuntimeError("GenerationScheduler closed")
             for req in slots:
@@ -407,27 +542,38 @@ class GenerationScheduler:
                     req.future.set_exception(err)
             raise
 
-    async def _admit(self, req, slots, cur, temps, active) -> None:
-        slot = next(i for i in range(len(slots)) if not active[i])
-        try:
-            tok = await asyncio.to_thread(
-                self.model.admit, slot, req.prompt, req.temperature, self._next_seed()
-            )
-        except asyncio.CancelledError:
-            raise
-        except Exception as exc:
+    async def _admit_batch(self, batch, slots, cur, temps, active) -> None:
+        free = [i for i in range(len(slots)) if not active[i]]
+
+        def dispatch_and_fetch():
+            placed = []
+            errors = []
+            for req, slot in zip(batch, free):
+                try:
+                    tok_dev = self.model.admit_dispatch(
+                        slot, req.prompt, req.temperature, self._next_seed()
+                    )
+                    placed.append((req, slot, tok_dev))
+                except Exception as exc:  # noqa: BLE001 - routed to the future
+                    errors.append((req, exc))
+            # one round trip fetches every admitted first token
+            toks = jax.device_get([t for _, _, t in placed]) if placed else []
+            return placed, toks, errors
+
+        placed, toks, errors = await asyncio.to_thread(dispatch_and_fetch)
+        for req, exc in errors:
             if not isinstance(exc, GraphUnitError):
-                log.exception("prefill admission failed")
+                log.exception("prefill admission failed", exc_info=exc)
             if not req.future.done():
                 req.future.set_exception(exc)
-            return
-        if self._token_done(req, int(tok)):
-            self._complete(req)
-            return
-        slots[slot] = req
-        cur[slot] = tok
-        temps[slot] = req.temperature
-        active[slot] = True
+        for (req, slot, _), tok in zip(placed, toks):
+            if self._token_done(req, int(tok)):
+                self._complete(req)
+                continue
+            slots[slot] = req
+            cur[slot] = int(tok)
+            temps[slot] = req.temperature
+            active[slot] = True
 
 
 PAD_ID = -1  # right-pad for ragged generated rows in dense responses

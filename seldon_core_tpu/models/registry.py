@@ -199,6 +199,7 @@ def build_generative_component(
     temperature: float = 0.0,
     eos_id: int | None = None,
     seq_impl: str = "dense",
+    decode_block: int = 8,
     **overrides,
 ):
     """Build a continuous-batching generative graph unit (JAX_GENERATIVE)."""
@@ -236,6 +237,7 @@ def build_generative_component(
         dtype=dtype,
         seq_impl=seq_impl,
         name=f"{family}:{preset or 'default'}",
+        decode_block=decode_block,
     )
     return GenerativeComponent(
         model,

@@ -239,10 +239,10 @@ class _Conn(asyncio.Protocol):
             incr &= 0x7FFFFFFF
             if stream_id == 0:
                 self.out_window += incr
-            else:
-                self._stream_out[stream_id] = (
-                    self._stream_out.get(stream_id, self.peer_initial_window) + incr
-                )
+            elif stream_id in self._stream_out:
+                # unknown ids are completed streams (state already dropped
+                # by forget_stream) — re-creating the entry would leak it
+                self._stream_out[stream_id] += incr
             self._pump_sends()
         elif ftype == PING:
             if not flags & ACK:
@@ -301,12 +301,15 @@ class _Conn(asyncio.Protocol):
         queue = self._send_queue
         self._send_queue = []
         blocked: set[int] = set()  # streams with requeued data this pump
+        finished: set[int] = set()  # streams whose final frame went out
         for stream_id, data, flags in queue:
             if stream_id in blocked:
                 self._send_queue.append((stream_id, data, flags))
                 continue
             if flags == _RAW_FRAME:
+                # raw frames are only used for trailers — end of stream
                 out.append(data)
+                finished.add(stream_id)
                 continue
             sent = 0
             swin = self._stream_out.get(stream_id, self.peer_initial_window)
@@ -328,8 +331,22 @@ class _Conn(asyncio.Protocol):
             if sent < len(data):
                 blocked.add(stream_id)
                 self._send_queue.append((stream_id, data[sent:], flags))
+            elif flags & END_STREAM:
+                finished.add(stream_id)
+        still_queued = {sid for sid, _, _ in self._send_queue}
+        for sid in finished - still_queued:
+            self._stream_out.pop(sid, None)
         if out:
             self.transport.write(b"".join(out))
+
+    def forget_stream(self, stream_id: int) -> None:
+        """Drop per-stream send-window state once a stream completes —
+        stream IDs are never reused, so entries left behind are a leak of
+        ~one dict slot per RPC on long-lived connections.  A remainder
+        parked in the send queue keeps the entry until it drains."""
+        if any(sid == stream_id for sid, _, _ in self._send_queue):
+            return
+        self._stream_out.pop(stream_id, None)
 
     # -- role hooks ---------------------------------------------------------
 
@@ -384,17 +401,24 @@ _TRAILERS_OK = hpack.encode_headers([(b"grpc-status", b"0")])
 class _ServerConn(_Conn):
     is_server = True
 
-    def __init__(self, handlers: dict[bytes, Handler]):
+    def __init__(self, handlers: dict[bytes, Handler], conns: "set[_ServerConn] | None" = None):
         super().__init__()
         self.handlers = handlers
         # stream -> [path, data buffer]
         self._streams: dict[int, list[Any]] = {}
         self._tasks: set[asyncio.Task] = set()
+        self._stream_tasks: dict[int, asyncio.Task] = {}
+        self._conns = conns
+        if conns is not None:
+            conns.add(self)
 
     def _on_closed(self, exc: Exception | None) -> None:
         for t in self._tasks:
             t.cancel()
         self._streams.clear()
+        self._stream_tasks.clear()
+        if self._conns is not None:
+            self._conns.discard(self)
 
     def _on_headers(self, stream_id: int, headers, end: bool) -> None:
         path = b""
@@ -418,6 +442,11 @@ class _ServerConn(_Conn):
 
     def _on_rst(self, stream_id: int, code: int) -> None:
         self._streams.pop(stream_id, None)
+        task = self._stream_tasks.pop(stream_id, None)
+        if task is not None:
+            # client cancelled (e.g. its deadline passed): stop the handler
+            # instead of computing a response nobody will read
+            task.cancel()
 
     def _finish_request(self, stream_id: int) -> None:
         path, body = self._streams.pop(stream_id)
@@ -434,11 +463,19 @@ class _ServerConn(_Conn):
             return
         task = asyncio.ensure_future(self._run(stream_id, handler, messages[0]))
         self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+        self._stream_tasks[stream_id] = task
+
+        def _done(t, sid=stream_id):
+            self._tasks.discard(t)
+            self._stream_tasks.pop(sid, None)
+
+        task.add_done_callback(_done)
 
     async def _run(self, stream_id: int, handler: Handler, payload: bytes) -> None:
         try:
             response = await handler(payload)
+        except asyncio.CancelledError:
+            return  # stream was reset; nobody is listening
         except GrpcCallError as e:
             self._send_error(stream_id, e.status, e.message)
             return
@@ -456,6 +493,7 @@ class _ServerConn(_Conn):
         self.send_raw_after_data(
             stream_id, frame(HEADERS, END_HEADERS | END_STREAM, stream_id, _TRAILERS_OK)
         )
+        self.forget_stream(stream_id)
 
     def _send_error(self, stream_id: int, status: int, message: str) -> None:
         if self.transport is None or self.transport.is_closing():
@@ -499,6 +537,7 @@ class FastGrpcServer:
     def __init__(self, handlers: dict[str, Handler]):
         self.handlers = {k.encode(): v for k, v in handlers.items()}
         self._server: asyncio.AbstractServer | None = None
+        self._conns: set[_ServerConn] = set()
         self.bound_port = 0
 
     def add_handler(self, path: str, fn: Handler) -> None:
@@ -511,6 +550,7 @@ class FastGrpcServer:
 
         loop = asyncio.get_running_loop()
         try:
+            factory = lambda: _ServerConn(self.handlers, self._conns)  # noqa: E731
             if host is None:
                 # ONE dual-stack socket ([::] with V6ONLY off), like the
                 # grpcio server this replaces: an IPv6-only cluster must not
@@ -518,15 +558,10 @@ class FastGrpcServer:
                 # with host=None would make one socket PER family — and with
                 # port=0 each would land on a DIFFERENT ephemeral port.)
                 sock = _dual_stack_socket(port, reuse_port)
-                self._server = await loop.create_server(
-                    lambda: _ServerConn(self.handlers), sock=sock
-                )
+                self._server = await loop.create_server(factory, sock=sock)
             else:
                 self._server = await loop.create_server(
-                    lambda: _ServerConn(self.handlers),
-                    host,
-                    port,
-                    reuse_port=reuse_port or None,
+                    factory, host, port, reuse_port=reuse_port or None
                 )
         except OSError as e:
             # strict-boot contract: a gRPC-only client must never see silent
@@ -536,10 +571,30 @@ class FastGrpcServer:
         return self.bound_port
 
     async def stop(self, grace: float | None = None) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        """grpc.aio-like stop: close the listener, give in-flight handlers
+        ``grace`` seconds to finish (GOAWAY tells clients no new streams),
+        then close every established connection."""
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
+        conns = list(self._conns)
+        for conn in conns:
+            if conn.transport is not None and not conn.transport.is_closing():
+                conn.transport.write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 0)))
+        if grace:
+            deadline = asyncio.get_running_loop().time() + grace
+            while any(c._tasks for c in conns):
+                if asyncio.get_running_loop().time() >= deadline:
+                    break
+                await asyncio.sleep(0.05)
+        for conn in conns:
+            if conn.transport is not None:
+                conn.transport.close()
+        self._conns.clear()
+        if server is not None:
+            # 3.12+: wait_closed also waits for connection handlers, so it
+            # must come AFTER the transports are closed or it never returns
+            await server.wait_closed()
 
     async def wait_for_termination(self) -> None:
         if self._server is not None:
@@ -601,11 +656,22 @@ class _ClientConn(_Conn):
             self.transport.write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 0)))
             self.transport.close()
 
-    def call(self, path: bytes, payload: bytes, metadata: tuple = ()) -> asyncio.Future:
-        if self.transport is None or self.transport.is_closing():
-            raise ConnectionError("h2 connection closed")
+    def next_stream_id(self) -> int:
         stream_id = self._next_stream
         self._next_stream += 2
+        return stream_id
+
+    def call(
+        self,
+        path: bytes,
+        payload: bytes,
+        metadata: tuple = (),
+        stream_id: int | None = None,
+    ) -> asyncio.Future:
+        if self.transport is None or self.transport.is_closing():
+            raise ConnectionError("h2 connection closed")
+        if stream_id is None:
+            stream_id = self.next_stream_id()
         fut = asyncio.get_running_loop().create_future()
         self._calls[stream_id] = [fut, None, bytearray()]
         self.transport.write(
@@ -613,6 +679,17 @@ class _ClientConn(_Conn):
         )
         self.send_data(stream_id, grpc_frame(payload), end_stream=True)
         return fut
+
+    def cancel_stream(self, stream_id: int) -> None:
+        """Local cancellation (timeout): RST_STREAM(CANCEL) + drop state."""
+        self._calls.pop(stream_id, None)
+        self._stream_out.pop(stream_id, None)
+        self._send_queue = [e for e in self._send_queue if e[0] != stream_id]
+        if self.transport is not None and not self.transport.is_closing():
+            self.transport.write(
+                frame(RST_STREAM, 0, stream_id, struct.pack(">I", 0x8))  # CANCEL
+            )
+        self.maybe_drain_close()
 
     def _on_headers(self, stream_id: int, headers, end: bool) -> None:
         call = self._calls.get(stream_id)
@@ -719,8 +796,16 @@ class FastGrpcChannel:
     ) -> bytes:
         conn = await self._connection()
         path_b = path if isinstance(path, bytes) else path.encode()
-        fut = conn.call(path_b, payload, metadata)
-        return await asyncio.wait_for(fut, timeout)
+        stream_id = conn.next_stream_id()
+        fut = conn.call(path_b, payload, metadata, stream_id)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            # tell the server to stop working on it and drop our stream
+            # state — silently abandoning the stream leaks the _calls entry
+            # and leaves the handler running with no deadline
+            conn.cancel_stream(stream_id)
+            raise
 
     async def close(self) -> None:
         conn, self._conn = self._conn, None

@@ -109,7 +109,8 @@ class TestSlotPrimitives:
         cfg, params = tiny
         model = GenerativeModel(cfg, params, n_slots=2)
         n = model.warmup()
-        assert n == len(model.prefill_buckets) + 1
+        # prefill buckets + the single-step decode + the decode_block scan
+        assert n == len(model.prefill_buckets) + 2
         assert np.all(np.asarray(model._cache["pos"]) == 0)
 
 
@@ -371,3 +372,51 @@ class TestRingPrefill:
         np.testing.assert_allclose(
             np.asarray(cd["k"]), np.asarray(cr["k"]), rtol=2e-4, atol=2e-4
         )
+
+
+class TestDecodeBlocks:
+    """Multi-token dispatch (decode_block > 1) must be output-identical to
+    the single-step loop — eos and budget enforcement move on-device."""
+
+    def test_block_sizes_agree(self, tiny):
+        cfg, params = tiny
+        prompt = np.array([5, 9, 2, 17, 3], np.int32)
+        ref = reference_generate(cfg, params, prompt, 7)
+
+        async def gen(block):
+            model = GenerativeModel(cfg, params, n_slots=2, decode_block=block)
+            sched = GenerationScheduler(model)
+            try:
+                # 7 tokens with block 4 crosses a block boundary; block 16
+                # exceeds the budget so the device mask must stop at 7
+                return await sched.submit(prompt, max_new_tokens=7)
+            finally:
+                await sched.close()
+
+        for block in (1, 4, 16):
+            np.testing.assert_array_equal(run(gen(block)), ref, err_msg=f"block={block}")
+
+    def test_eos_mid_block_frees_slot_for_queued_request(self, tiny):
+        cfg, params = tiny
+        p1 = np.array([5, 9, 2, 17, 3], np.int32)
+        p2 = np.array([30, 7], np.int32)
+        ref1 = reference_generate(cfg, params, p1, 12)
+        eos = int(ref1[2])  # an id that appears mid-way through block 8
+        stop = int(np.where(ref1 == eos)[0][0])  # first occurrence wins
+
+        async def go():
+            model = GenerativeModel(cfg, params, n_slots=1, decode_block=8)
+            sched = GenerationScheduler(model)
+            try:
+                # single slot: p2 can only run after p1's eos frees it
+                o1, o2 = await asyncio.gather(
+                    sched.submit(p1, max_new_tokens=12, eos_id=eos),
+                    sched.submit(p2, max_new_tokens=5),
+                )
+            finally:
+                await sched.close()
+            return o1, o2
+
+        o1, o2 = run(go())
+        np.testing.assert_array_equal(o1, ref1[: stop + 1])
+        np.testing.assert_array_equal(o2, reference_generate(cfg, params, p2, 5))

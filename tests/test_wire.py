@@ -290,3 +290,70 @@ class TestFastServer:
 
         cycled, out = run(go())
         assert cycled and out == b"3"
+
+    def test_timeout_sends_rst_and_cancels_handler(self):
+        """An abandoned deadline must not leak stream state or leave the
+        server handler running forever."""
+        started = asyncio.Event()
+        cancelled = asyncio.Event()
+
+        async def slow(payload: bytes) -> bytes:
+            started.set()
+            try:
+                await asyncio.sleep(60)
+            except asyncio.CancelledError:
+                cancelled.set()
+                raise
+            return payload
+
+        async def go():
+            server = FastGrpcServer({"/a/Slow": slow, "/a/B": _echo})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            with pytest.raises(asyncio.TimeoutError):
+                await ch.call("/a/Slow", b"x", timeout=0.3)
+            await asyncio.wait_for(cancelled.wait(), timeout=5)
+            conn = ch._conn
+            # client dropped its per-stream state
+            assert not conn._calls and not conn._stream_out
+            # the connection is still healthy for new calls
+            out = await ch.call("/a/B", b"ok")
+            await ch.close()
+            await server.stop()
+            return out
+
+        assert run(go()) == b"ok"
+
+    def test_stream_state_freed_after_calls(self):
+        """Per-stream send-window entries must not accumulate across RPCs
+        (one leak per call on long-lived engine->microservice channels)."""
+
+        async def go():
+            server = FastGrpcServer({"/a/B": _echo})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            for _ in range(50):
+                await ch.call("/a/B", b"x")
+            client_state = len(ch._conn._stream_out)
+            server_conn = next(iter(server._conns))
+            server_state = len(server_conn._stream_out)
+            await ch.close()
+            await server.stop()
+            return client_state, server_state
+
+        client_state, server_state = run(go())
+        assert client_state == 0
+        assert server_state == 0
+
+    def test_stop_closes_established_connections(self):
+        async def go():
+            server = FastGrpcServer({"/a/B": _echo})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            await ch.call("/a/B", b"x")
+            await server.stop(grace=1)
+            with pytest.raises((ConnectionError, GrpcCallError, asyncio.TimeoutError, OSError)):
+                await ch.call("/a/B", b"y", timeout=2)
+            await ch.close()
+
+        run(go())
