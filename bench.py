@@ -249,6 +249,80 @@ def stage_llm(detail: dict) -> None:
     }
 
 
+def stage_resnet(detail: dict) -> None:
+    """ResNet-50 bf16 wire-served — BASELINE config #3's model and the north
+    star's named workload (SURVEY §6)."""
+    from seldon_core_tpu.testing.loadtest import run_load
+
+    rows = int(os.environ.get("BENCH_RESNET_ROWS", "8"))
+    graph = {
+        "name": "resnet", "type": "MODEL", "implementation": "JAX_MODEL",
+        "parameters": [
+            {"name": "family", "value": "resnet", "type": "STRING"},
+            {"name": "preset", "value": "resnet50", "type": "STRING"},
+            {"name": "dtype", "value": "bfloat16", "type": "STRING"},
+            {"name": "buckets", "value": str(rows), "type": "STRING"},
+            {"name": "max_batch", "value": str(rows), "type": "INT"},
+        ],
+    }
+    payload = _image_payload(rows, 224)
+    with engine(graph, 18840, 18841, ready_timeout=600.0):
+        r = run_load(
+            "http://127.0.0.1:18840/api/v0.1/predictions", [payload],
+            concurrency=4, duration_s=SECONDS * 2,
+        )
+    detail["resnet50_wire"] = {
+        **r.summary(), "rows_per_request": rows,
+        "images_per_s": round(r.rps * rows, 1),
+        "model": "resnet-50 25M bf16, 224x224x3, wire-served",
+        "note": "bound by ~4.8MB base64 payloads over the ~100ms tunnel "
+                "(17MB/s wire), not the chip — each request moves 8 full "
+                "images through one CPU core",
+    }
+
+
+def _image_payload(rows: int, size: int) -> bytes:
+    import ml_dtypes
+
+    arr = np.random.default_rng(0).normal(size=(rows, size, size, 3))
+    buf = arr.astype(ml_dtypes.bfloat16).view(np.uint16).tobytes()
+    return json.dumps(
+        {"rawTensor": {"shape": [rows, size, size, 3], "dtype": "bfloat16",
+                       "data": base64.b64encode(buf).decode()}}
+    ).encode()
+
+
+def stage_ab(detail: dict) -> None:
+    """Epsilon-greedy A/B graph across two models — BASELINE config #3's
+    bandit routing shape, served in-process (router + 2 JAX units)."""
+    from seldon_core_tpu.testing.loadtest import run_load
+
+    child = lambda n, seed: {  # noqa: E731
+        "name": n, "type": "MODEL", "implementation": "JAX_MODEL",
+        "parameters": [
+            {"name": "family", "value": "mlp", "type": "STRING"},
+            {"name": "rng", "value": seed, "type": "INT"},
+        ],
+    }
+    graph = {
+        "name": "eg", "type": "ROUTER", "implementation": "EPSILON_GREEDY",
+        "parameters": [{"name": "epsilon", "value": "0.2", "type": "FLOAT"}],
+        "children": [child("model-a", "0"), child("model-b", "1")],
+    }
+    rows = 16
+    with engine(graph, 18850, 18851):
+        r = run_load(
+            "http://127.0.0.1:18850/api/v0.1/predictions",
+            [_raw_tensor_payload(rows, 784)],
+            concurrency=16, duration_s=SECONDS,
+        )
+    detail["ab_graph"] = {
+        **r.summary(), "rows_per_request": rows,
+        "predictions_per_s": round(r.rps * rows, 1),
+        "graph": "EPSILON_GREEDY router over 2 mlp JAX units, in-process",
+    }
+
+
 def main() -> None:
     detail: dict = {
         "hardware": "1 CPU core, 1 tunnel-attached TPU chip (~100ms RTT)",
@@ -259,6 +333,8 @@ def main() -> None:
         ("STUB", "BENCH_SKIP_STUB", stage_stub),
         ("BERT", "BENCH_SKIP_BERT", stage_bert),
         ("LLM", "BENCH_SKIP_LLM", stage_llm),
+        ("RESNET", "BENCH_SKIP_RESNET", stage_resnet),
+        ("AB", "BENCH_SKIP_AB", stage_ab),
     ]
     for name, skip_env, fn in stages:
         if os.environ.get(skip_env) == "1":

@@ -1,0 +1,30 @@
+"""Iris classifier — the smallest end-to-end example (CPU, no TPU needed).
+
+A user model is any class with ``predict(X, feature_names)``; this one is a
+tiny closed-form logistic-regression-style scorer so the example has zero
+training-time dependencies (the reference's sklearn_iris example pickles a
+fitted sklearn model instead — same serving contract either way;
+reference: examples/models/sklearn_iris/).
+"""
+
+import numpy as np
+
+# hand-fitted coefficients for the classic iris problem (rows: setosa,
+# versicolor, virginica; cols: sepal_l, sepal_w, petal_l, petal_w, bias)
+_W = np.array(
+    [
+        [0.4, 1.4, -2.2, -1.0, 0.3],
+        [0.4, -1.6, 0.4, -1.3, 1.2],
+        [-1.7, -1.5, 2.4, 2.4, -1.0],
+    ]
+)
+
+
+class IrisClassifier:
+    class_names = ["setosa", "versicolor", "virginica"]
+
+    def predict(self, X, feature_names):
+        X = np.atleast_2d(np.asarray(X, dtype=float))
+        logits = X @ _W[:, :4].T + _W[:, 4]
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)

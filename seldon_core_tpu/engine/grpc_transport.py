@@ -75,19 +75,54 @@ class GrpcNodeClient:
         self._output_transformer = _stub(ch, "OutputTransformer")
         self._combiner = _stub(ch, "Combiner")
 
-    async def _call(self, method, request) -> Payload:
-        from seldon_core_tpu.engine.transport import RemoteUnitError
+    async def _call(self, method, request, idempotent: bool = True) -> Payload:
+        """Unary call with bounded retry mirroring RestNodeClient: transient
+        transport failures retry for pure methods; feedback retries only
+        connection-refused (the request never reached the peer)."""
+        from seldon_core_tpu.engine.transport import (
+            RemoteUnitError,
+            _RetryableConnect,
+            _RetryableSent,
+            retry_loop,
+        )
 
-        try:
-            reply: pb.SeldonMessage = await method(request, timeout=self.timeout)
-        except grpc.aio.AioRpcError as e:
-            raise RemoteUnitError(
-                f"unit {self.spec.name!r} gRPC {self.target} unreachable: {e.code().name}"
-            ) from e
-        except (GrpcCallError, ConnectionError, asyncio.TimeoutError, OSError) as e:
-            raise RemoteUnitError(
-                f"unit {self.spec.name!r} gRPC {self.target} failed: {e}"
-            ) from e
+        GRPC_UNAVAILABLE = 14
+
+        async def attempt(_i: int) -> pb.SeldonMessage:
+            try:
+                return await method(request, timeout=self.timeout)
+            except grpc.aio.AioRpcError as e:
+                err = RemoteUnitError(
+                    f"unit {self.spec.name!r} gRPC {self.target} unreachable: {e.code().name}"
+                )
+                if e.code() != grpc.StatusCode.UNAVAILABLE:
+                    raise err from e
+                if "Failed to connect" in (e.details() or ""):
+                    raise _RetryableConnect(err) from e
+                raise _RetryableSent(err) from e
+            except GrpcCallError as e:
+                err = RemoteUnitError(
+                    f"unit {self.spec.name!r} gRPC {self.target} failed: {e}"
+                )
+                # a server-returned UNAVAILABLE (warming/overloaded) is the
+                # gRPC analogue of HTTP 503 — transient, retry if idempotent
+                if e.status == GRPC_UNAVAILABLE:
+                    raise _RetryableSent(err) from e
+                raise err from e
+            except ConnectionRefusedError as e:
+                raise _RetryableConnect(
+                    RemoteUnitError(
+                        f"unit {self.spec.name!r} gRPC {self.target} unreachable: {e}"
+                    )
+                ) from e
+            except (ConnectionError, asyncio.TimeoutError, OSError) as e:
+                raise _RetryableSent(
+                    RemoteUnitError(
+                        f"unit {self.spec.name!r} gRPC {self.target} failed: {e}"
+                    )
+                ) from e
+
+        reply = await retry_loop(attempt, idempotent=idempotent)
         if reply.HasField("status") and reply.status.status == pb.Status.FAILURE:
             raise RemoteUnitError(
                 f"unit {self.spec.name!r} gRPC failure: {reply.status.info}"
@@ -133,4 +168,4 @@ class GrpcNodeClient:
         if routing is not None:
             req.response.meta.routing[self.spec.name] = routing
         stub = self._router if self.spec.type == UnitType.ROUTER else self._model
-        await self._call(stub.SendFeedback, req)
+        await self._call(stub.SendFeedback, req, idempotent=False)

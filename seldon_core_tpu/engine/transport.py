@@ -32,6 +32,56 @@ class RemoteUnitError(GraphUnitError):
     """A remote unit returned an error status."""
 
 
+# Bounded retry for transient hop failures (one blipped connection must not
+# become a user-visible 500 — the reference at least had a pooled client
+# with a retry handler, api-frontend/.../service/HttpRetryHandler.java).
+RETRY_ATTEMPTS = 3
+RETRY_BASE_DELAY_S = 0.05
+RETRYABLE_HTTP = frozenset({502, 503, 504})
+
+
+async def retry_backoff(attempt: int) -> None:
+    import random
+
+    await asyncio.sleep(RETRY_BASE_DELAY_S * (2**attempt) * (0.5 + random.random()))
+
+
+class _RetryableConnect(Exception):
+    """Connection never established — safe to retry any method."""
+
+    def __init__(self, cause: Exception):
+        self.cause = cause
+
+
+class _RetryableSent(Exception):
+    """Request may have reached the peer — retry only idempotent methods."""
+
+    def __init__(self, cause: Exception):
+        self.cause = cause
+
+
+async def retry_loop(attempt, *, idempotent: bool, attempts: int = RETRY_ATTEMPTS):
+    """THE bounded-retry skeleton for every hop (engine REST, engine gRPC,
+    gateway->engine — one policy, three classifiers).  ``attempt(i)``
+    returns the result or raises: ``_RetryableConnect`` (connection never
+    made — retry anything), ``_RetryableSent`` (may have reached the peer —
+    retry only idempotent methods), anything else (no retry).  On
+    exhaustion the LAST classified error's ``cause`` is raised."""
+    last: Exception | None = None
+    for i in range(attempts):
+        try:
+            return await attempt(i)
+        except _RetryableConnect as e:
+            last = e.cause
+        except _RetryableSent as e:
+            if not idempotent:
+                raise e.cause
+            last = e.cause
+        if i < attempts - 1:
+            await retry_backoff(i)
+    raise last  # type: ignore[misc]
+
+
 class RestNodeClient:
     """NodeClient over HTTP JSON to a wrapped model microservice."""
 
@@ -47,21 +97,43 @@ class RestNodeClient:
         ep = spec.endpoint
         self.base = f"http://{ep.service_host}:{ep.service_port}"
 
-    async def _post(self, path: str, body: dict[str, Any]) -> dict[str, Any]:
+    async def _post(
+        self, path: str, body: dict[str, Any], idempotent: bool = True
+    ) -> dict[str, Any]:
+        """POST with bounded retry.  Pure graph methods (predict/transform/
+        route/aggregate) retry on connect errors, timeouts, and gateway-ish
+        5xx; feedback (stateful: bandit counters) retries ONLY when the
+        connection was never established, so a reward can't double-count."""
+        return await retry_loop(
+            lambda _i: self._post_once(path, body), idempotent=idempotent
+        )
+
+    async def _post_once(self, path: str, body: dict[str, Any]) -> dict[str, Any]:
         try:
             async with self.session.post(
                 self.base + path, json=body, timeout=self.timeout
             ) as resp:
                 data = await resp.json(content_type=None)
+                if resp.status in RETRYABLE_HTTP:
+                    raise _RetryableSent(
+                        RemoteUnitError(
+                            f"unit {self.spec.name!r} {path} -> HTTP {resp.status}"
+                        )
+                    )
                 if resp.status != 200:
                     reason = (data or {}).get("status", {}).get("info", "")
                     raise RemoteUnitError(
                         f"unit {self.spec.name!r} {path} -> HTTP {resp.status}: {reason}"
                     )
                 return data
+        except aiohttp.ClientConnectorError as e:
+            # connection never established: always safe to retry
+            raise _RetryableConnect(
+                RemoteUnitError(f"unit {self.spec.name!r} {path} unreachable: {e}")
+            ) from e
         except (aiohttp.ClientError, asyncio.TimeoutError, json.JSONDecodeError) as e:
-            raise RemoteUnitError(
-                f"unit {self.spec.name!r} {path} unreachable: {e}"
+            raise _RetryableSent(
+                RemoteUnitError(f"unit {self.spec.name!r} {path} failed: {e}")
             ) from e
 
     def _merge(self, p: Payload, out: Payload) -> Payload:
@@ -96,7 +168,7 @@ class RestNodeClient:
         body = feedback_to_dict(fb)
         if routing is not None:
             body["routing"] = routing
-        await self._post("/send-feedback", body)
+        await self._post("/send-feedback", body, idempotent=False)
 
 
 class TransportManager:

@@ -308,7 +308,10 @@ class GenerativeModel:
                 self._cache,
             )
             self.steps += k
-        return np.asarray(jax.device_get(toks_seq)), np.asarray(jax.device_get(act_seq))
+        # ONE device_get for both arrays: two separate fetches would pay two
+        # host round trips per block on a tunnel-attached chip
+        toks_np, act_np = jax.device_get((toks_seq, act_seq))
+        return np.asarray(toks_np), np.asarray(act_np)
 
     def warmup(self) -> int:
         """Compile the decode program and every prefill bucket.

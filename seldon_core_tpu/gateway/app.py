@@ -47,6 +47,15 @@ def _error(status: int, reason: str) -> web.Response:
     return web.json_response(failure_status_dict(status, reason), status=status)
 
 
+class _UpstreamError(Exception):
+    """A retryable upstream status, carried so the engine's real response
+    can be returned verbatim if every attempt fails the same way."""
+
+    def __init__(self, status: int, body: bytes):
+        self.status = status
+        self.body = body
+
+
 class GatewayApp:
     def __init__(
         self,
@@ -150,14 +159,53 @@ class GatewayApp:
     # -- data plane --------------------------------------------------------
 
     async def _forward(self, rec: DeploymentRecord, path: str, raw: bytes) -> tuple[int, bytes]:
+        """POST to the predictor's engine Service, with the same bounded
+        retry discipline as the engine's own hops (engine/transport.py
+        retry_loop): connect failures always retry (a rolling engine pod
+        briefly refuses connections); sent-but-failed retries only the
+        idempotent predictions path, never feedback (bandit reward
+        counters).  A persistent 5xx is returned VERBATIM after the last
+        attempt — the engine's status and diagnostic body must reach the
+        client, not a synthetic 503."""
+        from seldon_core_tpu.engine.transport import (
+            RETRY_ATTEMPTS,
+            RETRYABLE_HTTP,
+            _RetryableConnect,
+            _RetryableSent,
+            retry_loop,
+        )
+
         assert self._session is not None, "GatewayApp.start() not called"
-        async with self._session.post(
-            rec.rest_base + path,
-            data=raw,
-            headers={"Content-Type": "application/json"},
-            timeout=self.timeout,
-        ) as resp:
-            return resp.status, await resp.read()
+        idempotent = "feedback" not in path
+
+        async def attempt(i: int) -> tuple[int, bytes]:
+            try:
+                async with self._session.post(
+                    rec.rest_base + path,
+                    data=raw,
+                    headers={"Content-Type": "application/json"},
+                    timeout=self.timeout,
+                ) as resp:
+                    body = await resp.read()
+                    if (
+                        resp.status in RETRYABLE_HTTP
+                        and idempotent
+                        # the last attempt returns the real response
+                        and i < RETRY_ATTEMPTS - 1
+                    ):
+                        raise _RetryableSent(
+                            _UpstreamError(resp.status, body)
+                        )
+                    return resp.status, body
+            except aiohttp.ClientConnectorError as e:
+                raise _RetryableConnect(e) from e
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                raise _RetryableSent(e) from e
+
+        try:
+            return await retry_loop(attempt, idempotent=idempotent)
+        except _UpstreamError as e:
+            return e.status, e.body
 
     async def _ingress(self, request: web.Request, path: str, service: str) -> web.Response:
         if self._paused:

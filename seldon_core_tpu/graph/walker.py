@@ -77,6 +77,14 @@ class LocalClient:
     def __init__(self, spec: PredictiveUnitSpec, component: Any):
         self.spec = spec
         self.component = component
+        # Components exposing tags() hold per-request tag state on the
+        # shared instance (outlier scores, routing notes); without
+        # serialization two concurrent requests interleave method-call and
+        # tags()-read and one response carries the OTHER request's tags.
+        # Stateless components (no tags) keep full concurrency.
+        self._tag_lock = (
+            asyncio.Lock() if callable(getattr(component, "tags", None)) else None
+        )
 
     # -- helpers ----------------------------------------------------------
 
@@ -108,6 +116,12 @@ class LocalClient:
         return []
 
     async def _transform(self, p: Payload, method_name: str) -> Payload:
+        if self._tag_lock is not None:
+            async with self._tag_lock:
+                return await self._transform_inner(p, method_name)
+        return await self._transform_inner(p, method_name)
+
+    async def _transform_inner(self, p: Payload, method_name: str) -> Payload:
         comp = self.component
         raw_fn = getattr(comp, f"{method_name}_raw", None)
         if callable(raw_fn):

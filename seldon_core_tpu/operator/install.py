@@ -1,0 +1,328 @@
+"""Install-manifest rendering: everything needed to run the control plane.
+
+The reference ships its install as Helm charts + ksonnet prototypes
+(reference: helm-charts/seldon-core/templates/cluster-manager-deployment.yaml
+:1-60, seldon-core/seldon-core/core.libsonnet:1-60).  Here the manifests are
+rendered from the same Python constants the operator itself uses (ports,
+images, CRD schema) so the install can never drift from the code, and the
+rendered YAML is committed under ``deploy/`` for plain ``kubectl apply``
+(golden-file tests pin the two together).
+
+    python -m seldon_core_tpu.operator.install --out deploy/
+
+renders:
+
+- ``crd.yaml``        the seldondeployments CRD (also created on operator
+                      boot, 409-tolerant — reference CRDCreator.java:29-51)
+- ``operator.yaml``   namespace, RBAC, operator Deployment
+- ``gateway.yaml``    gateway RBAC + Deployment + Service (REST + gRPC)
+- ``tap-broker.yaml`` request/response tap broker + Service
+- ``install.yaml``    all of the above concatenated
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Any
+
+from seldon_core_tpu.operator.crd import CRD_GROUP
+from seldon_core_tpu.operator.kube_http import crd_manifest
+from seldon_core_tpu.operator.resources import ENGINE_GRPC_PORT, ENGINE_REST_PORT
+
+NAMESPACE = "seldon-system"
+OPERATOR_IMAGE = "seldon-core-tpu/operator:latest"
+GATEWAY_IMAGE = "seldon-core-tpu/gateway:latest"
+TAP_IMAGE = "seldon-core-tpu/tap-broker:latest"
+
+GATEWAY_REST_PORT = 8080
+GATEWAY_GRPC_PORT = 5000
+TAP_PORT = 7780
+
+
+def _meta(name: str, namespace: str | None = NAMESPACE, **labels: str) -> dict[str, Any]:
+    meta: dict[str, Any] = {"name": name, "labels": {"app": "seldon-core-tpu", **labels}}
+    if namespace:
+        meta["namespace"] = namespace
+    return meta
+
+
+def namespace_manifest() -> dict[str, Any]:
+    return {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NAMESPACE}}
+
+
+def operator_rbac() -> list[dict[str, Any]]:
+    """The operator owns CRs cluster-wide plus the workloads it emits
+    (Deployments, multi-host StatefulSets, Services, Pods for slice rolls)."""
+    return [
+        {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": _meta("seldon-operator"),
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": _meta("seldon-operator", namespace=None),
+            "rules": [
+                {
+                    "apiGroups": [CRD_GROUP],
+                    "resources": ["seldondeployments", "seldondeployments/status"],
+                    "verbs": ["get", "list", "watch", "create", "update", "patch"],
+                },
+                {
+                    "apiGroups": ["apiextensions.k8s.io"],
+                    "resources": ["customresourcedefinitions"],
+                    "verbs": ["get", "create"],
+                },
+                {
+                    "apiGroups": ["apps"],
+                    "resources": ["deployments", "statefulsets"],
+                    "verbs": ["get", "list", "watch", "create", "update", "delete"],
+                },
+                {
+                    "apiGroups": [""],
+                    # pods: whole-slice restarts of multi-host StatefulSets
+                    # (operator/controller.py::_roll_statefulset)
+                    "resources": ["services", "pods"],
+                    "verbs": ["get", "list", "watch", "create", "update", "delete"],
+                },
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": _meta("seldon-operator", namespace=None),
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "seldon-operator",
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": "seldon-operator",
+                    "namespace": NAMESPACE,
+                }
+            ],
+        },
+    ]
+
+
+def operator_deployment(image: str = OPERATOR_IMAGE, watch_namespace: str = "default") -> dict[str, Any]:
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": _meta("seldon-operator", component="operator"),
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app.kubernetes.io/name": "seldon-operator"}},
+            "template": {
+                "metadata": {"labels": {"app.kubernetes.io/name": "seldon-operator"}},
+                "spec": {
+                    "serviceAccountName": "seldon-operator",
+                    "containers": [
+                        {
+                            "name": "operator",
+                            "image": image,
+                            "command": ["sct-operator"],
+                            "env": [
+                                {"name": "SELDON_NAMESPACE", "value": watch_namespace},
+                            ],
+                            "resources": {
+                                "requests": {"cpu": "100m", "memory": "256Mi"}
+                            },
+                        }
+                    ],
+                },
+            },
+        },
+    }
+
+
+def gateway_rbac() -> list[dict[str, Any]]:
+    """The gateway only reads CRs (to register routes + OAuth clients)."""
+    return [
+        {
+            "apiVersion": "v1",
+            "kind": "ServiceAccount",
+            "metadata": _meta("seldon-gateway"),
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRole",
+            "metadata": _meta("seldon-gateway", namespace=None),
+            "rules": [
+                {
+                    "apiGroups": [CRD_GROUP],
+                    "resources": ["seldondeployments"],
+                    "verbs": ["get", "list", "watch"],
+                }
+            ],
+        },
+        {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "ClusterRoleBinding",
+            "metadata": _meta("seldon-gateway", namespace=None),
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole",
+                "name": "seldon-gateway",
+            },
+            "subjects": [
+                {
+                    "kind": "ServiceAccount",
+                    "name": "seldon-gateway",
+                    "namespace": NAMESPACE,
+                }
+            ],
+        },
+    ]
+
+
+def gateway_manifests(image: str = GATEWAY_IMAGE) -> list[dict[str, Any]]:
+    return [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": _meta("seldon-gateway", component="gateway"),
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app.kubernetes.io/name": "seldon-gateway"}},
+                "template": {
+                    "metadata": {
+                        "labels": {"app.kubernetes.io/name": "seldon-gateway"},
+                        "annotations": {
+                            "prometheus.io/scrape": "true",
+                            "prometheus.io/path": "/prometheus",
+                            "prometheus.io/port": str(GATEWAY_REST_PORT),
+                        },
+                    },
+                    "spec": {
+                        "serviceAccountName": "seldon-gateway",
+                        "containers": [
+                            {
+                                "name": "gateway",
+                                "image": image,
+                                "command": ["sct-gateway"],
+                                "args": ["--watch"],
+                                "env": [
+                                    {"name": "GATEWAY_PORT", "value": str(GATEWAY_REST_PORT)},
+                                    {"name": "GATEWAY_GRPC_PORT", "value": str(GATEWAY_GRPC_PORT)},
+                                ],
+                                "ports": [
+                                    {"containerPort": GATEWAY_REST_PORT, "name": "rest"},
+                                    {"containerPort": GATEWAY_GRPC_PORT, "name": "grpc"},
+                                ],
+                                "readinessProbe": {
+                                    "httpGet": {"path": "/ready", "port": GATEWAY_REST_PORT},
+                                    "initialDelaySeconds": 5,
+                                    "periodSeconds": 5,
+                                },
+                                "resources": {
+                                    "requests": {"cpu": "200m", "memory": "256Mi"}
+                                },
+                            }
+                        ],
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta("seldon-gateway"),
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {"app.kubernetes.io/name": "seldon-gateway"},
+                "ports": [
+                    {"port": GATEWAY_REST_PORT, "targetPort": GATEWAY_REST_PORT, "name": "rest"},
+                    {"port": GATEWAY_GRPC_PORT, "targetPort": GATEWAY_GRPC_PORT, "name": "grpc"},
+                ],
+            },
+        },
+    ]
+
+
+def tap_broker_manifests(image: str = TAP_IMAGE) -> list[dict[str, Any]]:
+    """Self-contained request/response tap (replaces the reference's
+    Kafka+ZooKeeper install, kafka/kafka.json)."""
+    return [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": _meta("seldon-tap-broker", component="tap"),
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app.kubernetes.io/name": "seldon-tap-broker"}},
+                "template": {
+                    "metadata": {"labels": {"app.kubernetes.io/name": "seldon-tap-broker"}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "tap-broker",
+                                "image": image,
+                                "command": ["sct-tap-broker"],
+                                "args": ["--dir", "/data", "--port", str(TAP_PORT)],
+                                "ports": [{"containerPort": TAP_PORT, "name": "tap"}],
+                                "volumeMounts": [{"name": "data", "mountPath": "/data"}],
+                                "resources": {
+                                    "requests": {"cpu": "100m", "memory": "128Mi"}
+                                },
+                            }
+                        ],
+                        "volumes": [{"name": "data", "emptyDir": {}}],
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta("seldon-tap-broker"),
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {"app.kubernetes.io/name": "seldon-tap-broker"},
+                "ports": [{"port": TAP_PORT, "targetPort": TAP_PORT, "name": "tap"}],
+            },
+        },
+    ]
+
+
+def render_all() -> dict[str, list[dict[str, Any]]]:
+    """filename (sans .yaml) -> manifest list."""
+    files = {
+        "crd": [crd_manifest()],
+        "operator": [namespace_manifest(), *operator_rbac(), operator_deployment()],
+        "gateway": [*gateway_rbac(), *gateway_manifests()],
+        "tap-broker": tap_broker_manifests(),
+    }
+    files["install"] = [m for group in ("crd", "operator", "gateway", "tap-broker") for m in files[group]]
+    return files
+
+
+def to_yaml(manifests: list[dict[str, Any]]) -> str:
+    import yaml
+
+    header = (
+        "# Rendered by `python -m seldon_core_tpu.operator.install` — do not\n"
+        "# hand-edit; golden tests (tests/test_install.py) pin this file to\n"
+        "# the renderer.\n"
+    )
+    return header + yaml.safe_dump_all(manifests, sort_keys=True, default_flow_style=False)
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="render install manifests")
+    parser.add_argument("--out", default="deploy")
+    args = parser.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    for name, manifests in render_all().items():
+        path = os.path.join(args.out, f"{name}.yaml")
+        with open(path, "w") as f:
+            f.write(to_yaml(manifests))
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,13 @@ def main(argv: list[str] | None = None) -> None:
     component = load_component(args.interface_name, parameters)
     name = os.environ.get("PREDICTIVE_UNIT_ID", args.interface_name)
 
+    if args.service_type == "OUTLIER_DETECTOR":
+        # wrap score() into a transform-input service tagging outlierScore
+        # (reference: wrappers/python/outlier_detector_microservice.py:15-56)
+        from seldon_core_tpu.runtime.outlier import OutlierDetectorAdapter
+
+        component = OutlierDetectorAdapter(component)
+
     if args.persistence:
         from seldon_core_tpu.runtime.persistence import start_persistence
 

@@ -240,9 +240,15 @@ class _Conn(asyncio.Protocol):
             if stream_id == 0:
                 self.out_window += incr
             elif stream_id in self._stream_out:
-                # unknown ids are completed streams (state already dropped
-                # by forget_stream) — re-creating the entry would leak it
                 self._stream_out[stream_id] += incr
+            elif self._stream_open(stream_id):
+                # credit granted before we pumped any DATA for the stream
+                # (e.g. the peer enlarges the window while the handler is
+                # still computing) — must not be dropped, or a big response
+                # can stall on flow control forever
+                self._stream_out[stream_id] = self.peer_initial_window + incr
+            # else: a completed stream (state dropped by forget_stream) —
+            # re-creating the entry would leak it
             self._pump_sends()
         elif ftype == PING:
             if not flags & ACK:
@@ -356,6 +362,12 @@ class _Conn(asyncio.Protocol):
     def _on_data(self, stream_id: int, data: bytes, end: bool) -> None:
         raise NotImplementedError
 
+    def _stream_open(self, stream_id: int) -> bool:
+        """Is this stream known-in-progress (request received / call
+        pending)?  Governs whether early WINDOW_UPDATEs create send-window
+        state."""
+        return False
+
     def _on_rst(self, stream_id: int, code: int) -> None:
         pass
 
@@ -408,6 +420,7 @@ class _ServerConn(_Conn):
         self._streams: dict[int, list[Any]] = {}
         self._tasks: set[asyncio.Task] = set()
         self._stream_tasks: dict[int, asyncio.Task] = {}
+        self.max_stream = 0  # highest accepted stream id (GOAWAY payload)
         self._conns = conns
         if conns is not None:
             conns.add(self)
@@ -427,6 +440,7 @@ class _ServerConn(_Conn):
                 path = value
                 break
         self._streams[stream_id] = [path, bytearray()]
+        self.max_stream = max(self.max_stream, stream_id)
         if end:
             self._finish_request(stream_id)
 
@@ -439,6 +453,9 @@ class _ServerConn(_Conn):
             self._stream_recv_credit(stream_id, len(data))
         if end:
             self._finish_request(stream_id)
+
+    def _stream_open(self, stream_id: int) -> bool:
+        return stream_id in self._streams or stream_id in self._stream_tasks
 
     def _on_rst(self, stream_id: int, code: int) -> None:
         self._streams.pop(stream_id, None)
@@ -580,7 +597,12 @@ class FastGrpcServer:
         conns = list(self._conns)
         for conn in conns:
             if conn.transport is not None and not conn.transport.is_closing():
-                conn.transport.write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 0)))
+                # last_stream_id = highest accepted: tells clients their
+                # in-flight streams WILL be answered (0 would mean "nothing
+                # was processed" and make them abandon in-flight RPCs)
+                conn.transport.write(
+                    frame(GOAWAY, 0, 0, struct.pack(">II", conn.max_stream, 0))
+                )
         if grace:
             deadline = asyncio.get_running_loop().time() + grace
             while any(c._tasks for c in conns):
@@ -623,6 +645,17 @@ class _ClientConn(_Conn):
             if not fut.done():
                 fut.set_exception(err)
         self._calls.clear()
+
+    def _stream_open(self, stream_id: int) -> bool:
+        return stream_id in self._calls
+
+    def _on_goaway(self, payload: bytes) -> None:
+        # graceful drain, not a hard close: a stopping server announces "no
+        # new streams" — in-flight calls must be allowed to finish (the
+        # whole point of its grace period); the connection closes itself
+        # once the last pending call resolves
+        self.drain_when_idle = True
+        self.maybe_drain_close()
 
     def _template(self, path: bytes, metadata: tuple = ()) -> bytes:
         key = (path, metadata)
@@ -765,6 +798,7 @@ class FastGrpcChannel:
             and conn.transport is not None
             and not conn.transport.is_closing()
             and not conn.exhausted
+            and not conn.drain_when_idle  # server sent GOAWAY: no new streams
         )
 
     async def _connection(self) -> _ClientConn:

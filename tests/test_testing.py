@@ -8,6 +8,7 @@ cluster."""
 
 import asyncio
 import json
+import os
 
 import numpy as np
 import pytest
@@ -15,6 +16,8 @@ from aiohttp import web
 from aiohttp.test_utils import TestServer
 
 from seldon_core_tpu.testing import ApiTester, Contract, MicroserviceTester
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 run = asyncio.run
 
@@ -306,3 +309,154 @@ class TestModelZooContracts:
 
         report = run(go())
         assert report.ok, report.failures
+
+
+class TestOutlierDetectorService:
+    """--service-type OUTLIER_DETECTOR wraps score() into a transform-input
+    service tagging outlierScore (round-2 weak #5: the flag was accepted
+    and silently ignored)."""
+
+    class Detector:
+        def __init__(self):
+            self.calls = 0
+
+        def score(self, X, names):
+            self.calls += 1
+            return np.abs(np.asarray(X)).sum(axis=1)
+
+    def test_adapter_scores_and_passes_through(self):
+        import asyncio as _asyncio
+
+        from aiohttp.test_utils import TestClient as _TC, TestServer as _TS
+
+        from seldon_core_tpu.runtime.outlier import OutlierDetectorAdapter
+        from seldon_core_tpu.runtime.server import MicroserviceApp
+
+        async def go():
+            adapter = OutlierDetectorAdapter(self.Detector())
+            app = MicroserviceApp(adapter, name="od", service_type="TRANSFORMER").build()
+            client = _TC(_TS(app))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/transform-input",
+                    json={"data": {"ndarray": [[1.0, -2.0], [3.0, 4.0]]}},
+                )
+                assert resp.status == 200
+                return await resp.json()
+            finally:
+                await client.close()
+
+        body = _asyncio.run(go())
+        assert body["data"]["ndarray"] == [[1.0, -2.0], [3.0, 4.0]]  # untouched
+        assert body["meta"]["tags"]["outlierScore"] == [3.0, 7.0]
+
+    def test_score_less_component_rejected(self):
+        import pytest as _pytest
+
+        from seldon_core_tpu.runtime.outlier import OutlierDetectorAdapter
+
+        class NoScore:
+            pass
+
+        with _pytest.raises(TypeError, match="score"):
+            OutlierDetectorAdapter(NoScore())
+
+    def test_cli_end_to_end(self, tmp_path):
+        """sct-microservice --service-type OUTLIER_DETECTOR over a real
+        socket: the reference flow a user migrating a detector follows."""
+        import json as _json
+        import os
+        import subprocess
+        import sys
+        import time
+        import urllib.request
+
+        (tmp_path / "MyDetector.py").write_text(
+            "import numpy as np\n"
+            "class MyDetector:\n"
+            "    def score(self, X, names):\n"
+            "        return np.asarray(X).max(axis=1)\n"
+        )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = f"{tmp_path}:{env.get('PYTHONPATH', '')}"
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "seldon_core_tpu.runtime.microservice",
+             "MyDetector", "REST", "--service-type", "OUTLIER_DETECTOR",
+             "--port", "19777"],
+            env=env,
+        )
+        try:
+            body = _json.dumps({"data": {"ndarray": [[5.0, 1.0]]}}).encode()
+            deadline = time.time() + 60
+            while True:
+                try:
+                    req = urllib.request.Request(
+                        "http://127.0.0.1:19777/transform-input", body,
+                        {"Content-Type": "application/json"},
+                    )
+                    with urllib.request.urlopen(req, timeout=5) as resp:
+                        out = _json.loads(resp.read())
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.5)
+            assert out["meta"]["tags"]["outlierScore"] == [5.0]
+            assert out["data"]["ndarray"] == [[5.0, 1.0]]
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+class TestExamplesRunnable:
+    """The example dirs must actually run — a gallery nobody can execute is
+    documentation debt (round-2 missing #5)."""
+
+    def test_iris_example_serves_and_passes_contract(self):
+        import asyncio as _asyncio
+        import sys
+
+        sys.path.insert(0, os.path.join(REPO_ROOT, "examples", "iris"))
+        try:
+            from IrisClassifier import IrisClassifier  # noqa: PLC0415
+        finally:
+            sys.path.pop(0)
+
+        from seldon_core_tpu.runtime.server import MicroserviceApp
+        from seldon_core_tpu.testing.tester import MicroserviceTester
+
+        async def go():
+            from aiohttp.test_utils import TestServer as _TS
+
+            app = MicroserviceApp(IrisClassifier(), name="iris").build()
+            srv = _TS(app)
+            await srv.start_server()
+            try:
+                tester = MicroserviceTester(
+                    Contract.load(
+                        os.path.join(REPO_ROOT, "examples", "iris", "contract.json")
+                    ),
+                    "127.0.0.1",
+                    srv.port,
+                )
+                return await tester.run(n_requests=4, batch_size=3)
+            finally:
+                await srv.close()
+
+        report = _asyncio.run(go())
+        assert report.ok and report.requests == 4
+
+    def test_example_seldondeployments_validate(self):
+        import yaml as _yaml
+
+        from seldon_core_tpu.operator.crd import SeldonDeployment
+        from seldon_core_tpu.operator.defaulting import defaulting, validate
+
+        for sub in ("iris", "mnist-cnn"):
+            path = os.path.join(REPO_ROOT, "examples", sub, "seldondeployment.yaml")
+            with open(path) as f:
+                raw = _yaml.safe_load(f)
+            cr = SeldonDeployment.from_dict(raw)
+            validate(defaulting(cr))

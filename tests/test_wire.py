@@ -357,3 +357,29 @@ class TestFastServer:
             await ch.close()
 
         run(go())
+
+    def test_graceful_stop_lets_inflight_finish(self):
+        """stop(grace) must let in-flight RPCs complete: GOAWAY carries the
+        highest accepted stream id and the client drains instead of killing
+        pending calls."""
+        release = asyncio.Event()
+
+        async def slow(payload: bytes) -> bytes:
+            await release.wait()
+            return payload + b"-done"
+
+        async def go():
+            server = FastGrpcServer({"/a/Slow": slow})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            call = asyncio.ensure_future(ch.call("/a/Slow", b"x", timeout=30))
+            await asyncio.sleep(0.2)  # request reaches the handler
+            stop_task = asyncio.ensure_future(server.stop(grace=10))
+            await asyncio.sleep(0.2)  # GOAWAY delivered while call in flight
+            release.set()
+            out = await call
+            await stop_task
+            await ch.close()
+            return out
+
+        assert run(go()) == b"x-done"
