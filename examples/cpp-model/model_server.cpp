@@ -1,0 +1,266 @@
+// A model microservice in plain C++ — no framework, no dependencies.
+//
+// The point: ANY container speaking the REST contract is a graph node.
+// The reference shipped dedicated R and Java wrappers (reference:
+// wrappers/s2i/R/microservice.R:1-40, wrappers/s2i/java/); here the
+// contract itself is the polyglot story, and this file is the non-Python
+// proof: ~300 lines serving
+//
+//     POST /predict          {"data":{"ndarray":[[...]]}} -> scores
+//     GET  /ping /ready      liveness / readiness
+//
+// wired into an inference graph exactly like a Python microservice (the
+// operator's env contract supplies the port via
+// PREDICTIVE_UNIT_SERVICE_PORT).
+//
+//   g++ -O2 -std=c++17 -o model_server model_server.cpp
+//   PREDICTIVE_UNIT_SERVICE_PORT=9002 ./model_server
+//
+// Driven end-to-end by tests/test_cpp_example.py.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cmath>
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// --------------------------------------------------------------------------
+// Tiny JSON: just enough to read {"data":{"ndarray":[[numbers...]]}}
+// --------------------------------------------------------------------------
+
+struct Parser {
+  const char* p;
+  const char* end;
+
+  explicit Parser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void ws() {
+    while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p;
+  }
+
+  bool lit(char c) {
+    ws();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    return false;
+  }
+
+  bool string(std::string* out) {
+    ws();
+    if (p >= end || *p != '"') return false;
+    ++p;
+    out->clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) ++p;  // keep escaped char raw
+      out->push_back(*p++);
+    }
+    if (p >= end) return false;
+    ++p;
+    return true;
+  }
+
+  bool number(double* out) {
+    ws();
+    char* after = nullptr;
+    *out = std::strtod(p, &after);
+    if (after == p) return false;
+    p = after;
+    return true;
+  }
+
+  // skip any JSON value (for object keys we don't care about)
+  bool skip() {
+    ws();
+    if (p >= end) return false;
+    if (*p == '"') {
+      std::string s;
+      return string(&s);
+    }
+    if (*p == '{' || *p == '[') {
+      char open = *p, close = (*p == '{') ? '}' : ']';
+      int depth = 0;
+      bool in_str = false;
+      for (; p < end; ++p) {
+        char c = *p;
+        if (in_str) {
+          if (c == '\\') ++p;
+          else if (c == '"') in_str = false;
+        } else if (c == '"') {
+          in_str = true;
+        } else if (c == open) {
+          ++depth;
+        } else if (c == close) {
+          if (--depth == 0) {
+            ++p;
+            return true;
+          }
+        }
+      }
+      return false;
+    }
+    while (p < end && *p != ',' && *p != '}' && *p != ']') ++p;
+    return true;
+  }
+};
+
+// find "ndarray": [[...], ...] anywhere in the body; rows of doubles
+static bool parse_ndarray(const std::string& body,
+                          std::vector<std::vector<double>>* rows) {
+  size_t at = body.find("\"ndarray\"");
+  if (at == std::string::npos) return false;
+  std::string rest = body.substr(at + 9);
+  Parser ps(rest);
+  if (!ps.lit(':') || !ps.lit('[')) return false;
+  while (true) {
+    if (ps.lit(']')) break;
+    if (!ps.lit('[')) return false;
+    std::vector<double> row;
+    while (true) {
+      if (ps.lit(']')) break;
+      double v;
+      if (!ps.number(&v)) return false;
+      row.push_back(v);
+      ps.lit(',');
+    }
+    rows->push_back(std::move(row));
+    ps.lit(',');
+  }
+  return !rows->empty();
+}
+
+// --------------------------------------------------------------------------
+// The "model": softmax over a fixed linear map — swap with your own math
+// --------------------------------------------------------------------------
+
+static std::vector<double> predict_row(const std::vector<double>& x) {
+  static const double W[3][4] = {
+      {0.4, 1.4, -2.2, -1.0}, {0.4, -1.6, 0.4, -1.3}, {-1.7, -1.5, 2.4, 2.4}};
+  static const double B[3] = {0.3, 1.2, -1.0};
+  std::vector<double> logits(3);
+  for (int c = 0; c < 3; ++c) {
+    double z = B[c];
+    for (size_t j = 0; j < x.size() && j < 4; ++j) z += W[c][j] * x[j];
+    logits[c] = z;
+  }
+  double mx = std::fmax(logits[0], std::fmax(logits[1], logits[2]));
+  double sum = 0;
+  for (double& l : logits) {
+    l = std::exp(l - mx);
+    sum += l;
+  }
+  for (double& l : logits) l /= sum;
+  return logits;
+}
+
+// --------------------------------------------------------------------------
+// Minimal HTTP/1.1 server (blocking, one request per iteration — plenty for
+// an example; a production C++ node would use a real event loop)
+// --------------------------------------------------------------------------
+
+static std::string response(int code, const std::string& body,
+                            const char* ctype = "application/json") {
+  char head[256];
+  std::snprintf(head, sizeof(head),
+                "HTTP/1.1 %d %s\r\nContent-Type: %s\r\nContent-Length: %zu\r\n"
+                "Connection: close\r\n\r\n",
+                code, code == 200 ? "OK" : "Error", ctype, body.size());
+  return std::string(head) + body;
+}
+
+static std::string handle(const std::string& method, const std::string& path,
+                          const std::string& body) {
+  if (method == "GET" && (path == "/ping" || path == "/ready"))
+    return response(200, "pong", "text/plain");
+  if (method == "POST" && path == "/predict") {
+    std::vector<std::vector<double>> rows;
+    if (!parse_ndarray(body, &rows))
+      return response(400,
+                      "{\"status\":{\"code\":400,\"status\":\"FAILURE\","
+                      "\"info\":\"expected data.ndarray\"}}");
+    std::string out =
+        "{\"data\":{\"names\":[\"setosa\",\"versicolor\",\"virginica\"],"
+        "\"ndarray\":[";
+    for (size_t i = 0; i < rows.size(); ++i) {
+      std::vector<double> scores = predict_row(rows[i]);
+      out += i ? ",[" : "[";
+      for (size_t c = 0; c < scores.size(); ++c) {
+        char num[32];
+        std::snprintf(num, sizeof(num), "%s%.9g", c ? "," : "", scores[c]);
+        out += num;
+      }
+      out += "]";
+    }
+    out += "]}}";
+    return response(200, out);
+  }
+  return response(404, "{\"status\":{\"code\":404,\"status\":\"FAILURE\"}}");
+}
+
+int main() {
+  const char* port_env = std::getenv("PREDICTIVE_UNIT_SERVICE_PORT");
+  int port = port_env ? std::atoi(port_env) : 9000;
+  std::signal(SIGPIPE, SIG_IGN);
+
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 64) != 0) {
+    std::perror("bind/listen");
+    return 1;
+  }
+  std::fprintf(stderr, "cpp model server on :%d\n", port);
+
+  std::string buf;
+  while (true) {
+    int conn = ::accept(fd, nullptr, nullptr);
+    if (conn < 0) continue;
+    buf.clear();
+    char chunk[8192];
+    size_t header_end = std::string::npos;
+    size_t content_length = 0;
+    // read headers, then the body per Content-Length
+    while (true) {
+      ssize_t n = ::recv(conn, chunk, sizeof(chunk), 0);
+      if (n <= 0) break;
+      buf.append(chunk, static_cast<size_t>(n));
+      if (header_end == std::string::npos) {
+        header_end = buf.find("\r\n\r\n");
+        if (header_end != std::string::npos) {
+          size_t cl = buf.find("Content-Length:");
+          if (cl == std::string::npos) cl = buf.find("content-length:");
+          if (cl != std::string::npos && cl < header_end)
+            content_length =
+                static_cast<size_t>(std::atol(buf.c_str() + cl + 15));
+        }
+      }
+      if (header_end != std::string::npos &&
+          buf.size() >= header_end + 4 + content_length)
+        break;
+    }
+    if (header_end != std::string::npos) {
+      size_t sp1 = buf.find(' ');
+      size_t sp2 = buf.find(' ', sp1 + 1);
+      std::string method = buf.substr(0, sp1);
+      std::string path = buf.substr(sp1 + 1, sp2 - sp1 - 1);
+      std::string body = buf.substr(header_end + 4);
+      std::string resp = handle(method, path, body);
+      ::send(conn, resp.data(), resp.size(), 0);
+    }
+    ::close(conn);
+  }
+}

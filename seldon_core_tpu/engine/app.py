@@ -58,6 +58,7 @@ class EngineApp:
         self.warmed = False
         self._warmup_error: BaseException | None = None
         self._warmup_task: asyncio.Task | None = None
+        self._profile_dir: str | None = None
 
     def build(self) -> web.Application:
         app = web.Application(client_max_size=256 * 1024 * 1024)
@@ -74,6 +75,11 @@ class EngineApp:
         r.add_post("/unpause", self.unpause)
         r.add_get("/unpause", self.unpause)
         r.add_get("/prometheus", self.prometheus)
+        # XLA/device profiling (SURVEY §5: the reference had only JMX):
+        # POST /profile/start {"dir": "/tmp/sct-profile"} ... /profile/stop
+        # then open the trace in TensorBoard / xprof
+        r.add_post("/profile/start", self.profile_start)
+        r.add_post("/profile/stop", self.profile_stop)
         app.on_startup.append(self._startup)
         app.on_cleanup.append(self._cleanup)
         return app
@@ -133,7 +139,10 @@ class EngineApp:
             try:
                 body = await self._json(request)
                 payload = payload_from_dict(body)
-                out = await self.service.predict(payload)
+                # opt-in per-node wall timings (meta.tags.sct_trace_ms) —
+                # request-scoped tracing the reference only had as logs
+                trace = request.headers.get("X-Seldon-Trace", "") == "1"
+                out = await self.service.predict(payload, trace=trace)
                 resp = payload_to_dict(out)
                 resp["status"] = {"code": 200, "status": "SUCCESS"}
                 return web.json_response(resp)
@@ -199,6 +208,40 @@ class EngineApp:
 
     async def prometheus(self, request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.expose(), content_type="text/plain")
+
+    async def profile_start(self, request: web.Request) -> web.Response:
+        import jax
+
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        out_dir = body.get("dir") or "/tmp/sct-profile"
+        # guard AFTER the await: no suspension between check and start_trace,
+        # or two concurrent starts both pass and the second 500s
+        if self._profile_dir is not None:
+            return web.json_response(
+                {"error": "profiler already running", "dir": self._profile_dir},
+                status=409,
+            )
+        self._profile_dir = out_dir
+        try:
+            jax.profiler.start_trace(out_dir)
+        except Exception as e:
+            self._profile_dir = None
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"status": "profiling", "dir": out_dir})
+
+    async def profile_stop(self, request: web.Request) -> web.Response:
+        import jax
+
+        if self._profile_dir is None:
+            return web.json_response({"error": "profiler not running"}, status=409)
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            out_dir, self._profile_dir = self._profile_dir, None
+        return web.json_response({"status": "stopped", "dir": out_dir})
 
 
 def main(argv: list[str] | None = None) -> None:

@@ -136,21 +136,33 @@ class GrpcNodeClient:
         out.meta.request_path.setdefault(self.spec.name, self.target)
         return out
 
+    # same retry-after-sent policy as RestNodeClient: only MODEL predict
+    # and aggregate are assumed pure (stateful online transformers /
+    # pull-tracking routers must not see a request twice)
+
     async def transform_input(self, p: Payload) -> Payload:
         if self.spec.type == UnitType.MODEL:
-            out = await self._call(self._model.Predict, payload_to_proto(p))
+            out = await self._call(
+                self._model.Predict, payload_to_proto(p), idempotent=True
+            )
         else:
-            out = await self._call(self._transformer.TransformInput, payload_to_proto(p))
+            out = await self._call(
+                self._transformer.TransformInput, payload_to_proto(p), idempotent=False
+            )
         return self._merge(p, out)
 
     async def transform_output(self, p: Payload) -> Payload:
         out = await self._call(
-            self._output_transformer.TransformOutput, payload_to_proto(p)
+            self._output_transformer.TransformOutput,
+            payload_to_proto(p),
+            idempotent=False,
         )
         return self._merge(p, out)
 
     async def route(self, p: Payload) -> int:
-        out = await self._call(self._router.Route, payload_to_proto(p))
+        out = await self._call(
+            self._router.Route, payload_to_proto(p), idempotent=False
+        )
         self._merge(p, out)
         if not out.is_numeric():
             return ROUTE_ALL
@@ -160,7 +172,7 @@ class GrpcNodeClient:
         req = pb.SeldonMessageList()
         for p in ps:
             req.seldonMessages.append(payload_to_proto(p))
-        out = await self._call(self._combiner.Aggregate, req)
+        out = await self._call(self._combiner.Aggregate, req, idempotent=True)
         return self._merge(ps[0], out)
 
     async def send_feedback(self, fb: FeedbackPayload, routing: int | None) -> None:

@@ -107,11 +107,11 @@ class PredictionService:
             self.deployment_name, self.predictor.name, unit_name
         ).inc(fb.reward)
 
-    async def predict(self, payload: Payload) -> Payload:
+    async def predict(self, payload: Payload, trace: bool = False) -> Payload:
         assert self.walker is not None, "PredictionService.start() not called"
         if not payload.meta.puid:
             payload.meta.puid = make_puid()
-        out = await self.walker.predict(payload)
+        out = await self.walker.predict(payload, trace=trace)
         if out.meta.metrics:
             self.metrics.record_custom(
                 self.deployment_name, self.predictor.name, self.predictor.graph.name,

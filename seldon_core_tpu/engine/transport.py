@@ -143,17 +143,33 @@ class RestNodeClient:
         out.meta.request_path.setdefault(self.spec.name, self.base)
         return out
 
+    # Retry-after-sent policy per method: only MODEL predict and COMBINER
+    # aggregate are assumed pure.  TRANSFORMER transform-input can be a
+    # stateful online detector (the builtin MahalanobisOutlier updates its
+    # running mean/covariance per call — double-feeding rows on a retried
+    # request would skew every future score), and routers may track pulls.
+
     async def transform_input(self, p: Payload) -> Payload:
-        path = "/predict" if self.spec.type == UnitType.MODEL else "/transform-input"
-        out = payload_from_dict(await self._post(path, payload_to_dict(p)))
+        if self.spec.type == UnitType.MODEL:
+            out = payload_from_dict(
+                await self._post("/predict", payload_to_dict(p), idempotent=True)
+            )
+        else:
+            out = payload_from_dict(
+                await self._post("/transform-input", payload_to_dict(p), idempotent=False)
+            )
         return self._merge(p, out)
 
     async def transform_output(self, p: Payload) -> Payload:
-        out = payload_from_dict(await self._post("/transform-output", payload_to_dict(p)))
+        out = payload_from_dict(
+            await self._post("/transform-output", payload_to_dict(p), idempotent=False)
+        )
         return self._merge(p, out)
 
     async def route(self, p: Payload) -> int:
-        out = payload_from_dict(await self._post("/route", payload_to_dict(p)))
+        out = payload_from_dict(
+            await self._post("/route", payload_to_dict(p), idempotent=False)
+        )
         self._merge(p, out)
         if not out.is_numeric():
             return ROUTE_ALL
@@ -161,7 +177,7 @@ class RestNodeClient:
 
     async def aggregate(self, ps: list[Payload]) -> Payload:
         body = {"seldonMessages": [payload_to_dict(p) for p in ps]}
-        out = payload_from_dict(await self._post("/aggregate", body))
+        out = payload_from_dict(await self._post("/aggregate", body, idempotent=True))
         return self._merge(ps[0], out)
 
     async def send_feedback(self, fb: FeedbackPayload, routing: int | None) -> None:

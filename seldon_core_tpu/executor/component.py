@@ -19,6 +19,11 @@ from seldon_core_tpu.graph.units import SeldonComponent
 
 
 class JaxModelComponent(SeldonComponent):
+    # metrics() returns cumulative queue gauges — safe to read concurrently;
+    # without this opt-out the walker's annotation lock would serialize the
+    # whole batching pipeline (see walker.make_annotation_lock)
+    SAFE_ANNOTATIONS = True
+
     def __init__(
         self,
         model: CompiledModel,

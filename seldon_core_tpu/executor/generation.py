@@ -594,6 +594,10 @@ class GenerativeComponent(SeldonComponent):
       ``strData`` JSON ``{"tokens": [[...], ...]}`` — per-request options.
     """
 
+    # metrics() exposes cumulative step counters only (safe to race);
+    # serializing would defeat continuous batching
+    SAFE_ANNOTATIONS = True
+
     def __init__(
         self,
         model: GenerativeModel,

@@ -39,7 +39,12 @@ from seldon_core_tpu.graph.spec import (
     TransportType,
     UnitType,
 )
-from seldon_core_tpu.graph.units import GraphUnitError, create_builtin, has_builtin
+from seldon_core_tpu.graph.units import (
+    GraphUnitError,
+    SeldonComponent,
+    create_builtin,
+    has_builtin,
+)
 
 ROUTE_ALL = -1  # route() result meaning "send to every child"
 
@@ -55,6 +60,31 @@ class NodeClient(Protocol):
 
 
 ClientFactory = Callable[[PredictiveUnitSpec], NodeClient]
+
+
+def make_annotation_lock(component: Any) -> "asyncio.Lock | None":
+    """A lock for components whose per-request annotations (tags()/metrics())
+    live on the shared instance: without serialization two concurrent
+    requests interleave method-call and annotation-read, and one response
+    carries the OTHER request's tags/metrics.
+
+    Only components OVERRIDING tags() or metrics() (vs the SeldonComponent
+    base) are locked — and a component can declare
+    ``SAFE_ANNOTATIONS = True`` to opt out when its annotations are
+    cumulative counters that tolerate racing (JaxModelComponent's queue
+    gauges do; locking those would collapse the batching pipeline to one
+    request at a time)."""
+    if getattr(component, "SAFE_ANNOTATIONS", False):
+        return None
+    cls = type(component)
+    overrides = False
+    for name in ("tags", "metrics"):
+        fn = getattr(component, name, None)
+        if callable(fn) and getattr(cls, name, None) is not getattr(
+            SeldonComponent, name, None
+        ):
+            overrides = True
+    return asyncio.Lock() if overrides else None
 
 
 async def _maybe_async(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
@@ -74,17 +104,18 @@ class LocalClient:
     ``predict``, TRANSFORMER's → ``transform_input``; reference:
     engine/.../service/InternalPredictionService.java:90-203)."""
 
-    def __init__(self, spec: PredictiveUnitSpec, component: Any):
+    def __init__(
+        self,
+        spec: PredictiveUnitSpec,
+        component: Any,
+        tag_lock: "asyncio.Lock | None" = None,
+    ):
         self.spec = spec
         self.component = component
-        # Components exposing tags() hold per-request tag state on the
-        # shared instance (outlier scores, routing notes); without
-        # serialization two concurrent requests interleave method-call and
-        # tags()-read and one response carries the OTHER request's tags.
-        # Stateless components (no tags) keep full concurrency.
-        self._tag_lock = (
-            asyncio.Lock() if callable(getattr(component, "tags", None)) else None
-        )
+        # ``tag_lock`` lets several clients over the SAME component share one
+        # lock (the microservice runtime builds both a model and a
+        # transformer client around one instance).
+        self._tag_lock = tag_lock if tag_lock is not None else make_annotation_lock(component)
 
     # -- helpers ----------------------------------------------------------
 
@@ -151,6 +182,12 @@ class LocalClient:
         return await self._transform(p, "transform_output")
 
     async def route(self, p: Payload) -> int:
+        if self._tag_lock is not None:
+            async with self._tag_lock:
+                return await self._route_inner(p)
+        return await self._route_inner(p)
+
+    async def _route_inner(self, p: Payload) -> int:
         fn = getattr(self.component, "route", None)
         if fn is None:
             return ROUTE_ALL
@@ -160,6 +197,12 @@ class LocalClient:
         return branch
 
     async def aggregate(self, ps: list[Payload]) -> Payload:
+        if self._tag_lock is not None:
+            async with self._tag_lock:
+                return await self._aggregate_inner(ps)
+        return await self._aggregate_inner(ps)
+
+    async def _aggregate_inner(self, ps: list[Payload]) -> Payload:
         comp = self.component
         raw_fn = getattr(comp, "aggregate_raw", None)
         if callable(raw_fn):
@@ -297,10 +340,39 @@ class GraphWalker:
 
     # -- prediction walk --------------------------------------------------
 
-    async def predict(self, payload: Payload) -> Payload:
-        return await self._execute(self.root, payload)
+    async def predict(self, payload: Payload, trace: bool = False) -> Payload:
+        """Walk the graph.  ``trace`` records per-node wall time into
+        ``meta.tags["sct_trace_ms"]`` — the request-scoped analogue of the
+        reference's latency logs (InternalPredictionService.java:267-268),
+        opted in per request so the hot path pays nothing by default."""
+        if not trace:
+            return await self._execute(self.root, payload)
+        import time
 
-    async def _execute(self, node: _NodeState, p: Payload) -> Payload:
+        timings: dict[str, float] = {}
+        out = await self._execute(self.root, payload, timings)
+        out.meta.tags["sct_trace_ms"] = {
+            k: round(v * 1000.0, 3) for k, v in timings.items()
+        }
+        return out
+
+    async def _execute(
+        self, node: _NodeState, p: Payload, timings: dict | None = None
+    ) -> Payload:
+        if timings is not None:
+            import time
+
+            t0 = time.perf_counter()
+            try:
+                return await self._execute_inner(node, p, timings)
+            finally:
+                # node time INCLUDES children (tree-shaped flame view)
+                timings[node.spec.name] = time.perf_counter() - t0
+        return await self._execute_inner(node, p, timings)
+
+    async def _execute_inner(
+        self, node: _NodeState, p: Payload, timings: dict | None = None
+    ) -> Payload:
         methods = node.methods
         if Method.TRANSFORM_INPUT in methods:
             p = await node.client.transform_input(p)
@@ -313,7 +385,7 @@ class GraphWalker:
             if branch == ROUTE_ALL:
                 results = list(
                     await asyncio.gather(
-                        *(self._execute(c, p) for c in node.children)
+                        *(self._execute(c, p, timings) for c in node.children)
                     )
                 )
             else:
@@ -322,7 +394,7 @@ class GraphWalker:
                         f"unit {node.spec.name!r} routed to child {branch} "
                         f"but has {len(node.children)} children"
                     )
-                results = [await self._execute(node.children[branch], p)]
+                results = [await self._execute(node.children[branch], p, timings)]
 
             if Method.AGGREGATE in methods:
                 p = await node.client.aggregate(results)

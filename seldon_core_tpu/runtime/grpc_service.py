@@ -44,11 +44,19 @@ class ComponentGrpc:
         self.component = component
         self.name = name
         self.service_type = service_type
+        # shared annotation lock across both views (see runtime/server.py)
+        from seldon_core_tpu.graph.walker import make_annotation_lock
+
+        shared_lock = make_annotation_lock(component)
         self._model_client = LocalClient(
-            PredictiveUnitSpec(name=name, type=UnitType.MODEL), component
+            PredictiveUnitSpec(name=name, type=UnitType.MODEL),
+            component,
+            tag_lock=shared_lock,
         )
         self._transformer_client = LocalClient(
-            PredictiveUnitSpec(name=name, type=UnitType.TRANSFORMER), component
+            PredictiveUnitSpec(name=name, type=UnitType.TRANSFORMER),
+            component,
+            tag_lock=shared_lock,
         )
 
     # -- handlers (shared across the typed services and Generic) -----------

@@ -76,11 +76,21 @@ class MicroserviceApp:
         self.service_type = service_type
         # Two client views over the same component: MODEL maps
         # transform_input->predict, TRANSFORMER maps it to transform_input.
+        # They SHARE one annotation lock — separate locks would let a
+        # /predict race a /transform-input over the same stateful component
+        # (the outlier adapter's score/tag pair).
+        from seldon_core_tpu.graph.walker import make_annotation_lock
+
+        shared_lock = make_annotation_lock(component)
         self._model_client = LocalClient(
-            PredictiveUnitSpec(name=name, type=UnitType.MODEL), component
+            PredictiveUnitSpec(name=name, type=UnitType.MODEL),
+            component,
+            tag_lock=shared_lock,
         )
         self._transformer_client = LocalClient(
-            PredictiveUnitSpec(name=name, type=UnitType.TRANSFORMER), component
+            PredictiveUnitSpec(name=name, type=UnitType.TRANSFORMER),
+            component,
+            tag_lock=shared_lock,
         )
 
     def build(self) -> web.Application:

@@ -651,9 +651,24 @@ class _ClientConn(_Conn):
 
     def _on_goaway(self, payload: bytes) -> None:
         # graceful drain, not a hard close: a stopping server announces "no
-        # new streams" — in-flight calls must be allowed to finish (the
-        # whole point of its grace period); the connection closes itself
-        # once the last pending call resolves
+        # new streams" — accepted in-flight calls finish (the point of its
+        # grace period), but streams ABOVE last_stream_id were refused and
+        # will never be answered: fail them now as retryable so the hop
+        # retry layer can resend instead of waiting out the call timeout
+        # (RFC 7540 §6.8)
+        last_stream = (
+            struct.unpack(">I", payload[:4])[0] & 0x7FFFFFFF
+            if len(payload) >= 4
+            else 0
+        )
+        refused = [sid for sid in self._calls if sid > last_stream]
+        err = ConnectionError(
+            f"stream refused by GOAWAY (last_stream_id={last_stream})"
+        )
+        for sid in refused:
+            fut, _, _ = self._calls.pop(sid)
+            if not fut.done():
+                fut.set_exception(err)
         self.drain_when_idle = True
         self.maybe_drain_close()
 

@@ -579,3 +579,56 @@ class TestHopRetries:
                 await srv.close()
 
         assert run(go()) == 1  # exactly one attempt
+
+
+class TestTracing:
+    """Opt-in request tracing (meta.tags.sct_trace_ms) and the XLA profiler
+    endpoints — SURVEY §5 asked for both; the reference had only JMX and
+    log lines."""
+
+    def test_trace_header_adds_per_node_timings(self):
+        async def go():
+            graph = {
+                "name": "eg", "type": "ROUTER", "implementation": "SIMPLE_ROUTER",
+                "children": [
+                    {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                ],
+            }
+            client = await _engine_client(
+                PredictorSpec.model_validate({"name": "p", "graph": graph})
+            )
+            try:
+                resp = await client.post(
+                    "/api/v0.1/predictions", json=REQ,
+                    headers={"X-Seldon-Trace": "1"},
+                )
+                traced = (await resp.json())["meta"]["tags"]["sct_trace_ms"]
+                resp2 = await client.post("/api/v0.1/predictions", json=REQ)
+                plain = (await resp2.json())["meta"].get("tags", {})
+                return traced, plain
+            finally:
+                await client.close()
+
+        traced, plain = run(go())
+        assert set(traced) == {"eg", "a"}
+        assert all(isinstance(v, float) for v in traced.values())
+        assert traced["eg"] >= traced["a"]  # parent includes child
+        assert "sct_trace_ms" not in plain  # zero cost unless asked
+
+    def test_profile_endpoints_round_trip(self, tmp_path):
+        async def go():
+            client = await _engine_client(default_predictor())
+            try:
+                r1 = await client.post("/profile/start", json={"dir": str(tmp_path)})
+                r_conflict = await client.post("/profile/start", json={})
+                r2 = await client.post("/profile/stop")
+                r_idle = await client.post("/profile/stop")
+                return r1.status, r_conflict.status, r2.status, r_idle.status
+            finally:
+                await client.close()
+
+        s1, sc, s2, si = run(go())
+        assert (s1, sc, s2, si) == (200, 409, 200, 409)
+        import os as _os
+
+        assert any(_os.scandir(str(tmp_path)))  # trace artifacts written

@@ -303,3 +303,67 @@ class TestFeedbackWalk:
         resp = run(w.predict(payload(np.zeros((1, 1)))))
         run(w.send_feedback(FeedbackPayload(response=resp, reward=2.0)))
         assert rewards == [2.0]
+
+
+class TestTagLockScope:
+    """The tag-consistency lock must serialize ONLY components that override
+    tags() (stateful: outlier scores); JAX model units inherit the stateless
+    base tags() and must keep full pipeline concurrency — locking them
+    collapsed wire throughput to one device step at a time."""
+
+    def test_jax_component_not_serialized(self):
+        from seldon_core_tpu.graph.spec import PredictiveUnitSpec, UnitType
+        from seldon_core_tpu.graph.units import SeldonComponent
+        from seldon_core_tpu.graph.walker import LocalClient
+
+        class LikeAJaxUnit(SeldonComponent):
+            def predict(self, X, names):
+                return X
+
+        client = LocalClient(
+            PredictiveUnitSpec(name="m", type=UnitType.MODEL), LikeAJaxUnit()
+        )
+        assert client._tag_lock is None
+
+    def test_stateful_tags_serialized(self):
+        from seldon_core_tpu.graph.spec import PredictiveUnitSpec, UnitType
+        from seldon_core_tpu.graph.units import MahalanobisOutlier
+        from seldon_core_tpu.graph.walker import LocalClient
+
+        client = LocalClient(
+            PredictiveUnitSpec(name="od", type=UnitType.TRANSFORMER),
+            MahalanobisOutlier(),
+        )
+        assert client._tag_lock is not None
+
+    def test_duck_typed_tags_serialized(self):
+        from seldon_core_tpu.graph.spec import PredictiveUnitSpec, UnitType
+        from seldon_core_tpu.graph.walker import LocalClient
+
+        class Duck:
+            def predict(self, X, names):
+                return X
+
+            def tags(self):
+                return {"k": 1}
+
+        client = LocalClient(
+            PredictiveUnitSpec(name="d", type=UnitType.MODEL), Duck()
+        )
+        assert client._tag_lock is not None
+
+    def test_stateful_metrics_serialized_unless_opted_out(self):
+        from seldon_core_tpu.executor.component import JaxModelComponent
+        from seldon_core_tpu.graph.walker import make_annotation_lock
+
+        class MetricsOnly:
+            def predict(self, X, names):
+                return X
+
+            def metrics(self):
+                return [{"key": "per_request_value", "value": 1.0}]
+
+        assert make_annotation_lock(MetricsOnly()) is not None
+        # JAX components opt out (cumulative gauges): locking them would
+        # serialize the batching pipeline
+        assert getattr(JaxModelComponent, "SAFE_ANNOTATIONS", False) is True
