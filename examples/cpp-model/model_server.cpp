@@ -55,20 +55,6 @@ struct Parser {
     return false;
   }
 
-  bool string(std::string* out) {
-    ws();
-    if (p >= end || *p != '"') return false;
-    ++p;
-    out->clear();
-    while (p < end && *p != '"') {
-      if (*p == '\\' && p + 1 < end) ++p;  // keep escaped char raw
-      out->push_back(*p++);
-    }
-    if (p >= end) return false;
-    ++p;
-    return true;
-  }
-
   bool number(double* out) {
     ws();
     char* after = nullptr;
@@ -78,39 +64,6 @@ struct Parser {
     return true;
   }
 
-  // skip any JSON value (for object keys we don't care about)
-  bool skip() {
-    ws();
-    if (p >= end) return false;
-    if (*p == '"') {
-      std::string s;
-      return string(&s);
-    }
-    if (*p == '{' || *p == '[') {
-      char open = *p, close = (*p == '{') ? '}' : ']';
-      int depth = 0;
-      bool in_str = false;
-      for (; p < end; ++p) {
-        char c = *p;
-        if (in_str) {
-          if (c == '\\') ++p;
-          else if (c == '"') in_str = false;
-        } else if (c == '"') {
-          in_str = true;
-        } else if (c == open) {
-          ++depth;
-        } else if (c == close) {
-          if (--depth == 0) {
-            ++p;
-            return true;
-          }
-        }
-      }
-      return false;
-    }
-    while (p < end && *p != ',' && *p != '}' && *p != ']') ++p;
-    return true;
-  }
 };
 
 // find "ndarray": [[...], ...] anywhere in the body; rows of doubles

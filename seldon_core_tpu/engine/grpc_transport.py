@@ -26,7 +26,12 @@ from seldon_core_tpu.graph.spec import PredictiveUnitSpec, UnitType
 from seldon_core_tpu.graph.walker import ROUTE_ALL
 from seldon_core_tpu.proto import prediction_pb2 as pb
 from seldon_core_tpu.proto.grpc_defs import SERVER_OPTIONS, Stub
-from seldon_core_tpu.wire import FastGrpcChannel, FastStub, GrpcCallError
+from seldon_core_tpu.wire import (
+    FastGrpcChannel,
+    FastStub,
+    GrpcCallError,
+    GrpcStreamRefusedError,
+)
 
 
 class ChannelCache:
@@ -113,6 +118,14 @@ class GrpcNodeClient:
                 raise _RetryableConnect(
                     RemoteUnitError(
                         f"unit {self.spec.name!r} gRPC {self.target} unreachable: {e}"
+                    )
+                ) from e
+            except GrpcStreamRefusedError as e:
+                # GOAWAY-refused: provably never processed (RFC 7540 §6.8) —
+                # safe to retry even non-idempotent methods
+                raise _RetryableConnect(
+                    RemoteUnitError(
+                        f"unit {self.spec.name!r} gRPC {self.target} refused: {e}"
                     )
                 ) from e
             except (ConnectionError, asyncio.TimeoutError, OSError) as e:

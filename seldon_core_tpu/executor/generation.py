@@ -83,24 +83,31 @@ class GenerativeModel:
         seq_impl: str = "dense",
         name: str = "generative",
         decode_block: int = 8,
+        driver: Any = None,
     ):
         if family_mod is None:
             from seldon_core_tpu.models import llama as family_mod
         if int(n_slots) < 1:
             # a zero-slot scheduler would park every request forever
             raise GraphUnitError(f"n_slots must be >= 1, got {n_slots}")
-        if mesh is not None and any(
+        # Multi-host slice: every prefill/decode call is SPMD across the
+        # hosts' processes, coordinated through the MultihostDriver (the
+        # coordinator leads; engine workers execute the same steps via the
+        # follower loop).  Token outputs get replicated so the coordinator
+        # reads them locally.
+        self._multihost = mesh is not None and any(
             d.process_index != jax.process_index() for d in mesh.devices.flat
-        ):
-            # the decode loop's admit/step calls are not yet routed through
-            # the MultihostDriver — spanning hosts would deadlock on the
-            # first collective.  Shard generative models within one host
-            # (tp<=chips_per_host); multi-host generative is tracked work.
-            raise GraphUnitError(
-                f"generative model {name!r}: mesh spans processes; "
-                "JAX_GENERATIVE is single-host for now (use tp/sp within "
-                "one host's chips)"
-            )
+        )
+        self.driver = driver if self._multihost else None
+        if self._multihost and self.driver is None:
+            from seldon_core_tpu.executor.multihost import get_driver
+
+            self.driver = get_driver()
+            if self.driver is None:
+                raise GraphUnitError(
+                    f"generative model {name!r}: mesh spans processes but no "
+                    "MultihostDriver exists (engine boot initializes it)"
+                )
         self.family = family_mod
         self.cfg = cfg
         self.n_slots = int(n_slots)
@@ -147,19 +154,28 @@ class GenerativeModel:
 
         fam = family_mod
 
+        def _replicate(x):
+            """Token outputs replicate across the slice so the coordinator
+            can read the full result locally (no-op single-host)."""
+            if not self._multihost:
+                return x
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+
         def _prefill(params, tokens, length, slot, temperature, seed, cache):
             logits, cache = fam.prefill_slot(
                 params, tokens, length, slot, cache, cfg, mesh=mesh, seq_impl=seq_impl
             )
             key = jax.random.PRNGKey(seed)
             tok = fam.sample_tokens(logits[None], temperature[None], key)[0]
-            return tok, cache
+            return _replicate(tok), cache
 
         def _decode(params, tokens, active, temperature, seed, cache):
             logits, cache = fam.decode_slots(params, tokens, cache, active, cfg)
             key = jax.random.PRNGKey(seed)
             toks = fam.sample_tokens(logits, temperature, key)
-            return toks, cache
+            return _replicate(toks), cache
 
         def _decode_k(k):
             """k decode steps in ONE device dispatch (lax.scan), with
@@ -199,7 +215,7 @@ class GenerativeModel:
                 (tokens, active, remaining, cache), (toks_seq, act_seq) = lax.scan(
                     body, (tokens, active, remaining, cache), jnp.arange(k)
                 )
-                return toks_seq, act_seq, cache
+                return _replicate(toks_seq), _replicate(act_seq), cache
 
             return fn
 
@@ -209,6 +225,25 @@ class GenerativeModel:
         self._decode = jax.jit(_decode, donate_argnums=(5,))
         self._decode_k_factory = _decode_k
         self._decode_k_jit: dict[int, Any] = {}
+        if self.driver is not None:
+            # symmetric SPMD step bodies for the follower loop; the k value
+            # rides the payload so any block size stays in lockstep
+            self._mh_prefill_key = self.driver.register_unique(
+                f"gen:{name}:prefill", self._exec_prefill
+            )
+            self._mh_decode_key = self.driver.register_unique(
+                f"gen:{name}:decode", self._exec_decode
+            )
+            self._mh_decode_k_key = self.driver.register_unique(
+                f"gen:{name}:decode_k", self._exec_decode_k
+            )
+            # reset writes the pos vector with a cross-process sharding —
+            # a device_put every process must participate in, so it's a
+            # driven step too (warmup calls it; a coordinator-only reset
+            # wedges the slice)
+            self._mh_reset_key = self.driver.register_unique(
+                f"gen:{name}:reset", self._exec_reset
+            )
 
         # observability
         self.steps = 0
@@ -226,6 +261,21 @@ class GenerativeModel:
             f"prompt length {n} exceeds max_seq {self.cfg.max_seq}"
         )
 
+    def _exec_prefill(self, payload: dict):
+        """Symmetric prefill body (runs on every slice process)."""
+        with self._lock:
+            tok, self._cache = self._prefill(
+                self.params,
+                payload["padded"],
+                np.int32(payload["length"]),
+                np.int32(payload["slot"]),
+                np.float32(payload["temperature"]),
+                np.int32(payload["seed"]),
+                self._cache,
+            )
+            self.prefills += 1
+        return tok
+
     def admit_dispatch(self, slot: int, prompt: np.ndarray, temperature: float, seed: int):
         """Enqueue one prefill WITHOUT fetching its sampled token (a device
         array is returned).  Several admissions dispatched back-to-back cost
@@ -239,23 +289,34 @@ class GenerativeModel:
         bucket = self.fit_bucket(L)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :L] = prompt
-        with self._lock:
-            tok, self._cache = self._prefill(
-                self.params,
-                padded,
-                np.int32(L),
-                np.int32(slot),
-                np.float32(temperature),
-                np.int32(seed),
-                self._cache,
-            )
-            self.prefills += 1
-        return tok
+        payload = {
+            "padded": padded,
+            "length": L,
+            "slot": int(slot),
+            "temperature": float(temperature),
+            "seed": int(seed),
+        }
+        if self.driver is not None:
+            return self.driver.lead(self._mh_prefill_key, payload)
+        return self._exec_prefill(payload)
 
     def admit(self, slot: int, prompt: np.ndarray, temperature: float, seed: int) -> int:
         """Prefill ``prompt`` (1-D int ids) into ``slot``; returns the first
         sampled token."""
         return int(self.admit_dispatch(slot, prompt, temperature, seed))
+
+    def _exec_decode(self, payload: dict):
+        with self._lock:
+            toks, self._cache = self._decode(
+                self.params,
+                np.asarray(payload["tokens"], np.int32),
+                np.asarray(payload["active"], bool),
+                np.asarray(payload["temperature"], np.float32),
+                np.int32(payload["seed"]),
+                self._cache,
+            )
+            self.steps += 1
+        return toks
 
     def step(
         self,
@@ -265,16 +326,16 @@ class GenerativeModel:
         seed: int,
     ) -> np.ndarray:
         """One decode step for all slots -> next token per slot (S,)."""
-        with self._lock:
-            toks, self._cache = self._decode(
-                self.params,
-                np.asarray(tokens, np.int32),
-                np.asarray(active, bool),
-                np.asarray(temperature, np.float32),
-                np.int32(seed),
-                self._cache,
-            )
-            self.steps += 1
+        payload = {
+            "tokens": np.asarray(tokens, np.int32),
+            "active": np.asarray(active, bool),
+            "temperature": np.asarray(temperature, np.float32),
+            "seed": int(seed),
+        }
+        if self.driver is not None:
+            toks = self.driver.lead(self._mh_decode_key, payload)
+        else:
+            toks = self._exec_decode(payload)
         return np.asarray(jax.device_get(toks))
 
     def step_k(
@@ -292,6 +353,26 @@ class GenerativeModel:
         are real.  ``eos`` is per-slot (-1 = none), ``remaining`` the
         per-slot token budget — both enforced on device so a slot stops
         consuming cache the step it finishes."""
+        payload = {
+            "tokens": np.asarray(tokens, np.int32),
+            "active": np.asarray(active, bool),
+            "temperature": np.asarray(temperature, np.float32),
+            "seed": int(seed),
+            "eos": np.asarray(eos, np.int32),
+            "remaining": np.asarray(remaining, np.int32),
+            "k": int(k),
+        }
+        if self.driver is not None:
+            toks_seq, act_seq = self.driver.lead(self._mh_decode_k_key, payload)
+        else:
+            toks_seq, act_seq = self._exec_decode_k(payload)
+        # ONE device_get for both arrays: two separate fetches would pay two
+        # host round trips per block on a tunnel-attached chip
+        toks_np, act_np = jax.device_get((toks_seq, act_seq))
+        return np.asarray(toks_np), np.asarray(act_np)
+
+    def _exec_decode_k(self, payload: dict):
+        k = int(payload["k"])
         fn = self._decode_k_jit.get(k)
         if fn is None:
             fn = jax.jit(self._decode_k_factory(k), donate_argnums=(7,))
@@ -299,19 +380,16 @@ class GenerativeModel:
         with self._lock:
             toks_seq, act_seq, self._cache = fn(
                 self.params,
-                np.asarray(tokens, np.int32),
-                np.asarray(active, bool),
-                np.asarray(temperature, np.float32),
-                np.int32(seed),
-                np.asarray(eos, np.int32),
-                np.asarray(remaining, np.int32),
+                np.asarray(payload["tokens"], np.int32),
+                np.asarray(payload["active"], bool),
+                np.asarray(payload["temperature"], np.float32),
+                np.int32(payload["seed"]),
+                np.asarray(payload["eos"], np.int32),
+                np.asarray(payload["remaining"], np.int32),
                 self._cache,
             )
             self.steps += k
-        # ONE device_get for both arrays: two separate fetches would pay two
-        # host round trips per block on a tunnel-attached chip
-        toks_np, act_np = jax.device_get((toks_seq, act_seq))
-        return np.asarray(toks_np), np.asarray(act_np)
+        return toks_seq, act_seq
 
     def warmup(self) -> int:
         """Compile the decode program and every prefill bucket.
@@ -352,13 +430,19 @@ class GenerativeModel:
             self.reset()
             return n
 
-    def reset(self) -> None:
-        """Zero every slot position (cache contents become unreachable)."""
+    def _exec_reset(self, payload: dict) -> None:
         with self._lock:
             zero = jax.device_put(
                 np.zeros(self.n_slots, np.int32), self._cache["pos"].sharding
             )
             self._cache = {**self._cache, "pos": zero}
+
+    def reset(self) -> None:
+        """Zero every slot position (cache contents become unreachable)."""
+        if self.driver is not None:
+            self.driver.lead(self._mh_reset_key, {})
+            return
+        self._exec_reset({})
 
 
 @dataclasses.dataclass

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import inspect
+import time
 from typing import Any, Awaitable, Callable, Protocol
 
 import numpy as np
@@ -347,8 +348,6 @@ class GraphWalker:
         opted in per request so the hot path pays nothing by default."""
         if not trace:
             return await self._execute(self.root, payload)
-        import time
-
         timings: dict[str, float] = {}
         out = await self._execute(self.root, payload, timings)
         out.meta.tags["sct_trace_ms"] = {
@@ -360,8 +359,6 @@ class GraphWalker:
         self, node: _NodeState, p: Payload, timings: dict | None = None
     ) -> Payload:
         if timings is not None:
-            import time
-
             t0 = time.perf_counter()
             try:
                 return await self._execute_inner(node, p, timings)

@@ -207,6 +207,13 @@ CACHE_LOGICAL_AXES = {"k": ("layers", "batch", None, "kv_heads", "head_dim"),
 
 
 def _select_attn(mesh: Mesh | None, seq_impl: str):
+    if seq_impl == "flash":
+        # Pallas tiled attention (ops/flash_attention.py): O(S*D) memory
+        # instead of materializing (B,H,S,S) scores — the long-context
+        # single-host path; ring/ulysses cover the multi-chip sp axis
+        from seldon_core_tpu.ops import flash_causal_attention_blhd
+
+        return flash_causal_attention_blhd
     if seq_impl == "dense" or mesh is None:
         return _dense_causal_attention
 

@@ -215,11 +215,11 @@ def build_generative_component(
             f"family {family!r} has no generative contract; "
             f"have {sorted(GENERATIVE_FAMILIES)}"
         ) from None
-    if seq_impl not in ("dense", "ring", "ulysses"):
+    if seq_impl not in ("dense", "flash", "ring", "ulysses"):
         # eagerly: a typo would otherwise surface as an opaque KeyError
         # inside jit tracing at warmup
         raise TypeError(
-            f"seq_impl must be one of dense/ring/ulysses, got {seq_impl!r}"
+            f"seq_impl must be one of dense/flash/ring/ulysses, got {seq_impl!r}"
         )
     fam = get_family(family)
     if cfg is None:

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the serving hot path.
+
+The compute plane is mostly XLA-fused jit code; kernels live here only
+where explicit tiling beats the compiler — currently flash attention
+(O(S^2) HBM traffic -> O(S*D)).
+"""
+
+from seldon_core_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_causal_attention_blhd,
+)
+
+__all__ = ["flash_attention", "flash_causal_attention_blhd"]

@@ -1,0 +1,147 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The dense causal attention in the model zoo materializes the full
+``(B, H, S, S)`` score matrix in HBM — at seq 8k and bf16 that is 128MB per
+head-batch and all of it HBM traffic.  This kernel computes attention in
+``(block_q, block_k)`` tiles resident in VMEM with the online-softmax
+recurrence, so scores never touch HBM and the MXU is fed back-to-back
+tiles: memory drops from O(S²) to O(S·D) and the arithmetic intensity
+matches the hardware (guide: /opt/skills/guides/pallas_guide.md; the
+technique is the standard flash-attention tiling).
+
+Layout: ``(B, H, S, D)``.  The grid is ``(B, H, Sq/bq, Sk/bk)`` — TPU
+iterates the last axis fastest, so each query tile accumulates over its
+key tiles in VMEM scratch and writes its output once on the final key
+step.  Causal masking is per-tile (fully-masked tiles skip the matmul
+entirely).
+
+On non-TPU backends (the CPU test harness) the kernel runs in Pallas
+interpret mode, so equivalence tests pin it to the dense reference
+everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-but-finite: -inf * 0 = nan would poison the rescale
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, block_q, block_k, n_k, causal, scale
+):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # tiles where every key position is after every query position are
+    # fully masked: skip their FLOPs entirely
+    live = (not causal) or (ki * block_k <= qi * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)  # (bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (bq, bk)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_scr[:, 0]
+        l_prev = l_scr[:, 0]
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_cur[:, None])
+        alpha = jnp.exp(m_prev - m_cur)
+        l_cur = alpha * l_prev + p.sum(axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_cur[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_cur[:, None], l_scr.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        l = l_scr[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
+        o_ref[0, 0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``(B, H, S, D)`` attention; blocks clamp to S and must divide it."""
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    block_q = min(block_q, S)
+    block_k = min(block_k, Sk)
+    if S % block_q or Sk % block_k:
+        raise ValueError(
+            f"seq lengths ({S}, {Sk}) must be divisible by blocks "
+            f"({block_q}, {block_k})"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n_q = S // block_q
+    n_k = Sk // block_k
+    scale = 1.0 / math.sqrt(D)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        n_k=n_k,
+        causal=causal,
+        scale=scale,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(B, H, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running max (col 0)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # running denom (col 0)
+            pltpu.VMEM((block_q, D), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_causal_attention_blhd(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Adapter for the model zoo's ``(B, L, H, D)`` attention contract
+    (``models/llama.py::_layer``): transpose, run the kernel, transpose back.
+    Falls back to nothing here — callers choose flash via ``seq_impl``."""
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = flash_attention(qt, kt, vt, causal=True)
+    return out.transpose(0, 2, 1, 3)

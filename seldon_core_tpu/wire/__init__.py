@@ -9,6 +9,13 @@ from seldon_core_tpu.wire.h2grpc import (
     FastGrpcServer,
     FastStub,
     GrpcCallError,
+    GrpcStreamRefusedError,
 )
 
-__all__ = ["FastGrpcChannel", "FastGrpcServer", "FastStub", "GrpcCallError"]
+__all__ = [
+    "FastGrpcChannel",
+    "FastGrpcServer",
+    "FastStub",
+    "GrpcCallError",
+    "GrpcStreamRefusedError",
+]

@@ -95,6 +95,12 @@ class GrpcCallError(Exception):
         self.message = message
 
 
+class GrpcStreamRefusedError(ConnectionError):
+    """The server's GOAWAY refused this stream (id > last_stream_id): RFC
+    7540 §6.8 guarantees it was never processed, so retrying is safe for
+    ANY method — including non-idempotent ones."""
+
+
 # ---------------------------------------------------------------------------
 # Shared connection machinery (frame parse + flow control)
 # ---------------------------------------------------------------------------
@@ -662,7 +668,7 @@ class _ClientConn(_Conn):
             else 0
         )
         refused = [sid for sid in self._calls if sid > last_stream]
-        err = ConnectionError(
+        err = GrpcStreamRefusedError(
             f"stream refused by GOAWAY (last_stream_id={last_stream})"
         )
         for sid in refused:

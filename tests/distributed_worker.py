@@ -88,16 +88,70 @@ def main() -> None:
         name="mh",
         driver=driver,
     )
+    # --- multi-host generative: the slot-cache decode loop across hosts ---
+    # Both processes construct the identical model (tp=2 shards the KV
+    # heads across the process boundary); the coordinator admits + decodes
+    # through the driver, the worker follows.
+    from seldon_core_tpu.executor.generation import GenerativeModel
+    from seldon_core_tpu.models import llama
+    from seldon_core_tpu.models.registry import get_family
+
+    lcfg = llama.Config.tiny(max_seq=64)
+    lparams = llama.init_params(jax.random.PRNGKey(0), lcfg)
+    gen_mesh = make_mesh(MeshPlan(dp=4, tp=2))
+    gmodel = GenerativeModel(
+        lcfg,
+        lparams,
+        family_mod=llama,
+        n_slots=2,
+        mesh=gen_mesh,
+        param_axes=get_family("llama").param_logical_axes(lparams),
+        decode_block=4,
+        name="mhgen",
+        driver=driver,
+    )
+
     if cfg.is_coordinator:
         driver.start_heartbeat()
         assert model.warmup((16,)) == 2
         got = model(x_np[:5])  # odd size: pads up to bucket 8
         want = np.maximum(x_np[:5] @ w_np, 0.0)
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+        # greedy reference: local dense forward loop on this process only
+        prompt = np.array([5, 9, 2, 17, 3], np.int32)
+        ref = list(prompt)
+        for _ in range(5):
+            import jax.numpy as jnp
+
+            logits = llama.forward(
+                lparams, jnp.asarray([ref], jnp.int32), lcfg, seq_impl="dense"
+            )
+            ref.append(int(np.asarray(logits)[0, -1].argmax()))
+        expected = ref[len(prompt):]
+
+        # warmup drives prefill-bucket compiles AND reset() through the
+        # driver — a coordinator-only reset device_put used to wedge the
+        # slice (review regression)
+        assert gmodel.warmup() > 0
+        first = gmodel.admit(0, prompt, 0.0, 0)
+        toks_seq, act_seq = gmodel.step_k(
+            np.array([first, 0], np.int32),
+            np.array([True, False]),
+            np.zeros(2, np.float32),
+            0,
+            np.array([-1, -1], np.int32),
+            np.array([4, 0], np.int32),
+            4,
+        )
+        got_toks = [first] + [int(toks_seq[i, 0]) for i in range(4) if act_seq[i, 0]]
+        assert got_toks == expected, (got_toks, expected)
         driver.shutdown()
+        print(f"OK-generative process={ordinal}")
         print(f"OK-serving process={ordinal}")
     else:
         driver.follower_loop()
+        print(f"OK-generative process={ordinal}")
         print(f"OK-serving process={ordinal}")
 
 
