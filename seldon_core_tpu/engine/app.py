@@ -142,6 +142,9 @@ class EngineApp:
                 # opt-in per-node wall timings (meta.tags.sct_trace_ms) —
                 # request-scoped tracing the reference only had as logs
                 trace = request.headers.get("X-Seldon-Trace", "") == "1"
+                from seldon_core_tpu.utils.tracectx import set_traceparent
+
+                set_traceparent(request.headers.get("traceparent"))
                 out = await self.service.predict(payload, trace=trace)
                 resp = payload_to_dict(out)
                 resp["status"] = {"code": 200, "status": "SUCCESS"}

@@ -34,8 +34,23 @@ class SeldonGrpc:
     def __init__(self, service: PredictionService):
         self.service = service
 
+    @staticmethod
+    def _seed_trace(context) -> None:
+        """grpcio path: pull traceparent from invocation metadata (the fast
+        server seeds it via its on_request_headers hook instead)."""
+        if context is None:
+            return
+        from seldon_core_tpu.utils.tracectx import set_traceparent
+
+        try:
+            md = {k: v for k, v in context.invocation_metadata()}
+        except Exception:
+            return
+        set_traceparent(md.get("traceparent"))
+
     @unary_guard
     async def Predict(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
+        self._seed_trace(context)
         out = await self.service.predict(payload_from_proto(request))
         msg = payload_to_proto(out)
         msg.status.code = 200
@@ -65,13 +80,24 @@ async def start_engine_grpc(
     if use_grpcio():
         return await _start_grpcio(handler, port, reuse_port)
 
+    from seldon_core_tpu.utils.tracectx import TRACEPARENT_HEADER, set_traceparent
     from seldon_core_tpu.wire import FastGrpcServer
+
+    def seed_trace_context(headers: list) -> None:
+        # gRPC ingress must feed the same trace-context propagation REST
+        # does, or the chain breaks at the engine for gRPC clients
+        tp = next(
+            (v.decode() for k, v in headers if k == TRACEPARENT_HEADER.encode()),
+            None,
+        )
+        set_traceparent(tp)
 
     server = FastGrpcServer(
         raw_handlers(
             "Seldon",
             {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback},
-        )
+        ),
+        on_request_headers=seed_trace_context,
     )
     bound = await server.start(port, reuse_port=reuse_port)
     server.bound_port = bound

@@ -93,9 +93,13 @@ class GrpcNodeClient:
 
         GRPC_UNAVAILABLE = 14
 
+        from seldon_core_tpu.utils.tracectx import outgoing_headers
+
+        metadata = tuple(outgoing_headers().items())
+
         async def attempt(_i: int) -> pb.SeldonMessage:
             try:
-                return await method(request, timeout=self.timeout)
+                return await method(request, timeout=self.timeout, metadata=metadata)
             except grpc.aio.AioRpcError as e:
                 err = RemoteUnitError(
                     f"unit {self.spec.name!r} gRPC {self.target} unreachable: {e.code().name}"

@@ -109,9 +109,14 @@ class RestNodeClient:
         )
 
     async def _post_once(self, path: str, body: dict[str, Any]) -> dict[str, Any]:
+        from seldon_core_tpu.utils.tracectx import outgoing_headers
+
         try:
             async with self.session.post(
-                self.base + path, json=body, timeout=self.timeout
+                self.base + path,
+                json=body,
+                timeout=self.timeout,
+                headers=outgoing_headers() or None,
             ) as resp:
                 data = await resp.json(content_type=None)
                 if resp.status in RETRYABLE_HTTP:

@@ -38,6 +38,7 @@ from seldon_core_tpu.gateway.store import (
     load_store_from_env,
 )
 from seldon_core_tpu.gateway.tap import RequestResponseTap, tap_from_env
+from seldon_core_tpu.utils.tracectx import outgoing_headers
 from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS, MetricsRegistry
 
 log = logging.getLogger(__name__)
@@ -183,7 +184,10 @@ class GatewayApp:
                 async with self._session.post(
                     rec.rest_base + path,
                     data=raw,
-                    headers={"Content-Type": "application/json"},
+                    headers={
+                        "Content-Type": "application/json",
+                        **outgoing_headers(),
+                    },
                     timeout=self.timeout,
                 ) as resp:
                     body = await resp.read()
@@ -215,6 +219,9 @@ class GatewayApp:
         deployment_name = "unknown"
         code = 200
         try:
+            from seldon_core_tpu.utils.tracectx import set_traceparent
+
+            set_traceparent(request.headers.get("traceparent"))
             rec = self._principal(request)
             principal = rec.oauth_key
             deployment_name = rec.name

@@ -1,0 +1,38 @@
+"""W3C trace-context propagation (the "optional OTel" of SURVEY §5).
+
+The reference had no distributed tracing at all — correlation was the puid
+plus latency log lines (reference: engine/.../InternalPredictionService.java
+:267-268).  Here an incoming ``traceparent`` header (W3C Trace Context) is
+carried through the request's async context and re-attached to every
+outgoing hop (engine -> microservice REST/gRPC, gateway -> engine), so an
+external OTel collector stitches the spans without this framework linking
+against an OTel SDK.
+
+asyncio tasks inherit contextvars, so the walker's fan-out tasks and the
+transport calls all see the ingress value with no explicit threading.
+"""
+
+from __future__ import annotations
+
+import contextvars
+
+TRACEPARENT_HEADER = "traceparent"
+
+_traceparent: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "sct_traceparent", default=None
+)
+
+
+def set_traceparent(value: str | None) -> None:
+    """Record the ingress trace context for this request's async context."""
+    _traceparent.set(value or None)
+
+
+def get_traceparent() -> str | None:
+    return _traceparent.get()
+
+
+def outgoing_headers() -> dict[str, str]:
+    """Headers to attach to a downstream hop ({} when no trace is active)."""
+    tp = _traceparent.get()
+    return {TRACEPARENT_HEADER: tp} if tp else {}

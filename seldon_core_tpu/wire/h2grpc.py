@@ -133,6 +133,18 @@ class _Conn(asyncio.Protocol):
     def connection_made(self, transport: asyncio.BaseTransport) -> None:
         self.transport = transport  # type: ignore[assignment]
         transport.set_write_buffer_limits(high=4 * 1024 * 1024)  # type: ignore[attr-defined]
+        # asyncio sets TCP_NODELAY only on sockets IT creates; connections
+        # accepted through our hand-made dual-stack listener socket keep
+        # Nagle on, and the small HEADERS/DATA writes then stall a flat
+        # ~44ms per RPC against delayed ACKs
+        sock = transport.get_extra_info("socket")
+        if sock is not None:
+            import socket as _socket
+
+            try:
+                sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            except OSError:
+                pass  # non-TCP transport (unix sockets, tests)
         if not self.is_server:
             self.transport.write(PREFACE)
         self.transport.write(
@@ -419,9 +431,18 @@ _TRAILERS_OK = hpack.encode_headers([(b"grpc-status", b"0")])
 class _ServerConn(_Conn):
     is_server = True
 
-    def __init__(self, handlers: dict[bytes, Handler], conns: "set[_ServerConn] | None" = None):
+    def __init__(
+        self,
+        handlers: dict[bytes, Handler],
+        conns: "set[_ServerConn] | None" = None,
+        on_request_headers: "Callable[[list], None] | None" = None,
+    ):
         super().__init__()
         self.handlers = handlers
+        # invoked with the request header list inside the context the
+        # handler task will inherit — lets the application seed per-request
+        # contextvars (e.g. traceparent) without wire/ knowing about them
+        self._on_request_headers = on_request_headers
         # stream -> [path, data buffer]
         self._streams: dict[int, list[Any]] = {}
         self._tasks: set[asyncio.Task] = set()
@@ -445,7 +466,7 @@ class _ServerConn(_Conn):
             if name == b":path":
                 path = value
                 break
-        self._streams[stream_id] = [path, bytearray()]
+        self._streams[stream_id] = [path, bytearray(), headers]
         self.max_stream = max(self.max_stream, stream_id)
         if end:
             self._finish_request(stream_id)
@@ -472,7 +493,7 @@ class _ServerConn(_Conn):
             task.cancel()
 
     def _finish_request(self, stream_id: int) -> None:
-        path, body = self._streams.pop(stream_id)
+        path, body, headers = self._streams.pop(stream_id)
         handler = self.handlers.get(path)
         if handler is None:
             self._send_error(stream_id, GRPC_STATUS_UNIMPLEMENTED, f"unknown method {path.decode()}")
@@ -484,7 +505,18 @@ class _ServerConn(_Conn):
         except GrpcCallError as e:
             self._send_error(stream_id, e.status, e.message)
             return
-        task = asyncio.ensure_future(self._run(stream_id, handler, messages[0]))
+        if self._on_request_headers is not None:
+            # run the hook + handler in a copied context so per-request
+            # contextvars it sets don't leak across requests
+            import contextvars
+
+            ctx = contextvars.copy_context()
+            ctx.run(self._on_request_headers, headers)
+            task = asyncio.get_running_loop().create_task(
+                self._run(stream_id, handler, messages[0]), context=ctx
+            )
+        else:
+            task = asyncio.ensure_future(self._run(stream_id, handler, messages[0]))
         self._tasks.add(task)
         self._stream_tasks[stream_id] = task
 
@@ -509,13 +541,29 @@ class _ServerConn(_Conn):
         if self.transport is None or self.transport.is_closing():
             return
         body = grpc_frame(response)
-        # headers + (windowed) data + trailers; the trailers ride the send
-        # queue so they can never overtake DATA parked on flow control
+        trailers = frame(HEADERS, END_HEADERS | END_STREAM, stream_id, _TRAILERS_OK)
+        swin = self._stream_out.get(stream_id, self.peer_initial_window)
+        if (
+            not self._send_queue
+            and len(body) <= self.peer_max_frame
+            and len(body) <= self.out_window
+            and len(body) <= swin
+        ):
+            # hot path: the whole response (headers + data + trailers) in
+            # ONE write — one syscall, one TCP segment group
+            self.out_window -= len(body)
+            self.transport.write(
+                frame(HEADERS, END_HEADERS, stream_id, _RESPONSE_HEADERS)
+                + frame(DATA, 0, stream_id, body)
+                + trailers
+            )
+            self._stream_out.pop(stream_id, None)
+            return
+        # windowed path: trailers ride the send queue so they can never
+        # overtake DATA parked on flow control
         self.transport.write(frame(HEADERS, END_HEADERS, stream_id, _RESPONSE_HEADERS))
         self.send_data(stream_id, body, end_stream=False)
-        self.send_raw_after_data(
-            stream_id, frame(HEADERS, END_HEADERS | END_STREAM, stream_id, _TRAILERS_OK)
-        )
+        self.send_raw_after_data(stream_id, trailers)
         self.forget_stream(stream_id)
 
     def _send_error(self, stream_id: int, status: int, message: str) -> None:
@@ -535,12 +583,20 @@ class _ServerConn(_Conn):
 def _dual_stack_socket(port: int, reuse_port: bool):
     import socket
 
+    # proto must be IPPROTO_TCP (not 0): asyncio's transport layer only
+    # applies TCP_NODELAY when sock.proto == IPPROTO_TCP, and sockets
+    # accepted from this listener inherit its proto — with Nagle left on,
+    # the response's small frames stall ~44ms against delayed ACKs
     try:
-        sock = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+        sock = socket.socket(
+            socket.AF_INET6, socket.SOCK_STREAM, socket.IPPROTO_TCP
+        )
         sock.setsockopt(socket.IPPROTO_IPV6, socket.IPV6_V6ONLY, 0)
         addr = ("::", port)
     except OSError:  # IPv6-less host
-        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock = socket.socket(
+            socket.AF_INET, socket.SOCK_STREAM, socket.IPPROTO_TCP
+        )
         addr = ("0.0.0.0", port)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     if reuse_port:
@@ -557,10 +613,15 @@ class FastGrpcServer:
     """Unary gRPC server on asyncio.  ``handlers`` maps full method paths
     (``/seldon.protos.Seldon/Predict``) to ``async fn(bytes) -> bytes``."""
 
-    def __init__(self, handlers: dict[str, Handler]):
+    def __init__(
+        self,
+        handlers: dict[str, Handler],
+        on_request_headers: "Callable[[list], None] | None" = None,
+    ):
         self.handlers = {k.encode(): v for k, v in handlers.items()}
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[_ServerConn] = set()
+        self._on_request_headers = on_request_headers
         self.bound_port = 0
 
     def add_handler(self, path: str, fn: Handler) -> None:
@@ -573,7 +634,9 @@ class FastGrpcServer:
 
         loop = asyncio.get_running_loop()
         try:
-            factory = lambda: _ServerConn(self.handlers, self._conns)  # noqa: E731
+            factory = lambda: _ServerConn(  # noqa: E731
+                self.handlers, self._conns, self._on_request_headers
+            )
             if host is None:
                 # ONE dual-stack socket ([::] with V6ONLY off), like the
                 # grpcio server this replaces: an IPv6-only cluster must not
@@ -643,7 +706,7 @@ class _ClientConn(_Conn):
         self.drain_when_idle = False  # set when replaced due to exhaustion
         # stream -> [future, headers, bytearray data]
         self._calls: dict[int, list[Any]] = {}
-        self._path_templates: dict[tuple, bytes] = {}
+        self._path_templates: dict[bytes, bytes] = {}
 
     def _on_closed(self, exc: Exception | None) -> None:
         err = ConnectionError(f"h2 connection lost: {exc}")
@@ -679,23 +742,34 @@ class _ClientConn(_Conn):
         self.maybe_drain_close()
 
     def _template(self, path: bytes, metadata: tuple = ()) -> bytes:
-        key = (path, metadata)
-        t = self._path_templates.get(key)
+        # cache keyed by PATH only: metadata can be per-request (traceparent
+        # carries a fresh span id per call), and keying on it would grow the
+        # cache unboundedly while never hitting.  The stateless HPACK encode
+        # lets the cached base block and the per-call metadata block simply
+        # concatenate.
+        t = self._path_templates.get(path)
         if t is None:
-            headers = [
-                (b":method", b"POST"),
-                (b":scheme", b"http"),
-                (b":path", path),
-                (b":authority", self.authority.encode()),
-                (b"content-type", b"application/grpc"),
-                (b"te", b"trailers"),
-            ]
-            headers.extend(
-                (k.encode() if isinstance(k, str) else k, v.encode() if isinstance(v, str) else v)
-                for k, v in metadata
+            t = hpack.encode_headers(
+                [
+                    (b":method", b"POST"),
+                    (b":scheme", b"http"),
+                    (b":path", path),
+                    (b":authority", self.authority.encode()),
+                    (b"content-type", b"application/grpc"),
+                    (b"te", b"trailers"),
+                ]
             )
-            t = hpack.encode_headers(headers)
-            self._path_templates[key] = t
+            self._path_templates[path] = t
+        if metadata:
+            t = t + hpack.encode_headers(
+                [
+                    (
+                        k.encode() if isinstance(k, str) else k,
+                        v.encode() if isinstance(v, str) else v,
+                    )
+                    for k, v in metadata
+                ]
+            )
         return t
 
     @property

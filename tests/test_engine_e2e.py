@@ -632,3 +632,53 @@ class TestTracing:
         import os as _os
 
         assert any(_os.scandir(str(tmp_path)))  # trace artifacts written
+
+
+class TestTraceContextPropagation:
+    """W3C traceparent headers flow engine -> remote unit, so an external
+    OTel collector can stitch spans across the graph (SURVEY §5 'optional
+    OTel' — the reference had no tracing at all)."""
+
+    def test_traceparent_reaches_remote_unit(self):
+        import aiohttp
+        from aiohttp import web as _web
+
+        seen = []
+
+        async def unit(request):
+            seen.append(request.headers.get("traceparent"))
+            return _web.json_response({"data": {"ndarray": [[1.0]]}})
+
+        async def go():
+            app = _web.Application()
+            app.router.add_post("/predict", unit)
+            srv = TestServer(app)
+            await srv.start_server()
+            predictor = PredictorSpec.model_validate(
+                {
+                    "name": "p",
+                    "graph": {
+                        "name": "m", "type": "MODEL",
+                        "endpoint": {"service_host": "127.0.0.1",
+                                     "service_port": srv.port, "type": "REST"},
+                    },
+                }
+            )
+            client = await _engine_client(predictor)
+            try:
+                tp = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+                resp = await client.post(
+                    "/api/v0.1/predictions", json=REQ,
+                    headers={"traceparent": tp},
+                )
+                assert resp.status == 200
+                # a request WITHOUT traceparent must not leak the old one
+                resp2 = await client.post("/api/v0.1/predictions", json=REQ)
+                assert resp2.status == 200
+                return seen, tp
+            finally:
+                await client.close()
+                await srv.close()
+
+        seen, tp = run(go())
+        assert seen == [tp, None]  # propagated, then NOT leaked

@@ -383,3 +383,51 @@ class TestFastServer:
             return out
 
         assert run(go()) == b"x-done"
+
+    def test_request_headers_hook_seeds_task_context(self):
+        """The on_request_headers hook runs in the handler task's context so
+        per-request contextvars (traceparent at the engine's gRPC ingress)
+        propagate to downstream hops without leaking across requests."""
+        import contextvars
+
+        var: contextvars.ContextVar = contextvars.ContextVar("probe", default=None)
+        seen = []
+
+        def hook(headers):
+            for k, v in headers:
+                if k == b"x-probe":
+                    var.set(v.decode())
+
+        async def echo_probe(payload: bytes) -> bytes:
+            seen.append(var.get())
+            return payload
+
+        async def go():
+            server = FastGrpcServer({"/a/B": echo_probe}, on_request_headers=hook)
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            await ch.call("/a/B", b"1", metadata=(("x-probe", "alpha"),))
+            await ch.call("/a/B", b"2")  # no header: must not inherit alpha
+            await ch.call("/a/B", b"3", metadata=(("x-probe", "beta"),))
+            await ch.close()
+            await server.stop()
+
+        run(go())
+        assert seen == ["alpha", None, "beta"]
+
+    def test_metadata_not_cached_in_template(self):
+        """Per-request metadata (fresh traceparent per call) must not grow
+        the hpack template cache."""
+
+        async def go():
+            server = FastGrpcServer({"/a/B": _echo})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            for i in range(50):
+                await ch.call("/a/B", b"x", metadata=(("traceparent", f"00-{i:032x}-{i:016x}-01"),))
+            cache_size = len(ch._conn._path_templates)
+            await ch.close()
+            await server.stop()
+            return cache_size
+
+        assert run(go()) == 1  # one entry per path, not per metadata
