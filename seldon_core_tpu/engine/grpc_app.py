@@ -59,6 +59,7 @@ class SeldonGrpc:
 
     @unary_guard
     async def SendFeedback(self, request: pb.Feedback, context) -> pb.SeldonMessage:
+        self._seed_trace(context)
         await self.service.send_feedback(feedback_from_proto(request))
         return payload_to_proto(Payload())
 

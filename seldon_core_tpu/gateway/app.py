@@ -364,6 +364,12 @@ def main(argv: list[str] | None = None) -> None:
     async def _stop_grpc(app_: web.Application) -> None:
         server = app_.get("grpc_server")
         if server is not None:
+            handler = getattr(server, "gateway_handler", None)
+            if handler is not None:
+                # closes per-deployment engine channels AND removes the
+                # store listener so a dead handler never schedules channel
+                # closes on a torn-down loop
+                await handler.close()
             await server.stop(grace=2.0)
 
     app.on_startup.append(_start_grpc)

@@ -7,12 +7,23 @@ built at deployment-add time (reference:
 api-frontend/.../grpc/SeldonGrpcServer.java:46-120,
 grpc/HeaderServerInterceptor.java:39-66, grpc/SeldonService.java:45-63).
 
-Same design: channels live in a cache keyed by deployment, built on first
-use and dropped when the deployment is removed.
+Two transports share the design (selected like the engine's server,
+``SCT_GRPC_IMPL``):
+
+- the default asyncio data plane (wire/h2grpc.py), proxying RAW BYTES —
+  the request proto is forwarded to the engine verbatim and the reply
+  returned verbatim, so the gateway pays zero proto decode/encode per call
+  (the reference's apife forwarded without re-serializing for REST only,
+  RestClientController.java:136-144);
+- a grpcio fallback with typed stubs.
+
+Both propagate W3C traceparent metadata inbound and outbound.
 """
 
 from __future__ import annotations
 
+import asyncio
+import contextvars
 import logging
 
 import grpc
@@ -26,57 +37,98 @@ from seldon_core_tpu.proto.grpc_defs import (
     add_service,
     bind_insecure_port,
     failure_message,
+    use_grpcio,
 )
+from seldon_core_tpu.utils.tracectx import outgoing_headers, set_traceparent
+from seldon_core_tpu.wire import FastGrpcChannel, FastGrpcServer, GrpcCallError
 
 log = logging.getLogger(__name__)
 
 OAUTH_METADATA_KEY = "oauth_token"
 
+# seeded per request (fast plane: the server's request-headers hook runs in
+# the handler task's context; grpcio: read from invocation metadata)
+_request_token: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "sct_gateway_token", default=""
+)
 
-class GatewayGrpc:
-    """Seldon service handlers proxying to per-deployment engine channels."""
+
+def _resolve_record(gateway, token: str) -> DeploymentRecord:
+    """oauth token -> deployment record (shared by both transports)."""
+    if not token:
+        raise AuthError("missing oauth_token metadata")
+    key = gateway.tokens.principal(token)
+    rec = gateway.store.get(key)
+    if rec is None:
+        raise AuthError("deployment no longer exists", 404)
+    return rec
+
+
+class _ChannelCacheBase:
+    """Per-deployment engine channels with store-event eviction.
+
+    Store events may fire from operator/poller threads; channel close must
+    hop back to the serving loop.  Close tasks are referenced until done —
+    a bare fire-and-forget task can be garbage-collected before running.
+    """
 
     def __init__(self, gateway, loop=None):
-        import asyncio
-
-        self.gateway = gateway  # GatewayApp (store + tokens)
-        self._channels: dict[str, grpc.aio.Channel] = {}
-        # the serving loop, captured at construction: store events may fire
-        # from operator/poller threads and must hop back here to close
-        # loop-bound channels
+        self.gateway = gateway
+        self._channels: dict[str, object] = {}
         self._loop = loop or asyncio.get_event_loop()
+        self._close_tasks: set[asyncio.Task] = set()
         gateway.store.add_listener(self._on_deployment_event)
+
+    def _new_channel(self, rec: DeploymentRecord):
+        raise NotImplementedError
+
+    def _channel(self, rec: DeploymentRecord):
+        ch = self._channels.get(rec.oauth_key)
+        if ch is None:
+            ch = self._new_channel(rec)
+            self._channels[rec.oauth_key] = ch
+        return ch
 
     def _on_deployment_event(self, event: str, rec: DeploymentRecord) -> None:
         if event in ("removed", "updated"):
             ch = self._channels.pop(rec.oauth_key, None)
             if ch is not None:
-                self._loop.call_soon_threadsafe(
-                    lambda c=ch: self._loop.create_task(c.close())
-                )
+                self._loop.call_soon_threadsafe(self._schedule_close, ch)
+
+    def _schedule_close(self, ch) -> None:
+        task = self._loop.create_task(ch.close())
+        self._close_tasks.add(task)
+        task.add_done_callback(self._close_tasks.discard)
+
+    async def close(self) -> None:
+        self.gateway.store.remove_listener(self._on_deployment_event)
+        channels, self._channels = list(self._channels.values()), {}
+        for ch in channels:
+            await ch.close()
+
+
+class GatewayGrpc(_ChannelCacheBase):
+    """grpcio-transport Seldon proxy (SCT_GRPC_IMPL=grpcio fallback)."""
+
+    def _new_channel(self, rec: DeploymentRecord):
+        return grpc.aio.insecure_channel(rec.grpc_target, options=SERVER_OPTIONS)
 
     def _resolve(self, context) -> DeploymentRecord:
         md = dict(context.invocation_metadata() or [])
-        token = md.get(OAUTH_METADATA_KEY, "")
-        if not token:
-            raise AuthError("missing oauth_token metadata")
-        key = self.gateway.tokens.principal(token)
-        rec = self.gateway.store.get(key)
-        if rec is None:
-            raise AuthError("deployment no longer exists", 404)
-        return rec
-
-    def _stub(self, rec: DeploymentRecord) -> Stub:
-        ch = self._channels.get(rec.oauth_key)
-        if ch is None:
-            ch = grpc.aio.insecure_channel(rec.grpc_target, options=SERVER_OPTIONS)
-            self._channels[rec.oauth_key] = ch
-        return Stub(ch, "Seldon")
+        # same trace propagation as the fast plane — fallback mode must not
+        # silently break the chain
+        set_traceparent(md.get("traceparent"))
+        return _resolve_record(self.gateway, md.get(OAUTH_METADATA_KEY, ""))
 
     async def Predict(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
         try:
             rec = self._resolve(context)
-            return await self._stub(rec).Predict(request, timeout=self.gateway.timeout.total)
+            stub = Stub(self._channel(rec), "Seldon")
+            return await stub.Predict(
+                request,
+                timeout=self.gateway.timeout.total,
+                metadata=tuple(outgoing_headers().items()) or None,
+            )
         except AuthError as e:
             return failure_message(str(e), e.status)
         except grpc.aio.AioRpcError as e:
@@ -85,27 +137,86 @@ class GatewayGrpc:
     async def SendFeedback(self, request: pb.Feedback, context) -> pb.SeldonMessage:
         try:
             rec = self._resolve(context)
-            return await self._stub(rec).SendFeedback(request, timeout=self.gateway.timeout.total)
+            stub = Stub(self._channel(rec), "Seldon")
+            return await stub.SendFeedback(
+                request,
+                timeout=self.gateway.timeout.total,
+                metadata=tuple(outgoing_headers().items()) or None,
+            )
         except AuthError as e:
             return failure_message(str(e), e.status)
         except grpc.aio.AioRpcError as e:
             return failure_message(f"engine unreachable: {e.code().name}", 503)
 
-    async def close(self) -> None:
-        for ch in self._channels.values():
-            await ch.close()
-        self._channels.clear()
+
+class FastGatewayGrpc(_ChannelCacheBase):
+    """The Seldon proxy on the asyncio data plane, relaying raw bytes."""
+
+    def _new_channel(self, rec: DeploymentRecord):
+        return FastGrpcChannel(rec.grpc_target)
+
+    def seed_metadata(self, headers: list) -> None:
+        """on_request_headers hook: runs inside the handler task's context."""
+        token = ""
+        traceparent = None
+        for k, v in headers:
+            if k == OAUTH_METADATA_KEY.encode():
+                token = v.decode()
+            elif k == b"traceparent":
+                traceparent = v.decode()
+        _request_token.set(token)
+        set_traceparent(traceparent)
+
+    async def _proxy(self, method: str, payload: bytes) -> bytes:
+        try:
+            rec = _resolve_record(self.gateway, _request_token.get())
+            return await self._channel(rec).call(
+                f"/seldon.protos.Seldon/{method}",
+                payload,
+                timeout=self.gateway.timeout.total,
+                metadata=tuple(outgoing_headers().items()),
+            )
+        except AuthError as e:
+            return failure_message(str(e), e.status).SerializeToString()
+        except (GrpcCallError, ConnectionError, asyncio.TimeoutError, OSError) as e:
+            return failure_message(f"engine unreachable: {e}", 503).SerializeToString()
+
+    async def predict_raw(self, payload: bytes) -> bytes:
+        return await self._proxy("Predict", payload)
+
+    async def feedback_raw(self, payload: bytes) -> bytes:
+        return await self._proxy("SendFeedback", payload)
 
 
-async def start_gateway_grpc(gateway, port: int) -> grpc.aio.Server:
-    import asyncio
+async def start_gateway_grpc(gateway, port: int):
+    """Gateway gRPC ingress — fast plane by default, grpcio fallback
+    (SCT_GRPC_IMPL=grpcio), mirroring the engine server selection."""
+    loop = asyncio.get_running_loop()
+    if use_grpcio():
+        server = grpc.aio.server(options=SERVER_OPTIONS)
+        handler = GatewayGrpc(gateway, loop=loop)
+        add_service(
+            server,
+            "Seldon",
+            {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback},
+        )
+        bound = await bind_insecure_port(server, port)
+        await server.start()
+        server.bound_port = bound
+        server.gateway_handler = handler  # for lifecycle access
+        log.info("gateway gRPC (Seldon proxy) on :%d", bound)
+        return server
 
-    server = grpc.aio.server(options=SERVER_OPTIONS)
-    handler = GatewayGrpc(gateway, loop=asyncio.get_running_loop())
-    add_service(server, "Seldon", {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback})
-    bound = await bind_insecure_port(server, port)
-    await server.start()
+    handler = FastGatewayGrpc(gateway, loop=loop)
+    server = FastGrpcServer(
+        {
+            "/seldon.protos.Seldon/Predict": handler.predict_raw,
+            "/seldon.protos.Seldon/SendFeedback": handler.feedback_raw,
+        },
+        on_request_headers=handler.seed_metadata,
+    )
+    bound = await server.start(port)
     server.bound_port = bound
-    server.gateway_handler = handler  # for lifecycle access
-    log.info("gateway gRPC (Seldon proxy) on :%d", bound)
+    server.gateway_handler = handler
+    log.info("gateway gRPC (Seldon raw proxy, h2 data plane) on :%d", bound)
     return server

@@ -71,6 +71,14 @@ class DeploymentStore:
     def add_listener(self, fn: Listener) -> None:
         self._listeners.append(fn)
 
+    def remove_listener(self, fn: Listener) -> None:
+        """Deregister (no-op when absent) — a closed gRPC handler must not
+        keep receiving events and scheduling work on a dead loop."""
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
     def _emit(self, event: str, rec: DeploymentRecord) -> None:
         for fn in self._listeners:
             try:

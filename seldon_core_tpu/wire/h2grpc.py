@@ -507,11 +507,21 @@ class _ServerConn(_Conn):
             return
         if self._on_request_headers is not None:
             # run the hook + handler in a copied context so per-request
-            # contextvars it sets don't leak across requests
+            # contextvars it sets don't leak across requests.  A hook
+            # failure (e.g. non-UTF-8 metadata) fails THIS stream only —
+            # letting it escape would GOAWAY the whole connection and kill
+            # every other caller multiplexed on it.
             import contextvars
 
             ctx = contextvars.copy_context()
-            ctx.run(self._on_request_headers, headers)
+            try:
+                ctx.run(self._on_request_headers, headers)
+            except Exception as e:
+                log.warning("request-headers hook failed: %s", e)
+                self._send_error(
+                    stream_id, GRPC_STATUS_UNKNOWN, f"bad request metadata: {e}"
+                )
+                return
             task = asyncio.get_running_loop().create_task(
                 self._run(stream_id, handler, messages[0]), context=ctx
             )
