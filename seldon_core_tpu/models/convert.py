@@ -1,0 +1,164 @@
+"""Checkpoint conversion: Hugging Face Llama weights -> serving params.
+
+``JAX_GENERATIVE`` graph units load npz checkpoints
+(``models/registry.py::_resolve_params`` -> ``executor/checkpoint.py``);
+real deployments start from published weights, so this maps a HF
+``LlamaForCausalLM`` state dict onto the zoo's stacked-layer layout
+(``models/llama.py::init_params`` — one array per parameter with a leading
+layers axis, scan-friendly) and writes the pickle-free npz.
+
+    python -m seldon_core_tpu.models.convert /path/to/hf-llama out.npz
+
+Correctness is pinned by tests/test_convert.py: a randomly initialized HF
+Llama is converted and our ``forward`` must reproduce transformers' logits
+(same rotate-half RoPE convention, so weights map by transpose/reshape
+only).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any
+
+import numpy as np
+
+from seldon_core_tpu.models.llama import Config
+
+
+def config_from_hf(hf_config: Any) -> Config:
+    # silent-wrongness guards: conversion must FAIL on model variants whose
+    # semantics the serving forward doesn't implement, never produce a
+    # checkpoint that serves diverging logits
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise NotImplementedError(
+            f"rope_scaling={scaling!r} is not implemented by the serving "
+            "RoPE (models/llama.py::_rope uses plain theta); converting "
+            "would produce wrong logits for every position"
+        )
+    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    explicit_hd = getattr(hf_config, "head_dim", None)
+    if explicit_hd is not None and explicit_hd != derived_hd:
+        raise NotImplementedError(
+            f"head_dim={explicit_hd} differs from hidden//n_heads="
+            f"{derived_hd}; the serving config derives head_dim and cannot "
+            "represent this model"
+        )
+    return Config(
+        vocab_size=hf_config.vocab_size,
+        hidden=hf_config.hidden_size,
+        n_layers=hf_config.num_hidden_layers,
+        n_heads=hf_config.num_attention_heads,
+        n_kv_heads=getattr(
+            hf_config, "num_key_value_heads", hf_config.num_attention_heads
+        ),
+        ffn=hf_config.intermediate_size,
+        max_seq=min(hf_config.max_position_embeddings, 8192),
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        norm_eps=getattr(hf_config, "rms_norm_eps", 1e-5),
+    )
+
+
+def params_from_hf_state_dict(state: dict, cfg: Config) -> dict:
+    """HF ``LlamaForCausalLM`` tensors -> the zoo's stacked param tree.
+
+    PyTorch ``Linear`` stores ``(out, in)``; our einsum contracts need
+    ``(in, ...out)``, hence the transposes.  HF checkpoints use the same
+    rotate-half RoPE as ``llama.py::_rope``, so no head permutation is
+    needed.
+    """
+
+    consumed: set[str] = set()
+
+    def t(name: str) -> np.ndarray:
+        consumed.add(name)
+        tensor = state[name]
+        arr = tensor.detach().cpu().numpy() if hasattr(tensor, "detach") else np.asarray(tensor)
+        return np.ascontiguousarray(arr, dtype=np.float32)
+
+    H, nh, nkv, hd, ffn = (
+        cfg.hidden, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.ffn,
+    )
+    layer_specs = {
+        "wq": lambda p: t(p + "self_attn.q_proj.weight").T.reshape(H, nh, hd),
+        "wk": lambda p: t(p + "self_attn.k_proj.weight").T.reshape(H, nkv, hd),
+        "wv": lambda p: t(p + "self_attn.v_proj.weight").T.reshape(H, nkv, hd),
+        "wo": lambda p: t(p + "self_attn.o_proj.weight").T.reshape(nh, hd, H),
+        "w_gate": lambda p: t(p + "mlp.gate_proj.weight").T,
+        "w_up": lambda p: t(p + "mlp.up_proj.weight").T,
+        "w_down": lambda p: t(p + "mlp.down_proj.weight").T,
+        "ln_att": lambda p: t(p + "input_layernorm.weight"),
+        "ln_mlp": lambda p: t(p + "post_attention_layernorm.weight"),
+    }
+    # stack one parameter at a time so peak memory holds only the torch
+    # model plus ONE stacked array's worth of per-layer copies (stacking
+    # every list at once triples the footprint — OOM territory at 8B fp32)
+    layers: dict[str, np.ndarray] = {}
+    for key, fn in layer_specs.items():
+        per_layer = [fn(f"model.layers.{i}.") for i in range(cfg.n_layers)]
+        layers[key] = np.stack(per_layer)
+        per_layer.clear()
+
+    tok_emb = t("model.embed_tokens.weight")
+    if "lm_head.weight" in state:
+        head = t("lm_head.weight").T
+    else:  # tied embeddings
+        head = tok_emb.T.copy()
+    params = {
+        "tok_emb": tok_emb,
+        "layers": layers,
+        "ln_f": t("model.norm.weight"),
+        "head": head,
+    }
+    # every remaining tensor is a weight the serving forward would ignore
+    # (projection biases from attention_bias/mlp_bias variants, extra
+    # norms, ...) — converting silently would serve wrong logits
+    leftovers = {
+        k for k in state
+        if k not in consumed and "rotary_emb" not in k  # inv_freq is derived
+    }
+    if leftovers:
+        sample = ", ".join(sorted(leftovers)[:5])
+        raise NotImplementedError(
+            f"{len(leftovers)} state-dict tensors have no serving "
+            f"counterpart (e.g. {sample}); this model variant is not "
+            "supported — refusing to write a checkpoint that would serve "
+            "wrong logits"
+        )
+    return params
+
+
+def convert_hf_llama(model_path: str, out_path: str) -> Config:
+    """Load a HF Llama (safetensors/bin) and write the serving npz."""
+    import torch  # noqa: PLC0415 - CPU-only load
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    hf_config = AutoConfig.from_pretrained(model_path)
+    model = AutoModelForCausalLM.from_pretrained(
+        model_path, torch_dtype=torch.float32, low_cpu_mem_usage=True
+    )
+    cfg = config_from_hf(hf_config)
+    params = params_from_hf_state_dict(model.state_dict(), cfg)
+    del model  # drop the torch copy before the npz write doubles buffers
+
+    from seldon_core_tpu.executor.checkpoint import save_params
+
+    save_params(out_path, params)
+    return cfg
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="HF Llama -> serving npz")
+    parser.add_argument("model_path", help="HF model directory or hub id")
+    parser.add_argument("out_path", help="npz checkpoint to write")
+    args = parser.parse_args(argv)
+    cfg = convert_hf_llama(args.model_path, args.out_path)
+    print(
+        f"wrote {args.out_path}: {cfg.n_layers} layers, hidden {cfg.hidden}, "
+        f"vocab {cfg.vocab_size}.  Serve with JAX_GENERATIVE parameters "
+        f'{{"checkpoint": "{args.out_path}", ...}} matching this config.'
+    )
+
+
+if __name__ == "__main__":
+    main()

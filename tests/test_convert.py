@@ -1,0 +1,116 @@
+"""HF Llama weight conversion pinned to transformers' own forward pass:
+the converted params must reproduce HF logits — the strongest possible
+check that our RoPE/GQA/RMSNorm/MLP semantics match real Llama."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from seldon_core_tpu.models import llama  # noqa: E402
+from seldon_core_tpu.models.convert import (  # noqa: E402
+    config_from_hf,
+    params_from_hf_state_dict,
+)
+
+
+def _tiny_hf_model():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        attention_bias=False,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    return transformers.LlamaForCausalLM(hf_cfg).eval(), hf_cfg
+
+
+class TestHfConversion:
+    def test_logits_match_transformers(self):
+        model, hf_cfg = _tiny_hf_model()
+        cfg = config_from_hf(hf_cfg)
+        params = params_from_hf_state_dict(model.state_dict(), cfg)
+
+        toks = np.array([[5, 9, 2, 17, 3, 42, 8, 1]], np.int64)
+        with torch.no_grad():
+            hf_logits = model(torch.from_numpy(toks)).logits.numpy()
+        ours = np.asarray(llama.forward(params, toks.astype(np.int32), cfg))
+        np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+    def test_gqa_and_greedy_continuation_match(self):
+        """Greedy argmax decoding must agree token-for-token (exercises the
+        kv-head grouping on real HF weights, not just one forward)."""
+        model, hf_cfg = _tiny_hf_model()
+        cfg = config_from_hf(hf_cfg)
+        params = params_from_hf_state_dict(model.state_dict(), cfg)
+
+        toks = [5, 9, 2, 17, 3]
+        hf_toks = list(toks)
+        our_toks = list(toks)
+        for _ in range(6):
+            with torch.no_grad():
+                nxt = int(model(torch.tensor([hf_toks])).logits[0, -1].argmax())
+            hf_toks.append(nxt)
+            logits = llama.forward(
+                params, np.asarray([our_toks], np.int32), cfg
+            )
+            our_toks.append(int(np.asarray(logits)[0, -1].argmax()))
+        assert our_toks == hf_toks
+
+    def test_tied_embeddings_fallback(self):
+        model, hf_cfg = _tiny_hf_model()
+        cfg = config_from_hf(hf_cfg)
+        state = {k: v for k, v in model.state_dict().items() if k != "lm_head.weight"}
+        params = params_from_hf_state_dict(state, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(params["head"]), np.asarray(params["tok_emb"]).T
+        )
+
+    def test_npz_round_trip_serves(self, tmp_path):
+        """convert -> save npz -> JAX_GENERATIVE-style checkpoint load."""
+        from seldon_core_tpu.executor.checkpoint import load_params, save_params
+
+        model, hf_cfg = _tiny_hf_model()
+        cfg = config_from_hf(hf_cfg)
+        params = params_from_hf_state_dict(model.state_dict(), cfg)
+        path = str(tmp_path / "llama.npz")
+        save_params(path, params)
+        loaded = load_params(path)
+        toks = np.array([[5, 9, 2]], np.int32)
+        a = np.asarray(llama.forward(params, toks, cfg))
+        b = np.asarray(llama.forward(loaded, toks, cfg))
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+class TestConversionGuards:
+    """Unsupported variants must FAIL conversion, never write a checkpoint
+    that serves wrong logits."""
+
+    def test_rope_scaling_rejected(self):
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=1, num_attention_heads=4,
+            rope_scaling={"rope_type": "linear", "factor": 2.0},
+        )
+        with pytest.raises(NotImplementedError, match="rope_scaling"):
+            config_from_hf(hf_cfg)
+
+    def test_attention_bias_rejected(self):
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+            attention_bias=True, tie_word_embeddings=False,
+        )
+        torch.manual_seed(0)
+        model = transformers.LlamaForCausalLM(hf_cfg)
+        cfg = config_from_hf(hf_cfg)
+        with pytest.raises(NotImplementedError, match="no serving counterpart"):
+            params_from_hf_state_dict(model.state_dict(), cfg)
