@@ -17,13 +17,15 @@ contract   wire messages, numpy codecs, typed graph parameters
 graph      inference-graph spec + async walker + built-in units
 runtime    user-model microservice runtime (REST/gRPC servers)
 engine     per-predictor orchestrator service
-executor   JAX execution plane: mesh, jit wrapper, batching queue
-models     Flax flagship models (MNIST, ResNet-50, BERT, Llama)
-ops        Pallas/JAX kernels
-parallel   sharding rules, ring attention, collectives
-gateway    external API gateway (auth, registry, proxy, metrics)
-operator   Kubernetes operator (CRD, reconcile, TPU resources)
-utils      metrics, puid, config
+executor   JAX execution plane: compiled models, batching, generation,
+           multi-host SPMD driver, checkpoints
+models     Flax model zoo (MLP, CNN, ResNet-50, BERT, Llama) + HF converter
+ops        Pallas TPU kernels (flash attention)
+parallel   meshes, sharding rules, ring attention, jax.distributed boot
+wire       asyncio HTTP/2 gRPC data plane (HPACK included)
+gateway    external API gateway (auth, registry, proxy, tap, metrics)
+operator   Kubernetes operator (CRD, reconcile, TPU scheduling, install)
+utils      metrics, puid, trace context, mesh env contract
 """
 
 __version__ = "0.1.0"

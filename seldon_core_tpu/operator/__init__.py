@@ -18,9 +18,12 @@ Same reconcile contract here, restructured:
 * :mod:`kube`       minimal k8s API client protocol + an in-process fake
                     (the reference had NO way to test its controller without
                     a cluster; the fake closes that gap)
-* :mod:`controller` reconcile: diff desired vs. owned, create/update/delete,
-                    FAILED parking, status writeback
-* :mod:`watcher`    watch loop with resourceVersion tracking and 410 resets
+* :mod:`controller` reconcile: diff desired vs. owned (spec-hash
+                    annotations), create/update/delete, FAILED parking,
+                    status writeback, whole-slice StatefulSet rolls
+* :mod:`watcher`    watch loops with resourceVersion tracking and 410 resets
+* :mod:`tpu`        TpuSpec: google.com/tpu resources + GKE node selectors
+* :mod:`install`    renders deploy/ manifests from these same constants
 """
 
 from seldon_core_tpu.operator.crd import SeldonDeployment
