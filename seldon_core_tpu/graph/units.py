@@ -55,6 +55,8 @@ class SimpleModel(SeldonComponent):
     the reference's benchmark/default model
     (reference: engine/.../predictors/SimpleModelUnit.java:33-46)."""
 
+    INLINE_SYNC = True  # microseconds of python math; skip the executor hop
+
     values = np.array([0.1, 0.9, 0.5])
     class_names = ["class0", "class1", "class2"]
 
@@ -67,6 +69,8 @@ class SimpleRouter(SeldonComponent):
     """Always routes to child 0
     (reference: engine/.../predictors/SimpleRouterUnit.java:28-31)."""
 
+    INLINE_SYNC = True  # microseconds of python math; skip the executor hop
+
     def route(self, X: np.ndarray, names: list[str]) -> int:
         return 0
 
@@ -75,6 +79,8 @@ class RandomABTest(SeldonComponent):
     """Routes to child 0 with probability ``ratioA``, else child 1; seeded for
     reproducibility (reference: engine/.../predictors/RandomABTestUnit.java:33-57,
     seeded Random(1337))."""
+
+    INLINE_SYNC = True  # microseconds of python math; skip the executor hop
 
     def __init__(self, ratioA: float = 0.5, seed: int = 1337, **_: Any):
         self.ratio_a = float(ratioA)
@@ -86,7 +92,11 @@ class RandomABTest(SeldonComponent):
 
 class AverageCombiner(SeldonComponent):
     """Element-wise mean of children outputs with strict shape agreement
-    (reference: engine/.../predictors/AverageCombinerUnit.java:34-81)."""
+    (reference: engine/.../predictors/AverageCombinerUnit.java:34-81).
+
+    NOT inline-sync: the stack+mean copies scale with arbitrary child
+    payload sizes — milliseconds of numpy on big batches belongs on the
+    thread pool, not the event loop."""
 
     def aggregate(self, Xs: list[np.ndarray], features: list[list[str]]) -> np.ndarray:
         if not Xs:
@@ -105,6 +115,8 @@ class EpsilonGreedy(SeldonComponent):
     """Multi-armed-bandit router: explore with probability epsilon, otherwise
     exploit the best-performing branch; rewards arrive via the feedback loop
     (reference behaviour: examples/routers/epsilon_greedy/EpsilonGreedy.py:12-60)."""
+
+    INLINE_SYNC = True  # microseconds of python math; skip the executor hop
 
     def __init__(
         self,
@@ -147,6 +159,8 @@ class EpsilonGreedy(SeldonComponent):
 class ThompsonSampling(SeldonComponent):
     """Beta-Bernoulli Thompson-sampling router (TPU-native extra beyond the
     reference's bandit example): sample a win-rate per branch, route argmax."""
+
+    INLINE_SYNC = True  # microseconds of python math; skip the executor hop
 
     def __init__(self, n_branches: int = 2, seed: int | None = 1337, **_: Any):
         self.n_branches = int(n_branches)

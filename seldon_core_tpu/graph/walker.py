@@ -117,6 +117,12 @@ class LocalClient:
         # lock (the microservice runtime builds both a model and a
         # transformer client around one instance).
         self._tag_lock = tag_lock if tag_lock is not None else make_annotation_lock(component)
+        # Components declaring INLINE_SYNC run their sync methods on the
+        # event loop directly: the ~40us run_in_executor hop dwarfs a
+        # trivial built-in (stub models, routers, combiners do microseconds
+        # of python math).  User components default to the thread pool —
+        # their predict() may block.
+        self._inline = bool(getattr(component, "INLINE_SYNC", False))
 
     # -- helpers ----------------------------------------------------------
 
@@ -153,11 +159,18 @@ class LocalClient:
                 return await self._transform_inner(p, method_name)
         return await self._transform_inner(p, method_name)
 
+    async def _call(self, fn, *args):
+        if inspect.iscoroutinefunction(fn):
+            return await fn(*args)
+        if self._inline:
+            return fn(*args)
+        return await _maybe_async(fn, *args)
+
     async def _transform_inner(self, p: Payload, method_name: str) -> Payload:
         comp = self.component
         raw_fn = getattr(comp, f"{method_name}_raw", None)
         if callable(raw_fn):
-            out = await _maybe_async(raw_fn, p)
+            out = await self._call(raw_fn, p)
             if not isinstance(out, Payload):
                 raise GraphUnitError(
                     f"{self.spec.name}.{method_name}_raw must return a Payload"
@@ -169,7 +182,7 @@ class LocalClient:
             # identity fallback, like the reference transformer runtime
             # (wrappers/python/transformer_microservice.py:20-38)
             return self._annotate(p)
-        result = await _maybe_async(fn, p.array, p.names)
+        result = await self._call(fn, p.array, p.names)
         result = np.asarray(result)
         return self._annotate(p.with_array(result, self._names_out(result, p)))
 
@@ -192,7 +205,7 @@ class LocalClient:
         fn = getattr(self.component, "route", None)
         if fn is None:
             return ROUTE_ALL
-        result = await _maybe_async(fn, p.array if p.is_numeric() else p.data, p.names)
+        result = await self._call(fn, p.array if p.is_numeric() else p.data, p.names)
         branch = int(np.asarray(result).ravel()[0])
         self._annotate(p)
         return branch
@@ -207,7 +220,7 @@ class LocalClient:
         comp = self.component
         raw_fn = getattr(comp, "aggregate_raw", None)
         if callable(raw_fn):
-            out = await _maybe_async(raw_fn, ps)
+            out = await self._call(raw_fn, ps)
             out.meta = ps[0].meta
             return self._annotate(out)
         fn = getattr(comp, "aggregate", None)
@@ -218,9 +231,7 @@ class LocalClient:
                     "but has no aggregate method"
                 )
             return ps[0]
-        result = await _maybe_async(
-            fn, [p.array for p in ps], [p.names for p in ps]
-        )
+        result = await self._call(fn, [p.array for p in ps], [p.names for p in ps])
         result = np.asarray(result)
         out = ps[0].with_array(result, self._names_out(result, ps[0]))
         return self._annotate(out)
@@ -233,7 +244,9 @@ class LocalClient:
         X = req.array if req is not None and req.is_numeric() else None
         names = req.names if req is not None else []
         truth = fb.truth.array if fb.truth is not None and fb.truth.is_numeric() else None
-        await _maybe_async(fn, X, names, fb.reward, truth, routing)
+        # _call keeps INLINE_SYNC bandits' counter updates on the event loop
+        # thread — the same thread their route() reads those arrays from
+        await self._call(fn, X, names, fb.reward, truth, routing)
 
 
 class _NodeState:
