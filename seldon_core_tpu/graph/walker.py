@@ -119,10 +119,12 @@ class LocalClient:
         self._tag_lock = tag_lock if tag_lock is not None else make_annotation_lock(component)
         # Components declaring INLINE_SYNC run their sync methods on the
         # event loop directly: the ~40us run_in_executor hop dwarfs a
-        # trivial built-in (stub models, routers, combiners do microseconds
-        # of python math).  User components default to the thread pool —
-        # their predict() may block.
-        self._inline = bool(getattr(component, "INLINE_SYNC", False))
+        # trivial built-in (stub models and routers do microseconds of
+        # python math).  The flag must be declared on the component's OWN
+        # class — a user subclass of a built-in inherits the attribute but
+        # may override methods with blocking work, and must default back to
+        # the thread pool.
+        self._inline = bool(type(component).__dict__.get("INLINE_SYNC", False))
 
     # -- helpers ----------------------------------------------------------
 

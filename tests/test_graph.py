@@ -367,3 +367,31 @@ class TestTagLockScope:
         # JAX components opt out (cumulative gauges): locking them would
         # serialize the batching pipeline
         assert getattr(JaxModelComponent, "SAFE_ANNOTATIONS", False) is True
+
+
+class TestInlineSyncScope:
+    def test_builtin_is_inline(self):
+        from seldon_core_tpu.graph.spec import PredictiveUnitSpec, UnitType
+        from seldon_core_tpu.graph.units import SimpleModel
+        from seldon_core_tpu.graph.walker import LocalClient
+
+        client = LocalClient(
+            PredictiveUnitSpec(name="m", type=UnitType.MODEL), SimpleModel()
+        )
+        assert client._inline
+
+    def test_user_subclass_falls_back_to_thread_pool(self):
+        """A subclass inherits INLINE_SYNC but may override methods with
+        blocking work — it must NOT run on the event loop."""
+        from seldon_core_tpu.graph.spec import PredictiveUnitSpec, UnitType
+        from seldon_core_tpu.graph.units import SimpleModel
+        from seldon_core_tpu.graph.walker import LocalClient
+
+        class MySlowModel(SimpleModel):
+            def predict(self, X, names):
+                return X  # imagine blocking I/O here
+
+        client = LocalClient(
+            PredictiveUnitSpec(name="m", type=UnitType.MODEL), MySlowModel()
+        )
+        assert not client._inline
