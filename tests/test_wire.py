@@ -431,3 +431,91 @@ class TestFastServer:
             return cache_size
 
         assert run(go()) == 1  # one entry per path, not per metadata
+
+    def test_ping_and_continuation_frames(self):
+        """Raw-frame drive of rarely-hit protocol paths: PING must be acked
+        with the same payload, and a header block split across HEADERS +
+        CONTINUATION must still parse into one request."""
+        from seldon_core_tpu.wire import hpack as _hpack
+        from seldon_core_tpu.wire.h2grpc import (
+            CONTINUATION,
+            DATA,
+            END_HEADERS,
+            END_STREAM,
+            HEADERS,
+            PING,
+            PREFACE,
+            frame,
+            grpc_frame,
+        )
+
+        async def go():
+            server = FastGrpcServer({"/a/B": _echo})
+            port = await server.start(0, host="127.0.0.1")
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(PREFACE)
+            # PING with a marker payload
+            writer.write(frame(PING, 0, 0, b"pingpong"))
+            # request headers split across HEADERS + CONTINUATION
+            block = _hpack.encode_headers(
+                [
+                    (b":method", b"POST"),
+                    (b":scheme", b"http"),
+                    (b":path", b"/a/B"),
+                    (b":authority", b"t"),
+                    (b"content-type", b"application/grpc"),
+                    (b"te", b"trailers"),
+                ]
+            )
+            half = len(block) // 2
+            writer.write(frame(HEADERS, 0, 1, block[:half]))  # no END_HEADERS
+            writer.write(frame(CONTINUATION, END_HEADERS, 1, block[half:]))
+            writer.write(frame(DATA, END_STREAM, 1, grpc_frame(b"hello")))
+            await writer.drain()
+            # collect frames until the response trailers arrive
+            buf = b""
+            saw_ping_ack = saw_data = False
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                chunk = await asyncio.wait_for(reader.read(4096), timeout=5)
+                if not chunk:
+                    break
+                buf += chunk
+                while len(buf) >= 9:
+                    n = (buf[0] << 16) | (buf[1] << 8) | buf[2]
+                    if len(buf) < 9 + n:
+                        break
+                    ftype, payload = buf[3], buf[9 : 9 + n]
+                    if ftype == PING and payload == b"pingpong":
+                        saw_ping_ack = True
+                    if ftype == DATA and b"hello" in payload:
+                        saw_data = True
+                    buf = buf[9 + n :]
+                if saw_ping_ack and saw_data:
+                    break
+            writer.close()
+            await server.stop()
+            return saw_ping_ack, saw_data
+
+        saw_ping_ack, saw_data = run(go())
+        assert saw_ping_ack and saw_data
+
+    def test_dynamic_table_size_update_from_peer(self):
+        """A peer shrinking its encoder table emits a table-size-update
+        opcode; the server's decoder must apply it and keep serving."""
+        from seldon_core_tpu.wire import hpack as _hpack
+
+        d = _hpack.Decoder(max_table_size=4096)
+        # block 1: add a dynamic entry
+        block1 = (
+            bytes([0x40]) + _hpack.encode_string(b"x-k") + _hpack.encode_string(b"v")
+        )
+        assert d.decode(block1) == [(b"x-k", b"v")]
+        # block 2: size update FIRST (RFC 7541 §4.2 requires it at block
+        # start) shrinking to zero, then a static index — entry evicted
+        block2 = (
+            _hpack.encode_int(0, 5, 0x20)  # table size -> 0
+            + _hpack.encode_int(2, 7, 0x80)  # static: :method GET
+        )
+        assert d.decode(block2) == [(b":method", b"GET")]
+        assert len(d._dynamic) == 0  # evicted by the size update
