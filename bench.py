@@ -365,6 +365,81 @@ def stage_ab(detail: dict) -> None:
     }
 
 
+def stage_gateway(detail: dict) -> None:
+    """Full L5->L4 path: OAuth'd requests through the gateway to a stub
+    engine — REST proxy and the raw-bytes gRPC relay.  The reference never
+    measured its apife; this pins the ingress overhead."""
+    import tempfile
+
+    from seldon_core_tpu.contract import Payload, payload_to_proto
+    from seldon_core_tpu.contract.payload import DataKind
+    from seldon_core_tpu.testing.loadtest import _fetch_token, run_load
+
+    secs = min(SECONDS, 6.0)
+    deployments = json.dumps(
+        [{"name": "bench", "oauth_key": "bk", "oauth_secret": "bs",
+          "engine_host": "127.0.0.1", "engine_rest_port": 18860,
+          "engine_grpc_port": 18861}]
+    )
+    with tempfile.NamedTemporaryFile(
+        "w", suffix="-gwdeps.json", delete=False
+    ) as f:
+        f.write(deployments)
+        dep_path = f.name
+    gw = subprocess.Popen(
+        [sys.executable, "-m", "seldon_core_tpu.gateway.app",
+         "--port", "18870", "--grpc-port", "18871", "--deployments", dep_path],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+    try:
+        with engine(None, 18860, 18861):  # default SIMPLE_MODEL graph
+            deadline = time.time() + 60
+            while True:
+                if gw.poll() is not None:
+                    raise RuntimeError(f"gateway died rc={gw.returncode}")
+                try:
+                    with urllib.request.urlopen(
+                        "http://127.0.0.1:18870/ready", timeout=2
+                    ) as r:
+                        if r.status == 200:
+                            break
+                except OSError:
+                    pass
+                if time.time() > deadline:
+                    raise RuntimeError("gateway never became ready")
+                time.sleep(1)
+            token = _fetch_token("http://127.0.0.1:18870/oauth/token", "bk", "bs")
+            rest = run_load(
+                "http://127.0.0.1:18870/api/v0.1/predictions",
+                [json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0]]}}).encode()],
+                concurrency=32, duration_s=secs,
+                headers={"Authorization": f"Bearer {token}"},
+            )
+            msg = payload_to_proto(
+                Payload.from_array(np.array([[1.0, 2.0, 3.0]]), kind=DataKind.TENSOR)
+            ).SerializeToString()
+            grpc_r = run_load(
+                "127.0.0.1:18871", [msg], grpc=True,
+                concurrency=32, duration_s=secs,
+                headers={"oauth_token": token},
+            )
+        detail["gateway_rest"] = rest.summary()
+        detail["gateway_grpc"] = {
+            **grpc_r.summary(),
+            "note": "raw-bytes relay: gateway forwards the proto verbatim",
+        }
+    finally:
+        gw.terminate()
+        try:
+            gw.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            gw.kill()
+        try:
+            os.unlink(dep_path)
+        except OSError:
+            pass
+
+
 def main() -> None:
     detail: dict = {
         "hardware": "1 CPU core, 1 tunnel-attached TPU chip (~100ms RTT)",
@@ -377,6 +452,7 @@ def main() -> None:
         ("LLM", "BENCH_SKIP_LLM", stage_llm),
         ("RESNET", "BENCH_SKIP_RESNET", stage_resnet),
         ("AB", "BENCH_SKIP_AB", stage_ab),
+        ("GATEWAY", "BENCH_SKIP_GATEWAY", stage_gateway),
     ]
     for name, skip_env, fn in stages:
         if os.environ.get(skip_env) == "1":
