@@ -34,6 +34,30 @@ from seldon_core_tpu.wire import (
 )
 
 
+# grpc-core wordings that mean "the TCP connect itself failed" — i.e. the
+# request provably never reached the peer, so even non-idempotent methods
+# may retry.  Substring-matched case-insensitively because these messages
+# are not a stable API; unknown wordings fail safe to _RetryableSent.
+# Deliberately NOT here: "connection reset" / ECONNRESET — a reset happens
+# on an ESTABLISHED connection, after the request may have been delivered
+# and processed; retrying a non-idempotent method there risks duplicate
+# execution.
+_CONNECT_FAILURE_MARKERS = (
+    "failed to connect",
+    "connection refused",
+    "connect failed",
+    "econnrefused",
+    "no route to host",
+    "name resolution",
+    "dns resolution",
+)
+
+
+def _is_connect_failure(details: str | None) -> bool:
+    d = (details or "").lower()
+    return any(m in d for m in _CONNECT_FAILURE_MARKERS)
+
+
 class ChannelCache:
     """target -> channel; one multiplexed connection per endpoint.  Fast
     (wire/h2grpc.py) channels by default, grpc.aio via SCT_GRPC_IMPL."""
@@ -106,7 +130,7 @@ class GrpcNodeClient:
                 )
                 if e.code() != grpc.StatusCode.UNAVAILABLE:
                     raise err from e
-                if "Failed to connect" in (e.details() or ""):
+                if _is_connect_failure(e.details()):
                     raise _RetryableConnect(err) from e
                 raise _RetryableSent(err) from e
             except GrpcCallError as e:

@@ -31,6 +31,7 @@ number of compiled broadcast programs small.
 from __future__ import annotations
 
 import logging
+import os
 import pickle
 import threading
 import time
@@ -189,8 +190,13 @@ class MultihostDriver:
 
         Runs on a (daemon) thread on worker processes — the collectives
         block, so this must not share the asyncio event loop serving
-        /ping.  Unknown keys and step exceptions are logged, not fatal:
-        the worker must stay in lockstep for subsequent collectives.
+        /ping.  Any failure after a step broadcast is received is FATAL:
+        the coordinator and the other workers execute the step's
+        collectives regardless, so a process that skips the step (can't
+        decode it, doesn't have the key — version skew) or aborts mid-step
+        leaves the slice desynchronized: the peers' collective wedges until
+        barrier timeout, or worse, pairs mismatched programs.  Hard-exiting
+        instead lets the supervisor (kubernetes) restart the slice cleanly.
         """
         if self.is_coordinator:
             raise RuntimeError("follower_loop() called on the coordinator")
@@ -202,10 +208,20 @@ class MultihostDriver:
                 continue
             try:
                 key, payload = pickle.loads(meta)
-                fn = self._fns.get(key)
-                if fn is None:
-                    log.error("multihost step for unregistered key %r", key)
-                    continue
+                fn = self._fns[key]
+            except Exception:
+                log.exception(
+                    "multihost follower: undecodable or unregistered step "
+                    "(version skew?); peers entered its collectives without "
+                    "us — terminating so the supervisor restarts the slice"
+                )
+                os._exit(13)
+            try:
                 fn(payload)
             except Exception:
-                log.exception("multihost follower step failed")
+                log.exception(
+                    "multihost follower step %r failed mid-step; slice is "
+                    "desynchronized — terminating so the supervisor restarts it",
+                    key,
+                )
+                os._exit(13)

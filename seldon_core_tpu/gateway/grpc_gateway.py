@@ -46,6 +46,14 @@ log = logging.getLogger(__name__)
 
 OAUTH_METADATA_KEY = "oauth_token"
 
+# canonical grpc-status -> HTTP code (the SeldonMessage failure contract is
+# HTTP-shaped; grpc-gateway's standard mapping)
+_GRPC_TO_HTTP = {
+    0: 200, 1: 499, 2: 500, 3: 400, 4: 504, 5: 404, 6: 409, 7: 403,
+    8: 429, 9: 400, 10: 409, 11: 400, 12: 501, 13: 500, 14: 503,
+    15: 500, 16: 401,
+}
+
 # seeded per request (fast plane: the server's request-headers hook runs in
 # the handler task's context; grpcio: read from invocation metadata)
 _request_token: contextvars.ContextVar[str] = contextvars.ContextVar(
@@ -107,6 +115,16 @@ class _ChannelCacheBase:
             await ch.close()
 
 
+def _aio_rpc_failure(e: "grpc.aio.AioRpcError") -> "pb.SeldonMessage":
+    """Map an engine AioRpcError to a failure SeldonMessage, preserving the
+    engine-chosen status; 'unreachable' wording only for true
+    transport-level UNAVAILABLE (same policy as the fast plane)."""
+    code = e.code().value[0]
+    if e.code() == grpc.StatusCode.UNAVAILABLE:
+        return failure_message(f"engine unreachable: {e.details()}", 503)
+    return failure_message(e.details() or e.code().name, _GRPC_TO_HTTP.get(code, 500))
+
+
 class GatewayGrpc(_ChannelCacheBase):
     """grpcio-transport Seldon proxy (SCT_GRPC_IMPL=grpcio fallback)."""
 
@@ -132,7 +150,7 @@ class GatewayGrpc(_ChannelCacheBase):
         except AuthError as e:
             return failure_message(str(e), e.status)
         except grpc.aio.AioRpcError as e:
-            return failure_message(f"engine unreachable: {e.code().name}", 503)
+            return _aio_rpc_failure(e)
 
     async def SendFeedback(self, request: pb.Feedback, context) -> pb.SeldonMessage:
         try:
@@ -146,7 +164,7 @@ class GatewayGrpc(_ChannelCacheBase):
         except AuthError as e:
             return failure_message(str(e), e.status)
         except grpc.aio.AioRpcError as e:
-            return failure_message(f"engine unreachable: {e.code().name}", 503)
+            return _aio_rpc_failure(e)
 
 
 class FastGatewayGrpc(_ChannelCacheBase):
@@ -178,7 +196,14 @@ class FastGatewayGrpc(_ChannelCacheBase):
             )
         except AuthError as e:
             return failure_message(str(e), e.status).SerializeToString()
-        except (GrpcCallError, ConnectionError, asyncio.TimeoutError, OSError) as e:
+        except GrpcCallError as e:
+            # the engine answered — it chose this status (e.g. INVALID_ARGUMENT
+            # for a bad request).  Propagate it instead of claiming the engine
+            # is down, which would mislead clients and alerting.
+            return failure_message(
+                e.message, _GRPC_TO_HTTP.get(e.status, 500)
+            ).SerializeToString()
+        except (ConnectionError, asyncio.TimeoutError, OSError) as e:
             return failure_message(f"engine unreachable: {e}", 503).SerializeToString()
 
     async def predict_raw(self, payload: bytes) -> bytes:

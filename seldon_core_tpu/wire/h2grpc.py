@@ -491,6 +491,9 @@ class _ServerConn(_Conn):
             # client cancelled (e.g. its deadline passed): stop the handler
             # instead of computing a response nobody will read
             task.cancel()
+        # drop any send-window state created by an early WINDOW_UPDATE —
+        # a cancelled stream never reaches the success path that pops it
+        self.forget_stream(stream_id)
 
     def _finish_request(self, stream_id: int) -> None:
         path, body, headers = self._streams.pop(stream_id)
@@ -577,6 +580,9 @@ class _ServerConn(_Conn):
         self.forget_stream(stream_id)
 
     def _send_error(self, stream_id: int, status: int, message: str) -> None:
+        # errored streams bypass the success path's forget_stream — drop the
+        # send-window slot here or every failed RPC leaks one dict entry
+        self.forget_stream(stream_id)
         if self.transport is None or self.transport.is_closing():
             return
         trailers = hpack.encode_headers(

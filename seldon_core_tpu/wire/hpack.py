@@ -178,22 +178,26 @@ def huffman_decode(data: bytes) -> bytes:
     node = _HUFFMAN_TREE
     root = _HUFFMAN_TREE
     depth = 0
+    ones = 0  # consecutive 1-bits on the current partial walk
     for byte in data:
         for i in (7, 6, 5, 4, 3, 2, 1, 0):
-            node = node[(byte >> i) & 1]
+            bit = (byte >> i) & 1
+            node = node[bit]
             depth += 1
+            ones = ones + 1 if bit else 0
             if node is None:
                 raise HpackError("invalid huffman sequence")
             if node[2] is not None:
                 out.append(node[2])
                 node = root
                 depth = 0
-    # trailing bits must be a prefix of EOS = all ones, < 8 bits — walking
-    # 1-bits from the root never hits a symbol within 7 steps, so reaching
-    # here with depth < 8 on an all-ones path is automatically valid; a
-    # stricter check would track the actual bits, which callers don't need
+                ones = 0
+    # RFC 7541 §5.2: trailing bits must be a prefix of EOS (all ones) and
+    # strictly shorter than 8 bits; anything else is a decoding error
     if depth > 7:
         raise HpackError("huffman padding longer than 7 bits")
+    if depth and ones != depth:
+        raise HpackError("huffman padding is not an EOS prefix")
     return bytes(out)
 
 

@@ -31,6 +31,17 @@ class TestHpack:
         for s in (b"", b"a", b"application/grpc", b"www.example.com", bytes(range(256))):
             assert hpack.huffman_decode(hpack.huffman_encode(s)) == s
 
+    def test_huffman_rejects_non_eos_padding(self):
+        # 'a' = 5 bits (00011); zero-bit padding would walk the tree and
+        # decode a spurious extra symbol — RFC 7541 §5.2 requires an error
+        code, length = hpack.HUFFMAN_CODES[ord("a")], hpack.HUFFMAN_LENGTHS[ord("a")]
+        padded_with_zeros = bytes([(code << (8 - length)) & 0xFF])
+        with pytest.raises(hpack.HpackError):
+            hpack.huffman_decode(padded_with_zeros)
+        # the same byte padded with EOS-prefix ones is valid
+        ok = bytes([(code << (8 - length)) | ((1 << (8 - length)) - 1)])
+        assert hpack.huffman_decode(ok) == b"a"
+
     def test_int_codec_boundaries(self):
         for value in (0, 1, 30, 31, 32, 127, 128, 255, 16383, 2**20):
             enc = hpack.encode_int(value, 5)
@@ -56,6 +67,63 @@ class TestHpack:
         block2 = hpack.encode_int(idx, 7, 0x80)
         assert d.decode(block2) == [(b"x-k", b"v1")]
 
+class TestStreamStateCleanup:
+    """Errored / client-cancelled RPCs must not leak _stream_out slots
+    (the send-window entry created by an early client WINDOW_UPDATE)."""
+
+    def _conn(self):
+        from seldon_core_tpu.wire.h2grpc import _ServerConn
+
+        conn = _ServerConn({})
+        conn.transport = None  # _send_error bails before writing
+        return conn
+
+    def test_send_error_drops_send_window(self):
+        conn = self._conn()
+        conn._stream_out[7] = 65535
+        conn._send_error(7, 2, "boom")
+        assert 7 not in conn._stream_out
+
+    def test_rst_drops_send_window(self):
+        conn = self._conn()
+        conn._stream_out[9] = 65535
+        conn._on_rst(9, 8)
+        assert 9 not in conn._stream_out
+
+
+class TestRetryClassification:
+    """Pin the UNAVAILABLE connect-vs-sent wordings (ADVICE r3): grpc-core
+    messages are unstable, so classification matches several markers."""
+
+    def test_connect_failure_markers(self):
+        from seldon_core_tpu.engine.grpc_transport import _is_connect_failure
+
+        for d in (
+            "Failed to connect to remote host",
+            "connection refused by peer",
+            "failed to connect to all addresses; ECONNREFUSED",
+            "DNS resolution failed for svc:9000",
+        ):
+            assert _is_connect_failure(d), d
+
+    def test_sent_failures_stay_sent(self):
+        from seldon_core_tpu.engine.grpc_transport import _is_connect_failure
+
+        # "Connection reset" means the connection was ESTABLISHED — the
+        # request may have been processed, so non-idempotent must NOT retry
+        for d in (
+            None,
+            "",
+            "Connection reset by peer",
+            "recvmsg: ECONNRESET",
+            "GOAWAY received",
+            "Socket closed",
+            "keepalive watchdog timeout",
+        ):
+            assert not _is_connect_failure(d), d
+
+
+class TestHpackEviction:
     def test_dynamic_table_eviction(self):
         d = hpack.Decoder(max_table_size=64)  # fits one small entry only
         for i in range(3):
