@@ -293,8 +293,10 @@ def _jax_model(parameters: dict[str, Any]) -> Any:
     ("bfloat16"/"float16"/"float32"), ``max_batch``, ``max_delay_ms``,
     ``buckets`` (comma-separated batch ladder, e.g. "8,32" — big models
     want few compiled programs), ``mesh`` ("auto" or "tp=4,fsdp=2" — shards
-    params over the slice per the family's logical axes), plus any
-    model-config field override (e.g. ``n_classes``).
+    params over the slice per the family's logical axes), ``input_dtype``
+    (warm the buckets for a non-default wire dtype, e.g. "uint8" images
+    normalized on device), plus any model-config field override (e.g.
+    ``n_classes``).
     """
     from seldon_core_tpu.models import registry as model_registry
 

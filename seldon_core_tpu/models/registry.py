@@ -151,6 +151,7 @@ def build_component(
     batching: bool = True,
     max_batch: int = 64,
     max_delay_ms: float = 2.0,
+    input_dtype: str | None = None,
     **kwargs,
 ) -> JaxModelComponent:
     if cfg is None:
@@ -165,13 +166,19 @@ def build_component(
     # leftover kwargs must be real build_compiled options; anything unknown
     # (e.g. a typo'd config field) fails loudly in build_compiled
     model = build_compiled(family, preset=preset, cfg=cfg, **kwargs)
+    warmup = example_input(family, cfg, 1)
+    if input_dtype is not None:
+        # serve a non-default wire dtype (e.g. uint8 images, normalized on
+        # device): warmup must compile the buckets for THAT dtype, or the
+        # first real request eats the compile
+        warmup = warmup.astype(np.dtype(input_dtype))
     return JaxModelComponent(
         model,
         class_names=class_names,
         batching=batching,
         max_batch=max_batch,
         max_delay_ms=max_delay_ms,
-        warmup_example=example_input(family, cfg, 1),
+        warmup_example=warmup,
     )
 
 

@@ -1,0 +1,330 @@
+"""Roofline accounting: exact FLOPs from XLA, measured device time, MFU.
+
+The reference never measures device utilization — its benchmark is a
+constant-returning stub (reference: docs/benchmarking.md:19-36,
+engine/.../predictors/SimpleModelUnit.java:33-46).  Serving a real model on
+TPU, "is it fast" has a precise answer: achieved FLOP/s over the chip's
+peak (MFU).  This module computes it three ways:
+
+- **FLOPs** come from XLA's own cost model (``compiled.cost_analysis()``)
+  on the exact serving program at the exact bucket shape — no hand-derived
+  formulas to drift out of date;
+- **device time** is measured by pipelining K dispatches and blocking once
+  at the end: dispatch is async, so the queue keeps the chip busy and the
+  amortized per-step time approximates pure device time even when the chip
+  sits behind a high-latency tunnel;
+- **peak** comes from the device kind (bf16 matmul peak per chip).
+
+Also usable as a CLI (``python -m seldon_core_tpu.utils.roofline --family
+bert --preset base --batch 32 --dtype bfloat16``) printing one JSON object —
+bench.py runs it as a subprocess so the measurement and the engine under
+test never contend for the same chip.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import numpy as np
+
+# bf16 matmul peak FLOP/s per chip, by device_kind substring (lowercased).
+# Order matters: more specific names first.
+_PEAKS: tuple[tuple[str, float], ...] = (
+    ("v6 lite", 918e12),  # Trillium / v6e
+    ("v6e", 918e12),
+    ("v5 lite", 197e12),  # v5e
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def chip_peak_flops(device=None) -> float | None:
+    """bf16 peak FLOP/s for one chip, or None off-TPU (CPU has no useful
+    published peak for this comparison)."""
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    if "tpu" not in kind and device.platform != "tpu":
+        return None
+    for marker, peak in _PEAKS:
+        if marker in kind:
+            return peak
+    return None
+
+
+def xla_flops(compiled) -> float | None:
+    """FLOPs of one execution of an XLA-compiled program, from the
+    compiler's cost model.  Returns None if the backend doesn't report it."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):  # older JAX: one dict per device
+        ca = ca[0] if ca else {}
+    flops = ca.get("flops") if isinstance(ca, dict) else None
+    if flops is None or not np.isfinite(flops) or flops <= 0:
+        return None
+    return float(flops)
+
+
+def _barrier(out) -> None:
+    """Wait until a dispatched step has truly executed.
+
+    ``jax.block_until_ready`` is NOT trustworthy on every platform (the
+    tunnel-attached 'axon' TPU client returns before execution), so the
+    barrier is a data fetch: materializing one element of the result cannot
+    complete before the program that produced it.
+    """
+    import jax
+
+    leaf = jax.tree.leaves(out)[0]
+    np.asarray(jax.device_get(leaf[(0,) * leaf.ndim]))
+
+
+def measure_step_time(
+    dispatch, example: np.ndarray, *, iters: int = 24, warmup: int = 3
+) -> float:
+    """Marginal seconds per device step, two-point method.
+
+    ``dispatch(example)`` enqueues one step and returns its (device) result.
+    IMPORTANT: successive dispatches must form a data-dependency chain (each
+    consuming a buffer the previous produced — e.g. a donated cache), so that
+    fetching one element of the LAST result provably waits for every step:
+    this platform's client executes lazily, and independent programs whose
+    outputs are never fetched may not run at all.  Timing two pipeline depths
+    and taking the slope cancels the fixed host/tunnel round trip (≈100 ms
+    here) that would otherwise swamp sub-ms steps.
+    """
+    for _ in range(warmup):
+        _barrier(dispatch(example))
+
+    def timed(n: int) -> float:
+        # min of 2: tunnel jitter is additive-positive, so the faster run
+        # is the better estimate of true cost
+        best = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(n):
+                out = dispatch(example)
+            _barrier(out)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
+    for attempt in range(3):
+        lo = max(2, iters // 4)
+        t_lo = timed(lo)
+        t_hi = timed(iters)
+        slope = (t_hi - t_lo) / (iters - lo)
+        # accept only if the added steps moved total time visibly above the
+        # jitter floor; otherwise deepen the pipeline and retry
+        if slope > 0 and (t_hi - t_lo) > 0.2 * t_lo:
+            return slope
+        iters *= 4
+    # measurement failed (jitter swamped the signal at every depth): say so
+    # — a fabricated near-zero time would read as absurd rows/s and MFU>1
+    return float("nan")
+
+
+def chained_step_time(
+    fn, x0, *, iters: int = 24, warmup: int = 3
+) -> float:
+    """measure_step_time for a step ``fn(x) -> out`` whose calls are
+    naturally independent: a zero-valued scalar distilled from each output
+    is added to the next input, forging the dependency chain the lazy
+    client needs.  The chain ops are element-wise over one input buffer —
+    noise next to a model forward step."""
+    import jax
+
+    state = {"x": x0}
+
+    def step(_ignored):
+        out = fn(state["x"])
+        leaf = jax.tree.leaves(out)[0]
+        zero = (leaf[(0,) * leaf.ndim] * 0).astype(x0.dtype)
+        state["x"] = x0 + zero
+        return out
+
+    return measure_step_time(step, None, iters=iters, warmup=warmup)
+
+
+def model_roofline(
+    family: str,
+    *,
+    preset: str | None = None,
+    batch: int = 32,
+    seq: int | None = None,
+    dtype: str | None = "bfloat16",
+    iters: int = 16,
+    **overrides,
+) -> dict:
+    """Build a model-zoo family at one bucket and measure its roofline.
+
+    Returns a dict with device seconds/step, rows/s, XLA FLOPs per step,
+    achieved FLOP/s, chip peak, and MFU (None off-TPU).
+    """
+    import jax
+
+    from seldon_core_tpu.executor import BucketSpec
+    from seldon_core_tpu.models import registry
+
+    cfg = registry.resolve_config(family, preset, **overrides)
+    model = registry.build_compiled(
+        family, preset=preset, cfg=cfg, dtype=dtype, buckets=BucketSpec((batch,))
+    )
+    example = registry.example_input(family, cfg, batch)
+    if seq is not None and example.ndim == 2 and example.dtype == np.int32:
+        # token models: example_input's seq is a placeholder; serve at `seq`
+        example = np.ones((batch, seq), np.int32)
+
+    x0 = model._place(example)
+    # one compile, used for BOTH the cost model and the timing loop — a
+    # second jit-cache compile of a big model costs minutes on a tunnel
+    exe = model._jitted.lower(model.params, x0).compile()
+    flops = xla_flops(exe)
+
+    sec = chained_step_time(lambda x: exe(model.params, x), x0, iters=iters)
+    peak = chip_peak_flops()
+    ok = np.isfinite(sec) and sec > 0
+    achieved = flops / sec if flops and ok else None
+    return {
+        "family": family,
+        "preset": preset or "default",
+        "batch": batch,
+        "seq": seq,
+        "dtype": dtype or "float32",
+        "measurement_failed": not ok,
+        "device_s_per_step": round(sec, 6) if ok else None,
+        "device_ms_per_step": round(sec * 1e3, 3) if ok else None,
+        "rows_per_s_device": round(batch / sec, 1) if ok else None,
+        "flops_per_step": flops,
+        "flops_per_row": round(flops / batch) if flops else None,
+        "achieved_tflops": round(achieved / 1e12, 2) if achieved else None,
+        "peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "mfu": round(achieved / peak, 4) if achieved and peak else None,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
+def generative_roofline(
+    family: str = "llama",
+    *,
+    preset: str | None = None,
+    n_slots: int = 8,
+    decode_block: int = 32,
+    dtype: str | None = "bfloat16",
+    prompt_len: int = 8,
+    iters: int = 8,
+    **overrides,
+) -> dict:
+    """Decode-loop roofline for a generative family: tokens/s at full slot
+    occupancy and MFU from XLA's cost model of the decode program."""
+    import jax
+
+    from seldon_core_tpu.models import registry
+
+    comp = registry.build_generative_component(
+        family,
+        preset=preset,
+        n_slots=n_slots,
+        decode_block=decode_block,
+        dtype=dtype,
+        max_new_tokens=decode_block,
+        **overrides,
+    )
+    model = comp.model
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, model.cfg.vocab_size, size=prompt_len)
+    last = [int(model.admit(s, prompt, 0.0, s)) for s in range(n_slots)]
+
+    # time the decode-k program directly at full slot occupancy;
+    # _exec_decode_k returns device arrays, so steps pipeline and one final
+    # block amortizes the host/tunnel round trip out of the measurement
+    payload = {
+        "tokens": np.asarray(last, np.int32),
+        "active": np.ones(n_slots, bool),
+        "temperature": np.zeros(n_slots, np.float32),
+        "seed": 0,
+        "eos": np.full(n_slots, -1, np.int32),
+        "remaining": np.full(n_slots, 1 << 30, np.int32),
+        "k": decode_block,
+    }
+    sec = measure_step_time(
+        lambda _x: model._exec_decode_k(payload)[0],
+        np.zeros(1),
+        iters=iters,
+    )
+
+    tokens_per_step = n_slots * decode_block
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(model.params)
+    )
+    # decode FLOPs ≈ 2·params per token (matmul-dominated; attention adds
+    # O(ctx·hidden) per token, small at these context lengths)
+    flops = 2.0 * n_params * tokens_per_step
+    peak = chip_peak_flops()
+    ok = np.isfinite(sec) and sec > 0
+    achieved = flops / sec if ok else None
+    return {
+        "family": family,
+        "preset": preset or "default",
+        "n_slots": n_slots,
+        "decode_block": decode_block,
+        "measurement_failed": not ok,
+        "device_s_per_block": round(sec, 6) if ok else None,
+        "tokens_per_s_device": round(tokens_per_step / sec, 1) if ok else None,
+        "n_params": n_params,
+        "flops_per_token": round(2.0 * n_params),
+        "achieved_tflops": round(achieved / 1e12, 3) if achieved else None,
+        "peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "mfu": round(achieved / peak, 4) if achieved and peak else None,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--family", required=True)
+    ap.add_argument("--preset", default=None)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--iters", type=int, default=16)
+    ap.add_argument("--generative", action="store_true")
+    ap.add_argument("--n-slots", type=int, default=8)
+    ap.add_argument("--decode-block", type=int, default=32)
+    args = ap.parse_args(argv)
+    if args.generative:
+        out = generative_roofline(
+            args.family,
+            preset=args.preset,
+            n_slots=args.n_slots,
+            decode_block=args.decode_block,
+            dtype=args.dtype,
+        )
+    else:
+        out = model_roofline(
+            args.family,
+            preset=args.preset,
+            batch=args.batch,
+            seq=args.seq,
+            dtype=args.dtype,
+            iters=args.iters,
+        )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

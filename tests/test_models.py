@@ -136,3 +136,57 @@ class TestRegistry:
     def test_config_overrides(self):
         cfg = registry.resolve_config("mlp", "tiny", n_classes=7)
         assert cfg.n_classes == 7 and dataclasses.is_dataclass(cfg)
+
+
+class TestUint8Ingest:
+    """The binary image-serving path: uint8 pixels in, normalization fused
+    into the jitted forward (models/resnet.py::apply)."""
+
+    def test_uint8_matches_prenormalized_float(self):
+        from seldon_core_tpu.executor import BucketSpec
+
+        m = registry.build_compiled(
+            "resnet", preset="tiny", buckets=BucketSpec((4,))
+        )
+        img = np.random.default_rng(0).integers(
+            0, 256, size=(4, 32, 32, 3), dtype=np.uint8
+        )
+        norm = (img.astype(np.float32) / 255.0 - resnet.IMAGENET_MEAN) / np.asarray(
+            resnet.IMAGENET_STD
+        )
+        out8 = np.asarray(m(img), np.float32)
+        outf = np.asarray(m(norm.astype(np.float32)), np.float32)
+        np.testing.assert_allclose(out8, outf, atol=1e-5)
+
+    def test_input_dtype_warms_uint8_bucket(self):
+        comp = registry.build_component(
+            "resnet", preset="tiny", input_dtype="uint8", max_batch=2
+        )
+        assert comp.warmup_example.dtype == np.uint8
+
+
+class TestRoofline:
+    def test_model_roofline_reports_flops_and_time(self):
+        from seldon_core_tpu.utils import roofline
+
+        out = roofline.model_roofline("mlp", preset="tiny", batch=8, iters=8)
+        assert out["device_s_per_step"] > 0
+        assert out["flops_per_step"] is None or out["flops_per_step"] > 0
+        assert out["rows_per_s_device"] > 0
+
+    def test_generative_roofline_tokens_per_s(self):
+        from seldon_core_tpu.utils import roofline
+
+        out = roofline.generative_roofline(
+            "llama", preset="tiny", n_slots=2, decode_block=4, iters=4
+        )
+        assert out["tokens_per_s_device"] > 0
+        assert out["n_params"] > 0
+
+    def test_peak_lookup_known_kinds(self):
+        from seldon_core_tpu.utils.roofline import _PEAKS
+
+        # marker table stays ordered most-specific-first ("v5 lite" must
+        # match before bare "v5" which is the v5p peak)
+        kinds = [m for m, _ in _PEAKS]
+        assert kinds.index("v5 lite") < kinds.index("v5")

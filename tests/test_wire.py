@@ -74,9 +74,15 @@ class TestStreamStateCleanup:
     def _conn(self):
         from seldon_core_tpu.wire.h2grpc import _ServerConn
 
-        conn = _ServerConn({})
-        conn.transport = None  # _send_error bails before writing
-        return conn
+        async def make():
+            # constructed under a running loop: _Conn.__init__ creates a
+            # future from the current loop, which may not exist depending
+            # on which tests ran before this one
+            conn = _ServerConn({})
+            conn.transport = None  # _send_error bails before writing
+            return conn
+
+        return run(make())
 
     def test_send_error_drops_send_window(self):
         conn = self._conn()
