@@ -119,6 +119,20 @@ class PredictionService:
             )
         return out
 
+    def generative_units(self) -> list:
+        """Every GenerativeComponent in the graph.  Streaming serves exactly
+        one generative unit directly — routing a token stream through
+        routers/combiners has no defined merge semantics, so the caller
+        distinguishes none (unsupported graph) from many (ambiguous)."""
+        from seldon_core_tpu.executor.generation import GenerativeComponent
+
+        assert self.walker is not None, "PredictionService.start() not called"
+        return [
+            comp
+            for _name, comp in self.walker.iter_components()
+            if isinstance(comp, GenerativeComponent)
+        ]
+
     async def send_feedback(self, fb: FeedbackPayload) -> None:
         assert self.walker is not None, "PredictionService.start() not called"
         await self.walker.send_feedback(fb)

@@ -33,7 +33,7 @@ import json
 import logging
 import os
 import threading
-from typing import Any
+from typing import Any, AsyncIterator, Callable
 
 import jax
 import numpy as np
@@ -453,6 +453,9 @@ class _Request:
     eos_id: int | None
     future: asyncio.Future
     out: list[int] = dataclasses.field(default_factory=list)
+    # streaming hook: called with each sampled token as it lands (in
+    # event-loop context, decode_block tokens at a time per device fetch)
+    on_token: "Callable[[int], None] | None" = None
 
 
 class GenerationScheduler:
@@ -479,8 +482,13 @@ class GenerationScheduler:
         max_new_tokens: int = 32,
         temperature: float = 0.0,
         eos_id: int | None = None,
+        on_token: "Callable[[int], None] | None" = None,
     ) -> np.ndarray:
-        """Generate up to ``max_new_tokens`` ids for a 1-D prompt."""
+        """Generate up to ``max_new_tokens`` ids for a 1-D prompt.
+
+        ``on_token`` (optional) fires per sampled token in event-loop
+        context — the streaming hook; tokens arrive ``decode_block`` at a
+        time per device fetch."""
         if self._closed:
             raise RuntimeError("GenerationScheduler is closed")
         prompt = np.asarray(prompt, np.int32).ravel()
@@ -509,7 +517,10 @@ class GenerationScheduler:
             self._task = asyncio.get_running_loop().create_task(self._run())
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         await self._queue.put(
-            _Request(prompt, max_new_tokens, float(temperature), eos_id, fut)
+            _Request(
+                prompt, max_new_tokens, float(temperature), eos_id, fut,
+                on_token=on_token,
+            )
         )
         return await fut
 
@@ -535,6 +546,12 @@ class GenerationScheduler:
 
     def _token_done(self, req: _Request, tok: int) -> bool:
         req.out.append(tok)
+        if req.on_token is not None:
+            try:
+                req.on_token(tok)
+            except Exception:  # a broken listener must not stall the loop
+                log.exception("on_token hook failed; detaching it")
+                req.on_token = None
         if req.eos_id is not None and tok == req.eos_id:
             return True
         return len(req.out) >= req.max_new_tokens
@@ -665,6 +682,8 @@ class GenerationScheduler:
 
 PAD_ID = -1  # right-pad for ragged generated rows in dense responses
 
+_STREAM_END = object()  # queue sentinel: the submit task completed
+
 
 class GenerativeComponent(SeldonComponent):
     """Graph unit serving a generative decoder.
@@ -754,6 +773,54 @@ class GenerativeComponent(SeldonComponent):
             rows, self.max_new_tokens, self.temperature, self.eos_id
         )
         return self._pad_rows(outs)
+
+    async def stream(
+        self,
+        prompt: np.ndarray,
+        *,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+        eos_id: int | None = None,
+    ) -> AsyncIterator[int]:
+        """Yield generated token ids as they decode (the streaming serving
+        path — neither the reference nor its successor streams at all).
+
+        Tokens surface ``decode_block`` at a time per device fetch: deploy
+        with a small block (e.g. 4-8) when time-to-first-token matters, the
+        default large block when bulk throughput does.
+        """
+        q: asyncio.Queue = asyncio.Queue()
+        task = asyncio.create_task(
+            self.scheduler.submit(
+                np.asarray(prompt, np.int32).ravel(),
+                max_new_tokens=(
+                    self.max_new_tokens if max_new_tokens is None else max_new_tokens
+                ),
+                temperature=(
+                    self.temperature if temperature is None else temperature
+                ),
+                eos_id=self.eos_id if eos_id is None else eos_id,
+                on_token=q.put_nowait,
+            )
+        )
+        task.add_done_callback(lambda t: q.put_nowait(_STREAM_END))
+        served = 0
+        try:
+            while True:
+                item = await q.get()
+                if item is _STREAM_END:
+                    break
+                served += 1
+                yield int(item)
+            # surface a failed submit (bad prompt, closed scheduler) —
+            # and tokens the hook delivered between our last get and the
+            # sentinel
+            result = task.result()
+            for tok in result[served:]:
+                yield int(tok)
+        finally:
+            if not task.done():
+                task.cancel()
 
     async def predict_raw(self, p):
         from seldon_core_tpu.contract.payload import DataKind, Payload

@@ -58,6 +58,15 @@ class Config:
         )
 
     @classmethod
+    def llama3_1b(cls, max_seq: int = 2048) -> "Config":
+        """Llama-3.2-1B shape (vocab truncated to keep the embedding from
+        dominating the 1.2B total): the bench-scale real model."""
+        return cls(
+            vocab_size=32000, hidden=2048, n_layers=16, n_heads=32,
+            n_kv_heads=8, ffn=8192, max_seq=max_seq,
+        )
+
+    @classmethod
     def tiny(cls, max_seq: int = 128) -> "Config":
         """Test-scale config: same code paths, toy sizes."""
         return cls(

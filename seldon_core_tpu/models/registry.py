@@ -66,7 +66,11 @@ _FAMILIES: dict[str, Family] = {
         "llama", llama.Config,
         lambda rng, cfg: llama.init_params(rng, cfg),
         llama.apply, llama.param_logical_axes,
-        presets={"llama3-8b": llama.Config.llama3_8b, "tiny": llama.Config.tiny},
+        presets={
+            "llama3-8b": llama.Config.llama3_8b,
+            "llama3-1b": llama.Config.llama3_1b,
+            "tiny": llama.Config.tiny,
+        },
         example_input=lambda c, b: np.ones((b, 16), np.int32),
     ),
 }

@@ -313,6 +313,7 @@ def main(argv: list[str] | None = None) -> None:
             n_slots=args.n_slots,
             decode_block=args.decode_block,
             dtype=args.dtype,
+            iters=args.iters,
         )
     else:
         out = model_roofline(

@@ -420,3 +420,136 @@ class TestDecodeBlocks:
         o1, o2 = run(go())
         np.testing.assert_array_equal(o1, ref1[: stop + 1])
         np.testing.assert_array_equal(o2, reference_generate(cfg, params, p2, 5))
+
+
+class TestStreaming:
+    """SSE token streaming (engine/app.py::predictions_stream) and the
+    scheduler's on_token hook underneath it."""
+
+    PREDICTOR = {
+        "name": "llm",
+        "graph": {
+            "name": "gen",
+            "type": "MODEL",
+            "implementation": "JAX_GENERATIVE",
+            "parameters": [
+                {"name": "family", "value": "llama", "type": "STRING"},
+                {"name": "preset", "value": "tiny", "type": "STRING"},
+                {"name": "n_slots", "value": "2", "type": "INT"},
+                {"name": "max_new_tokens", "value": "6", "type": "INT"},
+                {"name": "decode_block", "value": "2", "type": "INT"},
+            ],
+        },
+    }
+
+    def _events(self, text: str) -> list[dict]:
+        return [
+            json.loads(line[len("data: "):])
+            for line in text.splitlines()
+            if line.startswith("data: ")
+        ]
+
+    def test_stream_matches_unary(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.engine.app import EngineApp
+        from seldon_core_tpu.engine.service import PredictionService
+        from seldon_core_tpu.graph.spec import PredictorSpec
+
+        async def go():
+            service = PredictionService(
+                PredictorSpec.model_validate(self.PREDICTOR)
+            )
+            app = EngineApp(service).build()
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                # unary reference (temperature 0 -> deterministic)
+                resp = await client.post(
+                    "/api/v0.1/predictions",
+                    json={"strData": json.dumps({"tokens": [5, 9, 2, 17]})},
+                )
+                assert resp.status == 200, await resp.text()
+                expected = json.loads((await resp.json())["strData"])["tokens"]
+
+                resp = await client.post(
+                    "/api/v0.1/predictions/stream",
+                    json={"tokens": [5, 9, 2, 17]},
+                )
+                assert resp.status == 200, await resp.text()
+                assert resp.headers["Content-Type"].startswith("text/event-stream")
+                events = self._events(await resp.text())
+                toks = [e["token"] for e in events if "token" in e]
+                done = [e for e in events if e.get("done")]
+                assert toks == expected
+                assert done and done[0]["tokens"] == expected
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_stream_rejects_batch_and_non_generative(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.engine.app import EngineApp
+        from seldon_core_tpu.engine.service import PredictionService
+        from seldon_core_tpu.graph.spec import PredictorSpec
+
+        async def go():
+            service = PredictionService(
+                PredictorSpec.model_validate(self.PREDICTOR)
+            )
+            app = EngineApp(service).build()
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/api/v0.1/predictions/stream",
+                    json={"tokens": [[5, 9], [2, 17]]},
+                )
+                assert resp.status == 400
+            finally:
+                await client.close()
+
+            plain = PredictionService(
+                PredictorSpec.model_validate(
+                    {"name": "p", "graph": {"name": "m", "type": "MODEL",
+                                            "implementation": "SIMPLE_MODEL"}}
+                )
+            )
+            app = EngineApp(plain).build()
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resp = await client.post(
+                    "/api/v0.1/predictions/stream", json={"tokens": [1, 2]}
+                )
+                assert resp.status == 400
+                assert "no generative unit" in await resp.text()
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_on_token_hook_sees_every_token(self, tiny):
+        from seldon_core_tpu.executor.generation import (
+            GenerativeComponent,
+            GenerativeModel,
+        )
+
+        cfg, params = tiny
+        model = GenerativeModel(cfg, params, family_mod=llama, n_slots=2)
+        comp = GenerativeComponent(model, max_new_tokens=5)
+
+        async def go():
+            seen: list[int] = []
+            out = await comp.scheduler.submit(
+                np.array([5, 9, 2], np.int32),
+                max_new_tokens=5,
+                on_token=seen.append,
+            )
+            assert seen == list(out)
+            return out
+
+        out = run(go())
+        assert len(out) == 5
