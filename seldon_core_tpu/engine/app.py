@@ -62,6 +62,17 @@ class EngineApp:
 
     def build(self) -> web.Application:
         app = web.Application(client_max_size=256 * 1024 * 1024)
+
+        # which SO_REUSEPORT worker answered — lets operators (and the
+        # multi-worker test) see the kernel's accept balancing.  Resolved at
+        # build() time: workers fork before building, so a module-level
+        # constant would pin every worker to the parent's pid
+        worker_tag = str(os.getpid())
+
+        async def _tag_worker(request, response):
+            response.headers["X-Engine-Worker"] = worker_tag
+
+        app.on_response_prepare.append(_tag_worker)
         r = app.router
         for prefix in ("/api/v0.1", "/api/v1.0"):
             r.add_post(f"{prefix}/predictions", self.predictions)

@@ -39,6 +39,7 @@ from seldon_core_tpu.gateway.store import (
 )
 from seldon_core_tpu.gateway.tap import RequestResponseTap, tap_from_env
 from seldon_core_tpu.utils.tracectx import outgoing_headers
+from seldon_core_tpu.wire.h1client import H1ConnectError, H1Pool
 from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS, MetricsRegistry
 
 log = logging.getLogger(__name__)
@@ -72,8 +73,11 @@ class GatewayApp:
         self.tokens = tokens or token_store_from_env()
         self.tap = tap or tap_from_env()
         self.metrics = metrics or DEFAULT_METRICS
-        self.timeout = aiohttp.ClientTimeout(total=timeout_s)
-        self._session: aiohttp.ClientSession | None = None
+        self.timeout_s = timeout_s
+        # lean HTTP/1.1 forward pools, one per engine endpoint (wire/
+        # h1client.py — a general-purpose client costs hundreds of µs of
+        # feature machinery per hop, which is the proxy's entire budget)
+        self._pools: dict[str, "H1Pool"] = {}
         self._paused = False
         # removed deployments lose their live tokens immediately
         store.add_listener(self._on_deployment_event)
@@ -81,19 +85,28 @@ class GatewayApp:
     def _on_deployment_event(self, event: str, rec: DeploymentRecord) -> None:
         if event == "removed":
             self.tokens.revoke_for_key(rec.oauth_key)
+        if event in ("removed", "updated"):
+            pool = self._pools.pop(rec.oauth_key, None)
+            if pool is not None:
+                pool.evict()  # idle sockets close NOW, not on next recycle
+
+    def _pool(self, rec: DeploymentRecord) -> "H1Pool":
+        pool = self._pools.get(rec.oauth_key)
+        if pool is None:
+            host = rec.engine_host or rec.name
+            pool = H1Pool(host, rec.engine_rest_port)
+            self._pools[rec.oauth_key] = pool
+        return pool
 
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> None:
-        if self._session is None:
-            self._session = aiohttp.ClientSession(
-                connector=aiohttp.TCPConnector(limit=512, keepalive_timeout=30)
-            )
+        return None  # pools connect lazily per deployment
 
     async def close(self) -> None:
-        if self._session is not None:
-            await self._session.close()
-            self._session = None
+        pools, self._pools = list(self._pools.values()), {}
+        for pool in pools:
+            await pool.close()
         await self.tap.close()
 
     def build(self) -> web.Application:
@@ -176,34 +189,26 @@ class GatewayApp:
             retry_loop,
         )
 
-        assert self._session is not None, "GatewayApp.start() not called"
         idempotent = "feedback" not in path
+        pool = self._pool(rec)
+        fwd_headers = outgoing_headers() or None
 
         async def attempt(i: int) -> tuple[int, bytes]:
             try:
-                async with self._session.post(
-                    rec.rest_base + path,
-                    data=raw,
-                    headers={
-                        "Content-Type": "application/json",
-                        **outgoing_headers(),
-                    },
-                    timeout=self.timeout,
-                ) as resp:
-                    body = await resp.read()
-                    if (
-                        resp.status in RETRYABLE_HTTP
-                        and idempotent
-                        # the last attempt returns the real response
-                        and i < RETRY_ATTEMPTS - 1
-                    ):
-                        raise _RetryableSent(
-                            _UpstreamError(resp.status, body)
-                        )
-                    return resp.status, body
-            except aiohttp.ClientConnectorError as e:
+                resp = await pool.post(
+                    path, raw, headers=fwd_headers, timeout=self.timeout_s
+                )
+                if (
+                    resp.status in RETRYABLE_HTTP
+                    and idempotent
+                    # the last attempt returns the real response
+                    and i < RETRY_ATTEMPTS - 1
+                ):
+                    raise _RetryableSent(_UpstreamError(resp.status, resp.body))
+                return resp.status, resp.body
+            except H1ConnectError as e:
                 raise _RetryableConnect(e) from e
-            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            except (ConnectionError, asyncio.TimeoutError, OSError) as e:
                 raise _RetryableSent(e) from e
 
         try:
@@ -226,18 +231,34 @@ class GatewayApp:
             principal = rec.oauth_key
             deployment_name = rec.name
             raw = await request.read()
-            try:
-                body = json.loads(raw)  # validate only; forward untouched
-            except json.JSONDecodeError as e:
+            # the body is forwarded untouched either way (like the
+            # reference's apife, RestClientController.java:136-144), so a
+            # full json.loads here is pure overhead unless something
+            # downstream needs the OBJECT: the tap (request capture) or the
+            # feedback reward counter.  The hot prediction path does a
+            # shallow shape check only — the engine re-validates anyway and
+            # its 400 is returned verbatim.
+            body: Any = None
+            need_body = service == "feedback" or (
+                service == "predictions" and self.tap.enabled
+            )
+            if need_body:
+                try:
+                    body = json.loads(raw)
+                except json.JSONDecodeError as e:
+                    code = 400
+                    return _error(400, f"invalid JSON: {e}")
+            elif raw.lstrip()[:1] != b"{":
                 code = 400
-                return _error(400, f"invalid JSON: {e}")
+                return _error(400, "body must be a JSON object")
             try:
                 code, reply = await self._forward(rec, path, raw)
-            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 code = 503
                 return _error(503, f"engine unreachable for {rec.name}: {e}")
             if service == "predictions":
-                await self._tap_pair(rec, body, reply)
+                if self.tap.enabled:
+                    await self._tap_pair(rec, body, reply)
             else:
                 self._record_reward(rec, body)
             return web.Response(body=reply, status=code, content_type="application/json")

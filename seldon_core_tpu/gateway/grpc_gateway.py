@@ -144,7 +144,7 @@ class GatewayGrpc(_ChannelCacheBase):
             stub = Stub(self._channel(rec), "Seldon")
             return await stub.Predict(
                 request,
-                timeout=self.gateway.timeout.total,
+                timeout=self.gateway.timeout_s,
                 metadata=tuple(outgoing_headers().items()) or None,
             )
         except AuthError as e:
@@ -158,7 +158,7 @@ class GatewayGrpc(_ChannelCacheBase):
             stub = Stub(self._channel(rec), "Seldon")
             return await stub.SendFeedback(
                 request,
-                timeout=self.gateway.timeout.total,
+                timeout=self.gateway.timeout_s,
                 metadata=tuple(outgoing_headers().items()) or None,
             )
         except AuthError as e:
@@ -191,7 +191,7 @@ class FastGatewayGrpc(_ChannelCacheBase):
             return await self._channel(rec).call(
                 f"/seldon.protos.Seldon/{method}",
                 payload,
-                timeout=self.gateway.timeout.total,
+                timeout=self.gateway.timeout_s,
                 metadata=tuple(outgoing_headers().items()),
             )
         except AuthError as e:

@@ -23,12 +23,18 @@ log = logging.getLogger(__name__)
 
 
 class RequestResponseTap(Protocol):
+    # False lets the ingress skip building the publish arguments entirely
+    # (parsing request+reply JSON per call) when no sink is configured
+    enabled: bool
+
     async def publish(self, client_id: str, puid: str, request: Any, response: Any) -> None: ...
 
     async def close(self) -> None: ...
 
 
 class NullTap:
+    enabled = False
+
     async def publish(self, client_id: str, puid: str, request: Any, response: Any) -> None:
         return None
 
@@ -43,6 +49,8 @@ class QueuedTap:
     pair is dropped when the queue is full, and drops are counted).
 
     Subclasses implement ``_emit(client_id, line)`` (async, may block)."""
+
+    enabled = True
 
     def __init__(self, max_queue: int = 4096):
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)

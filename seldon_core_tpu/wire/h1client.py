@@ -1,0 +1,221 @@
+"""Minimal pooled HTTP/1.1 POST client for proxy hops.
+
+The gateway's REST forward is a fixed-shape request — POST, three headers,
+known body — yet a general-purpose client (aiohttp) spends hundreds of
+microseconds per call on feature machinery the hop never uses (cookie jars,
+middlewares, multidict normalization, URL re-parsing).  On a proxy that is
+pure per-request overhead, twice (request + response).  This client does
+only what the hop needs:
+
+- one persistent connection pool per (host, port), LIFO recycle;
+- requests written as a single pre-assembled bytes block;
+- responses parsed with two reads in the common case (header block +
+  content-length body); chunked and connection-close bodies supported.
+
+Analogue of the reference engine's InternalPredictionService pooling
+(reference: engine/.../service/InternalPredictionService.java:88-96 — a
+PoolingNHttpClientConnectionManager with maxTotal 150), built on asyncio
+streams.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+__all__ = ["H1Pool", "H1Response", "H1ConnectError", "H1SentError"]
+
+
+class H1ConnectError(ConnectionError):
+    """TCP connect to the upstream failed: the request was provably never
+    sent, so retrying is safe for ANY method."""
+
+
+class H1SentError(ConnectionError):
+    """The connection died after the request (or part of it) was written —
+    the upstream may have processed it; only idempotent methods retry."""
+
+
+class _StaleConn(ConnectionError):
+    """A REUSED connection died before a single response byte arrived —
+    the upstream closed an idle keep-alive socket.  RFC 9112 §9.3.1: treat
+    as if the request was never sent; safe to replay exactly once.  Any
+    failure AFTER response bytes (or on a fresh connection) must NOT
+    replay: the upstream may have processed the request."""
+
+
+class H1Response:
+    __slots__ = ("status", "body")
+
+    def __init__(self, status: int, body: bytes):
+        self.status = status
+        self.body = body
+
+
+_CRLF = b"\r\n"
+
+
+class H1Pool:
+    """Keep-alive connection pool to one upstream."""
+
+    def __init__(self, host: str, port: int, limit: int = 64):
+        self.host = host
+        self.port = port
+        self.limit = limit
+        self._idle: list[tuple[asyncio.StreamReader, asyncio.StreamWriter]] = []
+        self._host_hdr = f"{host}:{port}".encode()
+        self._closed = False
+
+    async def _open(self):
+        try:
+            return await asyncio.open_connection(self.host, self.port)
+        except OSError as e:
+            raise H1ConnectError(f"{self.host}:{self.port}: {e}") from e
+
+    def _recycle(self, conn) -> None:
+        if self._closed or len(self._idle) >= self.limit:
+            conn[1].close()
+        else:
+            self._idle.append(conn)
+
+    def evict(self) -> None:
+        """Stop recycling and close every idle socket NOW (deployment
+        endpoint changed).  In-flight requests finish on their own conns,
+        which the _closed flag then refuses to recycle."""
+        self._closed = True
+        idle, self._idle = self._idle, []
+        for _r, w in idle:
+            w.close()
+
+    async def close(self) -> None:
+        self.evict()
+
+    def _request_bytes(
+        self, path: str, body: bytes, headers: dict[str, str] | None
+    ) -> bytes:
+        parts = [
+            b"POST ", path.encode(), b" HTTP/1.1", _CRLF,
+            b"host: ", self._host_hdr, _CRLF,
+            b"content-type: application/json", _CRLF,
+            b"content-length: ", str(len(body)).encode(), _CRLF,
+        ]
+        if headers:
+            for k, v in headers.items():
+                parts.extend((k.encode(), b": ", v.encode(), _CRLF))
+        parts.extend((_CRLF, body))
+        return b"".join(parts)
+
+    async def post(
+        self,
+        path: str,
+        body: bytes,
+        headers: dict[str, str] | None = None,
+        timeout: float = 30.0,
+    ) -> H1Response:
+        """One POST within ONE overall ``timeout`` budget (connect + write
+        + read, including the stale-keep-alive replay).  Only a reused
+        connection that died before ANY response byte replays (see
+        _StaleConn); every other failure maps to H1ConnectError (connect
+        never happened) or H1SentError (upstream may have processed it) so
+        the caller's retry policy can classify honestly."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+
+        def remaining() -> float:
+            return max(0.001, deadline - loop.time())
+
+        req = self._request_bytes(path, body, headers)
+        reused = bool(self._idle)
+        conn = (
+            self._idle.pop()
+            if reused
+            else await asyncio.wait_for(self._open(), remaining())
+        )
+        try:
+            return await asyncio.wait_for(self._roundtrip(conn, req, reused), remaining())
+        except _StaleConn:
+            conn[1].close()
+            # replay exactly once, on a provably fresh connection
+            conn = await asyncio.wait_for(self._open(), remaining())
+            try:
+                return await asyncio.wait_for(
+                    self._roundtrip(conn, req, reused=False), remaining()
+                )
+            except (ConnectionError, asyncio.IncompleteReadError, OSError, ValueError) as e2:
+                conn[1].close()
+                raise H1SentError(str(e2)) from e2
+        except H1SentError:
+            conn[1].close()
+            raise
+        except (ConnectionError, asyncio.IncompleteReadError, OSError, ValueError) as e:
+            # ValueError: malformed framing (status line, lengths) — the
+            # response is unusable but the request WAS processed-or-may-be
+            conn[1].close()
+            raise H1SentError(str(e)) from e
+        except asyncio.TimeoutError:
+            conn[1].close()
+            raise
+
+    async def _roundtrip(self, conn, req: bytes, reused: bool) -> H1Response:
+        reader, writer = conn
+        try:
+            writer.write(req)
+            await writer.drain()
+            status_line = await reader.readline()
+        except (ConnectionError, OSError) as e:
+            # nothing read yet; a reused socket failing here is the classic
+            # upstream keep-alive timeout
+            if reused:
+                raise _StaleConn(str(e)) from e
+            raise
+        if not status_line:
+            if reused:
+                raise _StaleConn("idle keep-alive closed by upstream")
+            raise ConnectionResetError("upstream closed before responding")
+        try:
+            status = int(status_line.split(b" ", 2)[1])
+        except (IndexError, ValueError):
+            raise H1SentError(f"bad status line {status_line!r}") from None
+        length = None
+        chunked = False
+        keep_alive = True
+        while True:
+            line = await reader.readline()
+            if line in (_CRLF, b"\n", b""):
+                break
+            name, _, value = line.partition(b":")
+            name = name.strip().lower()
+            value = value.strip()
+            if name == b"content-length":
+                length = int(value)
+            elif name == b"transfer-encoding" and b"chunked" in value.lower():
+                chunked = True
+            elif name == b"connection" and value.lower() == b"close":
+                keep_alive = False
+        if chunked:
+            body = await self._read_chunked(reader)
+        elif length is not None:
+            body = await reader.readexactly(length)
+        elif not keep_alive:
+            body = await reader.read()
+        else:
+            raise H1SentError("response has no framing (length/chunked/close)")
+        if keep_alive:
+            self._recycle(conn)
+        else:
+            writer.close()
+        return H1Response(status, bytes(body))
+
+    @staticmethod
+    async def _read_chunked(reader: asyncio.StreamReader) -> bytes:
+        out = bytearray()
+        while True:
+            size_line = await reader.readline()
+            size = int(size_line.split(b";", 1)[0], 16)
+            if size == 0:
+                # consume trailers until the final blank line
+                while True:
+                    line = await reader.readline()
+                    if line in (_CRLF, b"\n", b""):
+                        return bytes(out)
+            out += await reader.readexactly(size)
+            await reader.readexactly(2)  # chunk's trailing CRLF

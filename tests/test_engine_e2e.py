@@ -8,6 +8,7 @@ microservice HTTP server (the reference can only do this on a live cluster).
 """
 
 import asyncio
+import os
 
 import numpy as np
 from aiohttp.test_utils import TestClient, TestServer
@@ -682,3 +683,69 @@ class TestTraceContextPropagation:
 
         seen, tp = run(go())
         assert seen == [tp, None]  # propagated, then NOT leaked
+
+
+class TestMultiWorkerIngress:
+    """--workers N: SO_REUSEPORT processes sharing one port (the Python
+    equivalent of the reference's 16-core multithreaded engine JVM,
+    docs/benchmarking.md:19-36).  Each worker owns its own service +
+    sub-batchers; kernel accept balancing spreads connections."""
+
+    def test_two_workers_share_one_port(self):
+        import json as _json
+        import subprocess
+        import sys
+        import time
+        import urllib.request
+
+        env = dict(os.environ)
+        env.pop("ENGINE_PREDICTOR", None)  # default stub graph
+        env["ENGINE_WARMUP"] = "0"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "seldon_core_tpu.engine.app",
+             "--port", "18908", "--grpc-port", "18909", "--workers", "2"],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        try:
+            deadline = time.time() + 120
+            while True:
+                assert proc.poll() is None, "engine died"
+                try:
+                    with urllib.request.urlopen(
+                        "http://127.0.0.1:18908/ready", timeout=2
+                    ) as r:
+                        if r.status == 200:
+                            break
+                except OSError:
+                    pass
+                assert time.time() < deadline, "engine never ready"
+                time.sleep(1)
+
+            body = _json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0]]}}).encode()
+            workers = set()
+            for _ in range(80):
+                req = urllib.request.Request(
+                    "http://127.0.0.1:18908/api/v0.1/predictions",
+                    data=body,
+                    headers={"Content-Type": "application/json",
+                             "Connection": "close"},
+                )
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    assert r.status == 200
+                    out = _json.loads(r.read())
+                    assert out["status"]["status"] == "SUCCESS"
+                    workers.add(r.headers.get("X-Engine-Worker"))
+                if len(workers) >= 2:
+                    break
+            assert len(workers) >= 2, (
+                f"kernel accept balancing never reached worker 2: {workers}"
+            )
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()

@@ -1,0 +1,213 @@
+"""wire/h1client.py — the lean HTTP/1.1 forward pool: framing modes,
+keep-alive recycling, stale-connection replay, and retry classification."""
+
+import asyncio
+
+import pytest
+from aiohttp import web
+
+from seldon_core_tpu.wire.h1client import H1ConnectError, H1Pool, H1SentError
+
+run = asyncio.run
+
+
+async def _server(handler):
+    app = web.Application()
+    app.router.add_post("/echo", handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = runner.addresses[0][1]
+    return runner, port
+
+
+class TestH1Pool:
+    def test_roundtrip_and_keepalive_reuse(self):
+        hits = []
+
+        async def echo(request):
+            hits.append(1)
+            return web.json_response({"got": (await request.read()).decode()})
+
+        async def go():
+            runner, port = await _server(echo)
+            pool = H1Pool("127.0.0.1", port)
+            try:
+                for i in range(5):
+                    resp = await pool.post("/echo", f"b{i}".encode())
+                    assert resp.status == 200
+                    assert f"b{i}".encode() in resp.body
+                # all five rode ONE recycled connection
+                assert len(pool._idle) == 1
+            finally:
+                await pool.close()
+                await runner.cleanup()
+
+        run(go())
+
+    def test_extra_headers_forwarded(self):
+        async def echo(request):
+            return web.json_response({"tp": request.headers.get("traceparent", "")})
+
+        async def go():
+            runner, port = await _server(echo)
+            pool = H1Pool("127.0.0.1", port)
+            try:
+                resp = await pool.post(
+                    "/echo", b"{}", headers={"traceparent": "00-aa-bb-01"}
+                )
+                assert b"00-aa-bb-01" in resp.body
+            finally:
+                await pool.close()
+                await runner.cleanup()
+
+        run(go())
+
+    def test_stale_keepalive_replays_once(self):
+        async def echo(request):
+            return web.json_response({"ok": True})
+
+        async def go():
+            runner, port = await _server(echo)
+            pool = H1Pool("127.0.0.1", port)
+            try:
+                resp = await pool.post("/echo", b"{}")
+                assert resp.status == 200
+                # poison the idle socket the way an upstream keep-alive
+                # timeout would: close it under the pool
+                _r, w = pool._idle[0]
+                w.close()
+                await asyncio.sleep(0.05)
+                resp = await pool.post("/echo", b"{}")  # replays on fresh conn
+                assert resp.status == 200
+            finally:
+                await pool.close()
+                await runner.cleanup()
+
+        run(go())
+
+    def test_connect_refused_is_connect_error(self):
+        async def go():
+            pool = H1Pool("127.0.0.1", 1)  # nothing listens on port 1
+            with pytest.raises(H1ConnectError):
+                await pool.post("/echo", b"{}")
+
+        run(go())
+
+    def test_chunked_response(self):
+        async def chunked(request):
+            resp = web.StreamResponse()
+            resp.enable_chunked_encoding()
+            await resp.prepare(request)
+            await resp.write(b"hello ")
+            await resp.write(b"world")
+            await resp.write_eof()
+            return resp
+
+        async def go():
+            runner, port = await _server(chunked)
+            pool = H1Pool("127.0.0.1", port)
+            try:
+                resp = await pool.post("/echo", b"{}")
+                assert resp.body == b"hello world"
+            finally:
+                await pool.close()
+                await runner.cleanup()
+
+        run(go())
+
+    def test_connection_close_response(self):
+        async def close_after(request):
+            resp = web.json_response({"bye": True})
+            resp.headers["Connection"] = "close"
+            return resp
+
+        async def go():
+            runner, port = await _server(close_after)
+            pool = H1Pool("127.0.0.1", port)
+            try:
+                resp = await pool.post("/echo", b"{}")
+                assert resp.status == 200 and b"bye" in resp.body
+                assert pool._idle == []  # closed conns are not recycled
+            finally:
+                await pool.close()
+                await runner.cleanup()
+
+        run(go())
+
+    def test_fresh_connection_death_is_sent_error(self):
+        async def go():
+            async def kill(reader, writer):
+                await reader.read(64)  # request partially read, then die
+                writer.close()
+
+            server = await asyncio.start_server(kill, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            pool = H1Pool("127.0.0.1", port)
+            try:
+                with pytest.raises(H1SentError):
+                    await pool.post("/echo", b"{}")
+            finally:
+                await pool.close()
+                server.close()
+
+        run(go())
+
+
+class TestReplaySafety:
+    """Replay is allowed ONLY when a reused conn died before any response
+    byte; mid-response death must surface as H1SentError (the upstream may
+    have processed the request — replaying would duplicate it)."""
+
+    def test_mid_response_death_on_reused_conn_does_not_replay(self):
+        async def go():
+            served = {"n": 0}
+
+            async def handler(reader, writer):
+                # request 1: full response, keep-alive
+                await reader.readuntil(b"\r\n\r\n")
+                await reader.readexactly(2)  # body "{}"
+                served["n"] += 1
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok"
+                )
+                await writer.drain()
+                # request 2 on the SAME conn: status line then death
+                await reader.readuntil(b"\r\n\r\n")
+                await reader.readexactly(2)
+                served["n"] += 1
+                writer.write(b"HTTP/1.1 200 OK\r\ncontent-length: 99\r\n\r\npart")
+                await writer.drain()
+                writer.close()
+
+            server = await asyncio.start_server(handler, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            pool = H1Pool("127.0.0.1", port)
+            try:
+                resp = await pool.post("/echo", b"{}")
+                assert resp.status == 200 and resp.body == b"ok"
+                with pytest.raises(H1SentError):
+                    await pool.post("/echo", b"{}")
+                # the dead request was NOT replayed on a fresh connection
+                assert served["n"] == 2
+            finally:
+                await pool.close()
+                server.close()
+
+        run(go())
+
+    def test_timeout_covers_connect(self):
+        import time
+
+        async def go():
+            # RFC 5737 TEST-NET address: SYN-blackholed or refused depending
+            # on the network; whatever the failure mode, post() must fail
+            # within the deadline (the point: connect is INSIDE the budget)
+            pool = H1Pool("203.0.113.1", 81)
+            t0 = time.monotonic()
+            with pytest.raises((asyncio.TimeoutError, H1ConnectError, H1SentError)):
+                await pool.post("/echo", b"{}", timeout=1.0)
+            assert time.monotonic() - t0 < 5.0
+
+        run(go())
