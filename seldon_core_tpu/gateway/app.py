@@ -78,6 +78,7 @@ class GatewayApp:
         # h1client.py — a general-purpose client costs hundreds of µs of
         # feature machinery per hop, which is the proxy's entire budget)
         self._pools: dict[str, "H1Pool"] = {}
+        self._loop: asyncio.AbstractEventLoop | None = None
         self._paused = False
         # removed deployments lose their live tokens immediately
         store.add_listener(self._on_deployment_event)
@@ -88,9 +89,17 @@ class GatewayApp:
         if event in ("removed", "updated"):
             pool = self._pools.pop(rec.oauth_key, None)
             if pool is not None:
-                pool.evict()  # idle sockets close NOW, not on next recycle
+                # store events may fire on operator/poller threads; the
+                # pool's StreamWriters belong to the serving loop, so hop
+                # (same hazard the gRPC channel cache documents)
+                if self._loop is not None:
+                    self._loop.call_soon_threadsafe(pool.evict)
+                else:  # no loop yet -> no sockets were ever opened
+                    pool.evict()
 
     def _pool(self, rec: DeploymentRecord) -> "H1Pool":
+        if self._loop is None:
+            self._loop = asyncio.get_running_loop()
         pool = self._pools.get(rec.oauth_key)
         if pool is None:
             host = rec.engine_host or rec.name
@@ -248,7 +257,12 @@ class GatewayApp:
                 except json.JSONDecodeError as e:
                     code = 400
                     return _error(400, f"invalid JSON: {e}")
+                if not isinstance(body, dict):
+                    code = 400
+                    return _error(400, "body must be a JSON object")
             elif raw.lstrip()[:1] != b"{":
+                # same grammar as the parsed branch: the accepted language
+                # must not depend on whether a tap is configured
                 code = 400
                 return _error(400, "body must be a JSON object")
             try:

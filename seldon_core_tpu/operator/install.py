@@ -180,14 +180,113 @@ def gateway_rbac() -> list[dict[str, Any]]:
     ]
 
 
+def token_redis_manifests() -> list[dict[str, Any]]:
+    """Memory-only redis backing the gateway's shared token store, so N
+    gateway replicas accept each other's OAuth tokens (the reference
+    deploys redis for exactly this: redis-memonly/redis-memonly.json.in,
+    api-frontend/.../AuthorizationServerConfiguration.java:64-67)."""
+    return [
+        {
+            # bearer tokens transit this store: it MUST NOT be an open
+            # cluster service.  Rotate this password at install time
+            # (kubectl create secret ... --from-literal=password=$(openssl
+            # rand -hex 24) --dry-run=client -o yaml | kubectl apply -f -).
+            "apiVersion": "v1",
+            "kind": "Secret",
+            "metadata": _meta("seldon-token-redis-auth", component="token-store"),
+            "type": "Opaque",
+            "stringData": {"password": "rotate-me-at-install-time"},
+        },
+        {
+            # defense in depth: only gateway pods may reach the store
+            "apiVersion": "networking.k8s.io/v1",
+            "kind": "NetworkPolicy",
+            "metadata": _meta("seldon-token-redis", component="token-store"),
+            "spec": {
+                "podSelector": {
+                    "matchLabels": {"app.kubernetes.io/name": "seldon-token-redis"}
+                },
+                "policyTypes": ["Ingress"],
+                "ingress": [
+                    {
+                        "from": [
+                            {
+                                "podSelector": {
+                                    "matchLabels": {
+                                        "app.kubernetes.io/name": "seldon-gateway"
+                                    }
+                                }
+                            }
+                        ],
+                        "ports": [{"port": 6379, "protocol": "TCP"}],
+                    }
+                ],
+            },
+        },
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": _meta("seldon-token-redis", component="token-store"),
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app.kubernetes.io/name": "seldon-token-redis"}},
+                "template": {
+                    "metadata": {"labels": {"app.kubernetes.io/name": "seldon-token-redis"}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "redis",
+                                "image": "redis:7-alpine",
+                                "env": [_redis_password_env()],
+                                # tokens are reissuable: no persistence, cap
+                                # memory like the reference's memonly config
+                                "args": ["--requirepass", "$(REDIS_PASSWORD)",
+                                         "--save", "", "--appendonly", "no",
+                                         "--maxmemory", "64mb",
+                                         "--maxmemory-policy", "allkeys-lru"],
+                                "ports": [{"containerPort": 6379, "name": "redis"}],
+                                "resources": {
+                                    "requests": {"cpu": "50m", "memory": "96Mi"}
+                                },
+                            }
+                        ],
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": _meta("seldon-token-redis"),
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {"app.kubernetes.io/name": "seldon-token-redis"},
+                "ports": [{"port": 6379, "targetPort": 6379, "name": "redis"}],
+            },
+        },
+    ]
+
+
+def _redis_password_env() -> dict[str, Any]:
+    return {
+        "name": "REDIS_PASSWORD",
+        "valueFrom": {
+            "secretKeyRef": {"name": "seldon-token-redis-auth", "key": "password"}
+        },
+    }
+
+
 def gateway_manifests(image: str = GATEWAY_IMAGE) -> list[dict[str, Any]]:
     return [
+        *token_redis_manifests(),
         {
             "apiVersion": "apps/v1",
             "kind": "Deployment",
             "metadata": _meta("seldon-gateway", component="gateway"),
             "spec": {
-                "replicas": 1,
+                # 2 replicas by default — tokens ride the shared store, so
+                # any replica authenticates any client
+                "replicas": 2,
                 "selector": {"matchLabels": {"app.kubernetes.io/name": "seldon-gateway"}},
                 "template": {
                     "metadata": {
@@ -209,6 +308,14 @@ def gateway_manifests(image: str = GATEWAY_IMAGE) -> list[dict[str, Any]]:
                                 "env": [
                                     {"name": "GATEWAY_PORT", "value": str(GATEWAY_REST_PORT)},
                                     {"name": "GATEWAY_GRPC_PORT", "value": str(GATEWAY_GRPC_PORT)},
+                                    _redis_password_env(),
+                                    {
+                                        "name": "GATEWAY_TOKEN_STORE",
+                                        # k8s expands $(REDIS_PASSWORD) from
+                                        # the env var defined above
+                                        "value": "redis://:$(REDIS_PASSWORD)@"
+                                                 "seldon-token-redis.seldon-system:6379",
+                                    },
                                 ],
                                 "ports": [
                                     {"containerPort": GATEWAY_REST_PORT, "name": "rest"},

@@ -141,7 +141,12 @@ class RedisStateStore:
     """Reference-compatible Redis store (same keys, pickled values).  Only
     importable when the ``redis`` package is installed in the image."""
 
-    def __init__(self, host: str | None = None, port: int | None = None):
+    def __init__(
+        self,
+        host: str | None = None,
+        port: int | None = None,
+        password: str | None = None,
+    ):
         try:
             import redis  # noqa: PLC0415
         except ImportError as e:  # pragma: no cover - env without redis
@@ -151,7 +156,8 @@ class RedisStateStore:
             ) from e
         host = host or os.environ.get("REDIS_SERVICE_HOST", "localhost")
         port = int(port or os.environ.get("REDIS_SERVICE_PORT", 6379))
-        self._client = redis.StrictRedis(host=host, port=port)
+        password = password or os.environ.get("REDIS_PASSWORD") or None
+        self._client = redis.StrictRedis(host=host, port=port, password=password)
 
     def get(self, key: str) -> bytes | None:
         return self._client.get(key)
@@ -177,9 +183,18 @@ def store_from_env(environ: dict | None = None) -> StateStore:
     if raw == "memory":
         return MemoryStateStore()
     if raw.startswith("redis://"):
+        # redis://[[user]:password@]host[:port] — auth'd stores keep tokens
+        # off the open cluster network (deploy/gateway.yaml pairs this with
+        # --requirepass)
         rest = raw[len("redis://"):]
+        password = None
+        if "@" in rest:
+            cred, _, rest = rest.rpartition("@")
+            password = cred.partition(":")[2] or cred or None
         host, _, port = rest.partition(":")
-        return RedisStateStore(host or None, int(port) if port else None)
+        return RedisStateStore(
+            host or None, int(port) if port else None, password=password
+        )
     if raw.startswith("file:"):
         return FileStateStore(raw[len("file:"):])
     if raw:

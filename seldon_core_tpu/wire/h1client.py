@@ -57,13 +57,20 @@ _CRLF = b"\r\n"
 class H1Pool:
     """Keep-alive connection pool to one upstream."""
 
-    def __init__(self, host: str, port: int, limit: int = 64):
+    def __init__(
+        self, host: str, port: int, limit: int = 64, max_conns: int = 512
+    ):
         self.host = host
         self.port = port
-        self.limit = limit
+        self.limit = limit  # idle sockets kept for reuse
         self._idle: list[tuple[asyncio.StreamReader, asyncio.StreamWriter]] = []
         self._host_hdr = f"{host}:{port}".encode()
         self._closed = False
+        # total concurrent requests (and hence sockets) — a burst must not
+        # exhaust fds or flood the upstream's accept queue; excess callers
+        # queue on the semaphore (created lazily: it binds to the loop)
+        self._max_conns = max_conns
+        self._sem: asyncio.Semaphore | None = None
 
     async def _open(self):
         try:
@@ -123,6 +130,16 @@ class H1Pool:
         def remaining() -> float:
             return max(0.001, deadline - loop.time())
 
+        if self._sem is None:
+            self._sem = asyncio.Semaphore(self._max_conns)
+        # the queue wait spends the same budget as the request itself
+        await asyncio.wait_for(self._sem.acquire(), remaining())
+        try:
+            return await self._post_locked(path, body, headers, remaining)
+        finally:
+            self._sem.release()
+
+    async def _post_locked(self, path, body, headers, remaining) -> H1Response:
         req = self._request_bytes(path, body, headers)
         reused = bool(self._idle)
         conn = (

@@ -258,3 +258,88 @@ class TestGatewayGrpc:
             payload_from_proto(good).array, [[0.1, 0.9, 0.5]]
         )
         assert bad.status.status == pb.Status.FAILURE
+
+
+class TestMultiReplicaTokens:
+    """deploy/gateway.yaml runs 2 replicas with GATEWAY_TOKEN_STORE set —
+    a token issued by one replica must authenticate at the other (the
+    reference backs its apife token store with redis for the same reason,
+    redis-memonly/)."""
+
+    def test_token_roams_between_replicas(self, tmp_path):
+        from seldon_core_tpu.gateway.auth import SharedTokenStore
+        from seldon_core_tpu.runtime.persistence import store_from_env
+
+        def shared_tokens():
+            return SharedTokenStore(
+                store_from_env({"PERSISTENCE_STORE": f"file:{tmp_path / 'tok'}"})
+            )
+
+        async def go():
+            engine = await _engine_client()
+            port = engine.server.port
+            rec = DeploymentRecord(
+                name="dep", oauth_key="key1", oauth_secret="sec1",
+                engine_host="127.0.0.1", engine_rest_port=port,
+            )
+            replicas = []
+            for _ in range(2):
+                store = DeploymentStore()
+                store.put(rec)
+                gwapp = GatewayApp(store, tokens=shared_tokens())
+                client = TestClient(TestServer(gwapp.build()))
+                await client.start_server()
+                replicas.append((client, gwapp))
+            try:
+                a, b = replicas[0][0], replicas[1][0]
+                resp = await a.post(
+                    "/oauth/token",
+                    data={"client_id": "key1", "client_secret": "sec1"},
+                )
+                token = (await resp.json())["access_token"]
+                # the OTHER replica accepts it
+                resp = await b.post(
+                    "/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0, 2.0]]}},
+                    headers={"Authorization": f"Bearer {token}"},
+                )
+                assert resp.status == 200, await resp.text()
+                body = await resp.json()
+                assert body["status"]["status"] == "SUCCESS"
+                # a bogus token still bounces everywhere
+                resp = await b.post(
+                    "/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0]]}},
+                    headers={"Authorization": "Bearer nope"},
+                )
+                assert resp.status == 401
+            finally:
+                for client, gwapp in replicas:
+                    await gwapp.close()
+                    await client.close()
+                await engine.close()
+
+        run(go())
+
+    def test_rendered_gateway_wires_the_store(self):
+        from seldon_core_tpu.operator.install import gateway_manifests
+
+        manifests = gateway_manifests()
+        dep = next(
+            m for m in manifests
+            if m["kind"] == "Deployment"
+            and m["metadata"]["name"] == "seldon-gateway"
+        )
+        assert dep["spec"]["replicas"] >= 2
+        entries = dep["spec"]["template"]["spec"]["containers"][0]["env"]
+        env = {e["name"]: e.get("value") for e in entries}
+        assert env["GATEWAY_TOKEN_STORE"].startswith("redis://:$(REDIS_PASSWORD)@")
+        assert "seldon-token-redis" in env["GATEWAY_TOKEN_STORE"]
+        # the password itself rides a secretKeyRef, never a literal
+        pw = next(e for e in entries if e["name"] == "REDIS_PASSWORD")
+        assert pw["valueFrom"]["secretKeyRef"]["name"] == "seldon-token-redis-auth"
+        redis = [
+            m for m in manifests
+            if m["metadata"]["name"] == "seldon-token-redis"
+        ]
+        assert {m["kind"] for m in redis} == {"Deployment", "Service", "NetworkPolicy"}

@@ -1,0 +1,69 @@
+# seldon-core-tpu R microservice runtime.
+#
+# Adapts a user model file to the prediction wire contract the engine's
+# REST NodeClient speaks (contract/codec.py; reference analogue:
+# /root/reference/wrappers/s2i/R/microservice.R — a plumber app exposing
+# /predict over the same SeldonMessage JSON).
+#
+# User contract: a `model.R` in the working directory defining
+#
+#     predict_model <- function(X) { ... }   # matrix in -> matrix out
+#     # optional: names_out <- c("class0", ...)
+#
+# Serve: Rscript microservice.R   (port from PREDICTIVE_UNIT_SERVICE_PORT)
+
+library(plumber)
+library(jsonlite)
+
+source("model.R")
+
+extract_batch <- function(body) {
+  data <- body$data
+  if (!is.null(data$ndarray)) {
+    X <- do.call(rbind, lapply(data$ndarray, unlist))
+  } else if (!is.null(data$tensor)) {
+    shape <- unlist(data$tensor$shape)
+    X <- matrix(unlist(data$tensor$values), nrow = shape[1], byrow = TRUE)
+  } else {
+    stop("request carries neither ndarray nor tensor")
+  }
+  storage.mode(X) <- "double"
+  X
+}
+
+predict_handler <- function(req, res) {
+  body <- tryCatch(fromJSON(req$postBody, simplifyVector = FALSE),
+                   error = function(e) NULL)
+  if (is.null(body)) {
+    res$status <- 400
+    return(list(status = list(code = 400, info = "invalid JSON",
+                              status = "FAILURE")))
+  }
+  tryCatch({
+    X <- extract_batch(body)
+    Y <- predict_model(X)
+    if (is.vector(Y)) Y <- matrix(Y, nrow = nrow(X))
+    nms <- if (exists("names_out")) as.list(names_out) else list()
+    list(
+      meta = list(),
+      data = list(names = nms,
+                  ndarray = lapply(seq_len(nrow(Y)), function(i) as.list(Y[i, ]))),
+      status = list(code = 200, status = "SUCCESS")
+    )
+  }, error = function(e) {
+    res$status <- 500
+    list(status = list(code = 500, info = conditionMessage(e),
+                       status = "FAILURE"))
+  })
+}
+
+port <- as.integer(Sys.getenv("PREDICTIVE_UNIT_SERVICE_PORT", "9000"))
+app <- plumber::pr()
+app <- plumber::pr_post(app, "/predict", predict_handler,
+                        serializer = plumber::serializer_unboxed_json())
+app <- plumber::pr_get(app, "/ping", function() "pong",
+                       serializer = plumber::serializer_text())
+app <- plumber::pr_get(app, "/health/status",
+                       function() list(status = "ok"),
+                       serializer = plumber::serializer_unboxed_json())
+plumber::pr_run(app, host = "0.0.0.0", port = port)
