@@ -30,10 +30,14 @@ from seldon_core_tpu.operator.crd import CRD_GROUP
 from seldon_core_tpu.operator.kube_http import crd_manifest
 from seldon_core_tpu.operator.resources import ENGINE_GRPC_PORT, ENGINE_REST_PORT
 
+from seldon_core_tpu import __version__ as VERSION
+
 NAMESPACE = "seldon-system"
-OPERATOR_IMAGE = "seldon-core-tpu/operator:latest"
-GATEWAY_IMAGE = "seldon-core-tpu/gateway:latest"
-TAP_IMAGE = "seldon-core-tpu/tap-broker:latest"
+# images pin to the release version (stamped by sct-release), not :latest —
+# a restarted pod must not silently pick up a new build
+OPERATOR_IMAGE = f"seldon-core-tpu/operator:{VERSION}"
+GATEWAY_IMAGE = f"seldon-core-tpu/gateway:{VERSION}"
+TAP_IMAGE = f"seldon-core-tpu/tap-broker:{VERSION}"
 
 GATEWAY_REST_PORT = 8080
 GATEWAY_GRPC_PORT = 5000
@@ -187,17 +191,6 @@ def token_redis_manifests() -> list[dict[str, Any]]:
     api-frontend/.../AuthorizationServerConfiguration.java:64-67)."""
     return [
         {
-            # bearer tokens transit this store: it MUST NOT be an open
-            # cluster service.  Rotate this password at install time
-            # (kubectl create secret ... --from-literal=password=$(openssl
-            # rand -hex 24) --dry-run=client -o yaml | kubectl apply -f -).
-            "apiVersion": "v1",
-            "kind": "Secret",
-            "metadata": _meta("seldon-token-redis-auth", component="token-store"),
-            "type": "Opaque",
-            "stringData": {"password": "rotate-me-at-install-time"},
-        },
-        {
             # defense in depth: only gateway pods may reach the store
             "apiVersion": "networking.k8s.io/v1",
             "kind": "NetworkPolicy",
@@ -268,6 +261,12 @@ def token_redis_manifests() -> list[dict[str, Any]]:
 
 
 def _redis_password_env() -> dict[str, Any]:
+    # the Secret is NOT part of install.yaml: shipping a literal password
+    # in a public manifest would make every install share it, and
+    # re-applying the file would reset a rotated one.  Operators create it
+    # once (deploy/README.md):
+    #   kubectl -n seldon-system create secret generic \
+    #     seldon-token-redis-auth --from-literal=password=$(openssl rand -hex 24)
     return {
         "name": "REDIS_PASSWORD",
         "valueFrom": {

@@ -34,7 +34,9 @@ from seldon_core_tpu.operator.names import (
 )
 from seldon_core_tpu.operator.tpu import TpuSpec
 
-ENGINE_IMAGE_DEFAULT = "seldon-core-tpu/engine:latest"
+from seldon_core_tpu import __version__ as _VERSION
+
+ENGINE_IMAGE_DEFAULT = f"seldon-core-tpu/engine:{_VERSION}"
 ENGINE_REST_PORT = 8000
 ENGINE_GRPC_PORT = 5001
 # health/drain/metrics are served on the REST port (the reference used a

@@ -1,0 +1,165 @@
+"""``sct-release`` — version stamping and changelog generation.
+
+The reference ships ``release.py`` (rewrites version strings across pom/
+package files) and ``create-changelog`` (git-log -> changelog); this is
+both for this repo's surfaces:
+
+    sct-release show                   # current version + surface audit
+    sct-release set 0.2.0              # stamp every surface + re-render
+    sct-release changelog              # CHANGELOG.md from git history
+    sct-release tag                    # annotated git tag v<version>
+
+Version surfaces (kept consistent, pinned by tests/test_release.py):
+
+- ``pyproject.toml``            [project] version
+- ``seldon_core_tpu/__init__.py``  ``__version__``
+- ``operator/install.py``       image tags -> re-rendered ``deploy/*.yaml``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+_VERSION_RE = re.compile(r"^\d+\.\d+\.\d+(?:[.-]?(?:rc|a|b|dev)\d*)?$")
+
+
+def repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _surfaces(root: str) -> dict[str, tuple[str, re.Pattern]]:
+    # image tags (operator/install.py, operator/resources.py) derive from
+    # __version__ at import time, so two stamped files cover everything
+    return {
+        "pyproject.toml": (
+            os.path.join(root, "pyproject.toml"),
+            re.compile(r'(?m)^version = "([^"]+)"$'),
+        ),
+        "seldon_core_tpu/__init__.py": (
+            os.path.join(root, "seldon_core_tpu", "__init__.py"),
+            re.compile(r'(?m)^__version__ = "([^"]+)"$'),
+        ),
+    }
+
+
+def read_versions(root: str | None = None) -> dict[str, str]:
+    root = root or repo_root()
+    out = {}
+    for name, (path, pat) in _surfaces(root).items():
+        m = pat.search(open(path).read())
+        out[name] = m.group(1) if m else "<missing>"
+    return out
+
+
+def set_version(version: str, root: str | None = None) -> list[str]:
+    """Stamp every surface and re-render deploy manifests.  Returns the
+    files touched."""
+    if not _VERSION_RE.match(version):
+        raise SystemExit(f"not a valid version: {version!r}")
+    root = root or repo_root()
+    touched = []
+    for name, (path, pat) in _surfaces(root).items():
+        src = open(path).read()
+        new, n = pat.subn(
+            lambda m: m.group(0).replace(m.group(1), version), src
+        )
+        if n != 1:
+            raise SystemExit(f"expected exactly one version in {name}, found {n}")
+        if new != src:
+            open(path, "w").write(new)
+            touched.append(name)
+    # image tags follow __version__ at import time, so the render must run
+    # in a FRESH process (this one already imported the old constant)
+    subprocess.run(
+        [sys.executable, "-m", "seldon_core_tpu.operator.install",
+         "--out", os.path.join(root, "deploy")],
+        check=True,
+        cwd=root,
+    )
+    touched.append("deploy/*.yaml")
+    return touched
+
+
+def changelog(root: str | None = None) -> str:
+    """Markdown changelog: commits grouped under each tag (newest first),
+    the reference's ``create-changelog`` as a function."""
+    root = root or repo_root()
+
+    def git(*args: str) -> str:
+        return subprocess.run(
+            ["git", *args], cwd=root, capture_output=True, text=True, check=True
+        ).stdout
+
+    tags = [t for t in git("tag", "--sort=-creatordate").splitlines() if t]
+    sections: list[tuple[str, str, str]] = []  # (title, range, date)
+    bounds = ["HEAD", *tags]
+    for i, upper in enumerate(bounds):
+        lower = bounds[i + 1] if i + 1 < len(bounds) else None
+        rng = f"{lower}..{upper}" if lower else upper
+        title = "Unreleased" if upper == "HEAD" else upper
+        date = (
+            git("log", "-1", "--format=%as", upper).strip() if upper != "HEAD" else ""
+        )
+        sections.append((title, rng, date))
+    lines = ["# Changelog", ""]
+    for title, rng, date in sections:
+        subjects = [
+            s for s in git("log", "--format=%s", rng).splitlines() if s
+        ]
+        if not subjects:
+            continue
+        header = f"## {title}" + (f" ({date})" if date else "")
+        lines += [header, ""]
+        lines += [f"- {s}" for s in subjects]
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("show")
+    p_set = sub.add_parser("set")
+    p_set.add_argument("version")
+    p_log = sub.add_parser("changelog")
+    p_log.add_argument("--out", default="CHANGELOG.md")
+    sub.add_parser("tag")
+    args = ap.parse_args(argv)
+
+    root = repo_root()
+    if args.cmd == "show":
+        versions = read_versions(root)
+        consistent = len(set(versions.values())) == 1
+        for name, v in versions.items():
+            print(f"{v:12} {name}")
+        if not consistent:
+            raise SystemExit("version surfaces DISAGREE — run sct-release set")
+    elif args.cmd == "set":
+        for name in set_version(args.version, root):
+            print(f"stamped {name}")
+    elif args.cmd == "changelog":
+        text = changelog(root)
+        path = os.path.join(root, args.out)
+        with open(path, "w") as f:
+            f.write(text)
+        print(f"wrote {path} ({len(text.splitlines())} lines)")
+    elif args.cmd == "tag":
+        versions = read_versions(root)
+        if len(set(versions.values())) != 1:
+            raise SystemExit("version surfaces disagree; run sct-release set first")
+        v = next(iter(versions.values()))
+        subprocess.run(
+            ["git", "tag", "-a", f"v{v}", "-m", f"release {v}"],
+            cwd=root, check=True,
+        )
+        print(f"tagged v{v}")
+
+
+if __name__ == "__main__":
+    main()
