@@ -162,18 +162,19 @@ def stage_mlp(detail: dict) -> float | None:
     """Headline: real MLP on TPU through the engine REST wire."""
     from seldon_core_tpu.testing.loadtest import run_load
 
-    rows = int(os.environ.get("BENCH_MLP_ROWS", "128"))
-    conc = int(os.environ.get("BENCH_CONCURRENCY", "48"))
+    rows = int(os.environ.get("BENCH_MLP_ROWS", "256"))
+    conc = int(os.environ.get("BENCH_CONCURRENCY", "64"))
     graph = {
         "name": "mlp", "type": "MODEL", "implementation": "JAX_MODEL",
         "parameters": [
             {"name": "family", "value": "mlp", "type": "STRING"},
             {"name": "dtype", "value": "bfloat16", "type": "STRING"},
             # big buckets amortize the tunnel's fixed per-call cost (the
-            # execute+fetch round trip dominates; device compute is sub-ms)
-            {"name": "buckets", "value": "256,1024", "type": "STRING"},
-            {"name": "max_batch", "value": "1024", "type": "INT"},
-            {"name": "max_delay_ms", "value": "2.0", "type": "FLOAT"},
+            # execute+fetch round trip dominates; device compute is ~5ms
+            # even at 2048 rows — roofline: 886k rows/s at batch 4096)
+            {"name": "buckets", "value": "256,2048", "type": "STRING"},
+            {"name": "max_batch", "value": "2048", "type": "INT"},
+            {"name": "max_delay_ms", "value": "3.0", "type": "FLOAT"},
         ],
     }
     with engine(graph, 18800, 18801):
@@ -406,15 +407,17 @@ def stage_llm_1b(detail: dict) -> None:
     from seldon_core_tpu.testing.loadtest import run_load
 
     max_new = 64
+    slots = int(os.environ.get("BENCH_LLM1B_SLOTS", "16"))
     dev = _roofline(["--family", "llama", "--preset", "llama3-1b",
-                     "--generative", "--n-slots", "8", "--decode-block", "16"])
+                     "--generative", "--n-slots", str(slots),
+                     "--decode-block", "16"])
     graph = {
         "name": "gen1b", "type": "MODEL", "implementation": "JAX_GENERATIVE",
         "parameters": [
             {"name": "family", "value": "llama", "type": "STRING"},
             {"name": "preset", "value": "llama3-1b", "type": "STRING"},
             {"name": "dtype", "value": "bfloat16", "type": "STRING"},
-            {"name": "n_slots", "value": "8", "type": "INT"},
+            {"name": "n_slots", "value": str(slots), "type": "INT"},
             {"name": "max_new_tokens", "value": str(max_new), "type": "INT"},
             {"name": "decode_block", "value": "16", "type": "INT"},
             # short context for the bench: every prefill bucket compiles at
@@ -428,7 +431,7 @@ def stage_llm_1b(detail: dict) -> None:
     with engine(graph, 18860, 18861, ready_timeout=900.0):
         r = run_load(
             "http://127.0.0.1:18860/api/v0.1/predictions", [body],
-            concurrency=8, duration_s=SECONDS * 2,
+            concurrency=slots * 2, duration_s=SECONDS * 2,
         )
         stream = _sse_ttft(
             "http://127.0.0.1:18860/api/v0.1/predictions/stream",

@@ -1,0 +1,18 @@
+# Iris scorer in R — served by the wrappers/r runtime (plumber).
+# Hand-fitted linear scores, softmax over 3 classes; mirrors
+# examples/iris/IrisClassifier.py so the two runtimes are comparable.
+
+W <- matrix(c(
+   0.4,  1.3, -2.0, -0.9,
+   0.3, -0.5,  0.1, -0.8,
+  -0.7, -1.2,  2.1,  2.2
+), nrow = 3, byrow = TRUE)
+b <- c(0.8, 1.5, -2.3)
+
+names_out <- c("setosa", "versicolor", "virginica")
+
+predict_model <- function(X) {
+  scores <- X %*% t(W) + matrix(b, nrow(X), 3, byrow = TRUE)
+  e <- exp(scores - apply(scores, 1, max))
+  e / rowSums(e)
+}

@@ -1,0 +1,43 @@
+"""Transformer pipeline units: standardize -> model -> label decode.
+
+The reference's example pipelines chain an input TRANSFORMER, a MODEL and
+an OUTPUT_TRANSFORMER (reference: examples/transformers/ — mean
+transformer + model); this is that shape with in-process components.
+Serve each class with `sct-microservice <Name> REST --service-type
+TRANSFORMER` (etc.) or compose them in one engine graph (see graph.json).
+"""
+
+import numpy as np
+
+
+class Standardize:
+    """Input TRANSFORMER: (x - mean) / std with fixed training stats."""
+
+    MEAN = np.array([5.8, 3.0, 3.8, 1.2])
+    STD = np.array([0.8, 0.4, 1.8, 0.8])
+
+    def transform_input(self, X, names):
+        return (np.asarray(X, float) - self.MEAN) / self.STD
+
+
+class Scorer:
+    """MODEL: linear scorer over standardized features."""
+
+    W = np.array([
+        [0.4, 1.3, -2.0, -0.9],
+        [0.3, -0.5, 0.1, -0.8],
+        [-0.7, -1.2, 2.1, 2.2],
+    ])
+    b = np.array([0.8, 1.5, -2.3])
+
+    def predict(self, X, names):
+        scores = np.asarray(X, float) @ self.W.T + self.b
+        e = np.exp(scores - scores.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+
+class ArgmaxLabel:
+    """OUTPUT_TRANSFORMER: probabilities -> winning class index."""
+
+    def transform_output(self, X, names):
+        return np.asarray(X).argmax(axis=1).reshape(-1, 1).astype(float)

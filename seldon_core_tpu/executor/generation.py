@@ -171,13 +171,18 @@ class GenerativeModel:
             tok = fam.sample_tokens(logits[None], temperature[None], key)[0]
             return _replicate(tok), cache
 
-        def _decode(params, tokens, active, temperature, seed, cache):
-            logits, cache = fam.decode_slots(params, tokens, cache, active, cfg)
-            key = jax.random.PRNGKey(seed)
-            toks = fam.sample_tokens(logits, temperature, key)
-            return _replicate(toks), cache
+        def _decode(window):
+            def fn(params, tokens, active, temperature, seed, cache):
+                logits, cache = fam.decode_slots(
+                    params, tokens, cache, active, cfg, window=window
+                )
+                key = jax.random.PRNGKey(seed)
+                toks = fam.sample_tokens(logits, temperature, key)
+                return _replicate(toks), cache
 
-        def _decode_k(k):
+            return fn
+
+        def _decode_k(k, window):
             """k decode steps in ONE device dispatch (lax.scan), with
             per-slot eos/budget early exit ON DEVICE.  One host round trip
             per k tokens instead of per token — the difference between 30
@@ -195,7 +200,7 @@ class GenerativeModel:
                     def run(args):
                         tokens, active, remaining, cache = args
                         logits, cache2 = fam.decode_slots(
-                            params, tokens, cache, active, cfg
+                            params, tokens, cache, active, cfg, window=window
                         )
                         key = jax.random.fold_in(base_key, i)
                         toks = fam.sample_tokens(logits, temperature, key)
@@ -222,9 +227,17 @@ class GenerativeModel:
         # cache buffers are donated: each step reuses the previous buffers
         # in place instead of holding two live copies of a multi-GB cache
         self._prefill = jax.jit(_prefill, donate_argnums=(6,))
-        self._decode = jax.jit(_decode, donate_argnums=(5,))
+        self._decode_factory = _decode
+        self._decode_jit: dict[int, Any] = {}  # window -> jitted step
         self._decode_k_factory = _decode_k
-        self._decode_k_jit: dict[int, Any] = {}
+        self._decode_k_jit: dict[tuple[int, int], Any] = {}  # (k, window)
+        # host-side per-slot position CEILING (>= true device position; the
+        # device may stop early on eos).  Drives the attention-window bucket:
+        # decode reads only cache rows [0, window) — the bandwidth bill once
+        # contexts are long — so each block attends over the smallest
+        # power-of-two covering the live positions (models/llama.py
+        # decode_slots docstring has the numbers).
+        self._pos_ceiling = np.zeros(self.n_slots, np.int64)
         if self.driver is not None:
             # symmetric SPMD step bodies for the follower loop; the k value
             # rides the payload so any block size stays in lockstep
@@ -296,6 +309,7 @@ class GenerativeModel:
             "temperature": float(temperature),
             "seed": int(seed),
         }
+        self._pos_ceiling[int(slot)] = L  # prefill wrote rows [0, L)
         if self.driver is not None:
             return self.driver.lead(self._mh_prefill_key, payload)
         return self._exec_prefill(payload)
@@ -305,9 +319,27 @@ class GenerativeModel:
         sampled token."""
         return int(self.admit_dispatch(slot, prompt, temperature, seed))
 
+    def _window_for(self, active: np.ndarray, extra: int) -> int:
+        """Smallest power-of-two cache window covering every ACTIVE slot's
+        position ceiling after ``extra`` more tokens (min 64, capped at
+        max_seq).  Computed on the coordinator and shipped in the payload so
+        every host compiles the same static shape."""
+        act = np.asarray(active, bool)
+        hi = int(self._pos_ceiling[act].max()) if act.any() else 0
+        need = hi + extra + 1
+        w = 64
+        while w < need:
+            w *= 2
+        return min(w, self.cfg.max_seq)
+
     def _exec_decode(self, payload: dict):
+        window = int(payload.get("window") or self.cfg.max_seq)
+        fn = self._decode_jit.get(window)
+        if fn is None:
+            fn = jax.jit(self._decode_factory(window), donate_argnums=(5,))
+            self._decode_jit[window] = fn
         with self._lock:
-            toks, self._cache = self._decode(
+            toks, self._cache = fn(
                 self.params,
                 np.asarray(payload["tokens"], np.int32),
                 np.asarray(payload["active"], bool),
@@ -324,6 +356,7 @@ class GenerativeModel:
         active: np.ndarray,
         temperature: np.ndarray,
         seed: int,
+        window: int | None = None,
     ) -> np.ndarray:
         """One decode step for all slots -> next token per slot (S,)."""
         payload = {
@@ -331,11 +364,13 @@ class GenerativeModel:
             "active": np.asarray(active, bool),
             "temperature": np.asarray(temperature, np.float32),
             "seed": int(seed),
+            "window": window or self._window_for(active, 1),
         }
         if self.driver is not None:
             toks = self.driver.lead(self._mh_decode_key, payload)
         else:
             toks = self._exec_decode(payload)
+        self._pos_ceiling[np.asarray(active, bool)] += 1
         return np.asarray(jax.device_get(toks))
 
     def step_k(
@@ -347,6 +382,7 @@ class GenerativeModel:
         eos: np.ndarray,
         remaining: np.ndarray,
         k: int,
+        window: int | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """``k`` decode steps in one dispatch -> ``(k, S)`` sampled tokens
         plus the ``(k, S)`` was-active-at-step mask that says which of them
@@ -361,11 +397,13 @@ class GenerativeModel:
             "eos": np.asarray(eos, np.int32),
             "remaining": np.asarray(remaining, np.int32),
             "k": int(k),
+            "window": window or self._window_for(active, k),
         }
         if self.driver is not None:
             toks_seq, act_seq = self.driver.lead(self._mh_decode_k_key, payload)
         else:
             toks_seq, act_seq = self._exec_decode_k(payload)
+        self._pos_ceiling[np.asarray(active, bool)] += k
         # ONE device_get for both arrays: two separate fetches would pay two
         # host round trips per block on a tunnel-attached chip
         toks_np, act_np = jax.device_get((toks_seq, act_seq))
@@ -373,10 +411,12 @@ class GenerativeModel:
 
     def _exec_decode_k(self, payload: dict):
         k = int(payload["k"])
-        fn = self._decode_k_jit.get(k)
+        window = int(payload.get("window") or self.cfg.max_seq)
+        key = (k, window)
+        fn = self._decode_k_jit.get(key)
         if fn is None:
-            fn = jax.jit(self._decode_k_factory(k), donate_argnums=(7,))
-            self._decode_k_jit[k] = fn
+            fn = jax.jit(self._decode_k_factory(k, window), donate_argnums=(7,))
+            self._decode_k_jit[key] = fn
         with self._lock:
             toks_seq, act_seq, self._cache = fn(
                 self.params,
@@ -408,27 +448,44 @@ class GenerativeModel:
             for b in self.prefill_buckets:
                 self.admit(0, np.ones(b, np.int32), 0.0, 0)
                 n += 1
-            self.step(
-                np.zeros(self.n_slots, np.int32),
-                np.zeros(self.n_slots, bool),
-                np.zeros(self.n_slots, np.float32),
-                0,
-            )
-            n += 1
-            if self.decode_block > 1:
-                self.step_k(
-                    np.zeros(self.n_slots, np.int32),
-                    np.zeros(self.n_slots, bool),
-                    np.zeros(self.n_slots, np.float32),
-                    0,
-                    np.full(self.n_slots, -1, np.int32),
-                    np.zeros(self.n_slots, np.int32),
-                    self.decode_block,
-                )
+            # every attention-window bucket compiles up front: a window
+            # first hit mid-serving would stall that decode block for the
+            # compile (seconds on a big model), wrecking its requests' p99.
+            # Only the program the scheduler will actually run compiles —
+            # step_k when decode_block > 1, the single-token step otherwise.
+            for w in self._window_buckets():
+                if self.decode_block > 1:
+                    self.step_k(
+                        np.zeros(self.n_slots, np.int32),
+                        np.zeros(self.n_slots, bool),
+                        np.zeros(self.n_slots, np.float32),
+                        0,
+                        np.full(self.n_slots, -1, np.int32),
+                        np.zeros(self.n_slots, np.int32),
+                        self.decode_block,
+                        window=w,
+                    )
+                else:
+                    self.step(
+                        np.zeros(self.n_slots, np.int32),
+                        np.zeros(self.n_slots, bool),
+                        np.zeros(self.n_slots, np.float32),
+                        0,
+                        window=w,
+                    )
                 n += 1
             # warmup wrote garbage into slot 0 and advanced nothing real
             self.reset()
             return n
+
+    def _window_buckets(self) -> list[int]:
+        out = []
+        w = 64
+        while w < self.cfg.max_seq:
+            out.append(w)
+            w *= 2
+        out.append(self.cfg.max_seq)
+        return out
 
     def _exec_reset(self, payload: dict) -> None:
         with self._lock:
@@ -439,6 +496,7 @@ class GenerativeModel:
 
     def reset(self) -> None:
         """Zero every slot position (cache contents become unreachable)."""
+        self._pos_ceiling[:] = 0
         if self.driver is not None:
             self.driver.lead(self._mh_reset_key, {})
             return

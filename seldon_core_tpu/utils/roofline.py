@@ -248,15 +248,18 @@ def generative_roofline(
 
     # time the decode-k program directly at full slot occupancy;
     # _exec_decode_k returns device arrays, so steps pipeline and one final
-    # block amortizes the host/tunnel round trip out of the measurement
+    # block amortizes the host/tunnel round trip out of the measurement.
+    # The attention window is what serving would pick for these positions.
+    active = np.ones(n_slots, bool)
     payload = {
         "tokens": np.asarray(last, np.int32),
-        "active": np.ones(n_slots, bool),
+        "active": active,
         "temperature": np.zeros(n_slots, np.float32),
         "seed": 0,
         "eos": np.full(n_slots, -1, np.int32),
         "remaining": np.full(n_slots, 1 << 30, np.int32),
         "k": decode_block,
+        "window": model._window_for(active, decode_block),
     }
     sec = measure_step_time(
         lambda _x: model._exec_decode_k(payload)[0],

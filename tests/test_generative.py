@@ -109,9 +109,12 @@ class TestSlotPrimitives:
         cfg, params = tiny
         model = GenerativeModel(cfg, params, n_slots=2)
         n = model.warmup()
-        # prefill buckets + the single-step decode + the decode_block scan
-        assert n == len(model.prefill_buckets) + 2
+        # prefill buckets + the serving decode program (step_k here, since
+        # decode_block > 1) per attention-window bucket
+        assert n == len(model.prefill_buckets) + len(model._window_buckets())
         assert np.all(np.asarray(model._cache["pos"]) == 0)
+        # the programs serving will run are the ones compiled
+        assert model._decode_k_jit and not model._decode_jit
 
 
 class TestScheduler:

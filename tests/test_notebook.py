@@ -1,5 +1,5 @@
-"""The walkthrough notebook must actually run — the reference's notebooks
-were its de-facto integration suite (SURVEY §4), so ours is executable too."""
+"""The notebooks must actually run — the reference's notebooks were its
+de-facto integration suite (SURVEY §4), so ours are executable too."""
 
 import os
 
@@ -7,14 +7,19 @@ import nbformat
 import pytest
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-NB = os.path.join(REPO_ROOT, "notebooks", "serving_walkthrough.ipynb")
+NOTEBOOKS = [
+    "serving_walkthrough.ipynb",
+    "graphs_and_canary.ipynb",
+]
 
 
 @pytest.mark.slow
-def test_walkthrough_notebook_executes():
-    nb = nbformat.read(NB, as_version=4)
+@pytest.mark.parametrize("name", NOTEBOOKS)
+def test_notebook_executes(name):
+    path = os.path.join(REPO_ROOT, "notebooks", name)
+    nb = nbformat.read(path, as_version=4)
     # execute the code cells in one namespace, like a kernel would
     ns: dict = {}
     for cell in nb.cells:
         if cell.cell_type == "code":
-            exec(compile("".join(cell.source), NB, "exec"), ns)  # noqa: S102
+            exec(compile("".join(cell.source), path, "exec"), ns)  # noqa: S102
