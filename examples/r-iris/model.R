@@ -1,18 +1,19 @@
 # Iris scorer in R — served by the wrappers/r runtime (plumber).
-# Hand-fitted linear scores, softmax over 3 classes; mirrors
-# examples/iris/IrisClassifier.py so the two runtimes are comparable.
+# EXACTLY the coefficients of examples/iris/IrisClassifier.py (pinned equal
+# by tests/test_examples.py), so the python and R runtimes answer the same.
 
+# rows: setosa, versicolor, virginica; cols: sepal_l, sepal_w, petal_l,
+# petal_w, bias
 W <- matrix(c(
-   0.4,  1.3, -2.0, -0.9,
-   0.3, -0.5,  0.1, -0.8,
-  -0.7, -1.2,  2.1,  2.2
+   0.4,  1.4, -2.2, -1.0,  0.3,
+   0.4, -1.6,  0.4, -1.3,  1.2,
+  -1.7, -1.5,  2.4,  2.4, -1.0
 ), nrow = 3, byrow = TRUE)
-b <- c(0.8, 1.5, -2.3)
 
 names_out <- c("setosa", "versicolor", "virginica")
 
 predict_model <- function(X) {
-  scores <- X %*% t(W) + matrix(b, nrow(X), 3, byrow = TRUE)
+  scores <- X %*% t(W[, 1:4]) + matrix(W[, 5], nrow(X), 3, byrow = TRUE)
   e <- exp(scores - apply(scores, 1, max))
   e / rowSums(e)
 }

@@ -87,13 +87,31 @@ class TestRExample:
             assert os.path.exists(os.path.join(ctx, f)), f
 
     def test_r_scores_match_python_iris(self):
-        """The R model's coefficients are the python iris example's — pin
-        them equal so the two stay comparable."""
+        """The R model must BE the python iris model: parse the R weight
+        matrix out of model.R and check it equals IrisClassifier's _W, then
+        check a prediction agrees."""
+        import re
+
         src = open(
             os.path.join(REPO_ROOT, "examples", "r-iris", "model.R")
         ).read()
-        pysrc = open(
-            os.path.join(REPO_ROOT, "examples", "iris", "IrisClassifier.py")
-        ).read()
-        for coef in ("0.4", "1.3", "-2.0", "2.2"):
-            assert coef in src
+        block = re.search(r"W <- matrix\(c\((.*?)\)", src, re.S).group(1)
+        r_w = np.array(
+            [float(tok) for tok in re.findall(r"-?\d+\.\d+", block)]
+        ).reshape(3, 5)
+
+        spec = importlib.util.spec_from_file_location(
+            "iris_py", os.path.join(REPO_ROOT, "examples", "iris", "IrisClassifier.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        np.testing.assert_array_equal(r_w, mod._W)
+
+        # and the math: replicate the R predict_model in numpy
+        X = np.array([[6.1, 2.8, 4.7, 1.2]])
+        scores = X @ r_w[:, :4].T + r_w[:, 4]
+        e = np.exp(scores - scores.max(axis=1, keepdims=True))
+        r_probs = e / e.sum(axis=1, keepdims=True)
+        py_probs = mod.IrisClassifier().predict(X, [])
+        np.testing.assert_allclose(r_probs, py_probs, atol=1e-12)
+        assert int(py_probs.argmax()) == 1  # canonical versicolor row
