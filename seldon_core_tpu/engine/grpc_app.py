@@ -63,6 +63,66 @@ class SeldonGrpc:
         await self.service.send_feedback(feedback_from_proto(request))
         return payload_to_proto(Payload())
 
+    async def stream_predict_raw(self, payload: bytes):
+        """Server-streaming token generation on the fast plane (no grpcio
+        analogue in the reference; REST twin: engine/app.py
+        predictions_stream).  Request: SeldonMessage strData
+        ``{"tokens": [...], ...}``.  Responses: one SeldonMessage strData
+        ``{"token": id}`` per generated token, then ``{"done": true,
+        "tokens": [...]}``."""
+        import json
+
+        from seldon_core_tpu.graph.units import GraphUnitError
+        from seldon_core_tpu.wire import GrpcCallError
+
+        units = self.service.generative_units()
+        if len(units) != 1:
+            raise GrpcCallError(
+                3,  # INVALID_ARGUMENT
+                "streaming needs exactly one generative unit in the graph "
+                f"(found {len(units)})",
+            )
+        req = pb.SeldonMessage()
+        req.ParseFromString(payload)
+        if not req.strData:
+            raise GrpcCallError(3, "StreamPredict takes strData JSON")
+        try:
+            body = json.loads(req.strData)
+            prompt = body["tokens"]
+            if not isinstance(prompt, (list, tuple)) or (
+                prompt and isinstance(prompt[0], (list, tuple))
+            ):
+                raise ValueError("streaming takes ONE prompt: flat 'tokens' list")
+            # coerce INSIDE the validation block (same rule as the REST
+            # twin): a malformed option is the CLIENT's error
+            max_new = body.get("max_new_tokens")
+            max_new = int(max_new) if max_new is not None else None
+            temperature = body.get("temperature")
+            temperature = float(temperature) if temperature is not None else None
+            eos = body.get("eos_id")
+            eos = int(eos) if eos is not None else None
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            raise GrpcCallError(3, f"bad stream request: {e}") from e
+
+        def msg(obj: dict) -> bytes:
+            out = pb.SeldonMessage()
+            out.strData = json.dumps(obj)
+            return out.SerializeToString()
+
+        tokens: list[int] = []
+        try:
+            async for tok in units[0].stream(
+                prompt,
+                max_new_tokens=max_new,
+                temperature=temperature,
+                eos_id=eos,
+            ):
+                tokens.append(tok)
+                yield msg({"token": tok})
+        except GraphUnitError as e:
+            raise GrpcCallError(3, str(e)) from e
+        yield msg({"done": True, "tokens": tokens})
+
 
 async def start_engine_grpc(
     service: PredictionService, port: int, *, reuse_port: bool = False
@@ -99,6 +159,11 @@ async def start_engine_grpc(
             {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback},
         ),
         on_request_headers=seed_trace_context,
+        # fast-plane-only extension (grpcio fallback serves unary only):
+        # token streaming for generative graphs
+        stream_handlers={
+            "/seldon.protos.Seldon/StreamPredict": handler.stream_predict_raw
+        },
     )
     bound = await server.start(port, reuse_port=reuse_port)
     server.bound_port = bound

@@ -1,4 +1,4 @@
-"""gRPC unary transport directly on asyncio — the engine's fast data plane.
+"""gRPC transport directly on asyncio — the engine's fast data plane.
 
 Why this exists: the Python ``grpcio`` stack costs ~270µs of CPU per unary
 RPC on one core (client+server), 1.8× the cost of the whole aiohttp REST
@@ -11,12 +11,14 @@ HTTP/2 (RFC 7540) + HPACK (wire/hpack.py) on asyncio recovers the
 protocol's intended cheapness while staying interoperable with standard
 grpc clients and servers (verified both directions in tests/test_wire.py).
 
-Scope: unary-unary calls, plaintext (h2c prior-knowledge, which is what
-grpc uses on insecure channels).  Implemented: connection preface,
-SETTINGS exchange/ack, HEADERS(+CONTINUATION), DATA, full HPACK decode,
-both directions of flow control (connection + stream windows, split on
-peer max-frame-size), PING reply, RST_STREAM, GOAWAY.  Not implemented:
-streaming RPCs, push, priorities (ignored — optional per spec), TLS.
+Scope: unary-unary and SERVER-STREAMING calls (token streaming for
+generative serving), plaintext (h2c prior-knowledge, which is what grpc
+uses on insecure channels).  Implemented: connection preface, SETTINGS
+exchange/ack, HEADERS(+CONTINUATION), DATA, full HPACK decode, both
+directions of flow control (connection + stream windows, split on peer
+max-frame-size, producer backpressure via drain_sends), PING reply,
+RST_STREAM, GOAWAY.  Not implemented: client-streaming / bidi RPCs, push,
+priorities (ignored — optional per spec), TLS.
 """
 
 from __future__ import annotations
@@ -126,6 +128,8 @@ class _Conn(asyncio.Protocol):
         self._recv_credit = 0
         # continuation state: (stream_id, flags, blocks)
         self._headers_in_flight: tuple[int, int, list[bytes]] | None = None
+        # streaming producers parked on flow control (drain_sends)
+        self._send_waiters: list[asyncio.Future] = []
         self.closed = asyncio.get_event_loop().create_future()
 
     # -- transport events ---------------------------------------------------
@@ -159,6 +163,12 @@ class _Conn(asyncio.Protocol):
     def connection_lost(self, exc: Exception | None) -> None:
         if not self.closed.done():
             self.closed.set_result(exc)
+        # parked streaming producers must not wait on a dead connection
+        waiters, self._send_waiters = self._send_waiters, []
+        err = ConnectionError(f"h2 connection lost: {exc}")
+        for fut, _sid in waiters:
+            if not fut.done():
+                fut.set_exception(err)
         self._on_closed(exc)
 
     def _on_closed(self, exc: Exception | None) -> None:  # overridden
@@ -362,6 +372,7 @@ class _Conn(asyncio.Protocol):
             self._stream_out.pop(sid, None)
         if out:
             self.transport.write(b"".join(out))
+        self._wake_send_waiters()
 
     def forget_stream(self, stream_id: int) -> None:
         """Drop per-stream send-window state once a stream completes —
@@ -371,6 +382,45 @@ class _Conn(asyncio.Protocol):
         if any(sid == stream_id for sid, _, _ in self._send_queue):
             return
         self._stream_out.pop(stream_id, None)
+
+    # -- send backpressure (streaming responses) ----------------------------
+
+    _SEND_HIGH_WATER = 256 * 1024
+
+    def _queued_send_bytes(self, stream_id: int) -> int:
+        # PER-STREAM accounting: one stream parked on its peer window must
+        # not head-of-line-block other producers multiplexed here
+        return sum(
+            len(d)
+            for sid, d, f in self._send_queue
+            if sid == stream_id and f != _RAW_FRAME
+        )
+
+    def _wake_send_waiters(self) -> None:
+        if not self._send_waiters:
+            return
+        still_blocked = []
+        for fut, sid in self._send_waiters:
+            if fut.done():
+                continue
+            if self._queued_send_bytes(sid) <= self._SEND_HIGH_WATER:
+                fut.set_result(None)
+            else:
+                still_blocked.append((fut, sid))
+        self._send_waiters = still_blocked
+
+    async def drain_sends(self, stream_id: int) -> None:
+        """Park until THIS stream's flow-control send queue is below the
+        high-water mark — a streaming producer must not buffer an unbounded
+        response for a slow peer."""
+        while True:
+            if self.transport is None or self.transport.is_closing():
+                raise ConnectionError("h2 connection closed")
+            if self._queued_send_bytes(stream_id) <= self._SEND_HIGH_WATER:
+                return
+            fut = asyncio.get_running_loop().create_future()
+            self._send_waiters.append((fut, stream_id))
+            await fut
 
     # -- role hooks ---------------------------------------------------------
 
@@ -436,9 +486,12 @@ class _ServerConn(_Conn):
         handlers: dict[bytes, Handler],
         conns: "set[_ServerConn] | None" = None,
         on_request_headers: "Callable[[list], None] | None" = None,
+        stream_handlers: "dict[bytes, Any] | None" = None,
     ):
         super().__init__()
         self.handlers = handlers
+        # server-streaming RPCs: async fn(bytes) -> AsyncIterator[bytes]
+        self.stream_handlers = stream_handlers or {}
         # invoked with the request header list inside the context the
         # handler task will inherit — lets the application seed per-request
         # contextvars (e.g. traceparent) without wire/ knowing about them
@@ -491,14 +544,21 @@ class _ServerConn(_Conn):
             # client cancelled (e.g. its deadline passed): stop the handler
             # instead of computing a response nobody will read
             task.cancel()
+        # purge DATA parked on flow control: the client dropped its stream
+        # state, so no WINDOW_UPDATE will ever release these bytes — left
+        # queued they'd keep drain_sends producers over the high-water mark
+        # forever
+        self._send_queue = [e for e in self._send_queue if e[0] != stream_id]
         # drop any send-window state created by an early WINDOW_UPDATE —
         # a cancelled stream never reaches the success path that pops it
         self.forget_stream(stream_id)
+        self._wake_send_waiters()
 
     def _finish_request(self, stream_id: int) -> None:
         path, body, headers = self._streams.pop(stream_id)
+        stream_handler = self.stream_handlers.get(path)
         handler = self.handlers.get(path)
-        if handler is None:
+        if handler is None and stream_handler is None:
             self._send_error(stream_id, GRPC_STATUS_UNIMPLEMENTED, f"unknown method {path.decode()}")
             return
         try:
@@ -508,6 +568,10 @@ class _ServerConn(_Conn):
         except GrpcCallError as e:
             self._send_error(stream_id, e.status, e.message)
             return
+        if stream_handler is not None:
+            coro = self._run_stream(stream_id, stream_handler, messages[0])
+        else:
+            coro = self._run(stream_id, handler, messages[0])
         if self._on_request_headers is not None:
             # run the hook + handler in a copied context so per-request
             # contextvars it sets don't leak across requests.  A hook
@@ -521,15 +585,14 @@ class _ServerConn(_Conn):
                 ctx.run(self._on_request_headers, headers)
             except Exception as e:
                 log.warning("request-headers hook failed: %s", e)
+                coro.close()
                 self._send_error(
                     stream_id, GRPC_STATUS_UNKNOWN, f"bad request metadata: {e}"
                 )
                 return
-            task = asyncio.get_running_loop().create_task(
-                self._run(stream_id, handler, messages[0]), context=ctx
-            )
+            task = asyncio.get_running_loop().create_task(coro, context=ctx)
         else:
-            task = asyncio.ensure_future(self._run(stream_id, handler, messages[0]))
+            task = asyncio.ensure_future(coro)
         self._tasks.add(task)
         self._stream_tasks[stream_id] = task
 
@@ -579,6 +642,70 @@ class _ServerConn(_Conn):
         self.send_raw_after_data(stream_id, trailers)
         self.forget_stream(stream_id)
 
+    async def _run_stream(self, stream_id: int, handler, payload: bytes) -> None:
+        """Server-streaming RPC: the handler is an async generator of
+        response message bytes; each message goes out as its own gRPC frame
+        the moment it is yielded (flow-control backpressure via
+        drain_sends), trailers close the stream."""
+        wrote_headers = False
+        try:
+            async for msg in handler(payload):
+                if self.transport is None or self.transport.is_closing():
+                    return
+                if not wrote_headers:
+                    self.transport.write(
+                        frame(HEADERS, END_HEADERS, stream_id, _RESPONSE_HEADERS)
+                    )
+                    wrote_headers = True
+                self.send_data(stream_id, grpc_frame(msg), end_stream=False)
+                # a slow consumer parks the PRODUCER here, not server memory
+                await self.drain_sends(stream_id)
+        except asyncio.CancelledError:
+            return  # stream was reset; nobody is listening
+        except GrpcCallError as e:
+            self._stream_failure(stream_id, wrote_headers, e.status, e.message)
+            return
+        except ConnectionError:
+            return
+        except Exception as e:
+            log.exception("grpc stream handler failed")
+            self._stream_failure(
+                stream_id, wrote_headers, GRPC_STATUS_UNKNOWN,
+                f"{type(e).__name__}: {e}",
+            )
+            return
+        if self.transport is None or self.transport.is_closing():
+            return
+        if not wrote_headers:  # empty stream: headers still owed
+            self.transport.write(
+                frame(HEADERS, END_HEADERS, stream_id, _RESPONSE_HEADERS)
+            )
+        self.send_raw_after_data(
+            stream_id, frame(HEADERS, END_HEADERS | END_STREAM, stream_id, _TRAILERS_OK)
+        )
+        self.forget_stream(stream_id)
+
+    def _stream_failure(
+        self, stream_id: int, wrote_headers: bool, status: int, message: str
+    ) -> None:
+        """Mid-stream errors become trailers (response HEADERS already went
+        out, so _send_error's :status block would be malformed)."""
+        if not wrote_headers:
+            self._send_error(stream_id, status, message)
+            return
+        if self.transport is None or self.transport.is_closing():
+            return
+        trailers = hpack.encode_headers(
+            [
+                (b"grpc-status", str(status).encode()),
+                (b"grpc-message", message.encode("utf-8", "replace")),
+            ]
+        )
+        self.send_raw_after_data(
+            stream_id, frame(HEADERS, END_HEADERS | END_STREAM, stream_id, trailers)
+        )
+        self.forget_stream(stream_id)
+
     def _send_error(self, stream_id: int, status: int, message: str) -> None:
         # errored streams bypass the success path's forget_stream — drop the
         # send-window slot here or every failed RPC leaks one dict entry
@@ -626,15 +753,21 @@ def _dual_stack_socket(port: int, reuse_port: bool):
 
 
 class FastGrpcServer:
-    """Unary gRPC server on asyncio.  ``handlers`` maps full method paths
-    (``/seldon.protos.Seldon/Predict``) to ``async fn(bytes) -> bytes``."""
+    """gRPC server on asyncio.  ``handlers`` maps full method paths
+    (``/seldon.protos.Seldon/Predict``) to ``async fn(bytes) -> bytes``;
+    ``stream_handlers`` maps paths to server-streaming handlers
+    (``async fn(bytes) -> AsyncIterator[bytes]``)."""
 
     def __init__(
         self,
         handlers: dict[str, Handler],
         on_request_headers: "Callable[[list], None] | None" = None,
+        stream_handlers: "dict[str, Any] | None" = None,
     ):
         self.handlers = {k.encode(): v for k, v in handlers.items()}
+        self.stream_handlers = {
+            k.encode(): v for k, v in (stream_handlers or {}).items()
+        }
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[_ServerConn] = set()
         self._on_request_headers = on_request_headers
@@ -642,6 +775,9 @@ class FastGrpcServer:
 
     def add_handler(self, path: str, fn: Handler) -> None:
         self.handlers[path.encode()] = fn
+
+    def add_stream_handler(self, path: str, fn) -> None:
+        self.stream_handlers[path.encode()] = fn
 
     async def start(
         self, port: int, host: str | None = None, reuse_port: bool = False
@@ -651,7 +787,8 @@ class FastGrpcServer:
         loop = asyncio.get_running_loop()
         try:
             factory = lambda: _ServerConn(  # noqa: E731
-                self.handlers, self._conns, self._on_request_headers
+                self.handlers, self._conns, self._on_request_headers,
+                self.stream_handlers,
             )
             if host is None:
                 # ONE dual-stack socket ([::] with V6ONLY off), like the
@@ -712,6 +849,52 @@ class FastGrpcServer:
 # Client
 # ---------------------------------------------------------------------------
 
+class _StreamCall:
+    """Client-side state for one server-streaming RPC: complete messages
+    land on ``queue`` as they arrive; the terminal item is
+    ``("end", status, message)`` or ``("err", exc)``."""
+
+    __slots__ = ("queue", "buf", "headers", "dead")
+
+    def __init__(self):
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.buf = bytearray()
+        self.headers: list | None = None
+        self.dead = False  # framing error seen: drop further input
+
+    def feed(self, data: bytes) -> None:
+        """Incremental gRPC length-prefix framing: push every complete
+        message, keep the remainder buffered."""
+        if self.dead:
+            return
+        self.buf += data
+        while True:
+            if len(self.buf) < 5:
+                return
+            if self.buf[0] != 0:
+                self.dead = True
+                self.buf.clear()
+                self.queue.put_nowait(
+                    ("err", GrpcCallError(GRPC_STATUS_UNKNOWN, "compressed messages unsupported"))
+                )
+                return
+            (ln,) = struct.unpack_from(">I", self.buf, 1)
+            if len(self.buf) < 5 + ln:
+                return
+            self.queue.put_nowait(("msg", bytes(self.buf[5 : 5 + ln])))
+            del self.buf[: 5 + ln]
+
+    def finish(self) -> None:
+        status = GRPC_STATUS_OK
+        message = ""
+        for name, value in self.headers or []:
+            if name == b"grpc-status":
+                status = int(value)
+            elif name == b"grpc-message":
+                message = value.decode("utf-8", "replace")
+        self.queue.put_nowait(("end", status, message))
+
+
 class _ClientConn(_Conn):
     is_server = False
 
@@ -722,6 +905,7 @@ class _ClientConn(_Conn):
         self.drain_when_idle = False  # set when replaced due to exhaustion
         # stream -> [future, headers, bytearray data]
         self._calls: dict[int, list[Any]] = {}
+        self._stream_calls: dict[int, _StreamCall] = {}
         self._path_templates: dict[bytes, bytes] = {}
 
     def _on_closed(self, exc: Exception | None) -> None:
@@ -730,9 +914,12 @@ class _ClientConn(_Conn):
             if not fut.done():
                 fut.set_exception(err)
         self._calls.clear()
+        for sc in self._stream_calls.values():
+            sc.queue.put_nowait(("err", err))
+        self._stream_calls.clear()
 
     def _stream_open(self, stream_id: int) -> bool:
-        return stream_id in self._calls
+        return stream_id in self._calls or stream_id in self._stream_calls
 
     def _on_goaway(self, payload: bytes) -> None:
         # graceful drain, not a hard close: a stopping server announces "no
@@ -754,6 +941,8 @@ class _ClientConn(_Conn):
             fut, _, _ = self._calls.pop(sid)
             if not fut.done():
                 fut.set_exception(err)
+        for sid in [s for s in self._stream_calls if s > last_stream]:
+            self._stream_calls.pop(sid).queue.put_nowait(("err", err))
         self.drain_when_idle = True
         self.maybe_drain_close()
 
@@ -796,7 +985,12 @@ class _ClientConn(_Conn):
         return self._next_stream >= 1 << 30
 
     def maybe_drain_close(self) -> None:
-        if self.drain_when_idle and not self._calls and self.transport is not None:
+        if (
+            self.drain_when_idle
+            and not self._calls
+            and not self._stream_calls
+            and self.transport is not None
+        ):
             self.transport.write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 0)))
             self.transport.close()
 
@@ -824,9 +1018,31 @@ class _ClientConn(_Conn):
         self.send_data(stream_id, grpc_frame(payload), end_stream=True)
         return fut
 
+    def start_stream(
+        self,
+        path: bytes,
+        payload: bytes,
+        metadata: tuple = (),
+        stream_id: int | None = None,
+    ) -> "_StreamCall":
+        """Open a server-streaming RPC; messages arrive on the returned
+        call's queue as the server yields them."""
+        if self.transport is None or self.transport.is_closing():
+            raise ConnectionError("h2 connection closed")
+        if stream_id is None:
+            stream_id = self.next_stream_id()
+        sc = _StreamCall()
+        self._stream_calls[stream_id] = sc
+        self.transport.write(
+            frame(HEADERS, END_HEADERS, stream_id, self._template(path, metadata))
+        )
+        self.send_data(stream_id, grpc_frame(payload), end_stream=True)
+        return sc
+
     def cancel_stream(self, stream_id: int) -> None:
         """Local cancellation (timeout): RST_STREAM(CANCEL) + drop state."""
         self._calls.pop(stream_id, None)
+        self._stream_calls.pop(stream_id, None)
         self._stream_out.pop(stream_id, None)
         self._send_queue = [e for e in self._send_queue if e[0] != stream_id]
         if self.transport is not None and not self.transport.is_closing():
@@ -836,6 +1052,14 @@ class _ClientConn(_Conn):
         self.maybe_drain_close()
 
     def _on_headers(self, stream_id: int, headers, end: bool) -> None:
+        sc = self._stream_calls.get(stream_id)
+        if sc is not None:
+            sc.headers = (sc.headers or []) + headers
+            if end:
+                self._stream_calls.pop(stream_id, None)
+                sc.finish()
+                self.maybe_drain_close()
+            return
         call = self._calls.get(stream_id)
         if call is None:
             return
@@ -847,6 +1071,20 @@ class _ClientConn(_Conn):
             self._finish(stream_id)
 
     def _on_data(self, stream_id: int, data: bytes, end: bool) -> None:
+        sc = self._stream_calls.get(stream_id)
+        if sc is not None:
+            sc.feed(data)
+            # streams are long-lived: replenish the PER-STREAM window
+            # continuously (the connection window is already credited for
+            # every DATA frame in _dispatch — crediting it again here would
+            # ratchet the peer's view past 2^31-1 and force a
+            # FLOW_CONTROL_ERROR GOAWAY, RFC 7540 §6.9.1)
+            self._stream_recv_credit(stream_id, len(data))
+            if end:
+                self._stream_calls.pop(stream_id, None)
+                sc.finish()
+                self.maybe_drain_close()
+            return
         call = self._calls.get(stream_id)
         if call is None:
             return
@@ -857,6 +1095,12 @@ class _ClientConn(_Conn):
             self._finish(stream_id)
 
     def _on_rst(self, stream_id: int, code: int) -> None:
+        sc = self._stream_calls.pop(stream_id, None)
+        if sc is not None:
+            sc.queue.put_nowait(
+                ("err", GrpcCallError(GRPC_STATUS_UNKNOWN, f"stream reset: h2 code {code}"))
+            )
+            return
         call = self._calls.pop(stream_id, None)
         if call is not None and not call[0].done():
             call[0].set_exception(
@@ -949,6 +1193,46 @@ class FastGrpcChannel:
             # tell the server to stop working on it and drop our stream
             # state — silently abandoning the stream leaks the _calls entry
             # and leaves the handler running with no deadline
+            conn.cancel_stream(stream_id)
+            raise
+
+    async def call_stream(
+        self,
+        path: str | bytes,
+        payload: bytes,
+        timeout: float = 300.0,
+        metadata: tuple = (),
+    ):
+        """Server-streaming RPC: async-iterates response message bytes as
+        the server yields them.  ``timeout`` bounds the WHOLE stream; a
+        non-OK grpc-status raises GrpcCallError after the received
+        messages."""
+        conn = await self._connection()
+        path_b = path if isinstance(path, bytes) else path.encode()
+        stream_id = conn.next_stream_id()
+        sc = conn.start_stream(path_b, payload, metadata, stream_id)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        try:
+            while True:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError()
+                item = await asyncio.wait_for(sc.queue.get(), remaining)
+                kind = item[0]
+                if kind == "msg":
+                    yield item[1]
+                elif kind == "end":
+                    _, status, message = item
+                    if status != GRPC_STATUS_OK:
+                        raise GrpcCallError(status, message)
+                    return
+                else:  # err
+                    raise item[1]
+        except BaseException:
+            # ANY abnormal exit (timeout, cancellation, framing error, a
+            # server-reported status): tell the server to stop and drop our
+            # stream state — the server may still be producing
             conn.cancel_stream(stream_id)
             raise
 

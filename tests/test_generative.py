@@ -556,3 +556,85 @@ class TestStreaming:
 
         out = run(go())
         assert len(out) == 5
+
+
+class TestGrpcStreaming:
+    """StreamPredict on the fast wire plane: gRPC clients get token
+    streaming with the same contract as the REST SSE endpoint."""
+
+    def test_grpc_stream_matches_unary(self):
+        from seldon_core_tpu.engine.grpc_app import start_engine_grpc
+        from seldon_core_tpu.engine.service import PredictionService
+        from seldon_core_tpu.graph.spec import PredictorSpec
+        from seldon_core_tpu.proto import prediction_pb2 as pb
+        from seldon_core_tpu.wire import FastGrpcChannel
+
+        spec = PredictorSpec.model_validate(TestStreaming.PREDICTOR)
+
+        async def go():
+            service = PredictionService(spec)
+            await service.start()
+            server = await start_engine_grpc(service, 0)
+            ch = FastGrpcChannel(f"127.0.0.1:{server.bound_port}")
+            try:
+                req = pb.SeldonMessage()
+                req.strData = json.dumps({"tokens": [5, 9, 2, 17]})
+                # unary reference
+                raw = await ch.call(
+                    "/seldon.protos.Seldon/Predict", req.SerializeToString()
+                )
+                resp = pb.SeldonMessage()
+                resp.ParseFromString(raw)
+                expected = json.loads(resp.strData)["tokens"]
+
+                events = []
+                async for msg in ch.call_stream(
+                    "/seldon.protos.Seldon/StreamPredict", req.SerializeToString()
+                ):
+                    out = pb.SeldonMessage()
+                    out.ParseFromString(msg)
+                    events.append(json.loads(out.strData))
+                toks = [e["token"] for e in events if "token" in e]
+                done = [e for e in events if e.get("done")]
+                assert toks == expected, (toks, expected)
+                assert done and done[0]["tokens"] == expected
+            finally:
+                await ch.close()
+                await server.stop()
+                await service.close()
+
+        run(go())
+
+    def test_grpc_stream_rejects_non_generative(self):
+        from seldon_core_tpu.engine.grpc_app import start_engine_grpc
+        from seldon_core_tpu.engine.service import PredictionService
+        from seldon_core_tpu.graph.spec import PredictorSpec
+        from seldon_core_tpu.proto import prediction_pb2 as pb
+        from seldon_core_tpu.wire import FastGrpcChannel, GrpcCallError
+
+        spec = PredictorSpec.model_validate(
+            {"name": "p", "graph": {"name": "m", "type": "MODEL",
+                                    "implementation": "SIMPLE_MODEL"}}
+        )
+
+        async def go():
+            service = PredictionService(spec)
+            await service.start()
+            server = await start_engine_grpc(service, 0)
+            ch = FastGrpcChannel(f"127.0.0.1:{server.bound_port}")
+            try:
+                req = pb.SeldonMessage()
+                req.strData = json.dumps({"tokens": [1, 2]})
+                with pytest.raises(GrpcCallError) as ei:
+                    async for _ in ch.call_stream(
+                        "/seldon.protos.Seldon/StreamPredict",
+                        req.SerializeToString(),
+                    ):
+                        pass
+                assert ei.value.status == 3  # INVALID_ARGUMENT
+            finally:
+                await ch.close()
+                await server.stop()
+                await service.close()
+
+        run(go())

@@ -593,3 +593,167 @@ class TestFastServer:
         )
         assert d.decode(block2) == [(b":method", b"GET")]
         assert len(d._dynamic) == 0  # evicted by the size update
+
+
+# ---------------------------------------------------------------------------
+# server-streaming
+# ---------------------------------------------------------------------------
+
+class TestServerStreaming:
+    def test_stream_messages_arrive_incrementally(self):
+        """Prove true streaming, not buffer-until-end: the handler parks
+        after its first yield until the CLIENT confirms receipt — a
+        buffering implementation would deadlock here."""
+
+        async def go():
+            got_first = asyncio.Event()
+
+            async def counter(payload: bytes):
+                n = int(payload.decode())
+                yield b"msg-0"
+                await asyncio.wait_for(got_first.wait(), 5)
+                for i in range(1, n):
+                    yield f"msg-{i}".encode()
+
+            server = FastGrpcServer({}, stream_handlers={"/test.Svc/Count": counter})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            out = []
+            async for msg in ch.call_stream("/test.Svc/Count", b"4", timeout=10):
+                if not out:
+                    got_first.set()
+                out.append(msg)
+            await ch.close()
+            await server.stop()
+            return out
+
+        out = run(go())
+        assert out == [b"msg-0", b"msg-1", b"msg-2", b"msg-3"]
+
+    def test_empty_stream_ok(self):
+        async def go():
+            async def empty(payload: bytes):
+                return
+                yield  # pragma: no cover
+
+            server = FastGrpcServer({}, stream_handlers={"/test.Svc/Empty": empty})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            out = [m async for m in ch.call_stream("/test.Svc/Empty", b"")]
+            await ch.close()
+            await server.stop()
+            return out
+
+        assert run(go()) == []
+
+    def test_mid_stream_error_reaches_client_after_messages(self):
+        async def go():
+            async def faulty(payload: bytes):
+                yield b"ok-1"
+                yield b"ok-2"
+                raise GrpcCallError(3, "bad argument later")
+
+            server = FastGrpcServer({}, stream_handlers={"/test.Svc/Faulty": faulty})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            out = []
+            err = None
+            try:
+                async for msg in ch.call_stream("/test.Svc/Faulty", b""):
+                    out.append(msg)
+            except GrpcCallError as e:
+                err = e
+            await ch.close()
+            await server.stop()
+            return out, err
+
+        out, err = run(go())
+        assert out == [b"ok-1", b"ok-2"]
+        assert err is not None and err.status == 3 and "later" in err.message
+
+    def test_unary_and_stream_share_one_connection(self):
+        async def go():
+            async def gen(payload: bytes):
+                for i in range(3):
+                    yield payload + str(i).encode()
+
+            server = FastGrpcServer(
+                {"/test.Svc/Echo": _echo},
+                stream_handlers={"/test.Svc/Gen": gen},
+            )
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            streamed = [m async for m in ch.call_stream("/test.Svc/Gen", b"x")]
+            unary = await ch.call("/test.Svc/Echo", b"hello")
+            assert ch._conn is not None  # same pooled connection
+            await ch.close()
+            await server.stop()
+            return streamed, unary
+
+        streamed, unary = run(go())
+        assert streamed == [b"x0", b"x1", b"x2"]
+        assert unary == b"hello"
+
+    def test_grpcio_client_reads_our_stream(self):
+        """Interop: a standard grpcio client consumes the fast server's
+        stream (the whole point of speaking real HTTP/2)."""
+
+        async def go():
+            async def gen(payload: bytes):
+                for i in range(3):
+                    yield f"tok-{i}".encode()
+
+            server = FastGrpcServer({}, stream_handlers={"/test.Svc/Gen": gen})
+            port = await server.start(0, host="127.0.0.1")
+            ch = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+            call = ch.unary_stream(
+                "/test.Svc/Gen",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            out = [m async for m in call(b"")]
+            await ch.close()
+            await server.stop()
+            return out
+
+        assert run(go()) == [b"tok-0", b"tok-1", b"tok-2"]
+
+    def test_big_messages_ride_flow_control(self):
+        async def go():
+            big = bytes(range(256)) * 4096  # 1 MiB per message
+
+            async def gen(payload: bytes):
+                for _ in range(4):
+                    yield big
+
+            server = FastGrpcServer({}, stream_handlers={"/test.Svc/Big": gen})
+            port = await server.start(0, host="127.0.0.1")
+            ch = FastGrpcChannel(f"127.0.0.1:{port}")
+            sizes = [len(m) async for m in ch.call_stream("/test.Svc/Big", b"")]
+            await ch.close()
+            await server.stop()
+            return sizes, len(big)
+
+        sizes, n = run(go())
+        assert sizes == [n] * 4
+
+    def test_rst_on_blocked_stream_frees_backpressure(self):
+        """A cancelled flow-control-blocked stream must not leave its
+        parked DATA counting against drain_sends forever (that would
+        wedge every later streaming producer on the connection)."""
+
+        async def go():
+            from seldon_core_tpu.wire.h2grpc import _ServerConn
+
+            conn = _ServerConn({})
+            conn.transport = None
+            # park >high-water bytes for stream 5
+            conn._send_queue.append((5, b"x" * (conn._SEND_HIGH_WATER + 1), 0))
+            assert conn._queued_send_bytes(5) > conn._SEND_HIGH_WATER
+            # per-stream accounting: stream 7 is NOT blocked by stream 5
+            assert conn._queued_send_bytes(7) == 0
+            conn._on_rst(5, 8)
+            assert conn._queued_send_bytes(5) == 0
+            assert conn._send_queue == []
+
+        run(go())
