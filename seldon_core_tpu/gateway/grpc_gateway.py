@@ -212,6 +212,30 @@ class FastGatewayGrpc(_ChannelCacheBase):
     async def feedback_raw(self, payload: bytes) -> bytes:
         return await self._proxy("SendFeedback", payload)
 
+    async def stream_predict_raw(self, payload: bytes):
+        """Relay the engine's server-streaming StreamPredict: messages
+        forward verbatim as the engine yields them (zero decode, like the
+        unary raw-bytes relay).  Failures BEFORE the first message surface
+        as the stream's grpc-status; engine-side mid-stream errors arrive
+        as its trailers and re-raise here verbatim."""
+        try:
+            rec = _resolve_record(self.gateway, _request_token.get())
+        except AuthError as e:
+            raise GrpcCallError(
+                16 if e.status == 401 else 5,  # UNAUTHENTICATED / NOT_FOUND
+                str(e),
+            ) from e
+        try:
+            async for msg in self._channel(rec).call_stream(
+                "/seldon.protos.Seldon/StreamPredict",
+                payload,
+                timeout=max(self.gateway.timeout_s * 30, 300.0),
+                metadata=tuple(outgoing_headers().items()),
+            ):
+                yield msg
+        except (ConnectionError, asyncio.TimeoutError, OSError) as e:
+            raise GrpcCallError(14, f"engine unreachable: {e}") from e
+
 
 async def start_gateway_grpc(gateway, port: int):
     """Gateway gRPC ingress — fast plane by default, grpcio fallback
@@ -239,6 +263,9 @@ async def start_gateway_grpc(gateway, port: int):
             "/seldon.protos.Seldon/SendFeedback": handler.feedback_raw,
         },
         on_request_headers=handler.seed_metadata,
+        stream_handlers={
+            "/seldon.protos.Seldon/StreamPredict": handler.stream_predict_raw
+        },
     )
     bound = await server.start(port)
     server.bound_port = bound

@@ -343,3 +343,87 @@ class TestMultiReplicaTokens:
             if m["metadata"]["name"] == "seldon-token-redis"
         ]
         assert {m["kind"] for m in redis} == {"Deployment", "Service", "NetworkPolicy"}
+
+
+class TestGatewayGrpcStreaming:
+    """The gateway relays the engine's StreamPredict verbatim — a gateway
+    gRPC client streams tokens without the gateway decoding anything."""
+
+    GEN = {
+        "name": "llm",
+        "graph": {
+            "name": "gen", "type": "MODEL", "implementation": "JAX_GENERATIVE",
+            "parameters": [
+                {"name": "family", "value": "llama", "type": "STRING"},
+                {"name": "preset", "value": "tiny", "type": "STRING"},
+                {"name": "n_slots", "value": "2", "type": "INT"},
+                {"name": "max_new_tokens", "value": "6", "type": "INT"},
+                {"name": "decode_block", "value": "2", "type": "INT"},
+            ],
+        },
+    }
+
+    def test_stream_relay_matches_engine(self):
+        from seldon_core_tpu.proto import prediction_pb2 as pb
+        from seldon_core_tpu.wire import FastGrpcChannel, GrpcCallError
+
+        async def go():
+            svc = PredictionService(PredictorSpec.model_validate(self.GEN))
+            await svc.start()
+            engine_grpc = await start_engine_grpc(svc, 0)
+            store = DeploymentStore()
+            store.put(
+                DeploymentRecord(
+                    name="dep", oauth_key="key1", oauth_secret="sec1",
+                    engine_host="127.0.0.1",
+                    engine_grpc_port=engine_grpc.bound_port,
+                )
+            )
+            gwapp = GatewayApp(store)
+            token, _ = gwapp.tokens.issue("key1")
+            gw_grpc = await start_gateway_grpc(gwapp, 0)
+            ch = FastGrpcChannel(f"127.0.0.1:{gw_grpc.bound_port}")
+            try:
+                req = pb.SeldonMessage()
+                req.strData = json.dumps({"tokens": [5, 9, 2, 17]})
+                raw = await ch.call(
+                    "/seldon.protos.Seldon/Predict",
+                    req.SerializeToString(),
+                    metadata=(("oauth_token", token),),
+                )
+                resp = pb.SeldonMessage(); resp.ParseFromString(raw)
+                expected = json.loads(resp.strData)["tokens"]
+
+                toks = []
+                async for m in ch.call_stream(
+                    "/seldon.protos.Seldon/StreamPredict",
+                    req.SerializeToString(),
+                    metadata=(("oauth_token", token),),
+                ):
+                    out = pb.SeldonMessage(); out.ParseFromString(m)
+                    evt = json.loads(out.strData)
+                    if "token" in evt:
+                        toks.append(evt["token"])
+                assert toks == expected, (toks, expected)
+
+                # bad token: UNAUTHENTICATED before any message
+                got = None
+                try:
+                    async for _ in ch.call_stream(
+                        "/seldon.protos.Seldon/StreamPredict",
+                        req.SerializeToString(),
+                        metadata=(("oauth_token", "junk"),),
+                    ):
+                        pass
+                except GrpcCallError as e:
+                    got = e.status
+                assert got == 16, got
+            finally:
+                await ch.close()
+                await gw_grpc.gateway_handler.close()
+                await gw_grpc.stop(None)
+                await engine_grpc.stop(None)
+                await svc.close()
+                await gwapp.close()
+
+        run(go())
