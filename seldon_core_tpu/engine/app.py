@@ -402,9 +402,16 @@ def _serve(port: int, grpc_port: int, reuse_port: bool) -> None:
         service, mesh_worker=mesh_cfg is not None and not mesh_cfg.is_coordinator
     )
     app = engine.build()
+    app.on_startup.append(_tune_loop)
     app.on_startup.append(make_grpc_startup(service, grpc_port, reuse_port=reuse_port))
     app.on_cleanup.append(_grpc_cleanup)
     web.run_app(app, port=port, access_log=None, reuse_port=reuse_port or None)
+
+
+async def _tune_loop(app) -> None:
+    from seldon_core_tpu.utils.loops import tune_server_loop
+
+    tune_server_loop()
 
 
 def make_grpc_startup(service: PredictionService, grpc_port: int, reuse_port: bool = False):

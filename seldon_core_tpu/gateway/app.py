@@ -45,6 +45,10 @@ from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS, MetricsReg
 log = logging.getLogger(__name__)
 
 
+def _error_bytes(status: int, reason: str) -> bytes:
+    return json.dumps(failure_status_dict(status, reason)).encode()
+
+
 def _error(status: int, reason: str) -> web.Response:
     return web.json_response(failure_status_dict(status, reason), status=status)
 
@@ -66,8 +70,17 @@ class GatewayApp:
         tap: RequestResponseTap | None = None,
         metrics: MetricsRegistry | None = None,
         timeout_s: float = 10.0,
+        stream_timeout_s: float | None = None,
     ):
         self.store = store
+        # explicit budget for relayed STREAMS (token streaming runs far
+        # longer than a unary call; deriving it from timeout_s with a
+        # multiplier was arbitrary and unconfigurable)
+        self.stream_timeout_s = (
+            stream_timeout_s
+            if stream_timeout_s is not None
+            else float(os.environ.get("GATEWAY_STREAM_TIMEOUT_S", "300"))
+        )
         # env-selected shared store (GATEWAY_TOKEN_STORE) so N replicas
         # accept each other's tokens, like the reference's Redis token store
         self.tokens = tokens or token_store_from_env()
@@ -157,20 +170,13 @@ class GatewayApp:
             form = await request.post()
             client_id = str(form.get("client_id", ""))
             client_secret = str(form.get("client_secret", ""))
-        rec = self.store.get(client_id)
-        # a deployment without a secret is unreachable through the gateway —
-        # empty==empty must not grant tokens
-        if rec is None or not rec.oauth_secret or not verify_secret(
-            rec.oauth_secret, client_secret
-        ):
-            return _error(401, "invalid client credentials")
-        token, expires_in = self.tokens.issue(rec.oauth_key)
-        return web.json_response(
-            {"access_token": token, "token_type": "bearer", "expires_in": int(expires_in)}
-        )
+        status, payload = self.issue_token(client_id, client_secret)
+        return web.json_response(payload, status=status)
 
     def _principal(self, request: web.Request) -> DeploymentRecord:
-        auth = request.headers.get("Authorization", "")
+        return self._principal_from_header(request.headers.get("Authorization", ""))
+
+    def _principal_from_header(self, auth: str) -> DeploymentRecord:
         if not auth.startswith("Bearer "):
             raise AuthError("missing bearer token")
         key = self.tokens.principal(auth[7:])
@@ -178,6 +184,22 @@ class GatewayApp:
         if rec is None:
             raise AuthError("deployment no longer exists", 404)
         return rec
+
+    def issue_token(self, client_id: str, client_secret: str) -> tuple[int, dict]:
+        """client_credentials grant core (shared by both REST front ends)."""
+        rec = self.store.get(client_id)
+        # a deployment without a secret is unreachable through the gateway —
+        # empty==empty must not grant tokens
+        if rec is None or not rec.oauth_secret or not verify_secret(
+            rec.oauth_secret, client_secret
+        ):
+            return 401, failure_status_dict(401, "invalid client credentials")
+        token, expires_in = self.tokens.issue(rec.oauth_key)
+        return 200, {
+            "access_token": token,
+            "token_type": "bearer",
+            "expires_in": int(expires_in),
+        }
 
     # -- data plane --------------------------------------------------------
 
@@ -226,8 +248,38 @@ class GatewayApp:
             return e.status, e.body
 
     async def _ingress(self, request: web.Request, path: str, service: str) -> web.Response:
+        # auth and paused-check BEFORE buffering the body: anonymous or
+        # drained traffic must not get a free 256MB buffer (ingress_core
+        # re-checks both; this is the cheap early exit)
         if self._paused:
             return _error(503, "gateway is paused")
+        try:
+            self._principal(request)
+        except AuthError as e:
+            return _error(e.status, str(e))
+        raw = await request.read()
+        code, body = await self.ingress_core(
+            request.headers.get("Authorization", ""),
+            request.headers.get("traceparent"),
+            raw,
+            path,
+            service,
+        )
+        return web.Response(body=body, status=code, content_type="application/json")
+
+    async def ingress_core(
+        self,
+        auth_header: str,
+        traceparent: str | None,
+        raw: bytes,
+        path: str,
+        service: str,
+    ) -> tuple[int, bytes]:
+        """Transport-independent ingress: auth, validate, forward, tap,
+        metrics.  Returns (status, JSON body bytes) — shared by the aiohttp
+        front end and the h1 splice front end's fallback path."""
+        if self._paused:
+            return 503, _error_bytes(503, "gateway is paused")
         start = time.perf_counter()
         principal = "anonymous"
         deployment_name = "unknown"
@@ -235,11 +287,10 @@ class GatewayApp:
         try:
             from seldon_core_tpu.utils.tracectx import set_traceparent
 
-            set_traceparent(request.headers.get("traceparent"))
-            rec = self._principal(request)
+            set_traceparent(traceparent)
+            rec = self._principal_from_header(auth_header)
             principal = rec.oauth_key
             deployment_name = rec.name
-            raw = await request.read()
             # the body is forwarded untouched either way (like the
             # reference's apife, RestClientController.java:136-144), so a
             # full json.loads here is pure overhead unless something
@@ -256,29 +307,29 @@ class GatewayApp:
                     body = json.loads(raw)
                 except json.JSONDecodeError as e:
                     code = 400
-                    return _error(400, f"invalid JSON: {e}")
+                    return 400, _error_bytes(400, f"invalid JSON: {e}")
                 if not isinstance(body, dict):
                     code = 400
-                    return _error(400, "body must be a JSON object")
+                    return 400, _error_bytes(400, "body must be a JSON object")
             elif raw.lstrip()[:1] != b"{":
                 # same grammar as the parsed branch: the accepted language
                 # must not depend on whether a tap is configured
                 code = 400
-                return _error(400, "body must be a JSON object")
+                return 400, _error_bytes(400, "body must be a JSON object")
             try:
                 code, reply = await self._forward(rec, path, raw)
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 code = 503
-                return _error(503, f"engine unreachable for {rec.name}: {e}")
+                return 503, _error_bytes(503, f"engine unreachable for {rec.name}: {e}")
             if service == "predictions":
                 if self.tap.enabled:
                     await self._tap_pair(rec, body, reply)
             else:
                 self._record_reward(rec, body)
-            return web.Response(body=reply, status=code, content_type="application/json")
+            return code, reply
         except AuthError as e:
             code = e.status
-            return _error(e.status, str(e))
+            return e.status, _error_bytes(e.status, str(e))
         finally:
             self.metrics.ingress_requests.labels(
                 principal,
@@ -351,6 +402,12 @@ def main(argv: list[str] | None = None) -> None:
         help="watch SeldonDeployment CRs on the cluster API "
         "(GATEWAY_KUBE_URL overrides the in-cluster endpoint)",
     )
+    parser.add_argument(
+        "--rest-impl",
+        choices=("h1", "aiohttp"),
+        default=os.environ.get("SCT_REST_IMPL", "h1"),
+        help="REST front end: the splice data plane (default) or aiohttp",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -360,6 +417,9 @@ def main(argv: list[str] | None = None) -> None:
         store.load_file(args.deployments)
 
     gateway = GatewayApp(store)
+    if args.rest_impl == "h1":
+        _run_h1(gateway, store, args)
+        return
     app = gateway.build()
 
     if args.watch:
@@ -410,6 +470,68 @@ def main(argv: list[str] | None = None) -> None:
     app.on_startup.append(_start_grpc)
     app.on_cleanup.append(_stop_grpc)
     web.run_app(app, port=args.port, access_log=None)
+
+
+def _run_h1(gateway: GatewayApp, store: DeploymentStore, args) -> None:
+    """Serve REST on the h1 splice front end (gateway/h1gateway.py) +
+    gRPC on the h2 data plane, in one asyncio loop."""
+
+    async def run() -> None:
+        from seldon_core_tpu.gateway.h1gateway import H1SpliceFrontend
+        from seldon_core_tpu.utils.loops import tune_server_loop
+
+        tune_server_loop()
+        frontend = H1SpliceFrontend(gateway)
+        await frontend.start(args.port)
+        log.info("gateway REST (h1 splice) on :%d", frontend.bound_port)
+
+        watcher = None
+        if args.watch:
+            from seldon_core_tpu.gateway.watch import GatewayWatcher
+            from seldon_core_tpu.operator.kube_http import HttpKube
+
+            kube = HttpKube(os.environ.get("GATEWAY_KUBE_URL") or None)
+            watcher = GatewayWatcher(
+                kube, store, namespace=os.environ.get("GATEWAY_NAMESPACE", "default")
+            )
+            await watcher.start()
+
+        grpc_server = None
+        try:
+            from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
+
+            grpc_server = await start_gateway_grpc(gateway, args.grpc_port)
+        except Exception as e:
+            if os.environ.get("GATEWAY_GRPC_OPTIONAL") == "1":
+                log.warning("gateway gRPC not started (optional): %s", e)
+            else:
+                log.error("gateway gRPC failed to start on :%d: %s", args.grpc_port, e)
+                await frontend.stop()
+                raise
+
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        import signal as _signal
+
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except (NotImplementedError, RuntimeError):
+                pass
+        try:
+            await stop.wait()
+        finally:
+            if watcher is not None:
+                await watcher.stop()
+            if grpc_server is not None:
+                handler = getattr(grpc_server, "gateway_handler", None)
+                if handler is not None:
+                    await handler.close()
+                await grpc_server.stop(grace=2.0)
+            await frontend.stop()
+            await gateway.close()
+
+    asyncio.run(run())
 
 
 if __name__ == "__main__":

@@ -41,6 +41,7 @@ from seldon_core_tpu.proto.grpc_defs import (
 )
 from seldon_core_tpu.utils.tracectx import outgoing_headers, set_traceparent
 from seldon_core_tpu.wire import FastGrpcChannel, FastGrpcServer, GrpcCallError
+from seldon_core_tpu.wire.h2grpc import grpc_frame
 
 log = logging.getLogger(__name__)
 
@@ -168,13 +169,20 @@ class GatewayGrpc(_ChannelCacheBase):
 
 
 class FastGatewayGrpc(_ChannelCacheBase):
-    """The Seldon proxy on the asyncio data plane, relaying raw bytes."""
+    """The Seldon proxy on the asyncio data plane.
+
+    Unary calls ride the wire plane's INLINE relay: the downstream DATA
+    payload (already a framed gRPC message) forwards verbatim to the engine
+    and the engine's framed response writes straight back — per request the
+    gateway does one header scan, one dict auth lookup and two coalesced
+    writes; no task, no future, no proto decode, no gRPC re-framing."""
 
     def _new_channel(self, rec: DeploymentRecord):
         return FastGrpcChannel(rec.grpc_target)
 
     def seed_metadata(self, headers: list) -> None:
-        """on_request_headers hook: runs inside the handler task's context."""
+        """on_request_headers hook: runs inside the handler task's context
+        (streaming RPCs only — unary relays scan headers inline)."""
         token = ""
         traceparent = None
         for k, v in headers:
@@ -185,32 +193,94 @@ class FastGatewayGrpc(_ChannelCacheBase):
         _request_token.set(token)
         set_traceparent(traceparent)
 
-    async def _proxy(self, method: str, payload: bytes) -> bytes:
-        try:
-            rec = _resolve_record(self.gateway, _request_token.get())
-            return await self._channel(rec).call(
-                f"/seldon.protos.Seldon/{method}",
-                payload,
-                timeout=self.gateway.timeout_s,
-                metadata=tuple(outgoing_headers().items()),
+    # -- inline unary relay -------------------------------------------------
+
+    def make_relay(self, method: str):
+        """-> sync fn(conn, stream_id, headers, framed_body) registered as a
+        wire-plane relay handler."""
+        path = f"/seldon.protos.Seldon/{method}".encode()
+        gateway = self.gateway
+
+        def relay(conn, stream_id: int, headers: list, framed: bytes) -> None:
+            token = b""
+            metadata: tuple = ()
+            for k, v in headers:
+                if k == b"oauth_token":
+                    token = v
+                elif k == b"traceparent":
+                    metadata = ((b"traceparent", v),)
+            try:
+                rec = _resolve_record(gateway, token.decode())
+            except AuthError as e:
+                conn.write_unary_response(
+                    stream_id,
+                    grpc_frame(failure_message(str(e), e.status).SerializeToString()),
+                )
+                return
+
+            def done(status: int, message: str, body: bytes) -> None:
+                conn.relay_cancels.pop(stream_id, None)
+                if status == 0:
+                    conn.write_unary_response(stream_id, body)
+                elif status == 14 and "unreachable" in message:
+                    conn.write_unary_response(
+                        stream_id,
+                        grpc_frame(failure_message(message, 503).SerializeToString()),
+                    )
+                else:
+                    # the engine answered — it chose this status (e.g.
+                    # INVALID_ARGUMENT for a bad request).  Propagate it
+                    # instead of claiming the engine is down.
+                    conn.write_unary_response(
+                        stream_id,
+                        grpc_frame(
+                            failure_message(
+                                message, _GRPC_TO_HTTP.get(status, 500)
+                            ).SerializeToString()
+                        ),
+                    )
+
+            channel = self._channel(rec)
+            cancel = channel.try_call_framed(
+                path, framed, done, timeout=gateway.timeout_s, metadata=metadata
             )
-        except AuthError as e:
-            return failure_message(str(e), e.status).SerializeToString()
-        except GrpcCallError as e:
-            # the engine answered — it chose this status (e.g. INVALID_ARGUMENT
-            # for a bad request).  Propagate it instead of claiming the engine
-            # is down, which would mislead clients and alerting.
-            return failure_message(
-                e.message, _GRPC_TO_HTTP.get(e.status, 500)
-            ).SerializeToString()
-        except (ConnectionError, asyncio.TimeoutError, OSError) as e:
-            return failure_message(f"engine unreachable: {e}", 503).SerializeToString()
+            if cancel is None:
+                # cold path: connection not yet established.  A client RST
+                # during the connect cancels the task (call never issued);
+                # once the call IS issued, the provisional cancel is swapped
+                # for the real stream cancel — unless the client already
+                # reset, in which case cancel the issued call immediately.
+                # Registered BEFORE create_task: an eager task can run
+                # through on_cancelable before create_task returns.
+                holder = {"cancel": None}
 
-    async def predict_raw(self, payload: bytes) -> bytes:
-        return await self._proxy("Predict", payload)
+                def provisional_cancel():
+                    c = holder["cancel"]
+                    if c is not None:
+                        c()
 
-    async def feedback_raw(self, payload: bytes) -> bytes:
-        return await self._proxy("SendFeedback", payload)
+                conn.relay_cancels[stream_id] = provisional_cancel
+
+                def on_cancelable(cancel2, sid=stream_id):
+                    if sid in conn.relay_cancels:
+                        conn.relay_cancels[sid] = cancel2
+                    else:
+                        cancel2()  # client reset while connecting
+
+                task = self._loop.create_task(
+                    channel.call_framed_connecting(
+                        path, framed, done,
+                        timeout=gateway.timeout_s, metadata=metadata,
+                        on_cancelable=on_cancelable,
+                    )
+                )
+                self._close_tasks.add(task)
+                task.add_done_callback(self._close_tasks.discard)
+                holder["cancel"] = task.cancel
+            else:
+                conn.relay_cancels[stream_id] = cancel
+
+        return relay
 
     async def stream_predict_raw(self, payload: bytes):
         """Relay the engine's server-streaming StreamPredict: messages
@@ -229,7 +299,7 @@ class FastGatewayGrpc(_ChannelCacheBase):
             async for msg in self._channel(rec).call_stream(
                 "/seldon.protos.Seldon/StreamPredict",
                 payload,
-                timeout=max(self.gateway.timeout_s * 30, 300.0),
+                timeout=getattr(self.gateway, "stream_timeout_s", 300.0),
                 metadata=tuple(outgoing_headers().items()),
             ):
                 yield msg
@@ -258,13 +328,14 @@ async def start_gateway_grpc(gateway, port: int):
 
     handler = FastGatewayGrpc(gateway, loop=loop)
     server = FastGrpcServer(
-        {
-            "/seldon.protos.Seldon/Predict": handler.predict_raw,
-            "/seldon.protos.Seldon/SendFeedback": handler.feedback_raw,
-        },
+        {},
         on_request_headers=handler.seed_metadata,
         stream_handlers={
             "/seldon.protos.Seldon/StreamPredict": handler.stream_predict_raw
+        },
+        relay_handlers={
+            "/seldon.protos.Seldon/Predict": handler.make_relay("Predict"),
+            "/seldon.protos.Seldon/SendFeedback": handler.make_relay("SendFeedback"),
         },
     )
     bound = await server.start(port)

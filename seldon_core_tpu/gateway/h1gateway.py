@@ -1,0 +1,928 @@
+"""HTTP/1.1 splice front end: the gateway's default REST data plane.
+
+The reference's apife forwards the raw JSON body untouched (reference:
+api-frontend/.../rest/RestClientController.java:136-144) but still pays a
+full servlet stack per request.  Here the observation is taken to its
+conclusion: on the hot path (``POST /api/v0.1/predictions``) the bytes the
+gateway RECEIVES are exactly the bytes it SENDS — so after parsing just the
+request line, ``Authorization``, and ``Content-Length``, the raw request
+block is spliced verbatim onto a pooled engine connection and the engine's
+response bytes are spliced straight back (head parsed only for framing).
+No request/response objects, no header re-serialization, no body copy
+beyond the kernel's.
+
+Upstream, requests MULTIPLEX over a few persistent pipelined connections
+(the h1 analogue of what HTTP/2 gives the gRPC relay): concurrent
+downstream requests ride one engine socket back-to-back, so one coalesced
+write carries many requests and one read returns many responses —
+kernel-side cost per request approaches the direct path's.  Responses
+dequeue strictly in order per connection (RFC 9112 §9.3.2); an engine
+connection that dies replays its un-responded (idempotent) requests on a
+fresh one.
+
+Everything that needs real parsing (oauth grants, feedback reward
+counters, tap-enabled predictions, ops endpoints) falls back to
+:class:`~seldon_core_tpu.gateway.app.GatewayApp`'s transport-independent
+cores, so behavior matches the aiohttp front end exactly.
+
+SSE streaming (``/api/v0.1/predictions/stream``) rides the same splice —
+chunked response bodies forward incrementally as they arrive, which gives
+REST clients authenticated token streaming through the gateway (previously
+engine-direct only).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import json
+import logging
+import time
+import urllib.parse
+from typing import Optional
+
+from seldon_core_tpu.contract import failure_status_dict
+from seldon_core_tpu.gateway.auth import AuthError
+from seldon_core_tpu.wire.h2grpc import _dual_stack_socket
+from seldon_core_tpu.wire.iobuf import WriteCoalescer
+
+log = logging.getLogger(__name__)
+
+_REASONS = {
+    200: b"OK", 400: b"Bad Request", 401: b"Unauthorized", 404: b"Not Found",
+    405: b"Method Not Allowed", 411: b"Length Required", 502: b"Bad Gateway",
+    503: b"Service Unavailable", 504: b"Gateway Timeout",
+}
+
+# POST paths spliced raw to the engine; value is the metrics service label
+_SPLICE_PATHS = {
+    b"/api/v0.1/predictions": "predictions",
+    b"/api/v0.1/predictions/stream": "predictions_stream",
+}
+
+# persistent pipelined engine connections per deployment: few enough that
+# writes coalesce, enough that pipelining depth stays shallow
+import os as _os
+
+_MAX_UPSTREAM_CONNS = int(_os.environ.get("SCT_GW_UPSTREAM_CONNS", "8"))
+
+# request body ceiling (aiohttp front-end parity: client_max_size)
+_MAX_BODY = int(_os.environ.get("GATEWAY_MAX_BODY", str(256 * 1024 * 1024)))
+
+# hop-by-hop headers an intermediary must not forward (RFC 9112 §7.6.1)
+_HOP_BY_HOP = (b"connection", b"keep-alive", b"proxy-connection", b"upgrade")
+
+
+def _response(status: int, body: bytes, content_type: bytes = b"application/json") -> bytes:
+    return (
+        b"HTTP/1.1 %d %s\r\ncontent-type: %s\r\ncontent-length: %d\r\n\r\n"
+        % (status, _REASONS.get(status, b""), content_type, len(body))
+        + body
+    )
+
+
+def _error_response(status: int, reason: str) -> bytes:
+    return _response(status, json.dumps(failure_status_dict(status, reason)).encode())
+
+
+class _Job:
+    """One spliced request in an upstream FIFO."""
+
+    __slots__ = ("down", "raw", "streaming")
+
+    def __init__(self, down: "_DownConn", raw: bytes, streaming: bool):
+        self.down: "_DownConn | None" = down  # None once abandoned/failed
+        self.raw: bytes = raw  # retained until its response starts (replay)
+        self.streaming = streaming
+
+
+# ---------------------------------------------------------------------------
+# Upstream (engine) side: pipelined multiplexing
+# ---------------------------------------------------------------------------
+
+class _UpConn(WriteCoalescer, asyncio.Protocol):
+    """One persistent engine connection carrying pipelined requests;
+    responses forward to the FIFO head's downstream as bytes arrive."""
+
+    def __init__(self, pool: "_UpstreamPool"):
+        self.pool = pool
+        self.transport: asyncio.Transport | None = None
+        self.streaming = False  # dedicated SSE conn: closed after its job
+        self.fifo: collections.deque[_Job] = collections.deque()
+        self.buf = bytearray()
+        # write coalescing: many pipelined requests -> one syscall
+        self._init_coalescer(pool.loop)
+        self.status = 0
+        # framing state for the ACTIVE (head-of-fifo) response
+        self._in_head = True
+        self._remaining: Optional[int] = None
+        self._chunked = False
+        self._close_framed = False
+        self._chunk_state = 0  # 0=size line, 1=data, 2=data CRLF, 3=trailers
+        self._chunk_left = 0
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        sock = transport.get_extra_info("socket")
+        if sock is not None:
+            import socket as _socket
+
+            try:
+                sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        if self.transport is not None:
+            self.transport.close()
+
+    @property
+    def alive(self) -> bool:
+        return self.transport is not None and not self.transport.is_closing()
+
+    # -- request side -------------------------------------------------------
+
+    def send_request(self, job: _Job) -> None:
+        self.fifo.append(job)
+        self.queue_write(job.raw)
+
+    # -- response side ------------------------------------------------------
+
+    def data_received(self, data: bytes) -> None:
+        if not self.fifo:
+            # unsolicited bytes with nothing outstanding: protocol confusion
+            self.close()
+            return
+        self.fifo[0].raw = b""  # response started: no replay for the head
+        if self._in_head:
+            self.buf += data
+            while True:
+                idx = self.buf.find(b"\r\n\r\n")
+                if idx < 0:
+                    return
+                head = bytes(self.buf[: idx + 4])
+                del self.buf[: idx + 4]
+                try:
+                    self._parse_head(head)
+                except ValueError as e:
+                    log.warning("bad engine response head: %s", e)
+                    self._fail_all(f"bad engine response: {e}")
+                    self.close()
+                    return
+                down = self.fifo[0].down
+                if 100 <= self.status < 200:
+                    # interim (e.g. 100 Continue): forward, keep head state
+                    if down is not None:
+                        down.forward(head)
+                    continue
+                self._in_head = False
+                if down is not None:
+                    down.forward(head)
+                rest = bytes(self.buf)
+                self.buf.clear()
+                if rest:
+                    self._feed_body(rest)
+                elif self._body_done():
+                    self._complete()
+                return
+        else:
+            self._feed_body(data)
+
+    def _parse_head(self, head: bytes) -> None:
+        # memoized: an engine's response head repeats byte-for-byte at
+        # steady state (same status/lengths; Date varies once a second)
+        cached = self.pool.head_cache.get(head)
+        if cached is not None:
+            (self.status, self._remaining, self._chunked,
+             self._close_framed) = cached
+            self._chunk_state = 0
+            self._chunk_left = 0
+            return
+        line_end = head.find(b"\r\n")
+        parts = head[:line_end].split(b" ", 2)
+        if len(parts) < 2:
+            raise ValueError(f"bad status line {head[:line_end]!r}")
+        self.status = int(parts[1])
+        self._remaining = None
+        self._chunked = False
+        self._close_framed = False
+        self._chunk_state = 0
+        self._chunk_left = 0
+        for line in head[line_end + 2 : -2].split(b"\r\n"):
+            name, _, value = line.partition(b":")
+            name = name.strip().lower()
+            if name == b"content-length":
+                self._remaining = int(value.strip())
+            elif name == b"transfer-encoding":
+                if b"chunked" in value.lower():
+                    self._chunked = True
+            elif name == b"connection":
+                if value.strip().lower() == b"close":
+                    self._close_framed = True
+        if self.status in (204, 304):
+            self._remaining = 0
+        if not self._chunked and self._remaining is None:
+            # no length, no chunking: framed by connection close — this conn
+            # cannot carry the rest of its pipeline
+            self._close_framed = True
+        if len(self.pool.head_cache) >= 256:
+            # clear-on-full: Date rotates every second, so stop-on-full
+            # would go permanently cold after 256 distinct heads
+            self.pool.head_cache.clear()
+        self.pool.head_cache[head] = (
+            self.status, self._remaining, self._chunked, self._close_framed
+        )
+
+    def _body_done(self) -> bool:
+        return not self._chunked and self._remaining == 0
+
+    def _feed_body(self, data: bytes) -> None:
+        down = self.fifo[0].down
+        if self._chunked:
+            self._feed_chunked(data)
+            return
+        if self._remaining is None:  # close-framed: forward until EOF
+            if down is not None:
+                down.forward(data)
+            return
+        n = len(data)
+        if n <= self._remaining:
+            self._remaining -= n
+            if down is not None:
+                down.forward(data)
+            if self._remaining == 0:
+                self._complete()
+        else:
+            share = self._remaining
+            self._remaining = 0
+            if down is not None:
+                down.forward(data[:share])
+            self._complete()
+            # remainder belongs to the NEXT pipelined response
+            if data[share:]:
+                self.data_received(data[share:])
+
+    def _feed_chunked(self, data: bytes) -> None:
+        """Incremental chunked-body forward: bytes stream downstream as they
+        arrive (SSE events must not buffer), state tracks chunk boundaries."""
+        down = self.fifo[0].down
+        self.buf += data
+        buf = self.buf
+        pos = 0
+        try:
+            while True:
+                if self._chunk_state == 0:  # chunk size line
+                    nl = buf.find(b"\r\n", pos)
+                    if nl < 0:
+                        break
+                    self._chunk_left = int(bytes(buf[pos:nl]).split(b";", 1)[0], 16)
+                    pos = nl + 2
+                    self._chunk_state = 3 if self._chunk_left == 0 else 1
+                elif self._chunk_state == 1:  # chunk data
+                    take = min(self._chunk_left, len(buf) - pos)
+                    if take == 0:
+                        break
+                    pos += take
+                    self._chunk_left -= take
+                    if self._chunk_left == 0:
+                        self._chunk_state = 2
+                elif self._chunk_state == 2:  # CRLF after chunk data
+                    if len(buf) - pos < 2:
+                        break
+                    pos += 2
+                    self._chunk_state = 0
+                else:  # trailers until blank line
+                    nl = buf.find(b"\r\n", pos)
+                    if nl < 0:
+                        break
+                    line = bytes(buf[pos:nl])
+                    pos = nl + 2
+                    if not line:
+                        if down is not None:
+                            down.forward(bytes(buf[:pos]))
+                        rest = bytes(buf[pos:])
+                        buf.clear()
+                        self._complete()
+                        if rest:
+                            self.data_received(rest)
+                        return
+        except ValueError as e:
+            log.warning("bad chunked framing from engine: %s", e)
+            self._fail_all(f"bad chunked framing: {e}")
+            self.close()
+            return
+        # forward everything consumed (complete chunks or mid-chunk data)
+        if pos:
+            if down is not None:
+                down.forward(bytes(buf[:pos]))
+            del buf[:pos]
+
+    def _complete(self) -> None:
+        job = self.fifo.popleft()
+        status = self.status
+        self._in_head = True
+        if self._close_framed or self.streaming:
+            # close-framed: the conn can't carry more responses; streaming:
+            # dedicated conn, not in the pool's rotation — either way it
+            # must not linger as an untracked idle socket
+            self.close()  # connection_lost replays any remaining fifo
+        if job.down is not None:
+            if self._close_framed:
+                # the forwarded head said "connection: close": the client
+                # expects ITS connection to close too
+                job.down.close_after = True
+            job.down.upstream_done(status)
+
+    def _fail_all(self, reason: str) -> None:
+        jobs, self.fifo = list(self.fifo), collections.deque()
+        for i, job in enumerate(jobs):
+            if job.down is None:
+                continue
+            # the head response may be partially forwarded; the rest were
+            # never answered
+            job.down.upstream_failed(reason, forwarded=(i == 0 and not self._in_head))
+
+    def connection_lost(self, exc) -> None:
+        self.pool.drop(self)
+        if not self.fifo:
+            return
+        jobs, self.fifo = list(self.fifo), collections.deque()
+        head_active = not self._in_head
+        # close-framed body: EOF IS completion for the head response
+        if head_active and self._remaining is None and not self._chunked:
+            job = jobs.pop(0)
+            if job.down is not None:
+                # close-delimited body: upstream EOF IS completion, and the
+                # client (whose head said close-framed) needs the same EOF
+                job.down.close_after = True
+                job.down.upstream_done(self.status)
+            head_active = False
+        elif head_active:
+            job = jobs.pop(0)
+            if job.down is not None:
+                job.down.upstream_failed(
+                    f"engine connection lost mid-response: {exc}", forwarded=True
+                )
+            head_active = False
+        # everything else was never answered: replay (predictions are
+        # idempotent; feedback never rides the splice)
+        for job in jobs:
+            if job.down is None:
+                continue
+            if job.raw:
+                self.pool.spawn_send(job)
+            else:
+                job.down.upstream_failed(f"engine connection lost: {exc}", forwarded=False)
+
+
+class _UpstreamPool:
+    """A small set of persistent pipelined _UpConns for one engine."""
+
+    def __init__(self, host: str, port: int, loop: asyncio.AbstractEventLoop):
+        self.host = host
+        self.port = port
+        self.loop = loop
+        self.conns: list[_UpConn] = []
+        self.stream_conns: set[_UpConn] = set()  # dedicated SSE conns
+        self.closed = False
+        self.head_cache: dict[bytes, tuple] = {}  # response-head parse memo
+        self._connecting = 0  # in-flight connects that count against the cap
+        self.pending: collections.deque[_Job] = collections.deque()
+        self._tasks: set[asyncio.Task] = set()
+
+    def submit(self, job: _Job) -> None:
+        """Queue on the least-loaded live connection, growing the set up to
+        the cap; streaming jobs get a dedicated connection (a long-lived SSE
+        response must not head-of-line-block pipelined unary calls).
+        ``conns`` holds live conns only (pruned by drop())."""
+        if not job.streaming:
+            best = None
+            best_depth = -1
+            for c in self.conns:
+                d = len(c.fifo)
+                if d == 0:
+                    c.send_request(job)
+                    return
+                if best is None or d < best_depth:
+                    best, best_depth = c, d
+            if len(self.conns) + self._connecting >= _MAX_UPSTREAM_CONNS:
+                if best is not None:
+                    best.send_request(job)
+                else:
+                    # every cap slot is an in-flight connect (cold burst):
+                    # park until one lands
+                    self.pending.append(job)
+                return
+        self.spawn_send(job)
+
+    def spawn_send(self, job: _Job) -> None:
+        """Connect a fresh conn, then send (cold path / replay / stream).
+        Non-streaming connects count against the cap while in flight."""
+        if not job.streaming:
+            self._connecting += 1
+
+        async def run():
+            counted = not job.streaming
+            try:
+                _, conn = await self.loop.create_connection(
+                    lambda: _UpConn(self), self.host, self.port
+                )
+            except OSError as e:
+                if counted:
+                    self._connecting -= 1
+                if job.down is not None:
+                    job.down.upstream_failed(f"engine unreachable: {e}", forwarded=False)
+                # parked jobs must not wait on a connect that failed
+                while self.pending:
+                    p = self.pending.popleft()
+                    if p.down is not None:
+                        p.down.upstream_failed(f"engine unreachable: {e}", forwarded=False)
+                return
+            if counted:
+                self._connecting -= 1
+            if self.closed:
+                conn.close()
+                return
+            if job.streaming:
+                conn.streaming = True
+                self.stream_conns.add(conn)
+            else:
+                self.conns.append(conn)
+            conn.send_request(job)
+            # drain parked jobs onto the now-live pool
+            while self.pending:
+                self.submit(self.pending.popleft())
+
+        task = self.loop.create_task(run())
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    def drop(self, conn: _UpConn) -> None:
+        try:
+            self.conns.remove(conn)
+        except ValueError:
+            pass
+        self.stream_conns.discard(conn)
+
+    def evict(self) -> None:
+        self.closed = True
+        conns, self.conns = self.conns, []
+        streams, self.stream_conns = list(self.stream_conns), set()
+        for c in conns:
+            c.close()
+        for c in streams:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# Downstream (client) side
+# ---------------------------------------------------------------------------
+
+class _DownConn(WriteCoalescer, asyncio.Protocol):
+    def __init__(self, frontend: "H1SpliceFrontend"):
+        self.frontend = frontend
+        self.gateway = frontend.gateway
+        self.transport: asyncio.Transport | None = None
+        self.buf = bytearray()
+        self._scan = 0
+        self.awaiting = False  # a response (splice or fallback) is in flight
+        self.job: _Job | None = None
+        self.t0 = 0.0
+        self.deadline = 0.0
+        self.service = ""
+        self.rec = None
+        self.forwarded = False  # response bytes already written downstream
+        self.close_after = False
+        self._sent_continue = False
+        self._tasks: set[asyncio.Task] = set()
+        # write coalescing: response head + body (and any same-iteration
+        # writes) leave in one syscall
+        self._init_coalescer(frontend.loop)
+
+    def write(self, data: bytes) -> None:
+        self.queue_write(data)
+
+    def _close(self) -> None:
+        self.flush_now()
+        if self.transport is not None:
+            self.transport.close()
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        sock = transport.get_extra_info("socket")
+        if sock is not None:
+            import socket as _socket
+
+            try:
+                sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+        self.frontend._conns.add(self)
+
+    def connection_lost(self, exc) -> None:
+        self.frontend._conns.discard(self)
+        job, self.job = self.job, None
+        if job is not None:
+            # client went away: abandon the job — its response (if any)
+            # gets consumed and discarded, keeping the upstream pipeline
+            # intact for other clients
+            job.down = None
+        for t in self._tasks:
+            t.cancel()
+
+    # -- request processing -------------------------------------------------
+
+    def data_received(self, data: bytes) -> None:
+        self.buf += data
+        if not self.awaiting:
+            self._process()
+
+    def _process(self) -> None:
+        while not self.awaiting:
+            buf = self.buf
+            idx = buf.find(b"\r\n\r\n", self._scan)
+            if idx < 0:
+                self._scan = max(0, len(buf) - 3)
+                if len(buf) > 1 << 20:
+                    self.write(_error_response(400, "request head too large"))
+                    self._close()
+                return
+            head = bytes(buf[: idx + 4])
+            # memoized: a steady-state client's request head repeats
+            # byte-for-byte (same token, same lengths) across requests AND
+            # across its connections
+            cache = self.frontend.req_head_cache
+            parsed = cache.get(head)
+            if parsed is None:
+                parsed = self._parse_request_head(head, idx)
+                if parsed is None:
+                    return  # error written, connection closing
+                # bounded by count AND entry size: an unauthenticated peer
+                # must not be able to pin megabytes via giant unique heads
+                if len(head) <= 4096:
+                    if len(cache) >= 256:
+                        cache.clear()  # self-healing, never stop-on-full
+                    cache[head] = parsed
+            (method, route, content_length, auth, traceparent,
+             chunked, expect, close_after, rewritten_head) = parsed
+            if chunked:
+                # nothing we serve needs chunked uploads; keep the parser
+                # simple and honest
+                self.write(_error_response(411, "chunked requests unsupported"))
+                self._close()
+                return
+            if content_length > _MAX_BODY:
+                self.write(_error_response(400, "request body too large"))
+                self._close()
+                return
+            if expect and not self._sent_continue:
+                # ack exactly once per request, even when the body arrives
+                # across many reads (each re-entering this parse)
+                self.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+                self._sent_continue = True
+            total = idx + 4 + content_length
+            if len(buf) < total:
+                self._scan = idx  # head found; waiting on body bytes
+                return
+            self._scan = 0
+            self._sent_continue = False
+            self.close_after = close_after
+            service = _SPLICE_PATHS.get(route) if method == b"POST" else None
+            if service == "predictions" and (expect or self.gateway.tap.enabled):
+                # Expect requests take the fallback hop so the engine never
+                # sees the Expect header (we already sent the 100); tap
+                # needs the body object.  Streams stay spliced: a duplicate
+                # interim 100 is legal (RFC 9110 §15.2), losing streaming
+                # through the fallback would not be.
+                service = None
+            if service is None:
+                head_headers = (auth, traceparent)
+                body = bytes(buf[idx + 4 : total])
+                del buf[:total]
+                self.awaiting = True
+                self.deadline = 0.0  # fallback cores carry their own timeouts
+                task = self.frontend.loop.create_task(
+                    self._fallback(method, route, head_headers, body)
+                )
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
+                return
+            if rewritten_head is not None:
+                # hop-by-hop headers stripped / HTTP/1.0 line upgraded: the
+                # shared upstream conn must never see a client's Connection
+                # semantics (RFC 9112 §7.6.1)
+                raw = rewritten_head + bytes(buf[idx + 4 : total])
+            else:
+                raw = bytes(buf[:total])
+            del buf[:total]
+            try:
+                rec = self.gateway._principal_from_header(auth)
+            except AuthError as e:
+                self.frontend.observe("anonymous", "unknown", service, e.status, 0.0)
+                self.write(_error_response(e.status, str(e)))
+                if self.close_after:
+                    self._close()
+                    return
+                continue
+            if self.gateway._paused:
+                self.write(_error_response(503, "gateway is paused"))
+                if self.close_after:
+                    self._close()
+                    return
+                continue
+            streaming = service == "predictions_stream"
+            self.rec = rec
+            self.service = service
+            self.awaiting = True
+            self.forwarded = False
+            self.t0 = time.perf_counter()
+            timeout = (
+                self.gateway.stream_timeout_s if streaming else self.gateway.timeout_s
+            )
+            self.deadline = self.frontend.loop.time() + timeout
+            job = _Job(self, raw, streaming)
+            self.job = job
+            self.frontend.pool_for(rec).submit(job)
+            return
+
+    def _parse_request_head(self, head: bytes, idx: int) -> tuple | None:
+        """Full request-head parse (cache miss); returns None after writing
+        an error response for malformed input."""
+        line_end = head.find(b"\r\n")
+        parts = head[:line_end].split(b" ", 2)
+        if len(parts) != 3:
+            self.write(_error_response(400, "malformed request line"))
+            self._close()
+            return None
+        method, path, version = parts
+        # strip query string for routing (forwarded verbatim regardless)
+        route = path.split(b"?", 1)[0]
+        content_length = None
+        auth = ""
+        traceparent = None
+        chunked = False
+        expect = False
+        close_after = version == b"HTTP/1.0"
+        needs_rewrite = version != b"HTTP/1.1"
+        kept_lines = []
+        for line in head[line_end + 2 : -4].split(b"\r\n"):
+            name, _, value = line.partition(b":")
+            name = name.lower()
+            if name in _HOP_BY_HOP:
+                needs_rewrite = True
+            else:
+                kept_lines.append(line)
+            if name == b"content-length":
+                # STRICT parse: the raw head is spliced onto a shared
+                # pipelined engine connection, so any framing value the
+                # engine could read differently (signs, underscores,
+                # duplicates) is a request-smuggling vector — reject
+                v = value.strip()
+                if not v.isdigit() or (
+                    content_length is not None and content_length != int(v)
+                ):
+                    self.write(_error_response(400, "bad content-length"))
+                    self._close()
+                    return None
+                content_length = int(v)
+            elif name == b"authorization":
+                auth = value.strip().decode("latin-1")
+            elif name == b"traceparent":
+                traceparent = value.strip().decode("latin-1")
+            elif name == b"transfer-encoding":
+                chunked = b"chunked" in value.lower()
+            elif name == b"expect":
+                expect = b"100-continue" in value.lower()
+            elif name == b"connection":
+                v = value.strip().lower()
+                if v == b"close":
+                    close_after = True
+                elif v == b"keep-alive":
+                    close_after = False
+        rewritten = None
+        if needs_rewrite:
+            # rebuild the head for the shared upstream conn: HTTP/1.1 line,
+            # hop-by-hop headers dropped (the gateway owns both connections'
+            # lifecycle; the client's Connection choice binds only downstream)
+            rewritten = (
+                method + b" " + path + b" HTTP/1.1\r\n"
+                + b"\r\n".join(kept_lines)
+                + (b"\r\n\r\n" if kept_lines else b"\r\n")
+            )
+        return (
+            method, route, content_length or 0, auth, traceparent,
+            chunked, expect, close_after, rewritten,
+        )
+
+    # -- splice callbacks ---------------------------------------------------
+
+    def forward(self, data: bytes) -> None:
+        self.forwarded = True
+        self.write(data)
+
+    def upstream_done(self, status: int) -> None:
+        self.job = None
+        rec = self.rec
+        self.frontend.observe(
+            rec.oauth_key if rec else "anonymous",
+            rec.name if rec else "unknown",
+            self.service,
+            status,
+            time.perf_counter() - self.t0,
+        )
+        self._next()
+
+    def upstream_failed(self, reason: str, forwarded: bool) -> None:
+        self.job = None
+        rec = self.rec
+        self.frontend.observe(
+            rec.oauth_key if rec else "anonymous",
+            rec.name if rec else "unknown",
+            self.service,
+            503,
+            time.perf_counter() - self.t0,
+        )
+        if self.transport is None or self.transport.is_closing():
+            return
+        if forwarded or self.forwarded:
+            # a partial response is on the wire: the only honest move is to
+            # cut the connection so the client sees a broken response
+            self._close()
+            return
+        self.write(_error_response(503, reason))
+        self._next()
+
+    def _next(self) -> None:
+        self.awaiting = False
+        self.rec = None
+        if self.transport is None or self.transport.is_closing():
+            return
+        if self.close_after:
+            self._close()
+            return
+        if self.buf:
+            self._process()
+
+    # -- fallback (full-parse) path -----------------------------------------
+
+    async def _fallback(self, method: bytes, route: bytes, meta, body: bytes) -> None:
+        auth, traceparent = meta
+        try:
+            status, payload, ctype = await self.frontend.handle_fallback(
+                method, route, auth, traceparent, body
+            )
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.exception("fallback handler failed")
+            status, payload, ctype = 500, json.dumps(
+                failure_status_dict(500, f"{type(e).__name__}: {e}")
+            ).encode(), b"application/json"
+        if self.transport is not None and not self.transport.is_closing():
+            self.write(_response(status, payload, ctype))
+        self._next()
+
+
+# ---------------------------------------------------------------------------
+# Front end
+# ---------------------------------------------------------------------------
+
+class H1SpliceFrontend:
+    """The gateway's default REST server (``SCT_REST_IMPL=aiohttp`` falls
+    back to the aiohttp app)."""
+
+    def __init__(self, gateway):
+        self.gateway = gateway
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: set[_DownConn] = set()
+        self._pools: dict[str, _UpstreamPool] = {}
+        self.req_head_cache: dict[bytes, tuple] = {}  # request-head parse memo
+        self._metric_children: dict[tuple, object] = {}
+        self._reap_handle: asyncio.TimerHandle | None = None
+        self.bound_port = 0
+        gateway.store.add_listener(self._on_deployment_event)
+
+    def _on_deployment_event(self, event: str, rec) -> None:
+        if event in ("removed", "updated"):
+            pool = self._pools.pop(rec.oauth_key, None)
+            if pool is not None and self.loop is not None:
+                self.loop.call_soon_threadsafe(pool.evict)
+
+    def pool_for(self, rec) -> _UpstreamPool:
+        pool = self._pools.get(rec.oauth_key)
+        if pool is None:
+            host = rec.engine_host or rec.name
+            pool = _UpstreamPool(host, rec.engine_rest_port, self.loop)
+            self._pools[rec.oauth_key] = pool
+        return pool
+
+    def observe(self, principal: str, name: str, service: str, code: int, dt: float) -> None:
+        key = (principal, name, service, code)
+        child = self._metric_children.get(key)
+        if child is None:
+            child = self.gateway.metrics.ingress_requests.labels(
+                principal, name, service, "POST", str(code)
+            )
+            if len(self._metric_children) < 4096:
+                self._metric_children[key] = child
+        child.observe(dt)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self, port: int, host: str | None = None) -> int:
+        self.loop = asyncio.get_running_loop()
+        if host is None:
+            sock = _dual_stack_socket(port, reuse_port=False)
+            self._server = await self.loop.create_server(
+                lambda: _DownConn(self), sock=sock
+            )
+        else:
+            self._server = await self.loop.create_server(
+                lambda: _DownConn(self), host, port
+            )
+        self.bound_port = self._server.sockets[0].getsockname()[1]
+        self._reap_handle = self.loop.call_later(1.0, self._reap)
+        return self.bound_port
+
+    def _reap(self) -> None:
+        now = self.loop.time()
+        for conn in list(self._conns):
+            if conn.awaiting and conn.deadline and now >= conn.deadline:
+                job, conn.job = conn.job, None
+                if job is not None:
+                    job.down = None  # discard whatever the engine returns
+                if conn.transport is not None and not conn.transport.is_closing():
+                    if not conn.forwarded:
+                        conn.write(_error_response(504, "engine timed out"))
+                    conn._close()
+        self._reap_handle = self.loop.call_later(1.0, self._reap)
+
+    async def stop(self) -> None:
+        self.gateway.store.remove_listener(self._on_deployment_event)
+        if self._reap_handle is not None:
+            self._reap_handle.cancel()
+            self._reap_handle = None
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for conn in list(self._conns):
+            if conn.transport is not None:
+                conn.transport.close()
+        self._conns.clear()
+        pools, self._pools = list(self._pools.values()), {}
+        for p in pools:
+            p.evict()
+
+    # -- fallback routing ---------------------------------------------------
+
+    async def handle_fallback(
+        self, method: bytes, route: bytes, auth: str, traceparent: str | None, body: bytes
+    ) -> tuple[int, bytes, bytes]:
+        gw = self.gateway
+        if route == b"/api/v0.1/predictions" and method == b"POST":
+            status, payload = await gw.ingress_core(
+                auth, traceparent, body, "/api/v0.1/predictions", "predictions"
+            )
+            return status, payload, b"application/json"
+        if route == b"/api/v0.1/feedback" and method == b"POST":
+            status, payload = await gw.ingress_core(
+                auth, traceparent, body, "/api/v0.1/feedback", "feedback"
+            )
+            return status, payload, b"application/json"
+        if route == b"/oauth/token" and method == b"POST":
+            client_id = client_secret = ""
+            if auth.startswith("Basic "):
+                import base64
+
+                try:
+                    decoded = base64.b64decode(auth[6:]).decode()
+                    client_id, _, client_secret = decoded.partition(":")
+                except Exception:
+                    return 400, json.dumps(
+                        failure_status_dict(400, "malformed basic auth header")
+                    ).encode(), b"application/json"
+            if not client_id:
+                form = urllib.parse.parse_qs(body.decode("latin-1"))
+                client_id = (form.get("client_id") or [""])[0]
+                client_secret = (form.get("client_secret") or [""])[0]
+            status, payload = gw.issue_token(client_id, client_secret)
+            return status, json.dumps(payload).encode(), b"application/json"
+        if route == b"/ping":
+            return 200, b"pong", b"text/plain"
+        if route == b"/ready":
+            if gw._paused:
+                return 503, b"paused", b"text/plain"
+            return 200, b"ready", b"text/plain"
+        if route == b"/pause" and method == b"POST":
+            gw._paused = True
+            return 200, b"paused", b"text/plain"
+        if route == b"/unpause" and method == b"POST":
+            gw._paused = False
+            return 200, b"unpaused", b"text/plain"
+        if route == b"/prometheus":
+            return 200, gw.metrics.expose(), b"text/plain"
+        return 404, json.dumps(
+            failure_status_dict(404, f"no route {route.decode('latin-1')}")
+        ).encode(), b"application/json"

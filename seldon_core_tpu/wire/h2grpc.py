@@ -24,11 +24,13 @@ priorities (ignored — optional per spec), TLS.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import logging
 import struct
 from typing import Any, Awaitable, Callable
 
 from seldon_core_tpu.wire import hpack
+from seldon_core_tpu.wire.iobuf import WriteCoalescer
 
 log = logging.getLogger(__name__)
 
@@ -72,10 +74,9 @@ _pack_header = struct.Struct(">IBBI")  # we pack len into top 3 bytes manually
 
 
 def frame(ftype: int, flags: int, stream_id: int, payload: bytes = b"") -> bytes:
-    n = len(payload)
+    # 9-byte frame header as ONE int → bytes: len(24) type(8) flags(8) id(32)
     return (
-        bytes(((n >> 16) & 0xFF, (n >> 8) & 0xFF, n & 0xFF, ftype, flags))
-        + stream_id.to_bytes(4, "big")
+        (len(payload) << 48 | ftype << 40 | flags << 32 | stream_id).to_bytes(9, "big")
         + payload
     )
 
@@ -107,7 +108,7 @@ class GrpcStreamRefusedError(ConnectionError):
 # Shared connection machinery (frame parse + flow control)
 # ---------------------------------------------------------------------------
 
-class _Conn(asyncio.Protocol):
+class _Conn(WriteCoalescer, asyncio.Protocol):
     """Common HTTP/2 connection state for both server and client roles."""
 
     is_server = False
@@ -130,7 +131,11 @@ class _Conn(asyncio.Protocol):
         self._headers_in_flight: tuple[int, int, list[bytes]] | None = None
         # streaming producers parked on flow control (drain_sends)
         self._send_waiters: list[asyncio.Future] = []
-        self.closed = asyncio.get_event_loop().create_future()
+        self._loop = asyncio.get_event_loop()
+        # write coalescing (wire/iobuf.py): frames queue and flush once per
+        # loop iteration — one writev carries many streams' frames
+        self._init_coalescer(self._loop)
+        self.closed = self._loop.create_future()
 
     # -- transport events ---------------------------------------------------
 
@@ -161,6 +166,7 @@ class _Conn(asyncio.Protocol):
         )
 
     def connection_lost(self, exc: Exception | None) -> None:
+        self.drop_writes()
         if not self.closed.done():
             self.closed.set_result(exc)
         # parked streaming producers must not wait on a dead connection
@@ -203,7 +209,8 @@ class _Conn(asyncio.Protocol):
             log.warning("h2 protocol error: %s", e)
             self._pos = pos
             if self.transport is not None:
-                self.transport.write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 1)))
+                self.queue_write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 1)))
+                self.flush_now()
                 self.transport.close()
             return
         # compact the buffer once consumed past 64KB to bound memory
@@ -260,7 +267,7 @@ class _Conn(asyncio.Protocol):
                 # 7541 §4.2), which is stateless (never uses the dynamic
                 # table) and therefore always compliant; our DECODER's limit
                 # is the 4096 we advertised, not the peer's value
-            self.transport.write(frame(SETTINGS, ACK, 0))
+            self.queue_write(frame(SETTINGS, ACK, 0))
             self._pump_sends()
         elif ftype == WINDOW_UPDATE:
             (incr,) = struct.unpack(">I", payload)
@@ -280,7 +287,7 @@ class _Conn(asyncio.Protocol):
             self._pump_sends()
         elif ftype == PING:
             if not flags & ACK:
-                self.transport.write(frame(PING, ACK, 0, payload))
+                self.queue_write(frame(PING, ACK, 0, payload))
         elif ftype == RST_STREAM:
             self._on_rst(stream_id, struct.unpack(">I", payload)[0])
         elif ftype == GOAWAY:
@@ -290,7 +297,11 @@ class _Conn(asyncio.Protocol):
         # PRIORITY and unknown frame types: ignored (per spec)
 
     def _headers_done(self, stream_id: int, flags: int, blocks: list[bytes]) -> None:
-        headers = self.decoder.decode(b"".join(blocks))
+        # memoized: repeat blocks (constant templates both directions) skip
+        # the full HPACK decode.  The list is shared — never mutated.
+        headers = self.decoder.decode_cached(
+            blocks[0] if len(blocks) == 1 else b"".join(blocks)
+        )
         self._on_headers(stream_id, headers, bool(flags & END_STREAM))
 
     # -- receive flow control ----------------------------------------------
@@ -300,7 +311,7 @@ class _Conn(asyncio.Protocol):
         one WINDOW_UPDATE per ~1MB consumed, not per frame."""
         self._recv_credit += n
         if self._recv_credit >= 1024 * 1024:
-            self.transport.write(
+            self.queue_write(
                 frame(WINDOW_UPDATE, 0, 0, struct.pack(">I", self._recv_credit))
             )
             self._recv_credit = 0
@@ -309,7 +320,7 @@ class _Conn(asyncio.Protocol):
         # per-stream windows: our INITIAL_WINDOW_SIZE is BIG_WINDOW; unary
         # messages larger than that need explicit stream credit
         if n > 0:
-            self.transport.write(
+            self.queue_write(
                 frame(WINDOW_UPDATE, 0, stream_id, struct.pack(">I", n))
             )
 
@@ -371,7 +382,7 @@ class _Conn(asyncio.Protocol):
         for sid in finished - still_queued:
             self._stream_out.pop(sid, None)
         if out:
-            self.transport.write(b"".join(out))
+            self.queue_write(b"".join(out) if len(out) > 1 else out[0])
         self._wake_send_waiters()
 
     def forget_stream(self, stream_id: int) -> None:
@@ -487,11 +498,19 @@ class _ServerConn(_Conn):
         conns: "set[_ServerConn] | None" = None,
         on_request_headers: "Callable[[list], None] | None" = None,
         stream_handlers: "dict[bytes, Any] | None" = None,
+        relay_handlers: "dict[bytes, Any] | None" = None,
     ):
         super().__init__()
         self.handlers = handlers
         # server-streaming RPCs: async fn(bytes) -> AsyncIterator[bytes]
         self.stream_handlers = stream_handlers or {}
+        # inline relays: sync fn(conn, stream_id, headers, framed_body) that
+        # completes the stream later via conn.write_unary_response — no
+        # task, no future, no gRPC re-framing (the proxy hot path)
+        self.relay_handlers = relay_handlers or {}
+        # stream_id -> zero-arg cancel fn, set by relay handlers so a client
+        # RST propagates upstream instead of leaving the backend computing
+        self.relay_cancels: dict[int, Any] = {}
         # invoked with the request header list inside the context the
         # handler task will inherit — lets the application seed per-request
         # contextvars (e.g. traceparent) without wire/ knowing about them
@@ -535,10 +554,20 @@ class _ServerConn(_Conn):
             self._finish_request(stream_id)
 
     def _stream_open(self, stream_id: int) -> bool:
-        return stream_id in self._streams or stream_id in self._stream_tasks
+        return (
+            stream_id in self._streams
+            or stream_id in self._stream_tasks
+            or stream_id in self.relay_cancels
+        )
 
     def _on_rst(self, stream_id: int, code: int) -> None:
         self._streams.pop(stream_id, None)
+        cancel = self.relay_cancels.pop(stream_id, None)
+        if cancel is not None:
+            try:
+                cancel()
+            except Exception:
+                log.exception("relay cancel failed")
         task = self._stream_tasks.pop(stream_id, None)
         if task is not None:
             # client cancelled (e.g. its deadline passed): stop the handler
@@ -556,6 +585,19 @@ class _ServerConn(_Conn):
 
     def _finish_request(self, stream_id: int) -> None:
         path, body, headers = self._streams.pop(stream_id)
+        relay = self.relay_handlers.get(path)
+        if relay is not None:
+            # proxy hot path: runs inline in this callback — auth, upstream
+            # forward and the response write all happen without creating a
+            # task or parsing the gRPC message framing
+            try:
+                relay(self, stream_id, headers, bytes(body))
+            except Exception as e:
+                log.exception("relay handler failed")
+                self._send_error(
+                    stream_id, GRPC_STATUS_UNKNOWN, f"{type(e).__name__}: {e}"
+                )
+            return
         stream_handler = self.stream_handlers.get(path)
         handler = self.handlers.get(path)
         if handler is None and stream_handler is None:
@@ -578,8 +620,6 @@ class _ServerConn(_Conn):
             # failure (e.g. non-UTF-8 metadata) fails THIS stream only —
             # letting it escape would GOAWAY the whole connection and kill
             # every other caller multiplexed on it.
-            import contextvars
-
             ctx = contextvars.copy_context()
             try:
                 ctx.run(self._on_request_headers, headers)
@@ -614,9 +654,13 @@ class _ServerConn(_Conn):
             log.exception("grpc handler failed")
             self._send_error(stream_id, GRPC_STATUS_UNKNOWN, f"{type(e).__name__}: {e}")
             return
+        self.write_unary_response(stream_id, grpc_frame(response))
+
+    def write_unary_response(self, stream_id: int, body: bytes) -> None:
+        """Complete a unary stream: response headers + ``body`` (already
+        gRPC-framed) + OK trailers.  Hot path is ONE coalesced write."""
         if self.transport is None or self.transport.is_closing():
             return
-        body = grpc_frame(response)
         trailers = frame(HEADERS, END_HEADERS | END_STREAM, stream_id, _TRAILERS_OK)
         swin = self._stream_out.get(stream_id, self.peer_initial_window)
         if (
@@ -628,7 +672,7 @@ class _ServerConn(_Conn):
             # hot path: the whole response (headers + data + trailers) in
             # ONE write — one syscall, one TCP segment group
             self.out_window -= len(body)
-            self.transport.write(
+            self.queue_write(
                 frame(HEADERS, END_HEADERS, stream_id, _RESPONSE_HEADERS)
                 + frame(DATA, 0, stream_id, body)
                 + trailers
@@ -637,7 +681,7 @@ class _ServerConn(_Conn):
             return
         # windowed path: trailers ride the send queue so they can never
         # overtake DATA parked on flow control
-        self.transport.write(frame(HEADERS, END_HEADERS, stream_id, _RESPONSE_HEADERS))
+        self.queue_write(frame(HEADERS, END_HEADERS, stream_id, _RESPONSE_HEADERS))
         self.send_data(stream_id, body, end_stream=False)
         self.send_raw_after_data(stream_id, trailers)
         self.forget_stream(stream_id)
@@ -653,7 +697,7 @@ class _ServerConn(_Conn):
                 if self.transport is None or self.transport.is_closing():
                     return
                 if not wrote_headers:
-                    self.transport.write(
+                    self.queue_write(
                         frame(HEADERS, END_HEADERS, stream_id, _RESPONSE_HEADERS)
                     )
                     wrote_headers = True
@@ -677,7 +721,7 @@ class _ServerConn(_Conn):
         if self.transport is None or self.transport.is_closing():
             return
         if not wrote_headers:  # empty stream: headers still owed
-            self.transport.write(
+            self.queue_write(
                 frame(HEADERS, END_HEADERS, stream_id, _RESPONSE_HEADERS)
             )
         self.send_raw_after_data(
@@ -720,7 +764,7 @@ class _ServerConn(_Conn):
                 (b"grpc-message", message.encode("utf-8", "replace")),
             ]
         )
-        self.transport.write(frame(HEADERS, END_HEADERS | END_STREAM, stream_id, trailers))
+        self.queue_write(frame(HEADERS, END_HEADERS | END_STREAM, stream_id, trailers))
 
 
 def _dual_stack_socket(port: int, reuse_port: bool):
@@ -763,10 +807,14 @@ class FastGrpcServer:
         handlers: dict[str, Handler],
         on_request_headers: "Callable[[list], None] | None" = None,
         stream_handlers: "dict[str, Any] | None" = None,
+        relay_handlers: "dict[str, Any] | None" = None,
     ):
         self.handlers = {k.encode(): v for k, v in handlers.items()}
         self.stream_handlers = {
             k.encode(): v for k, v in (stream_handlers or {}).items()
+        }
+        self.relay_handlers = {
+            k.encode(): v for k, v in (relay_handlers or {}).items()
         }
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[_ServerConn] = set()
@@ -779,6 +827,13 @@ class FastGrpcServer:
     def add_stream_handler(self, path: str, fn) -> None:
         self.stream_handlers[path.encode()] = fn
 
+    def add_relay_handler(self, path: str, fn) -> None:
+        """Register an inline proxy handler: sync ``fn(conn, stream_id,
+        headers, framed_body)`` that later calls
+        ``conn.write_unary_response(stream_id, framed_bytes)`` (or
+        ``conn._send_error``)."""
+        self.relay_handlers[path.encode()] = fn
+
     async def start(
         self, port: int, host: str | None = None, reuse_port: bool = False
     ) -> int:
@@ -788,7 +843,7 @@ class FastGrpcServer:
         try:
             factory = lambda: _ServerConn(  # noqa: E731
                 self.handlers, self._conns, self._on_request_headers,
-                self.stream_handlers,
+                self.stream_handlers, self.relay_handlers,
             )
             if host is None:
                 # ONE dual-stack socket ([::] with V6ONLY off), like the
@@ -822,9 +877,10 @@ class FastGrpcServer:
                 # last_stream_id = highest accepted: tells clients their
                 # in-flight streams WILL be answered (0 would mean "nothing
                 # was processed" and make them abandon in-flight RPCs)
-                conn.transport.write(
+                conn.queue_write(
                     frame(GOAWAY, 0, 0, struct.pack(">II", conn.max_stream, 0))
                 )
+                conn.flush_now()
         if grace:
             deadline = asyncio.get_running_loop().time() + grace
             while any(c._tasks for c in conns):
@@ -903,17 +959,25 @@ class _ClientConn(_Conn):
         self.authority = authority
         self._next_stream = 1
         self.drain_when_idle = False  # set when replaced due to exhaustion
-        # stream -> [future, headers, bytearray data]
+        # stream -> [sink, headers, bytearray data, is_cb]; sink is a Future
+        # (is_cb False) or a callback fn(status, message, framed_body)
+        # (is_cb True — the relay path: no future, no task, raw bytes out)
         self._calls: dict[int, list[Any]] = {}
         self._stream_calls: dict[int, _StreamCall] = {}
         self._path_templates: dict[bytes, bytes] = {}
+        # per-(path, metadata) header-block cache: steady-state clients send
+        # identical metadata every call (e.g. a bearer token) — cap guards
+        # against per-request-unique metadata (traceparent) blowing it up
+        self._header_cache: dict[tuple, bytes] = {}
 
     def _on_closed(self, exc: Exception | None) -> None:
         err = ConnectionError(f"h2 connection lost: {exc}")
-        for fut, _, _ in self._calls.values():
-            if not fut.done():
-                fut.set_exception(err)
-        self._calls.clear()
+        calls, self._calls = self._calls, {}
+        for sink, _, _, is_cb in calls.values():
+            if is_cb:
+                sink(14, f"engine unreachable: connection lost: {exc}", b"")
+            elif not sink.done():
+                sink.set_exception(err)
         for sc in self._stream_calls.values():
             sc.queue.put_nowait(("err", err))
         self._stream_calls.clear()
@@ -938,20 +1002,27 @@ class _ClientConn(_Conn):
             f"stream refused by GOAWAY (last_stream_id={last_stream})"
         )
         for sid in refused:
-            fut, _, _ = self._calls.pop(sid)
-            if not fut.done():
-                fut.set_exception(err)
+            sink, _, _, is_cb = self._calls.pop(sid)
+            if is_cb:
+                sink(14, "stream refused by GOAWAY", b"")
+            elif not sink.done():
+                sink.set_exception(err)
         for sid in [s for s in self._stream_calls if s > last_stream]:
             self._stream_calls.pop(sid).queue.put_nowait(("err", err))
         self.drain_when_idle = True
         self.maybe_drain_close()
 
     def _template(self, path: bytes, metadata: tuple = ()) -> bytes:
-        # cache keyed by PATH only: metadata can be per-request (traceparent
-        # carries a fresh span id per call), and keying on it would grow the
-        # cache unboundedly while never hitting.  The stateless HPACK encode
-        # lets the cached base block and the per-call metadata block simply
-        # concatenate.
+        # The stateless HPACK encode lets the cached base block and the
+        # per-call metadata block simply concatenate.  Repeat metadata
+        # (bearer tokens, fixed keys) hits the bounded (path, metadata)
+        # cache; per-request-unique metadata (traceparent span ids) would
+        # never hit, so the cache is capped rather than keyed on path only.
+        if metadata:
+            key = (path, metadata)
+            t = self._header_cache.get(key)
+            if t is not None:
+                return t
         t = self._path_templates.get(path)
         if t is None:
             t = hpack.encode_headers(
@@ -975,6 +1046,12 @@ class _ClientConn(_Conn):
                     for k, v in metadata
                 ]
             )
+            if len(self._header_cache) >= 64:
+                # clear-on-full, not stop-on-full: per-request-unique
+                # metadata (traceparent span ids) must not permanently
+                # poison the cache against repeat keys (bearer tokens)
+                self._header_cache.clear()
+            self._header_cache[(path, metadata)] = t
         return t
 
     @property
@@ -991,13 +1068,31 @@ class _ClientConn(_Conn):
             and not self._stream_calls
             and self.transport is not None
         ):
-            self.transport.write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 0)))
+            self.queue_write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 0)))
+            self.flush_now()
             self.transport.close()
 
     def next_stream_id(self) -> int:
         stream_id = self._next_stream
         self._next_stream += 2
         return stream_id
+
+    def _send_request(self, stream_id: int, path: bytes, framed: bytes, metadata: tuple) -> None:
+        """HEADERS + framed DATA; hot path is one coalesced write with no
+        send-queue machinery when the windows are open (the normal case)."""
+        hdr = frame(HEADERS, END_HEADERS, stream_id, self._template(path, metadata))
+        n = len(framed)
+        if (
+            not self._send_queue
+            and n <= self.peer_max_frame
+            and n <= self.out_window
+            and n <= self.peer_initial_window
+        ):
+            self.out_window -= n
+            self.queue_write(hdr + frame(DATA, END_STREAM, stream_id, framed))
+        else:
+            self.queue_write(hdr)
+            self.send_data(stream_id, framed, end_stream=True)
 
     def call(
         self,
@@ -1011,12 +1106,27 @@ class _ClientConn(_Conn):
         if stream_id is None:
             stream_id = self.next_stream_id()
         fut = asyncio.get_running_loop().create_future()
-        self._calls[stream_id] = [fut, None, bytearray()]
-        self.transport.write(
-            frame(HEADERS, END_HEADERS, stream_id, self._template(path, metadata))
-        )
-        self.send_data(stream_id, grpc_frame(payload), end_stream=True)
+        self._calls[stream_id] = [fut, None, bytearray(), False]
+        self._send_request(stream_id, path, grpc_frame(payload), metadata)
         return fut
+
+    def call_framed(
+        self,
+        path: bytes,
+        framed: bytes,
+        cb,
+        metadata: tuple = (),
+    ) -> int:
+        """Relay-path unary call: ``framed`` is an ALREADY-FRAMED gRPC body
+        forwarded verbatim; ``cb(status, message, framed_body)`` fires when
+        the response completes (framed_body raw, only meaningful on status
+        0).  No future, no task — callbacks all the way down."""
+        if self.transport is None or self.transport.is_closing():
+            raise ConnectionError("h2 connection closed")
+        stream_id = self.next_stream_id()
+        self._calls[stream_id] = [cb, None, bytearray(), True]
+        self._send_request(stream_id, path, framed, metadata)
+        return stream_id
 
     def start_stream(
         self,
@@ -1033,10 +1143,7 @@ class _ClientConn(_Conn):
             stream_id = self.next_stream_id()
         sc = _StreamCall()
         self._stream_calls[stream_id] = sc
-        self.transport.write(
-            frame(HEADERS, END_HEADERS, stream_id, self._template(path, metadata))
-        )
-        self.send_data(stream_id, grpc_frame(payload), end_stream=True)
+        self._send_request(stream_id, path, grpc_frame(payload), metadata)
         return sc
 
     def cancel_stream(self, stream_id: int) -> None:
@@ -1046,7 +1153,7 @@ class _ClientConn(_Conn):
         self._stream_out.pop(stream_id, None)
         self._send_queue = [e for e in self._send_queue if e[0] != stream_id]
         if self.transport is not None and not self.transport.is_closing():
-            self.transport.write(
+            self.queue_write(
                 frame(RST_STREAM, 0, stream_id, struct.pack(">I", 0x8))  # CANCEL
             )
         self.maybe_drain_close()
@@ -1095,6 +1202,7 @@ class _ClientConn(_Conn):
             self._finish(stream_id)
 
     def _on_rst(self, stream_id: int, code: int) -> None:
+        self._stream_out.pop(stream_id, None)
         sc = self._stream_calls.pop(stream_id, None)
         if sc is not None:
             sc.queue.put_nowait(
@@ -1102,16 +1210,22 @@ class _ClientConn(_Conn):
             )
             return
         call = self._calls.pop(stream_id, None)
-        if call is not None and not call[0].done():
+        if call is None:
+            return
+        if call[3]:
+            call[0](GRPC_STATUS_UNKNOWN, f"stream reset: h2 code {code}", b"")
+        elif not call[0].done():
             call[0].set_exception(
                 GrpcCallError(GRPC_STATUS_UNKNOWN, f"stream reset: h2 code {code}")
             )
 
     def _finish(self, stream_id: int) -> None:
-        fut, headers, body = self._calls.pop(stream_id)
+        sink, headers, body, is_cb = self._calls.pop(stream_id)
+        # drop send-window state a peer WINDOW_UPDATE may have created (a
+        # grpcio server credits every DATA frame) — left behind, each call
+        # would leak one dict entry
+        self._stream_out.pop(stream_id, None)
         self.maybe_drain_close()
-        if fut.done():
-            return
         status = GRPC_STATUS_OK
         message = ""
         for name, value in headers or []:
@@ -1119,6 +1233,13 @@ class _ClientConn(_Conn):
                 status = int(value)
             elif name == b"grpc-message":
                 message = value.decode("utf-8", "replace")
+        if is_cb:
+            # relay path: hand back the RAW framed body — no parse, no copy
+            sink(status, message, bytes(body) if status == GRPC_STATUS_OK else b"")
+            return
+        fut = sink
+        if fut.done():
+            return
         if status != GRPC_STATUS_OK:
             fut.set_exception(GrpcCallError(status, message))
             return
@@ -1145,6 +1266,10 @@ class FastGrpcChannel:
         self.authority = target
         self._conn: _ClientConn | None = None
         self._connecting: asyncio.Lock = asyncio.Lock()
+        # coarse deadline reaper for call_framed (relay) calls: one 1s timer
+        # for the whole channel instead of a TimerHandle per call
+        self._reap_entries: list[tuple[float, _ClientConn, int]] = []
+        self._reap_handle: asyncio.TimerHandle | None = None
 
     @staticmethod
     def _usable(conn: _ClientConn | None) -> bool:
@@ -1175,6 +1300,81 @@ class FastGrpcChannel:
             )
             self._conn = conn
             return conn
+
+    # -- relay (callback) path ---------------------------------------------
+
+    def try_call_framed(
+        self,
+        path: bytes,
+        framed: bytes,
+        cb,
+        timeout: float = 30.0,
+        metadata: tuple = (),
+    ):
+        """Synchronous send of an already-framed unary call on the pooled
+        connection; returns a zero-arg cancel fn, or ``None`` when no usable
+        connection exists (caller falls back to the async path).  ``cb``
+        fires exactly once: (status, message, framed_body)."""
+        conn = self._conn
+        if not self._usable(conn):
+            return None
+        # single-fire is guaranteed by _calls ownership: every completion
+        # path (response, RST, GOAWAY, connection loss, reaper, cancel) pops
+        # the entry before acting, so no wrapper is needed
+        sid = conn.call_framed(path, framed, cb, metadata)
+        loop = conn._loop
+        self._reap_entries.append((loop.time() + timeout, conn, sid))
+        if self._reap_handle is None:
+            self._reap_handle = loop.call_later(1.0, self._reap)
+
+        def cancel():
+            if conn._calls.pop(sid, None) is not None:
+                conn.cancel_stream(sid)
+
+        return cancel
+
+    def _reap(self) -> None:
+        self._reap_handle = None
+        now = asyncio.get_event_loop().time()
+        live = []
+        for deadline, conn, sid in self._reap_entries:
+            entry = conn._calls.get(sid)
+            if entry is None:
+                continue  # completed or cancelled
+            if now >= deadline:
+                conn._calls.pop(sid, None)
+                conn.cancel_stream(sid)
+                entry[0](4, "deadline exceeded", b"")  # DEADLINE_EXCEEDED
+            else:
+                live.append((deadline, conn, sid))
+        self._reap_entries = live
+        if live:
+            self._reap_handle = asyncio.get_event_loop().call_later(1.0, self._reap)
+
+    async def call_framed_connecting(
+        self,
+        path: bytes,
+        framed: bytes,
+        cb,
+        timeout: float = 30.0,
+        metadata: tuple = (),
+        on_cancelable=None,
+    ) -> None:
+        """Cold path for the relay: establish the connection, then send.
+        Connection failure surfaces through ``cb`` as UNAVAILABLE.  Once the
+        call is actually issued, ``on_cancelable(cancel_fn)`` fires so the
+        caller can swap its provisional cancel (task.cancel) for the real
+        stream cancel."""
+        try:
+            await self._connection()
+        except OSError as e:
+            cb(14, f"engine unreachable: {e}", b"")
+            return
+        cancel = self.try_call_framed(path, framed, cb, timeout, metadata)
+        if cancel is None:
+            cb(14, "engine unreachable: connection closed during connect", b"")
+        elif on_cancelable is not None:
+            on_cancelable(cancel)
 
     async def call(
         self,
@@ -1237,9 +1437,14 @@ class FastGrpcChannel:
             raise
 
     async def close(self) -> None:
+        if self._reap_handle is not None:
+            self._reap_handle.cancel()
+            self._reap_handle = None
+        self._reap_entries = []
         conn, self._conn = self._conn, None
         if conn is not None and conn.transport is not None:
-            conn.transport.write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 0)))
+            conn.queue_write(frame(GOAWAY, 0, 0, struct.pack(">II", 0, 0)))
+            conn.flush_now()
             conn.transport.close()
 
 

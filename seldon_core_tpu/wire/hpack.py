@@ -288,6 +288,13 @@ class Decoder:
         # must stay under
         self._max_size = max_table_size
         self._settings_max = max_table_size
+        # pure-decode memo: steady-state peers (our own stateless encoder)
+        # send byte-identical blocks every request; a decode that neither
+        # read nor wrote the dynamic table is a pure function of the bytes
+        # and can be replayed from this cache.  grpcio peers use incremental
+        # indexing, which marks the decode impure and bypasses the cache.
+        self._cache: dict[bytes, list[tuple[bytes, bytes]]] = {}
+        self._pure = True
 
     def _set_max(self, value: int) -> None:
         if value > self._settings_max:
@@ -310,11 +317,28 @@ class Decoder:
             raise HpackError("index 0 is invalid")
         if index <= len(STATIC_TABLE):
             return STATIC_TABLE[index - 1]
+        self._pure = False  # result depends on dynamic-table state
         dyn = index - len(STATIC_TABLE) - 1
         try:
             return self._dynamic[dyn]
         except IndexError:
             raise HpackError(f"dynamic table index {index} out of range") from None
+
+    def decode_cached(self, block: bytes) -> list[tuple[bytes, bytes]]:
+        """Memoized decode for repeat blocks.  The returned list is SHARED —
+        callers must not mutate it."""
+        hit = self._cache.get(block)
+        if hit is not None:
+            return hit
+        self._pure = True
+        headers = self.decode(block)
+        if self._pure:
+            if len(self._cache) >= 256:
+                # clear-on-full: unique blocks (per-request traceparent)
+                # must not permanently crowd out the hot repeat blocks
+                self._cache.clear()
+            self._cache[bytes(block)] = headers
+        return headers
 
     def decode(self, block: bytes) -> list[tuple[bytes, bytes]]:
         headers: list[tuple[bytes, bytes]] = []
@@ -326,6 +350,7 @@ class Decoder:
                 index, pos = decode_int(block, pos, 7)
                 headers.append(self._lookup(index))
             elif byte & 0x40:  # literal with incremental indexing
+                self._pure = False  # mutates the dynamic table
                 index, pos = decode_int(block, pos, 6)
                 name = self._lookup(index)[0] if index else None
                 if name is None:
@@ -334,6 +359,7 @@ class Decoder:
                 self._add(name, value)
                 headers.append((name, value))
             elif byte & 0x20:  # dynamic table size update
+                self._pure = False  # mutates decoder state
                 size, pos = decode_int(block, pos, 5)
                 self._set_max(size)
             else:  # literal without indexing (0x00) / never indexed (0x10)

@@ -1,0 +1,289 @@
+"""h1 splice front end tests (gateway/h1gateway.py): the gateway's default
+REST data plane.  Covers the raw splice hot path (auth, verbatim forward,
+keep-alive, pipelined multiplexing), the fallback endpoints (oauth, ops,
+feedback), framing strictness (content-length smuggling guards, chunked
+uploads), chunked/SSE response forwarding, and engine-failure handling."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu.engine.app import EngineApp
+from seldon_core_tpu.engine.service import PredictionService
+from seldon_core_tpu.gateway.app import GatewayApp
+from seldon_core_tpu.gateway.h1gateway import H1SpliceFrontend
+from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
+from seldon_core_tpu.graph.spec import PredictorSpec
+
+run = asyncio.run
+
+SIMPLE = {"name": "p", "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"}}
+
+
+async def _engine_client(spec=SIMPLE) -> TestClient:
+    service = PredictionService(PredictorSpec.model_validate(spec))
+    await service.start()
+    app = EngineApp(service).build()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def _frontend(engine_port: int, **gw_kwargs):
+    store = DeploymentStore()
+    store.put(
+        DeploymentRecord(
+            name="dep",
+            oauth_key="key1",
+            oauth_secret="sec1",
+            engine_host="127.0.0.1",
+            engine_rest_port=engine_port,
+        )
+    )
+    gw = GatewayApp(store, **gw_kwargs)
+    frontend = H1SpliceFrontend(gw)
+    port = await frontend.start(0, host="127.0.0.1")
+    return frontend, gw, port
+
+
+async def _token(session: aiohttp.ClientSession, port: int) -> str:
+    resp = await session.post(
+        f"http://127.0.0.1:{port}/oauth/token",
+        data={"grant_type": "client_credentials", "client_id": "key1", "client_secret": "sec1"},
+    )
+    assert resp.status == 200
+    return (await resp.json())["access_token"]
+
+
+class TestSplicePredict:
+    def test_predict_keepalive_and_ops(self):
+        async def go():
+            engine = await _engine_client()
+            frontend, gw, port = await _frontend(engine.server.port)
+            async with aiohttp.ClientSession() as s:
+                tok = await _token(s, port)
+                hdrs = {"Authorization": f"Bearer {tok}"}
+                out = []
+                # three spliced requests over ONE keep-alive connection
+                for _ in range(3):
+                    r = await s.post(
+                        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                        json={"data": {"ndarray": [[1.0, 2.0]]}},
+                        headers=hdrs,
+                    )
+                    out.append((r.status, await r.json()))
+                ping = await s.get(f"http://127.0.0.1:{port}/ping")
+                ready = await s.get(f"http://127.0.0.1:{port}/ready")
+                prom = await s.get(f"http://127.0.0.1:{port}/prometheus")
+                prom_text = await prom.text()
+                await frontend.stop()
+                await engine.close()
+                return out, ping.status, ready.status, prom.status, prom_text
+
+        out, ping, ready, prom, prom_text = run(go())
+        assert all(st == 200 for st, _ in out)
+        assert out[0][1]["data"]["ndarray"] == [[0.1, 0.9, 0.5]]
+        assert (ping, ready, prom) == (200, 200, 200)
+        assert "ingress" in prom_text
+
+    def test_auth_rejected_on_splice_path(self):
+        async def go():
+            engine = await _engine_client()
+            frontend, gw, port = await _frontend(engine.server.port)
+            async with aiohttp.ClientSession() as s:
+                r1 = await s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions", json={}
+                )
+                b1 = await r1.json()
+                r2 = await s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json={},
+                    headers={"Authorization": "Bearer junk"},
+                )
+                # connection stays usable after an auth failure
+                tok = await _token(s, port)
+                r3 = await s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0]]}},
+                    headers={"Authorization": f"Bearer {tok}"},
+                )
+                await frontend.stop()
+                await engine.close()
+                return r1.status, b1, r2.status, r3.status
+
+        s1, b1, s2, s3 = run(go())
+        assert s1 == 401 and b1["status"]["code"] == 401
+        assert s2 == 401
+        assert s3 == 200
+
+    def test_concurrent_requests_multiplex(self):
+        async def go():
+            engine = await _engine_client()
+            frontend, gw, port = await _frontend(engine.server.port)
+            async with aiohttp.ClientSession() as s:
+                tok = await _token(s, port)
+                hdrs = {"Authorization": f"Bearer {tok}"}
+
+                async def one(i):
+                    r = await s.post(
+                        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                        json={"data": {"ndarray": [[float(i), 2.0]]}},
+                        headers=hdrs,
+                    )
+                    return r.status, (await r.json())["status"]["code"]
+
+                results = await asyncio.gather(*(one(i) for i in range(24)))
+                # multiplexing respected the upstream conn cap
+                pool = next(iter(frontend._pools.values()))
+                n_conns = len(pool.conns)
+                await frontend.stop()
+                await engine.close()
+                return results, n_conns
+
+        results, n_conns = run(go())
+        assert all(r == (200, 200) for r in results)
+        from seldon_core_tpu.gateway.h1gateway import _MAX_UPSTREAM_CONNS
+
+        assert 1 <= n_conns <= _MAX_UPSTREAM_CONNS
+
+    def test_feedback_fallback_and_reward(self):
+        async def go():
+            engine = await _engine_client()
+            frontend, gw, port = await _frontend(engine.server.port)
+            async with aiohttp.ClientSession() as s:
+                tok = await _token(s, port)
+                r = await s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/feedback",
+                    json={"reward": 1.0},
+                    headers={"Authorization": f"Bearer {tok}"},
+                )
+                status = r.status
+                await frontend.stop()
+                await engine.close()
+                return status
+
+        assert run(go()) == 200
+
+    def test_engine_down_gives_503(self):
+        async def go():
+            frontend, gw, port = await _frontend(1)  # port 1: refused
+            async with aiohttp.ClientSession() as s:
+                tok = await _token(s, port)
+                r = await s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0]]}},
+                    headers={"Authorization": f"Bearer {tok}"},
+                )
+                body = await r.json()
+                await frontend.stop()
+                return r.status, body
+
+        status, body = run(go())
+        assert status == 503
+        assert body["status"]["code"] == 503
+
+    def test_404_unknown_route(self):
+        async def go():
+            frontend, gw, port = await _frontend(1)
+            async with aiohttp.ClientSession() as s:
+                r = await s.get(f"http://127.0.0.1:{port}/nope")
+                await frontend.stop()
+                return r.status
+
+        assert run(go()) == 404
+
+
+class TestFramingStrictness:
+    """The splice forwards raw bytes onto a SHARED pipelined engine
+    connection — framing the gateway and engine could read differently is
+    a smuggling vector and must be rejected."""
+
+    async def _raw(self, port: int, payload: bytes) -> bytes:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(payload)
+        await writer.drain()
+        data = await reader.read(4096)
+        writer.close()
+        return data
+
+    def test_bad_content_length_rejected(self):
+        async def go():
+            frontend, gw, port = await _frontend(1)
+            bad = (
+                b"POST /api/v0.1/predictions HTTP/1.1\r\n"
+                b"host: x\r\ncontent-length: 5_0\r\n\r\n"
+            )
+            resp = await self._raw(port, bad)
+            await frontend.stop()
+            return resp
+
+        assert b"400" in run(go()).split(b"\r\n")[0]
+
+    def test_conflicting_content_lengths_rejected(self):
+        async def go():
+            frontend, gw, port = await _frontend(1)
+            bad = (
+                b"POST /api/v0.1/predictions HTTP/1.1\r\n"
+                b"host: x\r\ncontent-length: 3\r\ncontent-length: 5\r\n\r\nabc"
+            )
+            resp = await self._raw(port, bad)
+            await frontend.stop()
+            return resp
+
+        assert b"400" in run(go()).split(b"\r\n")[0]
+
+    def test_chunked_upload_rejected(self):
+        async def go():
+            frontend, gw, port = await _frontend(1)
+            bad = (
+                b"POST /api/v0.1/predictions HTTP/1.1\r\n"
+                b"host: x\r\ntransfer-encoding: chunked\r\n\r\n"
+            )
+            resp = await self._raw(port, bad)
+            await frontend.stop()
+            return resp
+
+        assert b"411" in run(go()).split(b"\r\n")[0]
+
+
+class TestChunkedResponseSplice:
+    """SSE-shaped chunked responses forward through the splice."""
+
+    def test_chunked_stream_forwards(self):
+        async def go():
+            # an "engine" whose stream endpoint emits chunked SSE events
+            async def stream(request):
+                resp = web.StreamResponse()
+                resp.content_type = "text/event-stream"
+                resp.enable_chunked_encoding()
+                await resp.prepare(request)
+                for i in range(3):
+                    await resp.write(f"data: tok{i}\n\n".encode())
+                await resp.write_eof()
+                return resp
+
+            app = web.Application()
+            app.router.add_post("/api/v0.1/predictions/stream", stream)
+            engine = TestClient(TestServer(app))
+            await engine.start_server()
+            frontend, gw, port = await _frontend(engine.server.port)
+            async with aiohttp.ClientSession() as s:
+                tok = await _token(s, port)
+                r = await s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions/stream",
+                    data=b"{}",
+                    headers={"Authorization": f"Bearer {tok}"},
+                )
+                body = await r.content.read()
+                status = r.status
+                await frontend.stop()
+                await engine.close()
+                return status, body
+
+        status, body = run(go())
+        assert status == 200
+        assert body.count(b"data: tok") == 3
