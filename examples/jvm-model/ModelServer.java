@@ -1,0 +1,133 @@
+// A model microservice on the JVM — plain JDK, no dependencies.
+//
+// The reference shipped a dedicated Java wrapper (reference:
+// wrappers/s2i/java/); here the wire CONTRACT is the polyglot story: any
+// server speaking it is a graph node.  This file is the JVM proof — a
+// complete MODEL unit in one class on com.sun.net.httpserver:
+//
+//     POST /predict        {"data":{"ndarray":[[...]]}} -> class scores
+//     GET  /ping /ready    liveness / readiness
+//
+// The operator's env contract supplies the port
+// (PREDICTIVE_UNIT_SERVICE_PORT), identical to every other wrapper.
+//
+//   javac ModelServer.java && PREDICTIVE_UNIT_SERVICE_PORT=9003 java ModelServer
+//
+// Wrap into an image with `sct-wrap --language generic` (see
+// docs/RUNTIME_CONTRACT.md); driven end-to-end by
+// tests/test_jvm_example.py when a JDK is present.
+
+import com.sun.net.httpserver.HttpExchange;
+import com.sun.net.httpserver.HttpServer;
+import java.io.IOException;
+import java.io.OutputStream;
+import java.net.InetSocketAddress;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.List;
+
+public class ModelServer {
+
+    // A tiny fixed 3-class linear scorer over 4 features (iris-shaped),
+    // softmaxed — stands in for any JVM model library call.
+    static final double[][] W = {
+        {0.8, -0.4, -0.4}, {0.9, -0.2, -0.7}, {-1.2, 0.3, 0.9}, {-1.3, 0.2, 1.1},
+    };
+    static final double[] B = {0.4, 0.6, -1.0};
+
+    public static void main(String[] args) throws IOException {
+        int port = Integer.parseInt(
+            System.getenv().getOrDefault("PREDICTIVE_UNIT_SERVICE_PORT", "9003"));
+        HttpServer server = HttpServer.create(new InetSocketAddress(port), 64);
+        server.createContext("/predict", ModelServer::predict);
+        server.createContext("/ping", ex -> text(ex, 200, "pong"));
+        server.createContext("/ready", ex -> text(ex, 200, "ready"));
+        server.start();
+        System.out.println("jvm model server on :" + port);
+    }
+
+    static void predict(HttpExchange ex) throws IOException {
+        if (!ex.getRequestMethod().equals("POST")) { text(ex, 405, "POST only"); return; }
+        String body = new String(ex.getRequestBody().readAllBytes(), StandardCharsets.UTF_8);
+        List<double[]> rows;
+        try {
+            rows = parseNdarray(body);
+        } catch (RuntimeException e) {
+            json(ex, 400, "{\"status\":{\"code\":400,\"info\":\"" + e.getMessage()
+                + "\",\"status\":\"FAILURE\"}}");
+            return;
+        }
+        StringBuilder out = new StringBuilder(
+            "{\"data\":{\"names\":[\"setosa\",\"versicolor\",\"virginica\"],\"ndarray\":[");
+        for (int r = 0; r < rows.size(); r++) {
+            double[] x = rows.get(r);
+            double[] s = new double[B.length];
+            for (int c = 0; c < B.length; c++) {
+                s[c] = B[c];
+                for (int f = 0; f < x.length && f < W.length; f++) s[c] += x[f] * W[f][c];
+            }
+            double max = Double.NEGATIVE_INFINITY, sum = 0;
+            for (double v : s) max = Math.max(max, v);
+            for (int c = 0; c < s.length; c++) { s[c] = Math.exp(s[c] - max); sum += s[c]; }
+            if (r > 0) out.append(',');
+            out.append('[');
+            for (int c = 0; c < s.length; c++) {
+                if (c > 0) out.append(',');
+                out.append(s[c] / sum);
+            }
+            out.append(']');
+        }
+        out.append("]}}");
+        json(ex, 200, out.toString());
+    }
+
+    // Minimal parse of {"data":{"ndarray":[[...],...]}} — enough JSON for
+    // the numeric contract, zero dependencies (mirrors the C++ example).
+    static List<double[]> parseNdarray(String body) {
+        int k = body.indexOf("\"ndarray\"");
+        if (k < 0) throw new RuntimeException("body must carry data.ndarray");
+        int i = body.indexOf('[', k);
+        if (i < 0) throw new RuntimeException("malformed ndarray");
+        List<double[]> rows = new ArrayList<>();
+        List<Double> cur = null;
+        StringBuilder num = new StringBuilder();
+        int depth = 0;
+        for (; i < body.length(); i++) {
+            char ch = body.charAt(i);
+            if (ch == '[') { depth++; if (depth == 2) cur = new ArrayList<>(); }
+            else if (ch == ']' || ch == ',') {
+                if (num.length() > 0 && cur != null) {
+                    cur.add(Double.parseDouble(num.toString()));
+                    num.setLength(0);
+                }
+                if (ch == ']') {
+                    depth--;
+                    if (depth == 1 && cur != null) {
+                        double[] row = new double[cur.size()];
+                        for (int j = 0; j < row.length; j++) row[j] = cur.get(j);
+                        rows.add(row);
+                        cur = null;
+                    }
+                    if (depth == 0) break;
+                }
+            } else if (!Character.isWhitespace(ch)) num.append(ch);
+        }
+        if (rows.isEmpty()) throw new RuntimeException("empty ndarray");
+        return rows;
+    }
+
+    static void text(HttpExchange ex, int code, String s) throws IOException {
+        reply(ex, code, "text/plain", s);
+    }
+
+    static void json(HttpExchange ex, int code, String s) throws IOException {
+        reply(ex, code, "application/json", s);
+    }
+
+    static void reply(HttpExchange ex, int code, String ctype, String s) throws IOException {
+        byte[] b = s.getBytes(StandardCharsets.UTF_8);
+        ex.getResponseHeaders().set("Content-Type", ctype);
+        ex.sendResponseHeaders(code, b.length);
+        try (OutputStream os = ex.getResponseBody()) { os.write(b); }
+    }
+}

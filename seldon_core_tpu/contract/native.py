@@ -19,26 +19,43 @@ _LIB_PATH = os.path.join(
 )
 
 _lib = None
-if os.path.exists(_LIB_PATH):
+
+
+def _load() -> None:
+    global _lib
+    if not os.path.exists(_LIB_PATH):
+        _lib = None
+        return
     try:
-        _lib = ctypes.CDLL(_LIB_PATH)
-        _lib.sct_parse_dense.restype = ctypes.c_longlong
-        _lib.sct_parse_dense.argtypes = [
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.sct_parse_dense.restype = ctypes.c_longlong
+        lib.sct_parse_dense.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_double), ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.c_size_t),
         ]
-        _lib.sct_format_dense.restype = ctypes.c_longlong
-        _lib.sct_format_dense.argtypes = [
+        lib.sct_format_dense.restype = ctypes.c_longlong
+        lib.sct_format_dense.argtypes = [
             ctypes.POINTER(ctypes.c_double), ctypes.c_longlong, ctypes.c_longlong,
             ctypes.c_char_p, ctypes.c_size_t,
         ]
     except OSError:  # pragma: no cover - corrupt build
         _lib = None
+        return
+    _lib = lib
+
+
+_load()
 
 
 def available() -> bool:
+    return _lib is not None
+
+
+def reload() -> bool:
+    """Re-probe for the .so (e.g. after an on-demand ``make native``)."""
+    _load()
     return _lib is not None
 
 

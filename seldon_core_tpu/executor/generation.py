@@ -58,6 +58,11 @@ def _prefill_buckets(max_seq: int, smallest: int = 16) -> tuple[int, ...]:
     return tuple(sizes)
 
 
+class OutOfKVBlocks(Exception):
+    """The paged KV pool cannot reserve the blocks this request needs right
+    now; the scheduler holds the request until completions free blocks."""
+
+
 class GenerativeModel:
     """Compiled slot-cache generation engine for one decoder family.
 
@@ -84,12 +89,24 @@ class GenerativeModel:
         name: str = "generative",
         decode_block: int = 8,
         driver: Any = None,
+        kv_block_size: int = 16,
+        kv_blocks: int | None = None,
     ):
         if family_mod is None:
             from seldon_core_tpu.models import llama as family_mod
         if int(n_slots) < 1:
             # a zero-slot scheduler would park every request forever
             raise GraphUnitError(f"n_slots must be >= 1, got {n_slots}")
+        kv_block_size = int(kv_block_size)
+        if kv_block_size < 1 or kv_block_size & (kv_block_size - 1):
+            raise GraphUnitError(
+                f"kv_block_size must be a power of two, got {kv_block_size}"
+            )
+        if cfg.max_seq % kv_block_size:
+            raise GraphUnitError(
+                f"max_seq {cfg.max_seq} is not a multiple of kv_block_size "
+                f"{kv_block_size}"
+            )
         # Multi-host slice: every prefill/decode call is SPMD across the
         # hosts' processes, coordinated through the MultihostDriver (the
         # coordinator leads; engine workers execute the same steps via the
@@ -136,21 +153,46 @@ class GenerativeModel:
             params = jax.device_put(params)
         self.params = params
 
+        # paged KV pool: block 0 is the reserved garbage sink for inactive
+        # slots' fixed-shape writes (models/llama.py decode_slots_paged);
+        # default pool still admits every slot at full max_seq, an operator
+        # shrinks it (or raises n_slots) to oversubscribe against typical
+        # lengths instead of worst-case ones
+        self.kv_block_size = kv_block_size
+        self.max_blocks_per_slot = cfg.max_seq // kv_block_size
+        if kv_blocks is None:
+            kv_blocks = 1 + self.n_slots * self.max_blocks_per_slot
+        self.kv_blocks = int(kv_blocks)
+        min_blocks = 1 + self.max_blocks_per_slot
+        if self.kv_blocks < min_blocks:
+            raise GraphUnitError(
+                f"kv_blocks {self.kv_blocks} cannot hold even one max_seq "
+                f"request (+sink); need >= {min_blocks}"
+            )
+        self._free_blocks: list[int] = list(range(1, self.kv_blocks))
+        self._slot_blocks: dict[int, list[int]] = {}
+
         cache_dtype = dtype if dtype is not None else np.float32
-        cache = family_mod.init_slot_cache(cfg, self.n_slots, dtype=cache_dtype)
+        cache = family_mod.init_paged_cache(
+            cfg, self.n_slots, self.kv_blocks, kv_block_size, dtype=cache_dtype
+        )
         if mesh is not None:
-            # KV heads ride the tp axis like the attention weights; slots and
-            # sequence stay local (decode is latency-, not FLOP-bound)
+            # KV heads ride the tp axis like the attention weights; blocks
+            # and rows stay local (decode is latency-, not FLOP-bound)
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             kv_sh = NamedSharding(mesh, P(None, None, None, "tp", None))
+            rep = NamedSharding(mesh, P())
             cache = {
                 "k": jax.device_put(cache["k"], kv_sh),
                 "v": jax.device_put(cache["v"], kv_sh),
-                "pos": jax.device_put(cache["pos"], NamedSharding(mesh, P())),
+                "pos": jax.device_put(cache["pos"], rep),
+                "table": jax.device_put(cache["table"], rep),
             }
         self._cache = cache
-        self.prefill_buckets = _prefill_buckets(cfg.max_seq)
+        self.prefill_buckets = tuple(
+            b for b in _prefill_buckets(cfg.max_seq) if b >= kv_block_size
+        ) or (cfg.max_seq,)
 
         fam = family_mod
 
@@ -163,9 +205,10 @@ class GenerativeModel:
 
             return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
 
-        def _prefill(params, tokens, length, slot, temperature, seed, cache):
-            logits, cache = fam.prefill_slot(
-                params, tokens, length, slot, cache, cfg, mesh=mesh, seq_impl=seq_impl
+        def _prefill(params, tokens, length, slot, blocks, temperature, seed, cache):
+            logits, cache = fam.prefill_slot_paged(
+                params, tokens, length, slot, blocks, cache, cfg,
+                mesh=mesh, seq_impl=seq_impl,
             )
             key = jax.random.PRNGKey(seed)
             tok = fam.sample_tokens(logits[None], temperature[None], key)[0]
@@ -173,7 +216,7 @@ class GenerativeModel:
 
         def _decode(window):
             def fn(params, tokens, active, temperature, seed, cache):
-                logits, cache = fam.decode_slots(
+                logits, cache = fam.decode_slots_paged(
                     params, tokens, cache, active, cfg, window=window
                 )
                 key = jax.random.PRNGKey(seed)
@@ -196,26 +239,23 @@ class GenerativeModel:
 
                 def body(carry, i):
                     tokens, active, remaining, cache = carry
-
-                    def run(args):
-                        tokens, active, remaining, cache = args
-                        logits, cache2 = fam.decode_slots(
-                            params, tokens, cache, active, cfg, window=window
-                        )
-                        key = jax.random.fold_in(base_key, i)
-                        toks = fam.sample_tokens(logits, temperature, key)
-                        toks = jnp.where(active, toks, tokens)
-                        remaining2 = jnp.where(active, remaining - 1, remaining)
-                        done = (toks == eos) | (remaining2 <= 0)
-                        return toks, active & ~done, remaining2, cache2
-
-                    # all slots finished mid-block: skip the remaining
-                    # decode steps' FLOPs entirely
-                    tokens, active2, remaining, cache = lax.cond(
-                        active.any(), run, lambda a: a,
-                        (tokens, active, remaining, cache),
+                    # NOTE: no all-inactive early-exit cond here.  A
+                    # lax.cond whose false branch returns the carry verbatim
+                    # cannot alias the cache buffers of both branches, so
+                    # XLA inserts a full cache copy EVERY step — hundreds of
+                    # MB of pure overhead per token that dwarfs the FLOPs
+                    # the cond occasionally skips (decode is bandwidth-bound;
+                    # inactive slots' math is already masked).
+                    logits, cache = fam.decode_slots_paged(
+                        params, tokens, cache, active, cfg, window=window
                     )
-                    return (tokens, active2, remaining, cache), (tokens, active)
+                    key = jax.random.fold_in(base_key, i)
+                    toks = fam.sample_tokens(logits, temperature, key)
+                    toks = jnp.where(active, toks, tokens)
+                    remaining = jnp.where(active, remaining - 1, remaining)
+                    done = (toks == eos) | (remaining <= 0)
+                    active2 = active & ~done
+                    return (toks, active2, remaining, cache), (toks, active)
 
                 (tokens, active, remaining, cache), (toks_seq, act_seq) = lax.scan(
                     body, (tokens, active, remaining, cache), jnp.arange(k)
@@ -226,7 +266,7 @@ class GenerativeModel:
 
         # cache buffers are donated: each step reuses the previous buffers
         # in place instead of holding two live copies of a multi-GB cache
-        self._prefill = jax.jit(_prefill, donate_argnums=(6,))
+        self._prefill = jax.jit(_prefill, donate_argnums=(7,))
         self._decode_factory = _decode
         self._decode_jit: dict[int, Any] = {}  # window -> jitted step
         self._decode_k_factory = _decode_k
@@ -282,6 +322,7 @@ class GenerativeModel:
                 payload["padded"],
                 np.int32(payload["length"]),
                 np.int32(payload["slot"]),
+                np.asarray(payload["blocks"], np.int32),
                 np.float32(payload["temperature"]),
                 np.int32(payload["seed"]),
                 self._cache,
@@ -289,12 +330,49 @@ class GenerativeModel:
             self.prefills += 1
         return tok
 
-    def admit_dispatch(self, slot: int, prompt: np.ndarray, temperature: float, seed: int):
+    def reserve_blocks(self, slot: int, total_tokens: int) -> np.ndarray:
+        """Reserve the physical blocks ``slot`` needs for a request whose
+        prompt+generation will reach ``total_tokens``; returns the slot's
+        zero-padded table row.  Raises :class:`OutOfKVBlocks` when the pool
+        cannot cover it right now (the scheduler queues the request)."""
+        total = min(int(total_tokens), self.cfg.max_seq)
+        need = -(-total // self.kv_block_size)
+        self.release_slot(slot)  # a stale reservation on this slot is dead
+        if len(self._free_blocks) < need:
+            raise OutOfKVBlocks(
+                f"need {need} KV blocks, {len(self._free_blocks)} free"
+            )
+        got = self._free_blocks[-need:]
+        del self._free_blocks[-need:]
+        self._slot_blocks[slot] = got
+        row = np.zeros(self.max_blocks_per_slot, np.int32)
+        row[:need] = got
+        return row
+
+    def release_slot(self, slot: int) -> None:
+        """Return ``slot``'s reserved blocks to the pool (idempotent)."""
+        blocks = self._slot_blocks.pop(int(slot), None)
+        if blocks:
+            self._free_blocks.extend(blocks)
+
+    @property
+    def free_block_count(self) -> int:
+        return len(self._free_blocks)
+
+    def admit_dispatch(
+        self,
+        slot: int,
+        prompt: np.ndarray,
+        temperature: float,
+        seed: int,
+        reserve_tokens: int = 0,
+    ):
         """Enqueue one prefill WITHOUT fetching its sampled token (a device
         array is returned).  Several admissions dispatched back-to-back cost
         ONE host round trip when their tokens are fetched together —
         serializing fetch-per-admit costs one RTT each on a tunnel-attached
-        chip."""
+        chip.  ``reserve_tokens`` sizes the block reservation beyond the
+        prompt (the request's max_new_tokens)."""
         prompt = np.asarray(prompt, np.int32).ravel()
         L = prompt.shape[0]
         if L < 1:
@@ -302,10 +380,12 @@ class GenerativeModel:
         bucket = self.fit_bucket(L)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :L] = prompt
+        blocks_row = self.reserve_blocks(slot, L + max(0, int(reserve_tokens)))
         payload = {
             "padded": padded,
             "length": L,
             "slot": int(slot),
+            "blocks": blocks_row,
             "temperature": float(temperature),
             "seed": int(seed),
         }
@@ -314,10 +394,19 @@ class GenerativeModel:
             return self.driver.lead(self._mh_prefill_key, payload)
         return self._exec_prefill(payload)
 
-    def admit(self, slot: int, prompt: np.ndarray, temperature: float, seed: int) -> int:
+    def admit(
+        self,
+        slot: int,
+        prompt: np.ndarray,
+        temperature: float,
+        seed: int,
+        reserve_tokens: int = 0,
+    ) -> int:
         """Prefill ``prompt`` (1-D int ids) into ``slot``; returns the first
         sampled token."""
-        return int(self.admit_dispatch(slot, prompt, temperature, seed))
+        return int(
+            self.admit_dispatch(slot, prompt, temperature, seed, reserve_tokens)
+        )
 
     def _window_for(self, active: np.ndarray, extra: int) -> int:
         """Smallest power-of-two cache window covering every ACTIVE slot's
@@ -495,8 +584,11 @@ class GenerativeModel:
             self._cache = {**self._cache, "pos": zero}
 
     def reset(self) -> None:
-        """Zero every slot position (cache contents become unreachable)."""
+        """Zero every slot position and reclaim every block reservation
+        (cache contents become unreachable)."""
         self._pos_ceiling[:] = 0
+        for slot in list(self._slot_blocks):
+            self.release_slot(slot)
         if self.driver is not None:
             self.driver.lead(self._mh_reset_key, {})
             return
@@ -523,6 +615,9 @@ class GenerationScheduler:
     def __init__(self, model: GenerativeModel):
         self.model = model
         self._queue: asyncio.Queue[_Request] = asyncio.Queue()
+        # requests admitted to a slot but not to the KV pool (OutOfKVBlocks):
+        # retried ahead of the queue as completions free blocks
+        self._overflow: list[_Request] = []
         self._task: asyncio.Task | None = None
         self._closed = False
         # Random base so temperature>0 sampling differs across restarts and
@@ -623,12 +718,15 @@ class GenerationScheduler:
         try:
             while True:
                 batch: list[_Request] = []
-                if not active.any():
+                if not active.any() and not self._overflow:
                     # fully idle: park on the queue
                     batch.append(await self._queue.get())
-                # admit whatever else is waiting into remaining free slots;
-                # all prefills dispatch back-to-back and their first tokens
-                # are fetched in ONE device round trip
+                # admit whatever is waiting into remaining free slots —
+                # block-starved overflow first, then the queue; all prefills
+                # dispatch back-to-back and their first tokens are fetched
+                # in ONE device round trip
+                while self._overflow and int(active.sum()) + len(batch) < S:
+                    batch.append(self._overflow.pop(0))
                 while (
                     not self._queue.empty()
                     and int(active.sum()) + len(batch) < S
@@ -637,6 +735,18 @@ class GenerationScheduler:
                 if batch:
                     await self._admit_batch(batch, slots, cur, temps, active)
                 if not active.any():
+                    if self._overflow:
+                        # nothing in flight can ever free blocks: these
+                        # requests exceed the pool outright
+                        err = GraphUnitError(
+                            "request KV reservation exceeds the configured "
+                            f"pool ({self.model.kv_blocks - 1} blocks of "
+                            f"{self.model.kv_block_size})"
+                        )
+                        for req in self._overflow:
+                            if not req.future.done():
+                                req.future.set_exception(err)
+                        self._overflow.clear()
                     continue
                 seed = self._next_seed()
                 k = self.model.decode_block
@@ -684,6 +794,7 @@ class GenerationScheduler:
                         if slots[i] is not None and not slots[i].future.done():
                             slots[i].future.set_exception(exc)
                         slots[i] = None
+                        self.model.release_slot(i)
                     active[:] = False
                     continue
                 for step_i in range(toks_seq.shape[0]):
@@ -697,11 +808,17 @@ class GenerationScheduler:
                             self._complete(req)
                             slots[i] = None
                             active[i] = False
+                            self.model.release_slot(i)
         except asyncio.CancelledError:
             err = RuntimeError("GenerationScheduler closed")
-            for req in slots:
+            for i, req in enumerate(slots):
                 if req is not None and not req.future.done():
                     req.future.set_exception(err)
+                self.model.release_slot(i)
+            for req in self._overflow:
+                if not req.future.done():
+                    req.future.set_exception(err)
+            self._overflow.clear()
             raise
 
     async def _admit_batch(self, batch, slots, cur, temps, active) -> None:
@@ -710,19 +827,26 @@ class GenerationScheduler:
         def dispatch_and_fetch():
             placed = []
             errors = []
+            starved = []
             for req, slot in zip(batch, free):
                 try:
                     tok_dev = self.model.admit_dispatch(
-                        slot, req.prompt, req.temperature, self._next_seed()
+                        slot, req.prompt, req.temperature, self._next_seed(),
+                        reserve_tokens=req.max_new_tokens,
                     )
                     placed.append((req, slot, tok_dev))
+                except OutOfKVBlocks:
+                    # pool is momentarily full: hold until completions free
+                    # blocks (the run loop fails it if nothing is in flight)
+                    starved.append(req)
                 except Exception as exc:  # noqa: BLE001 - routed to the future
                     errors.append((req, exc))
             # one round trip fetches every admitted first token
             toks = jax.device_get([t for _, _, t in placed]) if placed else []
-            return placed, toks, errors
+            return placed, toks, errors, starved
 
-        placed, toks, errors = await asyncio.to_thread(dispatch_and_fetch)
+        placed, toks, errors, starved = await asyncio.to_thread(dispatch_and_fetch)
+        self._overflow.extend(starved)
         for req, exc in errors:
             if not isinstance(exc, GraphUnitError):
                 log.exception("prefill admission failed", exc_info=exc)
@@ -731,6 +855,7 @@ class GenerationScheduler:
         for (req, slot, _), tok in zip(placed, toks):
             if self._token_done(req, int(tok)):
                 self._complete(req)
+                self.model.release_slot(slot)
                 continue
             slots[slot] = req
             cur[slot] = int(tok)

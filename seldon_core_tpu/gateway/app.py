@@ -69,9 +69,11 @@ class GatewayApp:
         tokens: TokenStore | None = None,
         tap: RequestResponseTap | None = None,
         metrics: MetricsRegistry | None = None,
-        timeout_s: float = 10.0,
+        timeout_s: float | None = None,
         stream_timeout_s: float | None = None,
     ):
+        if timeout_s is None:
+            timeout_s = float(os.environ.get("GATEWAY_TIMEOUT_S", "10"))
         self.store = store
         # explicit budget for relayed STREAMS (token streaming runs far
         # longer than a unary call; deriving it from timeout_s with a

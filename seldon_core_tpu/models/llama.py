@@ -347,6 +347,166 @@ def prefill_slot(
     return h @ params["head"], cache
 
 
+# ---------------------------------------------------------------------------
+# paged KV cache (block pool + per-slot block tables)
+# ---------------------------------------------------------------------------
+#
+# The static slot cache above pre-allocates ``n_slots x max_seq`` rows, so
+# HBM is billed for the WORST-CASE length of every slot: at max_seq 8192 a
+# 16-slot 1.1B cache is 8.6 GB even when every request is 200 tokens.  The
+# paged layout allocates from a pool of fixed-size blocks:
+#
+#   k/v: (layers, n_blocks, block_size, kv_heads, head_dim)
+#   table: (n_slots, max_seq // block_size) int32  — physical block ids
+#
+# A slot's logical position p lives in physical row
+# ``(table[slot, p // bs], p % bs)``.  Blocks are RESERVED AT ADMISSION for
+# ``prompt + max_new_tokens`` (both known up front in serving), so there is
+# no mid-flight OOM and no preemption machinery — the TPU-friendly version
+# of vLLM's paged attention: shapes stay static, one compiled program per
+# (bucket, window), the allocator is a host-side free list.  Slot count now
+# scales with the POOL (HBM budget), not with n_slots x max_seq.
+
+def init_paged_cache(
+    cfg: Config, n_slots: int, n_blocks: int, block_size: int, dtype=jnp.float32
+) -> dict:
+    if cfg.max_seq % block_size:
+        raise ValueError(
+            f"max_seq {cfg.max_seq} must be a multiple of block_size {block_size}"
+        )
+    mb = cfg.max_seq // block_size
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "pos": jnp.zeros((n_slots,), jnp.int32),
+        "table": jnp.zeros((n_slots, mb), jnp.int32),
+    }
+
+
+def prefill_slot_paged(
+    params: dict,
+    tokens: jax.Array,
+    length: jax.Array,
+    slot: jax.Array,
+    blocks_row: jax.Array,
+    cache: dict,
+    cfg: Config,
+    *,
+    mesh: Mesh | None = None,
+    seq_impl: str = "dense",
+) -> tuple[jax.Array, dict]:
+    """Prefill ONE request's prompt into the blocks reserved for ``slot``.
+
+    ``tokens`` is ``(1, Lpad)`` right-padded to a bucket that is a multiple
+    of the block size; ``blocks_row`` is the slot's full ``(max_blocks,)``
+    table row (reserved physical ids, zero-padded).  Pad rows land in
+    reserved blocks and are masked by decode's validity test, exactly like
+    the static-slot variant."""
+    bs = cache["k"].shape[2]
+    lp = tokens.shape[1]
+    x, ks, vs = _prefill_core(params, tokens, cfg, _select_attn(mesh, seq_impl))
+    # (layers, 1, Lp, kv, hd) -> (layers, Lb, bs, kv, hd) scattered to the
+    # slot's first Lb physical blocks
+    lb = lp // bs
+    ksb = ks[:, 0].reshape(cfg.n_layers, lb, bs, cfg.n_kv_heads, cfg.head_dim)
+    vsb = vs[:, 0].reshape(cfg.n_layers, lb, bs, cfg.n_kv_heads, cfg.head_dim)
+    phys = blocks_row[:lb]
+    cache = {
+        "k": cache["k"].at[:, phys].set(ksb.astype(cache["k"].dtype)),
+        "v": cache["v"].at[:, phys].set(vsb.astype(cache["v"].dtype)),
+        "pos": cache["pos"].at[slot].set(length),
+        "table": cache["table"].at[slot].set(blocks_row),
+    }
+    h = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
+    h = _rmsnorm(h, params["ln_f"], cfg.norm_eps)
+    return h @ params["head"], cache
+
+
+def decode_slots_paged(
+    params: dict,
+    tokens: jax.Array,
+    cache: dict,
+    active: jax.Array,
+    cfg: Config,
+    *,
+    window: int | None = None,
+) -> tuple[jax.Array, dict]:
+    """One decode step for every slot against the paged cache.
+
+    Identical contract to :func:`decode_slots`; attention reads gather the
+    first ``window // block_size`` table entries per slot (same byte volume
+    as the static window read — the pool layout changes where rows LIVE,
+    not how many are read)."""
+    pos = cache["pos"]  # (S,)
+    table = cache["table"]  # (S, MB)
+    S = tokens.shape[0]
+    bs = cache["k"].shape[2]
+    W = cfg.max_seq if window is None else min(window, cfg.max_seq)
+    wb = max(1, W // bs)
+    W = wb * bs
+    read_idx = table[:, :wb]  # (S, wb) physical blocks attention reads
+    x = params["tok_emb"][tokens][:, None]  # (S, 1, E)
+    positions = pos[:, None]
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    valid = jnp.arange(W)[None, :] <= pos[:, None]  # (S, W)
+    slot_idx = jnp.arange(S)
+    # This step's write target: physical block + in-block offset per slot.
+    # INACTIVE slots still flow through the scatter (fixed shapes), but
+    # their table rows may reference blocks already reclaimed and handed to
+    # another request — their writes are routed to physical block 0, which
+    # the allocator reserves as a garbage sink and never hands out.
+    write_blk = jnp.where(
+        active,
+        jnp.take_along_axis(table, (pos // bs)[:, None], axis=1)[:, 0],
+        0,
+    )
+    write_off = pos % bs
+    kv, hd = cfg.n_kv_heads, cfg.head_dim
+
+    def body(carry, inputs):
+        x, ck, cv = carry
+        li, lp = inputs
+        h = _rmsnorm(x, lp["ln_att"], cfg.norm_eps)
+        q = jnp.einsum("ble,ehd->blhd", h, lp["wq"])
+        k = jnp.einsum("ble,ehd->blhd", h, lp["wk"])
+        v = jnp.einsum("ble,ehd->blhd", h, lp["wv"])
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        ck = ck.at[li, write_blk, write_off].set(k[:, 0].astype(ck.dtype))
+        cv = cv.at[li, write_blk, write_off].set(v[:, 0].astype(cv.dtype))
+        ckl = jax.lax.dynamic_index_in_dim(ck, li, 0, keepdims=False)
+        cvl = jax.lax.dynamic_index_in_dim(cv, li, 0, keepdims=False)
+        # gather each slot's visible blocks: (S, wb, bs, kv, hd) -> (S, W, ..)
+        kw = ckl[read_idx].reshape(S, W, kv, hd)
+        vw = cvl[read_idx].reshape(S, W, kv, hd)
+        groups = cfg.n_heads // cfg.n_kv_heads
+        qg = q.reshape(S, 1, cfg.n_kv_heads, groups, cfg.head_dim)
+        s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kw) * scale
+        s = jnp.where(valid[:, None, None, None, :], s, jnp.finfo(s.dtype).min)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bkgqs,bskd->bqkgd", p, vw)
+        o = o.reshape(S, 1, cfg.n_heads, cfg.head_dim)
+        x = x + jnp.einsum("blhd,hde->ble", o, lp["wo"])
+        h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
+        mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+        return (x + mlp, ck, cv), None
+
+    (x, new_k, new_v), _ = jax.lax.scan(
+        body,
+        (x, cache["k"], cache["v"]),
+        (jnp.arange(cfg.n_layers), params["layers"]),
+    )
+    cache = {
+        "k": new_k,
+        "v": new_v,
+        "pos": jnp.where(active, pos + 1, pos),
+        "table": table,
+    }
+    x = _rmsnorm(x[:, 0], params["ln_f"], cfg.norm_eps)
+    return x @ params["head"], cache
+
+
 def decode_slots(
     params: dict,
     tokens: jax.Array,

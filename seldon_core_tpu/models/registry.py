@@ -211,9 +211,14 @@ def build_generative_component(
     eos_id: int | None = None,
     seq_impl: str = "dense",
     decode_block: int = 8,
+    kv_block_size: int = 16,
+    kv_blocks: int | None = None,
     **overrides,
 ):
-    """Build a continuous-batching generative graph unit (JAX_GENERATIVE)."""
+    """Build a continuous-batching generative graph unit (JAX_GENERATIVE).
+
+    ``kv_block_size`` / ``kv_blocks`` size the paged KV pool (defaults:
+    16-token blocks, pool big enough for every slot at full max_seq)."""
     from seldon_core_tpu.executor.generation import (
         GenerativeComponent,
         GenerativeModel,
@@ -249,6 +254,8 @@ def build_generative_component(
         seq_impl=seq_impl,
         name=f"{family}:{preset or 'default'}",
         decode_block=decode_block,
+        kv_block_size=kv_block_size,
+        kv_blocks=kv_blocks,
     )
     return GenerativeComponent(
         model,

@@ -183,17 +183,25 @@ def store_from_env(environ: dict | None = None) -> StateStore:
     if raw == "memory":
         return MemoryStateStore()
     if raw.startswith("redis://"):
-        # redis://[[user]:password@]host[:port] — auth'd stores keep tokens
-        # off the open cluster network (deploy/gateway.yaml pairs this with
-        # --requirepass)
-        rest = raw[len("redis://"):]
-        password = None
-        if "@" in rest:
-            cred, _, rest = rest.rpartition("@")
-            password = cred.partition(":")[2] or cred or None
-        host, _, port = rest.partition(":")
+        # redis://[:password@]host[:port] — auth'd stores keep tokens off
+        # the open cluster network (deploy/redis.yaml pairs this with
+        # --requirepass).  urlsplit separates username/password properly:
+        # 'redis://user:@host' must not smuggle 'user:' in as the password,
+        # and a username is rejected loudly (Redis AUTH here is
+        # password-only) instead of silently dropped.
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(raw)
+        if parts.username:
+            raise ValueError(
+                "PERSISTENCE_STORE redis:// URLs take ':password@' only "
+                f"(got username {parts.username!r}; Redis AUTH is "
+                "password-based)"
+            )
         return RedisStateStore(
-            host or None, int(port) if port else None, password=password
+            parts.hostname or None,
+            parts.port,
+            password=parts.password or None,
         )
     if raw.startswith("file:"):
         return FileStateStore(raw[len("file:"):])

@@ -44,6 +44,38 @@ _PEAKS: tuple[tuple[str, float], ...] = (
 )
 
 
+# HBM bandwidth per chip, bytes/s (public chip specs).  Decode is
+# bandwidth-bound — every step must stream the full weight set plus the
+# attention window — so the honest decode roofline is bytes/bw, not FLOPs.
+_HBM_BW: tuple[tuple[str, float], ...] = (
+    ("v6 lite", 1.64e12),  # Trillium / v6e
+    ("v6e", 1.64e12),
+    ("v5 lite", 0.819e12),  # v5e
+    ("v5litepod", 0.819e12),
+    ("v5e", 0.819e12),
+    ("v5p", 2.765e12),
+    ("v5", 2.765e12),
+    ("v4", 1.228e12),
+    ("v3", 0.9e12),
+    ("v2", 0.7e12),
+)
+
+
+def chip_hbm_bandwidth(device=None) -> float | None:
+    """HBM bytes/s for one chip, or None off-TPU."""
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    if "tpu" not in kind and device.platform != "tpu":
+        return None
+    for marker, bw in _HBM_BW:
+        if marker in kind:
+            return bw
+    return None
+
+
 def chip_peak_flops(device=None) -> float | None:
     """bf16 peak FLOP/s for one chip, or None off-TPU (CPU has no useful
     published peak for this comparison)."""
@@ -267,6 +299,22 @@ def generative_roofline(
         iters=iters,
     )
 
+    # time one prefill (smallest bucket covering the prompt): the TTFT
+    # floor.  The prefill program donates the cache, so calls chain.
+    prefill_payload = {
+        "padded": np.zeros((1, model.fit_bucket(prompt_len)), np.int32),
+        "length": prompt_len,
+        "slot": 0,
+        "blocks": model.reserve_blocks(0, prompt_len + decode_block),
+        "temperature": 0.0,
+        "seed": 0,
+    }
+    prefill_sec = measure_step_time(
+        lambda _x: model._exec_prefill(prefill_payload),
+        np.zeros(1),
+        iters=max(4, iters // 2),
+    )
+
     tokens_per_step = n_slots * decode_block
     n_params = sum(
         int(np.prod(x.shape)) for x in jax.tree.leaves(model.params)
@@ -277,21 +325,94 @@ def generative_roofline(
     peak = chip_peak_flops()
     ok = np.isfinite(sec) and sec > 0
     achieved = flops / sec if ok else None
+
+    # HBM roofline: every decode step streams the weights once plus each
+    # slot's attention window (K and V) from the paged pool
+    p_leaves = jax.tree.leaves(model.params)
+    param_bytes = sum(int(np.prod(x.shape)) * x.dtype.itemsize for x in p_leaves)
+    cache_itemsize = model._cache["k"].dtype.itemsize
+    window = payload["window"]
+    cfg = model.cfg
+    kv_read = (
+        2 * cfg.n_layers * n_slots * window * cfg.n_kv_heads * cfg.head_dim
+        * cache_itemsize
+    )
+    bw = chip_hbm_bandwidth()
+    step_floor_s = (param_bytes + kv_read) / bw if bw else None
+    hbm_tok_s = n_slots / step_floor_s if step_floor_s else None
+    tok_s = tokens_per_step / sec if ok else None
+    pf_ok = np.isfinite(prefill_sec) and prefill_sec > 0
     return {
         "family": family,
         "preset": preset or "default",
         "n_slots": n_slots,
         "decode_block": decode_block,
+        "window": window,
         "measurement_failed": not ok,
         "device_s_per_block": round(sec, 6) if ok else None,
-        "tokens_per_s_device": round(tokens_per_step / sec, 1) if ok else None,
+        "tokens_per_s_device": round(tok_s, 1) if ok else None,
         "n_params": n_params,
         "flops_per_token": round(2.0 * n_params),
         "achieved_tflops": round(achieved / 1e12, 3) if achieved else None,
         "peak_tflops": round(peak / 1e12, 1) if peak else None,
         "mfu": round(achieved / peak, 4) if achieved and peak else None,
+        # bandwidth view: what fraction of the memory-bound ceiling decode hits
+        "hbm_bytes_per_step": param_bytes + kv_read,
+        "hbm_gb_s": round(bw / 1e9, 0) if bw else None,
+        "hbm_roofline_tok_s": round(hbm_tok_s, 1) if hbm_tok_s else None,
+        "hbm_frac": (
+            round(tok_s / hbm_tok_s, 4) if ok and hbm_tok_s else None
+        ),
+        # serving latency floors (device-side; wire adds codec + RTT)
+        "prefill_ms": round(prefill_sec * 1e3, 3) if pf_ok else None,
+        "ttft_floor_ms": (
+            round((prefill_sec + sec / decode_block) * 1e3, 3)
+            if ok and pf_ok else None
+        ),
+        "block_ms": round(sec * 1e3, 3) if ok else None,
+        "kv_block_size": model.kv_block_size,
+        "kv_blocks": model.kv_blocks,
         "device_kind": jax.devices()[0].device_kind,
     }
+
+
+def generative_sweep(
+    family: str = "llama",
+    *,
+    preset: str | None = None,
+    points: "list[tuple[int, int]] | None" = None,
+    dtype: str | None = "bfloat16",
+    prompt_len: int = 8,
+    iters: int = 8,
+    **overrides,
+) -> list[dict]:
+    """Operating-point table over (n_slots, decode_block): device tok/s,
+    HBM fraction, block latency and TTFT floor per point — the data behind
+    choosing a serving configuration instead of defaulting one."""
+    import gc as _gc
+
+    out = []
+    for n_slots, decode_block in points or [(8, 16), (16, 16), (32, 16), (32, 32), (64, 32)]:
+        r = generative_roofline(
+            family,
+            preset=preset,
+            n_slots=n_slots,
+            decode_block=decode_block,
+            dtype=dtype,
+            prompt_len=prompt_len,
+            iters=iters,
+            **overrides,
+        )
+        out.append({
+            k: r.get(k)
+            for k in (
+                "n_slots", "decode_block", "window", "tokens_per_s_device",
+                "hbm_frac", "hbm_roofline_tok_s", "block_ms", "prefill_ms",
+                "ttft_floor_ms", "measurement_failed",
+            )
+        })
+        _gc.collect()  # free the previous point's params + cache buffers
+    return out
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -308,7 +429,30 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--generative", action="store_true")
     ap.add_argument("--n-slots", type=int, default=8)
     ap.add_argument("--decode-block", type=int, default=32)
+    ap.add_argument(
+        "--sweep",
+        default=None,
+        help="operating-point sweep: comma list of SLOTSxBLOCK "
+        "(e.g. 8x16,16x16,32x32); prints {'sweep': [...]}",
+    )
+    ap.add_argument("--max-seq", type=int, default=None)
     args = ap.parse_args(argv)
+    overrides = {"max_seq": args.max_seq} if args.max_seq else {}
+    if args.sweep:
+        points = [
+            (int(s), int(b))
+            for s, b in (p.lower().split("x") for p in args.sweep.split(","))
+        ]
+        out = generative_sweep(
+            args.family,
+            preset=args.preset,
+            points=points,
+            dtype=args.dtype,
+            iters=args.iters,
+            **overrides,
+        )
+        print(json.dumps({"sweep": out}))
+        return
     if args.generative:
         out = generative_roofline(
             args.family,
@@ -317,6 +461,7 @@ def main(argv: list[str] | None = None) -> None:
             decode_block=args.decode_block,
             dtype=args.dtype,
             iters=args.iters,
+            **overrides,
         )
     else:
         out = model_roofline(

@@ -1181,12 +1181,13 @@ class _ClientConn(_Conn):
         sc = self._stream_calls.get(stream_id)
         if sc is not None:
             sc.feed(data)
-            # streams are long-lived: replenish the PER-STREAM window
-            # continuously (the connection window is already credited for
-            # every DATA frame in _dispatch — crediting it again here would
-            # ratchet the peer's view past 2^31-1 and force a
-            # FLOW_CONTROL_ERROR GOAWAY, RFC 7540 §6.9.1)
-            self._stream_recv_credit(stream_id, len(data))
+            # per-stream window credit is DEFERRED to call_stream's consumer
+            # loop: a slow consumer (e.g. a gateway relaying to a slow
+            # client) then exerts real backpressure — the server can run at
+            # most our advertised window ahead of consumption instead of
+            # buffering the whole stream here.  (The CONNECTION window is
+            # credited in _dispatch for every DATA frame; doing it again
+            # would ratchet past 2^31-1, RFC 7540 §6.9.1.)
             if end:
                 self._stream_calls.pop(stream_id, None)
                 sc.finish()
@@ -1421,6 +1422,12 @@ class FastGrpcChannel:
                 item = await asyncio.wait_for(sc.queue.get(), remaining)
                 kind = item[0]
                 if kind == "msg":
+                    # credit the stream window only as messages are
+                    # CONSUMED (5 = gRPC frame prefix); withheld credit is
+                    # the backpressure that stops a fast server overrunning
+                    # a slow consumer
+                    if conn.transport is not None and not conn.transport.is_closing():
+                        conn._stream_recv_credit(stream_id, len(item[1]) + 5)
                     yield item[1]
                 elif kind == "end":
                     _, status, message = item

@@ -10,6 +10,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NOTEBOOKS = [
     "serving_walkthrough.ipynb",
     "graphs_and_canary.ipynb",
+    "operator_end_to_end.ipynb",
 ]
 
 
@@ -20,6 +21,19 @@ def test_notebook_executes(name):
     nb = nbformat.read(path, as_version=4)
     # execute the code cells in one namespace, like a kernel would
     ns: dict = {}
-    for cell in nb.cells:
-        if cell.cell_type == "code":
-            exec(compile("".join(cell.source), path, "exec"), ns)  # noqa: S102
+    try:
+        for cell in nb.cells:
+            if cell.cell_type == "code":
+                exec(compile("".join(cell.source), path, "exec"), ns)  # noqa: S102
+    finally:
+        # a cell that raised may have left engine/gateway subprocesses
+        # running — they would squat their ports for every later test
+        import subprocess
+
+        for v in list(ns.values()):
+            if isinstance(v, subprocess.Popen) and v.poll() is None:
+                v.terminate()
+                try:
+                    v.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    v.kill()
