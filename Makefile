@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native clean
+.PHONY: proto test bench native obs-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -16,6 +16,12 @@ test:
 
 bench:
 	$(PYTHON) bench.py
+
+# fast observability smoke: stub engine, 50 requests, asserts the new
+# /prometheus histograms exist and /stats/breakdown accounts for the
+# measured wall time (same test runs in tier-1)
+obs-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_obs.py -q -k obs_check
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -121,6 +121,19 @@ def _token_payload(rows: int, seq: int, vocab: int) -> bytes:
     ).encode()
 
 
+def _breakdown(port: int) -> dict:
+    """Per-stage latency flight recorder snapshot (GET /stats/breakdown):
+    says WHERE the wall time of the preceding load run went (gateway-relay /
+    engine-route / node / queue-wait / device-step / ...), not just how much."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats/breakdown", timeout=5
+        ) as r:
+            return json.loads(r.read()).get("stages", {})
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _roofline(args: list[str], timeout: float = 600.0) -> dict:
     """Run the device roofline (utils/roofline.py) in its OWN process —
     bench's engine subprocesses need the chip to themselves; a resident
@@ -227,6 +240,7 @@ def stage_mlp(detail: dict) -> float | None:
                     "remote chip; a locally-attached TPU serves the same "
                     "program sub-ms (see BucketSpec warmup)",
         }
+        detail["mlp_wire"]["breakdown"] = _breakdown(18800)
         if r.failures:
             return None
         return max(pred_s, grpc_pred_s if not g.failures else 0.0)
@@ -529,9 +543,11 @@ def stage_ab(detail: dict) -> None:
             [_raw_tensor_payload(rows, 784)],
             concurrency=16, duration_s=SECONDS,
         )
+        bd = _breakdown(18850)
     detail["ab_graph"] = {
         **r.summary(), "rows_per_request": rows,
         "predictions_per_s": round(r.rps * rows, 1),
+        "breakdown": bd,
         "graph": "EPSILON_GREEDY router over 2 mlp JAX units, in-process",
     }
 
@@ -609,6 +625,12 @@ def stage_gateway(detail: dict) -> None:
                 "127.0.0.1:18861", [msg], grpc=True,
                 concurrency=32, duration_s=secs,
             ))
+            gw_breakdown = _breakdown(18870)
+            engine_breakdown = _breakdown(18860)
+        detail["gateway_breakdown"] = {
+            "gateway": gw_breakdown,
+            "engine": engine_breakdown,
+        }
         detail["gateway_rest"] = {
             **rest.summary(),
             "direct_engine_rps": direct.rps,
@@ -681,6 +703,7 @@ def main() -> None:
         "unit": "pred/s",
         "vs_baseline": round(headline / BASELINE_REST_RPS, 4),
         "stages": _compact_stages(detail),
+        "breakdown": _compact_breakdown(detail),
         "detail_file": "BENCH_DETAIL.json",
     }))
 
@@ -712,6 +735,19 @@ def _compact_stages(detail: dict) -> dict:
         if isinstance(v, dict) and isinstance(v.get(field), (int, float)):
             out[name] = round(v[field], 4)
     return out
+
+
+def _compact_breakdown(detail: dict) -> dict:
+    """One per-stage p99 block for the headline line (full quantiles stay
+    in BENCH_DETAIL.json): where the latency went, per stage name."""
+    for key in ("ab_graph", "mlp_wire"):
+        bd = (detail.get(key) or {}).get("breakdown") or {}
+        stages = {
+            s: v.get("p99_ms") for s, v in bd.items() if isinstance(v, dict)
+        }
+        if stages:
+            return {"source": key, "p99_ms": stages}
+    return {}
 
 
 if __name__ == "__main__":

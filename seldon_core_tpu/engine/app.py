@@ -31,6 +31,7 @@ from seldon_core_tpu.contract import (
 )
 from seldon_core_tpu.engine.service import PredictionService, load_predictor_spec
 from seldon_core_tpu.graph.units import GraphUnitError
+from seldon_core_tpu.obs import RECORDER, STAGE_STREAM_FLUSH, configure_exporters_from_env
 from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
 
 log = logging.getLogger(__name__)
@@ -89,6 +90,9 @@ class EngineApp:
         r.add_post("/unpause", self.unpause)
         r.add_get("/unpause", self.unpause)
         r.add_get("/prometheus", self.prometheus)
+        # span recorder + flight recorder (docs/OBSERVABILITY.md)
+        r.add_get("/stats/spans", self.stats_spans)
+        r.add_get("/stats/breakdown", self.stats_breakdown)
         # XLA/device profiling (SURVEY §5: the reference had only JMX):
         # POST /profile/start {"dir": "/tmp/sct-profile"} ... /profile/stop
         # then open the trace in TensorBoard / xprof
@@ -99,6 +103,7 @@ class EngineApp:
         return app
 
     async def _startup(self, app: web.Application) -> None:
+        configure_exporters_from_env()
         await self.service.start()
         if self.mesh_worker:
             # worker host of a multi-host slice: the same units (and hence
@@ -169,6 +174,16 @@ class EngineApp:
             except GraphUnitError as e:
                 h["code"] = "500"
                 return web.json_response(_status_body(500, str(e)), status=500)
+            except web.HTTPException as e:
+                # aiohttp-raised statuses (413 payload too large, ...) must
+                # not be recorded as 200s
+                h["code"] = str(e.status)
+                raise
+            except Exception:
+                # unexpected failure: aiohttp answers 500 — the histogram
+                # must say so too, not default to "200"
+                h["code"] = "500"
+                raise
 
     async def predictions_stream(self, request: web.Request) -> web.StreamResponse:
         """Server-sent-events token streaming for a generative graph.
@@ -182,39 +197,46 @@ class EngineApp:
         out the full generation (p50 397ms for 32 tokens in round 3).
         """
         import json
+        import time
 
         dep, pred = self.service.deployment_name, self.service.predictor.name
-        units = self.service.generative_units()
-        if len(units) != 1:
-            reason = (
-                "predictor graph has no generative unit"
-                if not units
-                else f"streaming is ambiguous: graph has {len(units)} "
-                     "generative units"
-            )
-            return web.json_response(_status_body(400, reason), status=400)
-        unit = units[0]
-        try:
-            body = await self._json(request)
-            if "strData" in body:  # full contract wrapper also accepted
-                body = json.loads(body["strData"])
-            prompt = body["tokens"]
-            if not isinstance(prompt, (list, tuple)) or (
-                prompt and isinstance(prompt[0], (list, tuple))
-            ):
-                raise CodecError("streaming takes ONE prompt: flat 'tokens' list")
-            # option coercion BEFORE headers go out: a bad option must be a
-            # 400 response, not a truncated 200 event stream
-            max_new = body.get("max_new_tokens")
-            max_new = int(max_new) if max_new is not None else None
-            temperature = body.get("temperature")
-            temperature = float(temperature) if temperature is not None else None
-            eos = body.get("eos_id")
-            eos = int(eos) if eos is not None else None
-        except (CodecError, KeyError, TypeError, ValueError) as e:
-            return web.json_response(_status_body(400, f"bad stream request: {e}"), status=400)
-
+        # the timer covers validation too: a rejected stream request must
+        # be a recorded 400, not an unrecorded return
         with self.metrics.time_server_request(dep, pred, "predictions_stream", "POST") as h:
+            units = self.service.generative_units()
+            if len(units) != 1:
+                reason = (
+                    "predictor graph has no generative unit"
+                    if not units
+                    else f"streaming is ambiguous: graph has {len(units)} "
+                         "generative units"
+                )
+                h["code"] = "400"
+                return web.json_response(_status_body(400, reason), status=400)
+            unit = units[0]
+            try:
+                body = await self._json(request)
+                if "strData" in body:  # full contract wrapper also accepted
+                    body = json.loads(body["strData"])
+                prompt = body["tokens"]
+                if not isinstance(prompt, (list, tuple)) or (
+                    prompt and isinstance(prompt[0], (list, tuple))
+                ):
+                    raise CodecError("streaming takes ONE prompt: flat 'tokens' list")
+                # option coercion BEFORE headers go out: a bad option must be a
+                # 400 response, not a truncated 200 event stream
+                max_new = body.get("max_new_tokens")
+                max_new = int(max_new) if max_new is not None else None
+                temperature = body.get("temperature")
+                temperature = float(temperature) if temperature is not None else None
+                eos = body.get("eos_id")
+                eos = int(eos) if eos is not None else None
+            except (CodecError, KeyError, TypeError, ValueError) as e:
+                h["code"] = "400"
+                return web.json_response(
+                    _status_body(400, f"bad stream request: {e}"), status=400
+                )
+
             resp = web.StreamResponse(
                 headers={
                     "Content-Type": "text/event-stream",
@@ -224,6 +246,7 @@ class EngineApp:
             )
             await resp.prepare(request)
             out: list[int] = []
+            flush_s = 0.0  # cumulative socket-write time -> stream-flush stage
             try:
                 gen = unit.stream(
                     prompt,
@@ -233,12 +256,16 @@ class EngineApp:
                 )
                 async for tok in gen:
                     out.append(tok)
+                    t_w = time.perf_counter()
                     await resp.write(
                         f"data: {json.dumps({'token': tok})}\n\n".encode()
                     )
+                    flush_s += time.perf_counter() - t_w
+                t_w = time.perf_counter()
                 await resp.write(
                     f"data: {json.dumps({'done': True, 'tokens': out})}\n\n".encode()
                 )
+                flush_s += time.perf_counter() - t_w
             except (ConnectionResetError, asyncio.CancelledError):
                 raise  # client went away / server draining: nothing to send
             except Exception as e:
@@ -249,6 +276,9 @@ class EngineApp:
                 await resp.write(
                     f"data: {json.dumps({'error': str(e)})}\n\n".encode()
                 )
+            finally:
+                if out:
+                    RECORDER.record_stage(STAGE_STREAM_FLUSH, flush_s)
             await resp.write_eof()
             return resp
 
@@ -265,6 +295,12 @@ class EngineApp:
             except GraphUnitError as e:
                 h["code"] = "500"
                 return web.json_response(_status_body(500, str(e)), status=500)
+            except web.HTTPException as e:
+                h["code"] = str(e.status)
+                raise
+            except Exception:
+                h["code"] = "500"
+                raise
 
     async def _json(self, request: web.Request) -> dict[str, Any]:
         import json
@@ -307,6 +343,18 @@ class EngineApp:
 
     async def prometheus(self, request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.expose(), content_type="text/plain")
+
+    async def stats_spans(self, request: web.Request) -> web.Response:
+        """Recent traces + slowest-N root spans from the in-process ring."""
+        try:
+            n = int(request.query.get("n", "20"))
+        except ValueError:
+            n = 20
+        return web.json_response(RECORDER.stats(n=max(1, min(n, 200))))
+
+    async def stats_breakdown(self, request: web.Request) -> web.Response:
+        """Aggregated per-stage p50/p90/p99 (the flight recorder)."""
+        return web.json_response({"stages": RECORDER.breakdown()})
 
     async def profile_start(self, request: web.Request) -> web.Response:
         import jax

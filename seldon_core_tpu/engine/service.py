@@ -18,6 +18,7 @@ from seldon_core_tpu.contract import FeedbackPayload, Payload
 from seldon_core_tpu.graph.spec import PredictorSpec, PredictiveUnitSpec
 from seldon_core_tpu.graph.walker import GraphWalker
 from seldon_core_tpu.engine.transport import TransportManager
+from seldon_core_tpu.obs import RECORDER, STAGE_ENGINE_ROUTE
 from seldon_core_tpu.utils.metrics import MetricsRegistry
 from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
 from seldon_core_tpu.utils.puid import make_puid
@@ -111,7 +112,18 @@ class PredictionService:
         assert self.walker is not None, "PredictionService.start() not called"
         if not payload.meta.puid:
             payload.meta.puid = make_puid()
-        out = await self.walker.predict(payload, trace=trace)
+        # the engine's span for this request (root when no traceparent came
+        # in); node spans open under it in the walker, both REST and gRPC
+        # ingress share this one site
+        with RECORDER.span(
+            "engine.predict",
+            service=self.deployment_name,
+            stage=STAGE_ENGINE_ROUTE,
+        ) as sp:
+            if sp is not None:
+                sp.set_attr("puid", payload.meta.puid)
+                sp.set_attr("predictor", self.predictor.name)
+            out = await self.walker.predict(payload, trace=trace)
         if out.meta.metrics:
             self.metrics.record_custom(
                 self.deployment_name, self.predictor.name, self.predictor.graph.name,

@@ -26,9 +26,33 @@ from __future__ import annotations
 import asyncio
 import collections
 import concurrent.futures
+import time
 from typing import Callable
 
 import numpy as np
+
+from seldon_core_tpu.obs import (
+    RECORDER,
+    STAGE_BATCH_ASSEMBLY,
+    STAGE_DEVICE_STEP,
+    STAGE_QUEUE_WAIT,
+    current_span,
+)
+from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
+
+_peak_flops_cache: list = []  # [float | None], filled on first use
+
+
+def _chip_peak() -> float | None:
+    """Chip bf16 peak FLOP/s (None off-TPU), resolved once per process."""
+    if not _peak_flops_cache:
+        try:
+            from seldon_core_tpu.utils.roofline import chip_peak_flops
+
+            _peak_flops_cache.append(chip_peak_flops())
+        except Exception:
+            _peak_flops_cache.append(None)
+    return _peak_flops_cache[0]
 
 
 class BatchQueue:
@@ -64,6 +88,15 @@ class BatchQueue:
         # observability
         self.steps = 0
         self.rows = 0
+        # FLOPs one batch row costs (set by the component wiring when the
+        # model knows; feeds the MFU gauge against the chip peak)
+        self.flops_per_row: float | None = getattr(runner, "flops_per_row", None)
+        m = DEFAULT_METRICS
+        self._m_queue_wait = m.queue_wait.labels(name)
+        self._m_device_step = m.device_step.labels(name)
+        self._m_batch_size = m.batch_size.labels(name)
+        self._m_queue_depth = m.queue_depth.labels(name)
+        self._m_mfu = m.mfu.labels(name)
 
     # ------------------------------------------------------------- lifecycle
     def _ensure_running(self) -> None:
@@ -85,7 +118,7 @@ class BatchQueue:
         await asyncio.gather(*self._inflight, return_exceptions=True)
         err = RuntimeError(f"BatchQueue {self.name!r} closed")
         while not self._queue.empty():
-            _, fut = self._queue.get_nowait()
+            _, fut, _ = self._queue.get_nowait()
             if not fut.done():
                 fut.set_exception(err)
         self._pool.shutdown(wait=False)
@@ -98,8 +131,22 @@ class BatchQueue:
         self._ensure_running()
         x = np.asarray(x)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((x, fut))
-        return await fut
+        await self._queue.put((x, fut, time.perf_counter()))
+        self._m_queue_depth.set(self._queue.qsize())
+        res = await fut
+        timing = getattr(fut, "_sct_timing", None)
+        if timing is not None:
+            # back in the request's context: attach the step timing to the
+            # enclosing span (the walker's node span) as events
+            sp = current_span()
+            if sp is not None:
+                qw, step_s = timing
+                sp.event(
+                    "batch-step",
+                    queue_wait_ms=round(qw * 1e3, 3),
+                    device_step_ms=round(step_s * 1e3, 3),
+                )
+        return res
 
     # ------------------------------------------------------------- internals
     @staticmethod
@@ -117,6 +164,7 @@ class BatchQueue:
         try:
             while True:
                 first = pending.popleft() if pending else await self._queue.get()
+                t_collect0 = loop.time()  # batch-assembly stage starts here
                 group = [first]
                 key = self._key(first[0])
                 rows = self._rows(first[0])
@@ -167,6 +215,9 @@ class BatchQueue:
                     rows += self._rows(item[0])
                     rows = drain(rows)  # absorb any burst that came with it
 
+                RECORDER.record_stage(
+                    STAGE_BATCH_ASSEMBLY, loop.time() - t_collect0
+                )
                 await self._sem.acquire()  # bound the in-flight pipeline
                 task = loop.create_task(self._step(loop, group))
                 self._inflight.add(task)
@@ -174,14 +225,22 @@ class BatchQueue:
                 group = []
         except asyncio.CancelledError:
             err = RuntimeError(f"BatchQueue {self.name!r} closed")
-            for _, fut in list(group) + list(pending):
+            for _, fut, _ in list(group) + list(pending):
                 if not fut.done():
                     fut.set_exception(err)
             raise
 
     async def _step(self, loop, group) -> None:
-        xs = [np.atleast_2d(x) for x, _ in group]
+        xs = [np.atleast_2d(x) for x, _, _ in group]
         batch = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
+        t_step0 = time.perf_counter()
+        waits = []
+        for _, _, t_enq in group:
+            qw = t_step0 - t_enq
+            waits.append(qw)
+            RECORDER.record_stage(STAGE_QUEUE_WAIT, qw)
+            self._m_queue_wait.observe(qw)
+        self._m_batch_size.observe(batch.shape[0])
         try:
             try:
                 cap = getattr(getattr(self.runner, "buckets", None), "max", None)
@@ -200,21 +259,33 @@ class BatchQueue:
                     out = await loop.run_in_executor(self._pool, self.runner, batch)
             except asyncio.CancelledError:
                 err: BaseException = RuntimeError(f"BatchQueue {self.name!r} closed")
-                for _, fut in group:
+                for _, fut, _ in group:
                     if not fut.done():
                         fut.set_exception(err)
                 raise
             except Exception as exc:  # propagate to every waiter
-                for _, fut in group:
+                for _, fut, _ in group:
                     if not fut.done():
                         fut.set_exception(exc)
                 return
+            step_s = time.perf_counter() - t_step0
+            RECORDER.record_stage(STAGE_DEVICE_STEP, step_s)
+            self._m_device_step.observe(step_s)
+            if self.flops_per_row and step_s > 0:
+                peak = _chip_peak()
+                if peak:
+                    self._m_mfu.set(
+                        batch.shape[0] * self.flops_per_row / step_s / peak
+                    )
             self.steps += 1
             self.rows += batch.shape[0]
             out = np.asarray(out)
             offset = 0
-            for (x, fut), rows in zip(group, (x.shape[0] for x in xs)):
+            for (x, fut, _), rows, qw in zip(
+                group, (x.shape[0] for x in xs), waits
+            ):
                 if not fut.done():
+                    fut._sct_timing = (qw, step_s)  # read back in submit()
                     res = out[offset : offset + rows]
                     fut.set_result(res if x.ndim > 1 else res[0])
                 offset += rows

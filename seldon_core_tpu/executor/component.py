@@ -43,6 +43,17 @@ class JaxModelComponent(SeldonComponent):
             if batching
             else None
         )
+        if self._queue is not None and self._queue.flops_per_row is None:
+            # feed the MFU gauge: ~2·params FLOPs per dense forward row
+            # (roofline's estimate; exact XLA cost would need a re-compile)
+            try:
+                import jax
+
+                self._queue.flops_per_row = 2.0 * sum(
+                    int(np.prod(x.shape)) for x in jax.tree.leaves(model.params)
+                )
+            except Exception:
+                pass
 
     def warmup(self) -> int:
         """Pre-compile every batch bucket; returns the program count.

@@ -33,17 +33,20 @@ import json
 import logging
 import os
 import threading
+import time
 from typing import Any, AsyncIterator, Callable
 
 import jax
 import numpy as np
 
 from seldon_core_tpu.graph.units import GraphUnitError, SeldonComponent
+from seldon_core_tpu.obs import RECORDER, STAGE_DEVICE_STEP, STAGE_TTFT
 from seldon_core_tpu.parallel.sharding import (
     DEFAULT_RULES,
     ShardingRules,
     shard_params,
 )
+from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
 
 log = logging.getLogger(__name__)
 
@@ -301,8 +304,29 @@ class GenerativeModel:
         # observability
         self.steps = 0
         self.prefills = 0
+        # decode FLOPs ≈ 2·params per token (roofline's estimate) — feeds
+        # the MFU gauge from measured step round trips
+        self.flops_per_token = 2.0 * sum(
+            int(np.prod(x.shape)) for x in jax.tree.leaves(self.params)
+        )
+        self._m_device_step = DEFAULT_METRICS.device_step.labels(name)
+        self._m_mfu = DEFAULT_METRICS.mfu.labels(name)
         # RLock: warmup calls admit/step under the same lock
         self._lock = threading.RLock()
+
+    def _record_step(self, step_s: float, tokens_emitted: int) -> None:
+        """Flight-recorder + metrics for one decode dispatch (runs on the
+        scheduler's worker thread; all sinks are thread-safe)."""
+        RECORDER.record_stage(STAGE_DEVICE_STEP, step_s)
+        self._m_device_step.observe(step_s)
+        if tokens_emitted and step_s > 0:
+            from seldon_core_tpu.executor.batcher import _chip_peak
+
+            peak = _chip_peak()
+            if peak:
+                self._m_mfu.set(
+                    tokens_emitted * self.flops_per_token / step_s / peak
+                )
 
     # ------------------------------------------------------------------ ops
 
@@ -455,12 +479,17 @@ class GenerativeModel:
             "seed": int(seed),
             "window": window or self._window_for(active, 1),
         }
+        t0 = time.perf_counter()
         if self.driver is not None:
             toks = self.driver.lead(self._mh_decode_key, payload)
         else:
             toks = self._exec_decode(payload)
         self._pos_ceiling[np.asarray(active, bool)] += 1
-        return np.asarray(jax.device_get(toks))
+        out = np.asarray(jax.device_get(toks))
+        self._record_step(
+            time.perf_counter() - t0, int(np.asarray(active, bool).sum())
+        )
+        return out
 
     def step_k(
         self,
@@ -488,6 +517,7 @@ class GenerativeModel:
             "k": int(k),
             "window": window or self._window_for(active, k),
         }
+        t0 = time.perf_counter()
         if self.driver is not None:
             toks_seq, act_seq = self.driver.lead(self._mh_decode_k_key, payload)
         else:
@@ -496,7 +526,9 @@ class GenerativeModel:
         # ONE device_get for both arrays: two separate fetches would pay two
         # host round trips per block on a tunnel-attached chip
         toks_np, act_np = jax.device_get((toks_seq, act_seq))
-        return np.asarray(toks_np), np.asarray(act_np)
+        act_np = np.asarray(act_np)
+        self._record_step(time.perf_counter() - t0, int(act_np.sum()))
+        return np.asarray(toks_np), act_np
 
     def _exec_decode_k(self, payload: dict):
         k = int(payload["k"])
@@ -606,6 +638,13 @@ class _Request:
     # streaming hook: called with each sampled token as it lands (in
     # event-loop context, decode_block tokens at a time per device fetch)
     on_token: "Callable[[int], None] | None" = None
+    # flight-recorder timestamps: submission and first sampled token
+    t0: float = 0.0
+    t_first_token: float = 0.0
+    # the submitting request's live span (captured at submit, same loop):
+    # first-token lands on it as an event even though the scheduler loop
+    # runs outside the request's contextvar scope
+    span: Any = None
 
 
 class GenerationScheduler:
@@ -669,10 +708,13 @@ class GenerationScheduler:
         if self._task is None or self._task.done():
             self._task = asyncio.get_running_loop().create_task(self._run())
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        from seldon_core_tpu.obs import current_span
+
         await self._queue.put(
             _Request(
                 prompt, max_new_tokens, float(temperature), eos_id, fut,
-                on_token=on_token,
+                on_token=on_token, t0=time.perf_counter(),
+                span=current_span(),
             )
         )
         return await fut
@@ -696,8 +738,23 @@ class GenerationScheduler:
     def _complete(self, req: _Request) -> None:
         if not req.future.done():
             req.future.set_result(np.asarray(req.out, np.int32))
+        if req.out and req.t0:
+            dur = time.perf_counter() - req.t0
+            m = DEFAULT_METRICS
+            m.generated_tokens.labels(self.model.name).inc(len(req.out))
+            if dur > 0:
+                m.tokens_per_s.labels(self.model.name).set(len(req.out) / dur)
 
     def _token_done(self, req: _Request, tok: int) -> bool:
+        if not req.out and req.t0:
+            # first sampled token: the serving TTFT (queue wait + prefill
+            # + the first decode fetch)
+            req.t_first_token = time.perf_counter()
+            ttft = req.t_first_token - req.t0
+            RECORDER.record_stage(STAGE_TTFT, ttft)
+            DEFAULT_METRICS.ttft.labels(self.model.name).observe(ttft)
+            if req.span is not None:
+                req.span.event("first-token", ttft_ms=round(ttft * 1e3, 3))
         req.out.append(tok)
         if req.on_token is not None:
             try:

@@ -38,7 +38,13 @@ from seldon_core_tpu.gateway.store import (
     load_store_from_env,
 )
 from seldon_core_tpu.gateway.tap import RequestResponseTap, tap_from_env
-from seldon_core_tpu.utils.tracectx import outgoing_headers
+from seldon_core_tpu.obs import RECORDER, STAGE_GATEWAY_RELAY, configure_exporters_from_env
+from seldon_core_tpu.utils.tracectx import (
+    TRACE_RESPONSE_HEADER,
+    current_trace_id,
+    outgoing_headers,
+    set_traceparent,
+)
 from seldon_core_tpu.wire.h1client import H1ConnectError, H1Pool
 from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS, MetricsRegistry
 
@@ -125,6 +131,7 @@ class GatewayApp:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> None:
+        configure_exporters_from_env()
         return None  # pools connect lazily per deployment
 
     async def close(self) -> None:
@@ -144,6 +151,8 @@ class GatewayApp:
         r.add_post("/pause", self.pause)
         r.add_post("/unpause", self.unpause)
         r.add_get("/prometheus", self.prometheus)
+        r.add_get("/stats/spans", self.stats_spans)
+        r.add_get("/stats/breakdown", self.stats_breakdown)
 
         async def _startup(app_: web.Application) -> None:
             await self.start()
@@ -267,7 +276,16 @@ class GatewayApp:
             path,
             service,
         )
-        return web.Response(body=body, status=code, content_type="application/json")
+        # echo the trace id (the puid of the tracing world) so clients can
+        # quote it to operators; ingress_core set/minted it in this context
+        headers = {}
+        tid = current_trace_id()
+        if tid:
+            headers[TRACE_RESPONSE_HEADER] = tid
+        return web.Response(
+            body=body, status=code, content_type="application/json",
+            headers=headers,
+        )
 
     async def ingress_core(
         self,
@@ -281,15 +299,40 @@ class GatewayApp:
         metrics.  Returns (status, JSON body bytes) — shared by the aiohttp
         front end and the h1 splice front end's fallback path."""
         if self._paused:
+            # drained traffic still counts: a 503 storm during a rollout
+            # must be visible in the ingress histogram
+            self.metrics.ingress_requests.labels(
+                "anonymous", "unknown", service, "POST", "503"
+            ).observe(0.0)
             return 503, _error_bytes(503, "gateway is paused")
         start = time.perf_counter()
+        # seed the hop's trace context; a trace-naive client gets a minted
+        # root here so the engine's spans still stitch into one trace
+        set_traceparent(traceparent)
+        with RECORDER.span(
+            "gateway.ingress", service=service, stage=STAGE_GATEWAY_RELAY
+        ) as sp:
+            code, reply = await self._ingress_inner(
+                auth_header, raw, path, service, start,
+            )
+            if sp is not None:
+                sp.set_attr("code", code)
+                if code >= 400:
+                    sp.set_status("ERROR")
+            return code, reply
+
+    async def _ingress_inner(
+        self,
+        auth_header: str,
+        raw: bytes,
+        path: str,
+        service: str,
+        start: float,
+    ) -> tuple[int, bytes]:
         principal = "anonymous"
         deployment_name = "unknown"
         code = 200
         try:
-            from seldon_core_tpu.utils.tracectx import set_traceparent
-
-            set_traceparent(traceparent)
             rec = self._principal_from_header(auth_header)
             principal = rec.oauth_key
             deployment_name = rec.name
@@ -390,6 +433,16 @@ class GatewayApp:
 
     async def prometheus(self, request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.expose(), content_type="text/plain")
+
+    async def stats_spans(self, request: web.Request) -> web.Response:
+        try:
+            n = int(request.query.get("n", "20"))
+        except ValueError:
+            n = 20
+        return web.json_response(RECORDER.stats(n=max(1, min(n, 200))))
+
+    async def stats_breakdown(self, request: web.Request) -> web.Response:
+        return web.json_response({"stages": RECORDER.breakdown()})
 
 
 def main(argv: list[str] | None = None) -> None:

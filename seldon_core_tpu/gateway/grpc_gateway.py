@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import logging
+import time
 
 import grpc
 
@@ -39,7 +40,14 @@ from seldon_core_tpu.proto.grpc_defs import (
     failure_message,
     use_grpcio,
 )
-from seldon_core_tpu.utils.tracectx import outgoing_headers, set_traceparent
+from seldon_core_tpu.obs import RECORDER, STAGE_GATEWAY_RELAY
+from seldon_core_tpu.utils.tracectx import (
+    ensure_traceparent,
+    new_traceparent,
+    outgoing_headers,
+    parse_traceparent,
+    set_traceparent,
+)
 from seldon_core_tpu.wire import FastGrpcChannel, FastGrpcServer, GrpcCallError
 from seldon_core_tpu.wire.h2grpc import grpc_frame
 
@@ -135,19 +143,23 @@ class GatewayGrpc(_ChannelCacheBase):
     def _resolve(self, context) -> DeploymentRecord:
         md = dict(context.invocation_metadata() or [])
         # same trace propagation as the fast plane — fallback mode must not
-        # silently break the chain
+        # silently break the chain; trace-naive clients get a minted root
         set_traceparent(md.get("traceparent"))
+        ensure_traceparent()
         return _resolve_record(self.gateway, md.get(OAUTH_METADATA_KEY, ""))
 
     async def Predict(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
         try:
             rec = self._resolve(context)
             stub = Stub(self._channel(rec), "Seldon")
-            return await stub.Predict(
-                request,
-                timeout=self.gateway.timeout_s,
-                metadata=tuple(outgoing_headers().items()) or None,
-            )
+            with RECORDER.span(
+                "gateway.grpc.Predict", service=rec.name, stage=STAGE_GATEWAY_RELAY
+            ):
+                return await stub.Predict(
+                    request,
+                    timeout=self.gateway.timeout_s,
+                    metadata=tuple(outgoing_headers().items()) or None,
+                )
         except AuthError as e:
             return failure_message(str(e), e.status)
         except grpc.aio.AioRpcError as e:
@@ -157,11 +169,14 @@ class GatewayGrpc(_ChannelCacheBase):
         try:
             rec = self._resolve(context)
             stub = Stub(self._channel(rec), "Seldon")
-            return await stub.SendFeedback(
-                request,
-                timeout=self.gateway.timeout_s,
-                metadata=tuple(outgoing_headers().items()) or None,
-            )
+            with RECORDER.span(
+                "gateway.grpc.SendFeedback", service=rec.name, stage=STAGE_GATEWAY_RELAY
+            ):
+                return await stub.SendFeedback(
+                    request,
+                    timeout=self.gateway.timeout_s,
+                    metadata=tuple(outgoing_headers().items()) or None,
+                )
         except AuthError as e:
             return failure_message(str(e), e.status)
         except grpc.aio.AioRpcError as e:
@@ -192,6 +207,7 @@ class FastGatewayGrpc(_ChannelCacheBase):
                 traceparent = v.decode()
         _request_token.set(token)
         set_traceparent(traceparent)
+        ensure_traceparent()
 
     # -- inline unary relay -------------------------------------------------
 
@@ -203,12 +219,24 @@ class FastGatewayGrpc(_ChannelCacheBase):
 
         def relay(conn, stream_id: int, headers: list, framed: bytes) -> None:
             token = b""
-            metadata: tuple = ()
+            tp: bytes | None = None
             for k, v in headers:
                 if k == b"oauth_token":
                     token = v
                 elif k == b"traceparent":
-                    metadata = ((b"traceparent", v),)
+                    tp = v
+            # mint a root trace for trace-naive clients (same policy as the
+            # REST front ends) so the engine hop always correlates
+            minted = None
+            tp_parsed = parse_traceparent(tp.decode() if tp else None)
+            if tp_parsed is None:
+                minted = new_traceparent(sampled=RECORDER.should_sample())
+                tp_parsed = parse_traceparent(minted)
+                metadata: tuple = ((b"traceparent", minted.encode()),)
+            else:
+                metadata = ((b"traceparent", tp),)
+            trace_id, peer_span, flags = tp_parsed
+            t0_wall, t0 = time.time(), time.perf_counter()
             try:
                 rec = _resolve_record(gateway, token.decode())
             except AuthError as e:
@@ -220,6 +248,20 @@ class FastGatewayGrpc(_ChannelCacheBase):
 
             def done(status: int, message: str, body: bytes) -> None:
                 conn.relay_cancels.pop(stream_id, None)
+                dt = time.perf_counter() - t0
+                RECORDER.record_stage(STAGE_GATEWAY_RELAY, dt)
+                RECORDER.record_span(
+                    f"gateway.grpc.{method}",
+                    trace_id=trace_id,
+                    span_id=peer_span if minted is not None else None,
+                    parent_id=None if minted is not None else peer_span,
+                    start=t0_wall,
+                    duration_s=dt,
+                    service=rec.name,
+                    status="OK" if status == 0 else "ERROR",
+                    attrs={"grpc_status": status},
+                    sampled=bool(flags & 0x01),
+                )
                 if status == 0:
                     conn.write_unary_response(stream_id, body)
                 elif status == 14 and "unreachable" in message:

@@ -43,6 +43,13 @@ from typing import Optional
 
 from seldon_core_tpu.contract import failure_status_dict
 from seldon_core_tpu.gateway.auth import AuthError
+from seldon_core_tpu.obs import RECORDER, STAGE_GATEWAY_RELAY, configure_exporters_from_env
+from seldon_core_tpu.utils.tracectx import (
+    TRACE_RESPONSE_HEADER,
+    get_traceparent,
+    new_traceparent,
+    parse_traceparent,
+)
 from seldon_core_tpu.wire.h2grpc import _dual_stack_socket
 from seldon_core_tpu.wire.iobuf import WriteCoalescer
 
@@ -73,10 +80,15 @@ _MAX_BODY = int(_os.environ.get("GATEWAY_MAX_BODY", str(256 * 1024 * 1024)))
 _HOP_BY_HOP = (b"connection", b"keep-alive", b"proxy-connection", b"upgrade")
 
 
-def _response(status: int, body: bytes, content_type: bytes = b"application/json") -> bytes:
+def _response(
+    status: int,
+    body: bytes,
+    content_type: bytes = b"application/json",
+    extra_headers: bytes = b"",
+) -> bytes:
     return (
-        b"HTTP/1.1 %d %s\r\ncontent-type: %s\r\ncontent-length: %d\r\n\r\n"
-        % (status, _REASONS.get(status, b""), content_type, len(body))
+        b"HTTP/1.1 %d %s\r\ncontent-type: %s\r\ncontent-length: %d\r\n%s\r\n"
+        % (status, _REASONS.get(status, b""), content_type, len(body), extra_headers)
         + body
     )
 
@@ -177,7 +189,7 @@ class _UpConn(WriteCoalescer, asyncio.Protocol):
                     continue
                 self._in_head = False
                 if down is not None:
-                    down.forward(head)
+                    down.forward_head(head)
                 rest = bytes(self.buf)
                 self.buf.clear()
                 if rest:
@@ -493,6 +505,10 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
         self.rec = None
         self.forwarded = False  # response bytes already written downstream
         self.close_after = False
+        # (trace_id, span_id, parent_id, sampled, epoch_start) of the
+        # in-flight spliced request; trace id echoed on the response head
+        self._trace: tuple | None = None
+        self.echo_trace_id: bytes | None = None
         self._sent_continue = False
         self._tasks: set[asyncio.Task] = set()
         # write coalescing: response head + body (and any same-iteration
@@ -607,11 +623,26 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                 self._tasks.add(task)
                 task.add_done_callback(self._tasks.discard)
                 return
-            if rewritten_head is not None:
-                # hop-by-hop headers stripped / HTTP/1.0 line upgraded: the
-                # shared upstream conn must never see a client's Connection
-                # semantics (RFC 9112 §7.6.1)
-                raw = rewritten_head + bytes(buf[idx + 4 : total])
+            # trace context: forward a client-sent (valid) traceparent
+            # verbatim; mint a spec-valid root and INJECT it into the
+            # spliced head when the client is trace-naive, so the engine's
+            # spans always have a trace to join
+            minted = None
+            tp_parsed = parse_traceparent(traceparent)
+            if tp_parsed is None:
+                minted = new_traceparent(sampled=self.frontend.recorder.should_sample())
+                tp_parsed = parse_traceparent(minted)
+            if rewritten_head is not None or minted is not None:
+                # hop-by-hop headers stripped / HTTP/1.0 line upgraded /
+                # traceparent minted: rebuild the head for the shared
+                # upstream conn (RFC 9112 §7.6.1)
+                head_out = rewritten_head if rewritten_head is not None else head
+                if minted is not None:
+                    head_out = (
+                        head_out[:-2]
+                        + b"traceparent: " + minted.encode() + b"\r\n\r\n"
+                    )
+                raw = head_out + bytes(buf[idx + 4 : total])
             else:
                 raw = bytes(buf[:total])
             del buf[:total]
@@ -625,6 +656,10 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                     return
                 continue
             if self.gateway._paused:
+                # drained traffic must be a RECORDED 503, not a silent one
+                self.frontend.observe(
+                    rec.oauth_key, rec.name, service, 503, 0.0
+                )
                 self.write(_error_response(503, "gateway is paused"))
                 if self.close_after:
                     self._close()
@@ -636,6 +671,18 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
             self.awaiting = True
             self.forwarded = False
             self.t0 = time.perf_counter()
+            trace_id, peer_span, flags = tp_parsed
+            self._trace = (
+                trace_id,
+                # minted root: the injected span id IS the gateway's span,
+                # so the engine parents under it; client-sent: the gateway
+                # span is a fresh sibling of the engine's under the client
+                peer_span if minted is not None else None,
+                None if minted is not None else peer_span,
+                bool(flags & 0x01),
+                time.time(),
+            )
+            self.echo_trace_id = trace_id.encode()
             timeout = (
                 self.gateway.stream_timeout_s if streaming else self.gateway.timeout_s
             )
@@ -720,27 +767,64 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
         self.forwarded = True
         self.write(data)
 
+    def forward_head(self, head: bytes) -> None:
+        """Forward the engine's (final) response head, echoing the trace id
+        so the client can correlate without parsing spans."""
+        self.forwarded = True
+        echo = self.echo_trace_id
+        if echo:
+            head = head[:-2] + TRACE_RESPONSE_HEADER.encode() + b": " + echo + b"\r\n\r\n"
+        self.write(head)
+
+    def _finish_trace(self, status: int, dt: float) -> None:
+        """Record the relay stage + root span for one spliced request
+        (span assembled by hand: the splice lives in protocol callbacks,
+        not in one task's contextvar scope)."""
+        rec = self.frontend.recorder
+        rec.record_stage(STAGE_GATEWAY_RELAY, dt)
+        tr, self._trace = self._trace, None
+        self.echo_trace_id = None
+        if tr is None:
+            return
+        trace_id, span_id, parent_id, sampled, start = tr
+        rec.record_span(
+            "gateway.relay",
+            trace_id=trace_id,
+            span_id=span_id,
+            parent_id=parent_id,
+            start=start,
+            duration_s=dt,
+            service=self.service,
+            status="OK" if status < 400 else "ERROR",
+            attrs={"code": status},
+            sampled=sampled,
+        )
+
     def upstream_done(self, status: int) -> None:
         self.job = None
         rec = self.rec
+        dt = time.perf_counter() - self.t0
+        self._finish_trace(status, dt)
         self.frontend.observe(
             rec.oauth_key if rec else "anonymous",
             rec.name if rec else "unknown",
             self.service,
             status,
-            time.perf_counter() - self.t0,
+            dt,
         )
         self._next()
 
     def upstream_failed(self, reason: str, forwarded: bool) -> None:
         self.job = None
         rec = self.rec
+        dt = time.perf_counter() - self.t0
+        self._finish_trace(503, dt)
         self.frontend.observe(
             rec.oauth_key if rec else "anonymous",
             rec.name if rec else "unknown",
             self.service,
             503,
-            time.perf_counter() - self.t0,
+            dt,
         )
         if self.transport is None or self.transport.is_closing():
             return
@@ -779,7 +863,17 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                 failure_status_dict(500, f"{type(e).__name__}: {e}")
             ).encode(), b"application/json"
         if self.transport is not None and not self.transport.is_closing():
-            self.write(_response(status, payload, ctype))
+            extra = b""
+            parsed = parse_traceparent(get_traceparent())
+            if parsed is not None and route in (
+                b"/api/v0.1/predictions", b"/api/v0.1/feedback"
+            ):
+                # ingress_core seeded/minted the trace in this task's context
+                extra = (
+                    TRACE_RESPONSE_HEADER.encode() + b": "
+                    + parsed[0].encode() + b"\r\n"
+                )
+            self.write(_response(status, payload, ctype, extra_headers=extra))
         self._next()
 
 
@@ -793,6 +887,7 @@ class H1SpliceFrontend:
 
     def __init__(self, gateway):
         self.gateway = gateway
+        self.recorder = RECORDER
         self.loop: asyncio.AbstractEventLoop | None = None
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[_DownConn] = set()
@@ -832,6 +927,7 @@ class H1SpliceFrontend:
 
     async def start(self, port: int, host: str | None = None) -> int:
         self.loop = asyncio.get_running_loop()
+        configure_exporters_from_env()
         if host is None:
             sock = _dual_stack_socket(port, reuse_port=False)
             self._server = await self.loop.create_server(
@@ -852,6 +948,18 @@ class H1SpliceFrontend:
                 job, conn.job = conn.job, None
                 if job is not None:
                     job.down = None  # discard whatever the engine returns
+                # the timeout is a real 504: ingress metrics + the relay
+                # span must both say so
+                rec = conn.rec
+                dt = time.perf_counter() - conn.t0
+                conn._finish_trace(504, dt)
+                self.observe(
+                    rec.oauth_key if rec else "anonymous",
+                    rec.name if rec else "unknown",
+                    conn.service,
+                    504,
+                    dt,
+                )
                 if conn.transport is not None and not conn.transport.is_closing():
                     if not conn.forwarded:
                         conn.write(_error_response(504, "engine timed out"))
@@ -923,6 +1031,10 @@ class H1SpliceFrontend:
             return 200, b"unpaused", b"text/plain"
         if route == b"/prometheus":
             return 200, gw.metrics.expose(), b"text/plain"
+        if route == b"/stats/spans":
+            return 200, json.dumps(self.recorder.stats(n=20)).encode(), b"application/json"
+        if route == b"/stats/breakdown":
+            return 200, json.dumps({"stages": self.recorder.breakdown()}).encode(), b"application/json"
         return 404, json.dumps(
             failure_status_dict(404, f"no route {route.decode('latin-1')}")
         ).encode(), b"application/json"

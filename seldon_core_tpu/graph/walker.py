@@ -46,6 +46,7 @@ from seldon_core_tpu.graph.units import (
     create_builtin,
     has_builtin,
 )
+from seldon_core_tpu.obs import RECORDER, STAGE_NODE
 
 ROUTE_ALL = -1  # route() result meaning "send to every child"
 
@@ -373,14 +374,22 @@ class GraphWalker:
     async def _execute(
         self, node: _NodeState, p: Payload, timings: dict | None = None
     ) -> Payload:
-        if timings is not None:
-            t0 = time.perf_counter()
-            try:
-                return await self._execute_inner(node, p, timings)
-            finally:
-                # node time INCLUDES children (tree-shaped flame view)
-                timings[node.spec.name] = time.perf_counter() - t0
-        return await self._execute_inner(node, p, timings)
+        # always-on child span per graph node: fan-out tasks inherit this
+        # node's context (asyncio.gather wraps children in tasks, each with
+        # a contextvar copy), so the trace is the flame tree
+        with RECORDER.span(
+            f"node:{node.spec.name}",
+            service=node.spec.name,
+            stage=STAGE_NODE,
+        ):
+            if timings is not None:
+                t0 = time.perf_counter()
+                try:
+                    return await self._execute_inner(node, p, timings)
+                finally:
+                    # node time INCLUDES children (tree-shaped flame view)
+                    timings[node.spec.name] = time.perf_counter() - t0
+            return await self._execute_inner(node, p, timings)
 
     async def _execute_inner(
         self, node: _NodeState, p: Payload, timings: dict | None = None

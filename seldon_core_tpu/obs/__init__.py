@@ -1,0 +1,36 @@
+"""Observability: in-process span recorder + per-stage latency flight
+recorder for the serving hot path (see docs/OBSERVABILITY.md).
+
+``RECORDER`` is the process-wide default (like ``utils/metrics.DEFAULT``);
+exporters configured via env attach on first use by the serving apps
+(``configure_exporters_from_env``).
+"""
+
+from __future__ import annotations
+
+from seldon_core_tpu.obs.spans import (  # noqa: F401
+    RECORDER,
+    STAGE_BATCH_ASSEMBLY,
+    STAGE_DEVICE_STEP,
+    STAGE_ENGINE_ROUTE,
+    STAGE_GATEWAY_RELAY,
+    STAGE_NODE,
+    STAGE_QUEUE_WAIT,
+    STAGE_STREAM_FLUSH,
+    STAGE_TTFT,
+    STAGES,
+    Span,
+    SpanRecorder,
+    current_span,
+)
+
+
+def configure_exporters_from_env(recorder: SpanRecorder | None = None) -> list:
+    """Attach env-selected exporters (idempotent: second call is a no-op
+    unless the recorder has none yet).  Called at engine/gateway boot."""
+    from seldon_core_tpu.obs.export import exporters_from_env
+
+    rec = recorder or RECORDER
+    if not rec.exporters:
+        rec.exporters = exporters_from_env()
+    return rec.exporters

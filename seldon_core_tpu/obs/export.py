@@ -1,0 +1,231 @@
+"""Span exporters: OTLP/HTTP JSON and the taplog broker.
+
+Same bounded-block discipline as ``taplog.append`` / ``gateway/tap.py``:
+every exporter fronts a bounded in-memory queue drained by a background
+task; ``offer`` never blocks and never raises — a full queue (dead
+collector, dead broker, stalled disk) DROPS the span and counts the drop.
+The serving path's worst case is one deque append.
+
+Selection is by env (``exporters_from_env``):
+
+    SCT_OTLP_ENDPOINT=http://collector:4318/v1/traces   OTLP/HTTP JSON
+    SCT_SPANS_BROKER=host:port                          taplog topic sct.spans
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import TYPE_CHECKING, Any
+
+if TYPE_CHECKING:  # pragma: no cover
+    from seldon_core_tpu.obs.spans import Span
+
+log = logging.getLogger(__name__)
+
+SPANS_TOPIC = "sct.spans"
+_BATCH = 64  # spans per emit: one POST / broker frame carries a batch
+
+
+def _ns(seconds: float) -> str:
+    # OTLP encodes uint64 nanos as JSON strings (proto3 JSON mapping)
+    return str(int(seconds * 1e9))
+
+
+def _otlp_value(v: Any) -> dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def _otlp_attrs(attrs: dict) -> list[dict]:
+    return [{"key": k, "value": _otlp_value(v)} for k, v in attrs.items()]
+
+
+def otlp_payload(spans: "list[Span]", service_name: str = "seldon-core-tpu") -> dict:
+    """OTLP/HTTP JSON body (``ExportTraceServiceRequest``) for a span batch
+    — what an OTel collector's ``otlp`` receiver ingests on /v1/traces."""
+    otlp_spans = []
+    for s in spans:
+        end = s.start + s.duration_s
+        otlp_spans.append(
+            {
+                "traceId": s.trace_id,
+                "spanId": s.span_id,
+                **({"parentSpanId": s.parent_id} if s.parent_id else {}),
+                "name": s.name,
+                "kind": 2,  # SPAN_KIND_SERVER
+                "startTimeUnixNano": _ns(s.start),
+                "endTimeUnixNano": _ns(end),
+                "attributes": _otlp_attrs(
+                    {**s.attrs, **({"service.stage": s.service} if s.service else {})}
+                ),
+                "events": [
+                    {
+                        "name": name,
+                        "timeUnixNano": _ns(ts),
+                        "attributes": _otlp_attrs(attrs),
+                    }
+                    for name, ts, attrs in s.events
+                ],
+                "status": {"code": 2 if s.status == "ERROR" else 1},
+            }
+        )
+    return {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": _otlp_attrs({"service.name": service_name})
+                },
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "seldon_core_tpu.obs"},
+                        "spans": otlp_spans,
+                    }
+                ],
+            }
+        ]
+    }
+
+
+class QueuedSpanExporter:
+    """Base: bounded queue + lazy drain task; ``offer`` is drop-on-full.
+
+    The drain task binds to whichever running loop first offers a span
+    (engine and gateway each run one serving loop).  Offers from threads or
+    before any loop exists are dropped and counted — an exporter must never
+    be a reason a device-step thread blocks.
+    """
+
+    def __init__(self, max_queue: int | None = None):
+        if max_queue is None:
+            max_queue = int(os.environ.get("SCT_SPANS_EXPORT_QUEUE", "2048"))
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=max_queue)
+        self._task: asyncio.Task | None = None
+        self.exported = 0
+        self.dropped = 0
+
+    def offer(self, span: "Span") -> None:
+        try:
+            if self._task is None or self._task.done():
+                self._task = asyncio.get_running_loop().create_task(self._drain())
+            self._queue.put_nowait(span)
+        except (asyncio.QueueFull, RuntimeError):
+            # full queue, or no running loop in this thread: drop, count
+            self.dropped += 1
+
+    async def _drain(self) -> None:
+        while True:
+            batch = [await self._queue.get()]
+            while len(batch) < _BATCH:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            try:
+                await self._emit(batch)
+                self.exported += len(batch)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # a dead endpoint costs each batch its bounded timeout,
+                # then the spans are gone — serving never notices
+                self.dropped += len(batch)
+                log.debug("span export failed (%d dropped): %s", len(batch), e)
+
+    async def _emit(self, batch: "list[Span]") -> None:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        if self._task is not None:
+            for _ in range(20):  # brief best-effort flush
+                if self._queue.empty():
+                    break
+                await asyncio.sleep(0.01)
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+
+class OtlpJsonExporter(QueuedSpanExporter):
+    """POST span batches as OTLP/HTTP JSON to a collector endpoint.
+
+    Timeout is bounded (``SCT_OTLP_TIMEOUT_S``, default 1s) so a hung
+    collector costs the drain task — never the serving path — at most that
+    per batch."""
+
+    def __init__(self, endpoint: str, timeout_s: float | None = None, max_queue: int | None = None):
+        super().__init__(max_queue)
+        self.endpoint = endpoint
+        self.timeout_s = (
+            timeout_s
+            if timeout_s is not None
+            else float(os.environ.get("SCT_OTLP_TIMEOUT_S", "1.0"))
+        )
+        self._session = None
+
+    async def _emit(self, batch: "list[Span]") -> None:
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+            )
+        async with self._session.post(
+            self.endpoint, json=otlp_payload(batch)
+        ) as resp:
+            if resp.status >= 400:
+                raise RuntimeError(f"collector returned {resp.status}")
+
+    async def close(self) -> None:
+        await super().close()
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+
+class TaplogSpanExporter(QueuedSpanExporter):
+    """Durable capture: append spans to the tap broker's ``sct.spans``
+    topic (key = trace id), bounded-block like every other taplog publisher
+    — consumers replay traces by offset after the fact."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout_s: float = 0.02,
+        max_queue: int | None = None,
+        topic: str = SPANS_TOPIC,
+    ):
+        super().__init__(max_queue)
+        from seldon_core_tpu.taplog import TapBrokerClient
+
+        self.topic = topic
+        self.client = TapBrokerClient(host, port, timeout_s=timeout_s)
+
+    async def _emit(self, batch: "list[Span]") -> None:
+        for span in batch:
+            await self.client.append(self.topic, span.trace_id, span.to_dict())
+
+    async def close(self) -> None:
+        await super().close()
+        await self.client.close()
+
+
+def exporters_from_env(environ: dict | None = None) -> list:
+    env = environ if environ is not None else os.environ
+    out: list = []
+    endpoint = env.get("SCT_OTLP_ENDPOINT", "")
+    if endpoint:
+        out.append(OtlpJsonExporter(endpoint))
+    broker = env.get("SCT_SPANS_BROKER", "")
+    if broker:
+        host, _, port = broker.partition(":")
+        out.append(TaplogSpanExporter(host or "127.0.0.1", int(port or 7780)))
+    return out

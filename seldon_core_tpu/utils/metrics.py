@@ -99,6 +99,48 @@ class MetricsRegistry:
             ["model_name"],
             registry=self.registry,
         )
+        # TPU-serving vocabulary (fed by executor/batcher.py and
+        # executor/generation.py; MFU peak comes from utils/roofline.py)
+        self.queue_wait = Histogram(
+            "seldon_executor_queue_wait_seconds",
+            "Time a request waited in the batching queue before its device step",
+            ["model_name"],
+            registry=self.registry,
+            buckets=_BUCKETS,
+        )
+        self.device_step = Histogram(
+            "seldon_executor_device_step_seconds",
+            "Device step round-trip time (dispatch through result fetch)",
+            ["model_name"],
+            registry=self.registry,
+            buckets=_BUCKETS,
+        )
+        self.mfu = Gauge(
+            "seldon_executor_mfu",
+            "Model FLOP/s utilization of the most recent device step "
+            "(achieved/chip peak; absent off-TPU)",
+            ["model_name"],
+            registry=self.registry,
+        )
+        self.ttft = Histogram(
+            "seldon_generative_ttft_seconds",
+            "Generative time-to-first-token (submit to first sampled token)",
+            ["model_name"],
+            registry=self.registry,
+            buckets=_BUCKETS,
+        )
+        self.generated_tokens = Counter(
+            "seldon_generative_tokens_total",
+            "Generated tokens (rate() gives sustained tokens/s)",
+            ["model_name"],
+            registry=self.registry,
+        )
+        self.tokens_per_s = Gauge(
+            "seldon_generative_tokens_per_s",
+            "Per-request decode rate of the most recently completed generation",
+            ["model_name"],
+            registry=self.registry,
+        )
 
     @contextmanager
     def time_server_request(

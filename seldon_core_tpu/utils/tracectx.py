@@ -4,9 +4,12 @@ The reference had no distributed tracing at all — correlation was the puid
 plus latency log lines (reference: engine/.../InternalPredictionService.java
 :267-268).  Here an incoming ``traceparent`` header (W3C Trace Context) is
 carried through the request's async context and re-attached to every
-outgoing hop (engine -> microservice REST/gRPC, gateway -> engine), so an
-external OTel collector stitches the spans without this framework linking
-against an OTel SDK.
+outgoing hop (engine -> microservice REST/gRPC, gateway -> engine); when the
+client sends none the gateway MINTS one (spec-valid: random 16-byte
+trace-id, 8-byte span-id, sampled flag), so every request is traceable even
+from trace-naive clients.  ``obs/spans.py`` records spans against these ids
+in process; an external OTel collector stitches them without this framework
+linking against an OTel SDK.
 
 asyncio tasks inherit contextvars, so the walker's fan-out tasks and the
 transport calls all see the ingress value with no explicit threading.
@@ -15,12 +18,62 @@ transport calls all see the ingress value with no explicit threading.
 from __future__ import annotations
 
 import contextvars
+import os
 
 TRACEPARENT_HEADER = "traceparent"
+TRACE_RESPONSE_HEADER = "x-sct-trace-id"  # echoed like x-seldon-puid
 
 _traceparent: contextvars.ContextVar[str | None] = contextvars.ContextVar(
     "sct_traceparent", default=None
 )
+
+_HEX = set("0123456789abcdef")
+
+
+def _hexok(s: str) -> bool:
+    return bool(s) and all(c in _HEX for c in s)
+
+
+def make_trace_id() -> str:
+    """Random 16-byte trace-id, never all-zero (the spec's invalid value)."""
+    while True:
+        tid = os.urandom(16).hex()
+        if tid != "0" * 32:
+            return tid
+
+
+def make_span_id() -> str:
+    while True:
+        sid = os.urandom(8).hex()
+        if sid != "0" * 16:
+            return sid
+
+
+def new_traceparent(sampled: bool = True) -> str:
+    """A spec-valid version-00 traceparent with fresh ids."""
+    return f"00-{make_trace_id()}-{make_span_id()}-{'01' if sampled else '00'}"
+
+
+def parse_traceparent(tp: str | None) -> tuple[str, str, int] | None:
+    """-> (trace_id, span_id, flags) or None for anything non-conformant.
+    Strict on the parts this framework relies on (lengths, hex, non-zero
+    ids); tolerant of future versions per spec §4.3 (any 2-hex version
+    except ff parses as version-00)."""
+    if not tp:
+        return None
+    parts = tp.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if len(version) != 2 or not _hexok(version) or version == "ff":
+        return None
+    if len(trace_id) != 32 or not _hexok(trace_id) or trace_id == "0" * 32:
+        return None
+    if len(span_id) != 16 or not _hexok(span_id) or span_id == "0" * 16:
+        return None
+    if len(flags) != 2 or not _hexok(flags):
+        return None
+    return trace_id, span_id, int(flags, 16)
 
 
 def set_traceparent(value: str | None) -> None:
@@ -30,6 +83,27 @@ def set_traceparent(value: str | None) -> None:
 
 def get_traceparent() -> str | None:
     return _traceparent.get()
+
+
+def ensure_traceparent() -> tuple[str, bool]:
+    """Current traceparent if valid, else mint + set a fresh root one.
+    Returns ``(traceparent, generated)``."""
+    tp = _traceparent.get()
+    if tp is not None and parse_traceparent(tp) is not None:
+        return tp, False
+    tp = new_traceparent()
+    _traceparent.set(tp)
+    return tp, True
+
+
+def current_trace_id() -> str | None:
+    parsed = parse_traceparent(_traceparent.get())
+    return parsed[0] if parsed else None
+
+
+def is_sampled() -> bool:
+    parsed = parse_traceparent(_traceparent.get())
+    return bool(parsed and parsed[2] & 0x01)
 
 
 def outgoing_headers() -> dict[str, str]:

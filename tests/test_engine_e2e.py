@@ -637,8 +637,10 @@ class TestTracing:
 
 class TestTraceContextPropagation:
     """W3C traceparent headers flow engine -> remote unit, so an external
-    OTel collector can stitch spans across the graph (SURVEY §5 'optional
-    OTel' — the reference had no tracing at all)."""
+    OTel collector can stitch spans across the graph.  Since the obs layer
+    landed, each hop re-parents the span id (the engine/node spans are real
+    now) and a trace-naive request gets a MINTED trace instead of none —
+    the invariants are trace-id continuity and no cross-request leaks."""
 
     def test_traceparent_reaches_remote_unit(self):
         import aiohttp
@@ -682,7 +684,16 @@ class TestTraceContextPropagation:
                 await srv.close()
 
         seen, tp = run(go())
-        assert seen == [tp, None]  # propagated, then NOT leaked
+        from seldon_core_tpu.utils.tracectx import parse_traceparent
+
+        assert len(seen) == 2 and all(s is not None for s in seen)
+        first, second = parse_traceparent(seen[0]), parse_traceparent(seen[1])
+        # hop 1 stays in the client's trace (span id re-parented by the
+        # engine/node spans, trace id intact)
+        assert first is not None and first[0] == parse_traceparent(tp)[0]
+        assert seen[0] != tp  # a real span sits between client and unit
+        # hop 2 was trace-naive: a fresh MINTED trace, NOT the leaked old one
+        assert second is not None and second[0] != first[0]
 
 
 class TestMultiWorkerIngress:

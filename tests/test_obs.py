@@ -1,0 +1,618 @@
+"""Observability layer tests: W3C trace-context generation/propagation
+(gateway -> engine REST and gRPC hops, walker fan-out contextvar
+inheritance), the span recorder + flight recorder, bounded exporters, and
+the obs-check acceptance gate (`make obs-check`): gateway -> engine ->
+2-node graph -> batcher yields one trace with >= 4 spans and a breakdown
+whose stages account for the measured wall time."""
+
+import asyncio
+import json
+import re
+import time
+
+import aiohttp
+import numpy as np
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu.engine.app import EngineApp
+from seldon_core_tpu.engine.service import PredictionService
+from seldon_core_tpu.executor.batcher import BatchQueue
+from seldon_core_tpu.gateway.app import GatewayApp
+from seldon_core_tpu.gateway.h1gateway import H1SpliceFrontend
+from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
+from seldon_core_tpu.graph.spec import PredictorSpec
+from seldon_core_tpu.obs import RECORDER, SpanRecorder
+from seldon_core_tpu.obs.export import TaplogSpanExporter, otlp_payload
+from seldon_core_tpu.obs.spans import Span
+from seldon_core_tpu.utils.metrics import MetricsRegistry
+from seldon_core_tpu.utils.tracectx import (
+    ensure_traceparent,
+    get_traceparent,
+    new_traceparent,
+    parse_traceparent,
+    set_traceparent,
+)
+
+run = asyncio.run
+
+TRACEPARENT_RE = re.compile(r"^00-[0-9a-f]{32}-[0-9a-f]{16}-[0-9a-f]{2}$")
+
+SIMPLE = {
+    "name": "p",
+    "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+}
+
+# 2-node graph: identity transformer over a batched model component
+TWO_NODE = {
+    "name": "p",
+    "graph": {
+        "name": "root",
+        "type": "TRANSFORMER",
+        "endpoint": {"type": "LOCAL"},
+        "children": [
+            {"name": "batched", "type": "MODEL", "endpoint": {"type": "LOCAL"}},
+        ],
+    },
+}
+
+
+class BatchedStub:
+    """Model component behind a real BatchQueue (no JAX needed): exercises
+    the queue-wait / batch-assembly / device-step stages on CPU."""
+
+    def __init__(self):
+        self._q = BatchQueue(
+            lambda b: b * 2.0, max_batch=8, max_delay_ms=1.0, name="stub"
+        )
+
+    async def predict(self, X, names):
+        return await self._q.submit(np.asarray(X, dtype=float))
+
+    async def close(self):
+        await self._q.close()
+
+
+class IdentityRoot:
+    def transform_input(self, X, names):
+        return X
+
+
+async def _engine_client(spec=SIMPLE, components=None) -> TestClient:
+    service = PredictionService(
+        PredictorSpec.model_validate(spec), components=components
+    )
+    await service.start()
+    app = EngineApp(service).build()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def _frontend(engine_port: int, **gw_kwargs):
+    store = DeploymentStore()
+    store.put(
+        DeploymentRecord(
+            name="dep",
+            oauth_key="key1",
+            oauth_secret="sec1",
+            engine_host="127.0.0.1",
+            engine_rest_port=engine_port,
+        )
+    )
+    gw = GatewayApp(store, **gw_kwargs)
+    frontend = H1SpliceFrontend(gw)
+    port = await frontend.start(0, host="127.0.0.1")
+    return frontend, gw, port
+
+
+async def _token(session: aiohttp.ClientSession, port: int) -> str:
+    resp = await session.post(
+        f"http://127.0.0.1:{port}/oauth/token",
+        data={"client_id": "key1", "client_secret": "sec1"},
+    )
+    return (await resp.json())["access_token"]
+
+
+class TestTraceContext:
+    def test_new_traceparent_is_spec_valid(self):
+        for _ in range(50):
+            tp = new_traceparent()
+            assert TRACEPARENT_RE.match(tp), tp
+            trace_id, span_id, flags = parse_traceparent(tp)
+            assert trace_id != "0" * 32 and span_id != "0" * 16
+            assert flags & 0x01  # sampled by default
+
+    def test_parse_rejects_malformed(self):
+        bad = [
+            None, "", "junk", "00-abc-def-01",
+            "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # zero trace id
+            "00-" + "1" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+            "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",  # forbidden version
+            "00-" + "Z" * 32 + "-" + "2" * 16 + "-01",  # non-hex
+        ]
+        for tp in bad:
+            assert parse_traceparent(tp) is None, tp
+
+    def test_ensure_generates_and_keeps(self):
+        async def go():
+            set_traceparent(None)
+            tp, generated = ensure_traceparent()
+            assert generated and TRACEPARENT_RE.match(tp)
+            tp2, generated2 = ensure_traceparent()
+            assert not generated2 and tp2 == tp
+            # invalid incoming value is replaced, not propagated
+            set_traceparent("not-a-traceparent")
+            tp3, generated3 = ensure_traceparent()
+            assert generated3 and TRACEPARENT_RE.match(tp3)
+
+        run(go())
+
+
+class TestSpanRecorder:
+    def test_ring_is_bounded(self):
+        rec = SpanRecorder(max_spans=16, max_stage_samples=8, sample=1.0)
+        for i in range(100):
+            with rec.span(f"s{i}", stage="node"):
+                pass
+            set_traceparent(None)  # each span its own trace
+        assert len(rec._spans) == 16
+        assert rec.recorded == 100
+        bd = rec.breakdown()
+        assert bd["node"]["count"] == 100 and bd["node"]["window"] == 8
+
+    def test_sample_zero_records_nothing_but_propagates(self):
+        async def go():
+            rec = SpanRecorder(max_spans=16, sample=0.0)
+            set_traceparent(None)
+            with rec.span("root", stage="node"):
+                inner = get_traceparent()
+                assert inner is not None and TRACEPARENT_RE.match(inner)
+            assert len(rec._spans) == 0
+            assert rec.breakdown()["node"]["count"] == 1  # flight recorder still on
+
+        run(go())
+
+    def test_child_span_parents_and_error_status(self):
+        async def go():
+            rec = SpanRecorder(max_spans=16, sample=1.0)
+            set_traceparent(None)
+            try:
+                with rec.span("parent"):
+                    with rec.span("child"):
+                        raise ValueError("boom")
+            except ValueError:
+                pass
+            child, parent = rec._spans[0], rec._spans[1]
+            assert child.name == "child" and parent.name == "parent"
+            assert child.trace_id == parent.trace_id
+            assert child.parent_id == parent.span_id
+            assert child.status == "ERROR" and parent.status == "ERROR"
+
+        run(go())
+
+    def test_walker_fanout_children_inherit_contextvar(self):
+        """The walker's gather fan-out wraps children in tasks; each must
+        inherit the request's trace context (and the node spans must form
+        one trace)."""
+        from seldon_core_tpu.graph.walker import GraphWalker
+
+        seen: dict[str, str] = {}
+
+        class Capture:
+            # async on purpose: runs inline on the event loop, in the
+            # fan-out task's context (a sync method would hop to the thread
+            # pool, which does not carry contextvars)
+            def __init__(self, tag):
+                self.tag = tag
+
+            async def predict(self, X, names):
+                seen[self.tag] = get_traceparent()
+                return X
+
+        class Avg:
+            async def aggregate(self, Xs, names):
+                return np.mean(Xs, axis=0)
+
+        spec = {
+            "name": "combo",
+            "type": "COMBINER",
+            "endpoint": {"type": "LOCAL"},
+            "children": [
+                {"name": "a", "type": "MODEL", "endpoint": {"type": "LOCAL"}},
+                {"name": "b", "type": "MODEL", "endpoint": {"type": "LOCAL"}},
+            ],
+        }
+
+        async def go():
+            from seldon_core_tpu.contract.payload import Payload
+
+            walker = GraphWalker(
+                PredictorSpec.model_validate(
+                    {"name": "p", "graph": spec}
+                ).graph,
+                components={"combo": Avg(), "a": Capture("a"), "b": Capture("b")},
+            )
+            tp = new_traceparent()
+            set_traceparent(tp)
+            await walker.predict(Payload.from_array(np.ones((1, 2))))
+            return tp
+
+        tp = run(go())
+        trace_id = parse_traceparent(tp)[0]
+        assert set(seen) == {"a", "b"}
+        for tag, inner in seen.items():
+            parsed = parse_traceparent(inner)
+            assert parsed is not None, (tag, inner)
+            assert parsed[0] == trace_id  # same trace through the fan-out
+            assert parsed[1] != parse_traceparent(tp)[1]  # child span id
+
+
+class TestRestHopPropagation:
+    def test_aiohttp_gateway_forwards_and_mints(self):
+        """gateway -> engine REST hop: a client traceparent arrives at the
+        engine verbatim; a trace-naive client gets a minted one; the trace
+        id is echoed in the response header."""
+        received: list = []
+
+        async def go():
+            async def pred(req):
+                received.append(req.headers.get("traceparent"))
+                return web.json_response(
+                    {"meta": {"puid": "x"}, "data": {"ndarray": [[1.0]]}}
+                )
+
+            eng = web.Application()
+            eng.router.add_post("/api/v0.1/predictions", pred)
+            eng_server = TestServer(eng)
+            await eng_server.start_server()
+            store = DeploymentStore()
+            store.put(DeploymentRecord(
+                name="dep", oauth_key="k", oauth_secret="s",
+                engine_host="127.0.0.1", engine_rest_port=eng_server.port,
+            ))
+            gw = GatewayApp(store, metrics=MetricsRegistry())
+            client = TestClient(TestServer(gw.build()))
+            await client.start_server()
+            try:
+                r = await client.post(
+                    "/oauth/token", data={"client_id": "k", "client_secret": "s"}
+                )
+                tok = (await r.json())["access_token"]
+                hdrs = {"Authorization": f"Bearer {tok}"}
+                body = {"data": {"ndarray": [[1.0]]}}
+                tp = new_traceparent()
+                r1 = await client.post(
+                    "/api/v0.1/predictions", json=body,
+                    headers={**hdrs, "traceparent": tp},
+                )
+                echo1 = r1.headers.get("x-sct-trace-id")
+                r2 = await client.post("/api/v0.1/predictions", json=body, headers=hdrs)
+                echo2 = r2.headers.get("x-sct-trace-id")
+                return tp, echo1, echo2
+            finally:
+                await client.close()
+                await eng_server.close()
+
+        tp, echo1, echo2 = run(go())
+        client_trace = parse_traceparent(tp)[0]
+        # hop 1: client's trace id survived to the engine
+        got1 = parse_traceparent(received[0])
+        assert got1 is not None and got1[0] == client_trace
+        assert echo1 == client_trace
+        # hop 2: gateway minted a valid traceparent for the naive client
+        got2 = parse_traceparent(received[1])
+        assert got2 is not None and got2[0] != client_trace
+        assert echo2 == got2[0]
+
+    def test_h1_splice_injects_minted_traceparent(self):
+        """The splice forwards raw bytes — when the client omits a
+        traceparent the gateway must REWRITE the head to inject one, and
+        echo the trace id on the response."""
+        received: list = []
+
+        async def go():
+            async def pred(req):
+                received.append(req.headers.get("traceparent"))
+                return web.json_response({"data": {"ndarray": [[1.0]]}})
+
+            eng = web.Application()
+            eng.router.add_post("/api/v0.1/predictions", pred)
+            eng_server = TestServer(eng)
+            await eng_server.start_server()
+            frontend, gw, port = await _frontend(eng_server.port)
+            async with aiohttp.ClientSession() as s:
+                tok = await _token(s, port)
+                hdrs = {"Authorization": f"Bearer {tok}"}
+                body = {"data": {"ndarray": [[1.0]]}}
+                r1 = await s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json=body, headers=hdrs,
+                )
+                echo1 = r1.headers.get("x-sct-trace-id")
+                tp = new_traceparent()
+                r2 = await s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json=body, headers={**hdrs, "traceparent": tp},
+                )
+                echo2 = r2.headers.get("x-sct-trace-id")
+                assert r1.status == 200 and r2.status == 200
+            await frontend.stop()
+            await eng_server.close()
+            return tp, echo1, echo2
+
+        tp, echo1, echo2 = run(go())
+        minted = parse_traceparent(received[0])
+        assert minted is not None, "splice did not inject a traceparent"
+        assert echo1 == minted[0]
+        # client-sent traceparent forwards verbatim
+        assert received[1] == tp
+        assert echo2 == parse_traceparent(tp)[0]
+
+
+class TestGrpcHopPropagation:
+    def test_grpc_relay_mints_and_forwards(self):
+        """The gateway gRPC relay (fast plane) must attach a minted
+        traceparent to the engine-bound metadata for trace-naive clients
+        and forward a client-sent one verbatim — asserted against the
+        channel the relay actually dials, no sockets involved."""
+        from seldon_core_tpu.gateway.grpc_gateway import FastGatewayGrpc
+
+        calls: list = []
+
+        class FakeChannel:
+            def try_call_framed(self, path, framed, done, timeout=None, metadata=()):
+                calls.append(metadata)
+                done(0, "", b"\x00\x00\x00\x00\x00")
+                return lambda: None
+
+            async def close(self):
+                pass
+
+        class FakeConn:
+            def __init__(self):
+                self.relay_cancels: dict = {}
+                self.responses: list = []
+
+            def write_unary_response(self, stream_id, body):
+                self.responses.append((stream_id, body))
+
+        async def go():
+            store = DeploymentStore()
+            store.put(DeploymentRecord(
+                name="dep", oauth_key="k", oauth_secret="s",
+                engine_host="127.0.0.1", engine_rest_port=1,
+            ))
+            gw = GatewayApp(store, metrics=MetricsRegistry())
+            handler = FastGatewayGrpc(gw)
+            handler._channels["k"] = FakeChannel()
+            tok, _ = gw.tokens.issue("k")
+            relay = handler.make_relay("Predict")
+            conn = FakeConn()
+            base = RECORDER.recorded
+            tp = new_traceparent()
+            relay(conn, 1, [(b"oauth_token", tok.encode()),
+                            (b"traceparent", tp.encode())], b"framed")
+            relay(conn, 3, [(b"oauth_token", tok.encode())], b"framed")
+            await handler.close()
+            return tp, conn, base
+
+        tp, conn, base = run(go())
+        assert len(conn.responses) == 2  # both relays answered
+        # hop 1: client traceparent forwarded verbatim
+        md1 = dict(calls[0])
+        assert md1[b"traceparent"].decode() == tp
+        # hop 2: a minted, spec-valid traceparent was injected
+        md2 = dict(calls[1])
+        minted = parse_traceparent(md2[b"traceparent"].decode())
+        assert minted is not None, "relay did not mint a traceparent"
+        assert minted[0] != parse_traceparent(tp)[0]
+        # both relays recorded gateway spans
+        assert RECORDER.recorded - base >= 2
+
+
+class TestExporters:
+    def _spans(self, n=3):
+        return [
+            Span(
+                trace_id="ab" * 16, span_id=f"{i:016x}", parent_id=None,
+                name=f"s{i}", service="svc", start=1000.0 + i,
+                duration_s=0.25, attrs={"code": 200},
+                events=[("first-token", 1000.5, {"ms": 1.5})],
+            )
+            for i in range(1, n + 1)
+        ]
+
+    def test_otlp_payload_shape(self):
+        payload = otlp_payload(self._spans(2))
+        rs = payload["resourceSpans"][0]
+        attrs = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+        assert attrs["service.name"] == {"stringValue": "seldon-core-tpu"}
+        spans = rs["scopeSpans"][0]["spans"]
+        assert len(spans) == 2
+        s = spans[0]
+        assert s["traceId"] == "ab" * 16 and len(s["spanId"]) == 16
+        # nanos are proto3-JSON stringified uint64s
+        assert s["startTimeUnixNano"] == str(int(1001.0 * 1e9))
+        assert s["endTimeUnixNano"] == str(int(1001.25 * 1e9))
+        assert s["events"][0]["name"] == "first-token"
+        json.dumps(payload)  # wire-serializable
+
+    def test_otlp_exporter_posts_to_collector(self):
+        """End-to-end OTLP/HTTP: spans offered to the exporter arrive at a
+        collector endpoint as a valid ExportTraceServiceRequest."""
+        from seldon_core_tpu.obs.export import OtlpJsonExporter
+
+        received: list = []
+
+        async def go():
+            async def collect(req):
+                received.append(await req.json())
+                return web.json_response({})
+
+            app = web.Application()
+            app.router.add_post("/v1/traces", collect)
+            srv = TestServer(app)
+            await srv.start_server()
+            exp = OtlpJsonExporter(
+                f"http://127.0.0.1:{srv.port}/v1/traces", timeout_s=2.0
+            )
+            for s in self._spans(3):
+                exp.offer(s)
+            deadline = asyncio.get_event_loop().time() + 5
+            while not received and asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
+            await exp.close()
+            await srv.close()
+            return exp.exported
+
+        exported = run(go())
+        assert exported == 3 and received
+        spans = received[0]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert [s["name"] for s in spans] == ["s1", "s2", "s3"]
+
+    def test_dead_broker_never_blocks_offer(self):
+        """Bounded-exporter discipline: a dead endpoint costs drops, not
+        serving-path time (the ISSUE's bugfix satellite)."""
+
+        async def go():
+            exp = TaplogSpanExporter("127.0.0.1", 1, timeout_s=0.02, max_queue=32)
+            t0 = time.perf_counter()
+            for s in self._spans(200):
+                exp.offer(s)
+            offer_cost = time.perf_counter() - t0
+            assert offer_cost < 0.5, "offer must never block"
+            await asyncio.sleep(0.3)  # let the drain task hit its timeouts
+            await exp.close()
+            assert exp.dropped > 0 and exp.exported == 0
+
+        run(go())
+
+    def test_offer_without_loop_drops(self):
+        exp = TaplogSpanExporter("127.0.0.1", 1, timeout_s=0.02)
+        for s in self._spans(3):
+            exp.offer(s)  # no running loop: must not raise
+        assert exp.dropped == 3
+
+
+class TestErrorCodeAudit:
+    def test_unexpected_engine_error_records_500(self):
+        """A component blowing up with an unanticipated exception must land
+        in the latency histogram as a 500, not the default '200'."""
+
+        class Exploder:
+            def predict(self, X, names):
+                raise RuntimeError("kaboom")
+
+        async def go():
+            metrics = MetricsRegistry()
+            service = PredictionService(
+                PredictorSpec.model_validate(TWO_NODE),
+                components={"root": IdentityRoot(), "batched": Exploder()},
+                metrics=metrics,
+            )
+            await service.start()
+            client = TestClient(TestServer(EngineApp(service).build()))
+            await client.start_server()
+            try:
+                r = await client.post(
+                    "/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0, 2.0]]}},
+                )
+                assert r.status == 500
+                prom = metrics.expose().decode()
+            finally:
+                await client.close()
+            return prom
+
+        prom = run(go())
+        assert 'code="500"' in prom
+        # the 500 is in the server-requests histogram specifically
+        assert re.search(
+            r'seldon_api_engine_server_requests_duration_seconds_count\{[^}]*code="500"',
+            prom,
+        )
+
+
+class TestObsCheck:
+    def test_obs_check_end_to_end(self):
+        """`make obs-check` / the acceptance gate: 50 requests through
+        gateway -> engine -> 2-node graph -> batcher.  Asserts (1) one
+        trace holds >= 4 spans, (2) /stats/breakdown reports non-zero
+        queue-wait and device-step, (3) /prometheus exposes the new
+        histograms, (4) the breakdown's engine-route total stays within
+        10% of the measured wall time (it is a subset of it)."""
+
+        async def go():
+            stub = BatchedStub()
+            engine_client = await _engine_client(
+                TWO_NODE, components={"root": IdentityRoot(), "batched": stub}
+            )
+            frontend, gw, port = await _frontend(engine_client.server.port)
+            base_recorded = RECORDER.recorded
+            # the recorder is process-global: snapshot so the assertions
+            # measure THIS run, not every suite that ran before it
+            base_stages = RECORDER.breakdown()
+            async with aiohttp.ClientSession() as s:
+                tok = await _token(s, port)
+                hdrs = {"Authorization": f"Bearer {tok}"}
+                body = {"data": {"ndarray": [[1.0, 2.0, 3.0]]}}
+                wall_s = 0.0
+                t_all0 = time.perf_counter()
+                for _ in range(50):
+                    t0 = time.perf_counter()
+                    r = await s.post(
+                        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                        json=body, headers=hdrs,
+                    )
+                    assert r.status == 200
+                    await r.read()
+                    wall_s += time.perf_counter() - t0
+                wall_all_s = time.perf_counter() - t_all0
+
+                spans_resp = await s.get(
+                    f"http://127.0.0.1:{port}/stats/spans?n=60"
+                )
+                stats = await spans_resp.json()
+                bd_resp = await s.get(f"http://127.0.0.1:{port}/stats/breakdown")
+                stages = (await bd_resp.json())["stages"]
+                prom_resp = await s.get(f"http://127.0.0.1:{port}/prometheus")
+                prom = await prom_resp.text()
+            await frontend.stop()
+            await engine_client.close()
+            return stats, stages, prom, wall_s, wall_all_s, base_recorded, base_stages
+
+        stats, stages, prom, wall_s, wall_all_s, base_recorded, base_stages = run(go())
+
+        def delta(stage, field):
+            before = (base_stages.get(stage) or {}).get(field, 0)
+            return stages[stage][field] - before
+
+        # (1) one request = one trace with gateway.relay + engine.predict +
+        # node:root + node:batched >= 4 spans
+        assert RECORDER.recorded - base_recorded >= 200  # 4 spans x 50
+        full = [t for t in stats["traces"] if t["span_count"] >= 4]
+        assert full, f"no trace with >=4 spans: {stats['traces'][:2]}"
+        names = {s["name"] for s in full[0]["spans"]}
+        assert {"gateway.relay", "engine.predict", "node:root", "node:batched"} <= names
+
+        # (2) the batcher stages are visible and non-zero
+        for stage in ("queue-wait", "device-step", "engine-route", "gateway-relay"):
+            assert stage in stages, f"missing stage {stage}: {list(stages)}"
+            assert delta(stage, "count") >= 50 or stage == "device-step"
+            assert delta(stage, "total_ms") > 0
+
+        # (3) the new TPU-serving histograms are scraped
+        assert "seldon_executor_queue_wait_seconds" in prom
+        assert "seldon_executor_device_step_seconds" in prom
+
+        # (4) stage accounting is consistent with the measured wall time:
+        # this run's engine-route total is a strict subset of the
+        # client-observed wall, so it must not exceed wall + 10%, and must
+        # be non-zero (the engine did real work per request)
+        engine_total_s = delta("engine-route", "total_ms") / 1e3
+        assert engine_total_s <= wall_s * 1.10, (engine_total_s, wall_s)
+        assert engine_total_s > 0
+        # and the per-stage device view cannot exceed the engine view + 10%
+        device_total_s = delta("device-step", "total_ms") / 1e3
+        assert device_total_s <= engine_total_s * 1.10 + 0.05

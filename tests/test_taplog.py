@@ -214,6 +214,45 @@ class TestGatewayBrokerTap:
         run(go())
 
 
+class TestSpanExport:
+    def test_spans_reach_the_broker(self, tmp_path):
+        """Exporter satellite: spans published to the `sct.spans` topic are
+        durably consumable by offset, key = trace id."""
+        from seldon_core_tpu.obs.export import SPANS_TOPIC, TaplogSpanExporter
+        from seldon_core_tpu.obs.spans import SpanRecorder
+
+        async def go():
+            broker = TapBrokerServer(str(tmp_path), port=0)
+            await broker.start()
+            exporter = TaplogSpanExporter(
+                "127.0.0.1", broker.bound_port, timeout_s=2.0
+            )
+            rec = SpanRecorder(max_spans=16, sample=1.0)
+            rec.exporters = [exporter]
+            with rec.span("engine.predict", service="dep") as sp:
+                sp.event("first-token", ms=1.2)
+            consumer = TapBrokerClient("127.0.0.1", broker.bound_port, timeout_s=2.0)
+            records = []
+            deadline = asyncio.get_event_loop().time() + 5
+            while asyncio.get_event_loop().time() < deadline:
+                records = await consumer.fetch(SPANS_TOPIC)
+                if records:
+                    break
+                await asyncio.sleep(0.02)
+            await consumer.close()
+            await exporter.close()
+            await broker.close()
+            return records, rec._spans[0]
+
+        records, span = run(go())
+        assert records, "span never reached the broker"
+        value = records[0]["value"]
+        assert records[0]["key"] == span.trace_id
+        assert value["name"] == "engine.predict"
+        assert value["events"][0]["name"] == "first-token"
+        assert value["duration_ms"] >= 0
+
+
 class TestTornTailRecovery:
     def test_crash_torn_tail_truncated_on_reopen(self, tmp_path):
         """A partial record left by a crash mid-write must be truncated on
