@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check clean
+.PHONY: proto test bench native obs-check qos-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -22,6 +22,12 @@ bench:
 # measured wall time (same test runs in tier-1)
 obs-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_obs.py -q -k obs_check
+
+# overload acceptance gate (docs/QOS.md): saturating two-wave load, QoS-on
+# sheds with sub-step 429s, spends zero device steps on shed requests, and
+# beats QoS-off on completions-within-deadline (same test runs in tier-1)
+qos-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_qos.py -q -k qos_check
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

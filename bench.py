@@ -58,12 +58,15 @@ def engine(
     grpc_port: int,
     ready_timeout: float = 300.0,
     workers: int = 1,
+    extra_env: dict | None = None,
 ):
     env = dict(os.environ)
     if graph is not None:
         env["ENGINE_PREDICTOR"] = _b64_predictor(graph)
     else:
         env.pop("ENGINE_PREDICTOR", None)
+    if extra_env:
+        env.update(extra_env)
     proc = subprocess.Popen(
         [sys.executable, "-m", "seldon_core_tpu.engine.app",
          "--port", str(port), "--grpc-port", str(grpc_port),
@@ -132,6 +135,30 @@ def _breakdown(port: int) -> dict:
             return json.loads(r.read()).get("stages", {})
     except Exception as e:
         return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _stats_qos(port: int) -> dict:
+    """QoS plane snapshot (GET /stats/qos): admitted/shed counters by
+    reason, deadline-miss ledger, brownout state."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats/qos", timeout=5
+        ) as r:
+            return json.loads(r.read()).get("qos", {})
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _under_deadline_fraction(result, deadline_s: float) -> float | None:
+    """Fraction of ALL requests (any status) the client saw answered
+    within ``deadline_s`` — from the merged latency histogram."""
+    from seldon_core_tpu.testing.loadtest import _BIN_EDGES
+
+    total = int(result.hist.sum())
+    if not total:
+        return None
+    idx = int(np.searchsorted(_BIN_EDGES, deadline_s))
+    return round(float(result.hist[: idx + 1].sum()) / total, 4)
 
 
 def _roofline(args: list[str], timeout: float = 600.0) -> dict:
@@ -552,6 +579,84 @@ def stage_ab(detail: dict) -> None:
     }
 
 
+def stage_overload(detail: dict) -> None:
+    """QoS overload sweep (docs/QOS.md): the same saturating load run
+    twice against the batched MLP graph — admission control ON (tight
+    caps + a default deadline the gateway/engine enforce) and OFF (legacy
+    unbounded queues).  Records admitted/shed counts from /stats/qos and
+    the deadline-hit rate per run.  With QoS off the queue absorbs the
+    whole flood and the device burns steps on requests that already
+    missed their SLO; with QoS on the excess is 429'd at admission and
+    queue-expired work is dropped before its device step.
+    ``BENCH_OVERLOAD_GRAPH=stub`` swaps in the no-device stub graph (CPU
+    smoke runs)."""
+    from seldon_core_tpu.testing.loadtest import run_load
+
+    deadline_ms = float(os.environ.get("BENCH_OVERLOAD_DEADLINE_MS", "250"))
+    conc = int(os.environ.get("BENCH_OVERLOAD_CONCURRENCY", "128"))
+    rows = int(os.environ.get("BENCH_OVERLOAD_ROWS", "8"))
+    secs = min(SECONDS, 6.0)
+    if os.environ.get("BENCH_OVERLOAD_GRAPH") == "stub":
+        graph = None
+        body = json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0]]}}).encode()
+    else:
+        graph = {
+            "name": "mlp", "type": "MODEL", "implementation": "JAX_MODEL",
+            "parameters": [
+                {"name": "family", "value": "mlp", "type": "STRING"},
+                {"name": "dtype", "value": "bfloat16", "type": "STRING"},
+                {"name": "buckets", "value": "64,256", "type": "STRING"},
+                {"name": "max_batch", "value": "256", "type": "INT"},
+                {"name": "max_delay_ms", "value": "3.0", "type": "FLOAT"},
+            ],
+        }
+        body = _raw_tensor_payload(rows, 784)
+    hdrs = {"x-sct-deadline-ms": str(deadline_ms)}
+
+    qos_env = {
+        "SCT_QOS_MAX_INFLIGHT": "64",
+        "SCT_QOS_MAX_QUEUE": "64",
+        "SCT_QOS_DEFAULT_DEADLINE_MS": str(deadline_ms),
+    }
+    with engine(graph, 18880, 18881, extra_env=qos_env):
+        r_on = run_load(
+            "http://127.0.0.1:18880/api/v0.1/predictions", [body],
+            concurrency=conc, duration_s=secs, headers=hdrs,
+        )
+        snap_on = _stats_qos(18880)
+    with engine(graph, 18882, 18883, extra_env={"SCT_QOS": "0"}):
+        r_off = run_load(
+            "http://127.0.0.1:18882/api/v0.1/predictions", [body],
+            concurrency=conc, duration_s=secs, headers=hdrs,
+        )
+        snap_off = _stats_qos(18882)
+
+    def hit_rate(result, shed: int) -> float | None:
+        """Within-deadline COMPLETIONS / all requests.  Shed 429s answer
+        in well under any deadline, so the under-deadline fraction minus
+        the shed fraction isolates real completions."""
+        frac = _under_deadline_fraction(result, deadline_ms / 1e3)
+        if frac is None or not result.requests:
+            return None
+        return round(max(0.0, frac - shed / result.requests), 4)
+
+    on_ok = r_on.requests - r_on.failures
+    detail["overload_qos_on"] = {
+        **r_on.summary(),
+        "deadline_ms": deadline_ms,
+        "served": on_ok,
+        "shed_or_expired": r_on.failures,
+        "hit_rate": hit_rate(r_on, r_on.failures),
+        "stats_qos": snap_on,
+    }
+    detail["overload_qos_off"] = {
+        **r_off.summary(),
+        "deadline_ms": deadline_ms,
+        "hit_rate": hit_rate(r_off, 0),
+        "stats_qos": snap_off,
+    }
+
+
 def stage_gateway(detail: dict) -> None:
     """Full L5->L4 path: OAuth'd requests through the gateway to a stub
     engine — REST proxy and the raw-bytes gRPC relay.  The reference never
@@ -672,6 +777,7 @@ def main() -> None:
         ("RESNET", "BENCH_SKIP_RESNET", stage_resnet),
         ("AB", "BENCH_SKIP_AB", stage_ab),
         ("GATEWAY", "BENCH_SKIP_GATEWAY", stage_gateway),
+        ("OVERLOAD", "BENCH_SKIP_OVERLOAD", stage_overload),
     ]
     for name, skip_env, fn in stages:
         if os.environ.get(skip_env) == "1":
@@ -725,6 +831,8 @@ _STAGE_HEADLINES = (
     ("ab_graph", "predictions_per_s", "ab_pred_s"),
     ("gateway_rest", "rps", "gateway_rest_rps"),
     ("gateway_grpc", "rps", "gateway_grpc_rps"),
+    ("overload_qos_on", "hit_rate", "overload_hit_rate_on"),
+    ("overload_qos_off", "hit_rate", "overload_hit_rate_off"),
 )
 
 

@@ -29,6 +29,7 @@ from seldon_core_tpu.contract import (
     payload_from_dict,
     payload_to_dict,
 )
+from seldon_core_tpu import qos
 from seldon_core_tpu.engine.service import PredictionService, load_predictor_spec
 from seldon_core_tpu.graph.units import GraphUnitError
 from seldon_core_tpu.obs import RECORDER, STAGE_STREAM_FLUSH, configure_exporters_from_env
@@ -42,10 +43,25 @@ def _status_body(code: int, reason: str) -> dict[str, Any]:
 
 
 class EngineApp:
-    def __init__(self, service: PredictionService, mesh_worker: bool = False):
+    def __init__(
+        self,
+        service: PredictionService,
+        mesh_worker: bool = False,
+        qos_controller: "qos.AdmissionController | None" = None,
+    ):
         self.service = service
         self.paused = False
         self.metrics = service.metrics
+        # QoS plane (docs/QOS.md): per-deployment admission control +
+        # deadline propagation; env-configured (SCT_QOS_*), on by default.
+        # Registered process-wide so the generation scheduler's brownout
+        # clamp sees the same policy object.
+        self.qos = (
+            qos_controller
+            if qos_controller is not None
+            else qos.AdmissionController.from_env(service.deployment_name)
+        )
+        qos.set_active_controller(self.qos)
         # Non-coordinator host of a multi-host slice: joins the mesh and
         # executes SPMD steps under the coordinator's direction (see
         # executor/multihost.py follower loop) but never serves ingress —
@@ -93,6 +109,8 @@ class EngineApp:
         # span recorder + flight recorder (docs/OBSERVABILITY.md)
         r.add_get("/stats/spans", self.stats_spans)
         r.add_get("/stats/breakdown", self.stats_breakdown)
+        # QoS plane state: admission/shed counters, brownout, estimates
+        r.add_get("/stats/qos", self.stats_qos)
         # XLA/device profiling (SURVEY §5: the reference had only JMX):
         # POST /profile/start {"dir": "/tmp/sct-profile"} ... /profile/stop
         # then open the trace in TensorBoard / xprof
@@ -152,22 +170,72 @@ class EngineApp:
 
     # -- handlers ---------------------------------------------------------
 
+    def _admit(self, request: web.Request):
+        """Seed the request's QoS context (deadline + priority headers ->
+        contextvars the batching layers read) and pass admission control.
+        Raises :class:`~seldon_core_tpu.qos.QosRejection` on shed."""
+        if not self.qos.enabled:
+            # SCT_QOS=0 restores the legacy plane end to end: no deadline
+            # plumbing, no priority, no shedding anywhere downstream
+            qos.seed_from_headers(None, None)
+            return self.qos.admit()
+        budget_ms, priority = qos.seed_from_headers(
+            request.headers.get(qos.DEADLINE_HEADER),
+            request.headers.get(qos.PRIORITY_HEADER),
+        )
+        if budget_ms is None and self.qos.default_deadline_ms:
+            budget_ms = self.qos.default_deadline_ms
+            qos.set_budget_ms(budget_ms)
+        return self.qos.admit(
+            priority, budget_s=budget_ms / 1e3 if budget_ms else None
+        )
+
+    def _qos_reject(self, e: "qos.QosRejection") -> web.Response:
+        """Map a QoS shed to its wire response (429/504, 429s carry
+        Retry-After) and record WHY on the trace — an operator reading the
+        span sees the shed reason, not a silent missing request."""
+        with RECORDER.span("qos.shed", service=self.service.deployment_name) as sp:
+            if sp is not None:
+                sp.set_attr("reason", e.reason)
+                sp.set_attr("code", e.status)
+                sp.set_status("ERROR")
+        headers = {}
+        if e.status == 429:
+            headers["Retry-After"] = e.retry_after_header()
+        return web.json_response(
+            _status_body(e.status, str(e)), status=e.status, headers=headers
+        )
+
     async def predictions(self, request: web.Request) -> web.Response:
         dep, pred = self.service.deployment_name, self.service.predictor.name
         with self.metrics.time_server_request(dep, pred, "predictions", "POST") as h:
+            from seldon_core_tpu.utils.tracectx import set_traceparent
+
+            # trace context BEFORE admission: a shed decision must land on
+            # the client's trace, or overload debugging goes dark exactly
+            # when it matters
+            set_traceparent(request.headers.get("traceparent"))
+            try:
+                ticket = self._admit(request)
+            except qos.QosRejection as e:
+                h["code"] = str(e.status)
+                return self._qos_reject(e)
             try:
                 body = await self._json(request)
                 payload = payload_from_dict(body)
                 # opt-in per-node wall timings (meta.tags.sct_trace_ms) —
                 # request-scoped tracing the reference only had as logs
                 trace = request.headers.get("X-Seldon-Trace", "") == "1"
-                from seldon_core_tpu.utils.tracectx import set_traceparent
-
-                set_traceparent(request.headers.get("traceparent"))
                 out = await self.service.predict(payload, trace=trace)
                 resp = payload_to_dict(out)
                 resp["status"] = {"code": 200, "status": "SUCCESS"}
                 return web.json_response(resp)
+            except qos.QosRejection as e:
+                # shed below admission: bounded queue overflow (429) or a
+                # deadline that expired in a queue (504 — answered without
+                # spending a device step)
+                h["code"] = str(e.status)
+                return self._qos_reject(e)
             except CodecError as e:
                 h["code"] = "400"
                 return web.json_response(_status_body(400, str(e)), status=400)
@@ -184,6 +252,11 @@ class EngineApp:
                 # must say so too, not default to "200"
                 h["code"] = "500"
                 raise
+            finally:
+                # release covers disconnects too: aiohttp cancels this
+                # handler when the client drops, the batching layers skip
+                # the cancelled future, and the admission slot frees here
+                ticket.release()
 
     async def predictions_stream(self, request: web.Request) -> web.StreamResponse:
         """Server-sent-events token streaming for a generative graph.
@@ -196,91 +269,107 @@ class EngineApp:
         the first token after prefill + one decode block instead of waiting
         out the full generation (p50 397ms for 32 tokens in round 3).
         """
-        import json
-        import time
-
         dep, pred = self.service.deployment_name, self.service.predictor.name
         # the timer covers validation too: a rejected stream request must
         # be a recorded 400, not an unrecorded return
         with self.metrics.time_server_request(dep, pred, "predictions_stream", "POST") as h:
-            units = self.service.generative_units()
-            if len(units) != 1:
-                reason = (
-                    "predictor graph has no generative unit"
-                    if not units
-                    else f"streaming is ambiguous: graph has {len(units)} "
-                         "generative units"
-                )
-                h["code"] = "400"
-                return web.json_response(_status_body(400, reason), status=400)
-            unit = units[0]
-            try:
-                body = await self._json(request)
-                if "strData" in body:  # full contract wrapper also accepted
-                    body = json.loads(body["strData"])
-                prompt = body["tokens"]
-                if not isinstance(prompt, (list, tuple)) or (
-                    prompt and isinstance(prompt[0], (list, tuple))
-                ):
-                    raise CodecError("streaming takes ONE prompt: flat 'tokens' list")
-                # option coercion BEFORE headers go out: a bad option must be a
-                # 400 response, not a truncated 200 event stream
-                max_new = body.get("max_new_tokens")
-                max_new = int(max_new) if max_new is not None else None
-                temperature = body.get("temperature")
-                temperature = float(temperature) if temperature is not None else None
-                eos = body.get("eos_id")
-                eos = int(eos) if eos is not None else None
-            except (CodecError, KeyError, TypeError, ValueError) as e:
-                h["code"] = "400"
-                return web.json_response(
-                    _status_body(400, f"bad stream request: {e}"), status=400
-                )
+            from seldon_core_tpu.utils.tracectx import set_traceparent
 
-            resp = web.StreamResponse(
-                headers={
-                    "Content-Type": "text/event-stream",
-                    "Cache-Control": "no-cache",
-                    "X-Accel-Buffering": "no",
-                }
-            )
-            await resp.prepare(request)
-            out: list[int] = []
-            flush_s = 0.0  # cumulative socket-write time -> stream-flush stage
+            set_traceparent(request.headers.get("traceparent"))
             try:
-                gen = unit.stream(
-                    prompt,
-                    max_new_tokens=max_new,
-                    temperature=temperature,
-                    eos_id=eos,
-                )
-                async for tok in gen:
-                    out.append(tok)
-                    t_w = time.perf_counter()
-                    await resp.write(
-                        f"data: {json.dumps({'token': tok})}\n\n".encode()
-                    )
-                    flush_s += time.perf_counter() - t_w
+                ticket = self._admit(request)
+            except qos.QosRejection as e:
+                h["code"] = str(e.status)
+                return self._qos_reject(e)
+            try:
+                return await self._predictions_stream_admitted(request, h)
+            finally:
+                ticket.release()
+
+    async def _predictions_stream_admitted(
+        self, request: web.Request, h: dict
+    ) -> web.StreamResponse:
+        import json
+        import time
+
+        units = self.service.generative_units()
+        if len(units) != 1:
+            reason = (
+                "predictor graph has no generative unit"
+                if not units
+                else f"streaming is ambiguous: graph has {len(units)} "
+                     "generative units"
+            )
+            h["code"] = "400"
+            return web.json_response(_status_body(400, reason), status=400)
+        unit = units[0]
+        try:
+            body = await self._json(request)
+            if "strData" in body:  # full contract wrapper also accepted
+                body = json.loads(body["strData"])
+            prompt = body["tokens"]
+            if not isinstance(prompt, (list, tuple)) or (
+                prompt and isinstance(prompt[0], (list, tuple))
+            ):
+                raise CodecError("streaming takes ONE prompt: flat 'tokens' list")
+            # option coercion BEFORE headers go out: a bad option must be a
+            # 400 response, not a truncated 200 event stream
+            max_new = body.get("max_new_tokens")
+            max_new = int(max_new) if max_new is not None else None
+            temperature = body.get("temperature")
+            temperature = float(temperature) if temperature is not None else None
+            eos = body.get("eos_id")
+            eos = int(eos) if eos is not None else None
+        except (CodecError, KeyError, TypeError, ValueError) as e:
+            h["code"] = "400"
+            return web.json_response(
+                _status_body(400, f"bad stream request: {e}"), status=400
+            )
+
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Accel-Buffering": "no",
+            }
+        )
+        await resp.prepare(request)
+        out: list[int] = []
+        flush_s = 0.0  # cumulative socket-write time -> stream-flush stage
+        try:
+            gen = unit.stream(
+                prompt,
+                max_new_tokens=max_new,
+                temperature=temperature,
+                eos_id=eos,
+            )
+            async for tok in gen:
+                out.append(tok)
                 t_w = time.perf_counter()
                 await resp.write(
-                    f"data: {json.dumps({'done': True, 'tokens': out})}\n\n".encode()
+                    f"data: {json.dumps({'token': tok})}\n\n".encode()
                 )
                 flush_s += time.perf_counter() - t_w
-            except (ConnectionResetError, asyncio.CancelledError):
-                raise  # client went away / server draining: nothing to send
-            except Exception as e:
-                # headers are gone; the error must ride the stream itself.
-                # Broad on purpose: device failures surface as backend-
-                # specific exception types (e.g. XlaRuntimeError)
-                h["code"] = "500"
-                await resp.write(
-                    f"data: {json.dumps({'error': str(e)})}\n\n".encode()
-                )
-            finally:
-                if out:
-                    RECORDER.record_stage(STAGE_STREAM_FLUSH, flush_s)
-            await resp.write_eof()
-            return resp
+            t_w = time.perf_counter()
+            await resp.write(
+                f"data: {json.dumps({'done': True, 'tokens': out})}\n\n".encode()
+            )
+            flush_s += time.perf_counter() - t_w
+        except (ConnectionResetError, asyncio.CancelledError):
+            raise  # client went away / server draining: nothing to send
+        except Exception as e:
+            # headers are gone; the error must ride the stream itself.
+            # Broad on purpose: device failures surface as backend-
+            # specific exception types (e.g. XlaRuntimeError)
+            h["code"] = "500"
+            await resp.write(
+                f"data: {json.dumps({'error': str(e)})}\n\n".encode()
+            )
+        finally:
+            if out:
+                RECORDER.record_stage(STAGE_STREAM_FLUSH, flush_s)
+        await resp.write_eof()
+        return resp
 
     async def feedback(self, request: web.Request) -> web.Response:
         dep, pred = self.service.deployment_name, self.service.predictor.name
@@ -355,6 +444,11 @@ class EngineApp:
     async def stats_breakdown(self, request: web.Request) -> web.Response:
         """Aggregated per-stage p50/p90/p99 (the flight recorder)."""
         return web.json_response({"stages": RECORDER.breakdown()})
+
+    async def stats_qos(self, request: web.Request) -> web.Response:
+        """QoS plane state: admission caps, shed counters by reason,
+        deadline-miss ledger, brownout, predicted completion time."""
+        return web.json_response({"qos": self.qos.snapshot()})
 
     async def profile_start(self, request: web.Request) -> web.Response:
         import jax

@@ -109,14 +109,19 @@ class RestNodeClient:
         )
 
     async def _post_once(self, path: str, body: dict[str, Any]) -> dict[str, Any]:
+        from seldon_core_tpu.qos.context import outgoing_qos_headers
         from seldon_core_tpu.utils.tracectx import outgoing_headers
 
+        # trace context + the request's REMAINING deadline budget (qos
+        # plane: every hop decrements x-sct-deadline-ms by the time already
+        # spent) ride every unit hop
+        headers = {**outgoing_headers(), **outgoing_qos_headers()}
         try:
             async with self.session.post(
                 self.base + path,
                 json=body,
                 timeout=self.timeout,
-                headers=outgoing_headers() or None,
+                headers=headers or None,
             ) as resp:
                 data = await resp.json(content_type=None)
                 if resp.status in RETRYABLE_HTTP:

@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import concurrent.futures
+import os
 import time
 from typing import Callable
 
@@ -38,6 +39,8 @@ from seldon_core_tpu.obs import (
     STAGE_QUEUE_WAIT,
     current_span,
 )
+from seldon_core_tpu.qos import DeadlineExceeded, QueueFull, note_deadline_miss
+from seldon_core_tpu.qos.context import get_deadline
 from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
 
 _peak_flops_cache: list = []  # [float | None], filled on first use
@@ -64,11 +67,21 @@ class BatchQueue:
         max_delay_ms: float = 2.0,
         pipeline_depth: int = 8,
         name: str = "model",
+        maxsize: int | None = None,
     ):
         self.runner = runner
         self.max_batch = int(max_batch)
         self.max_delay = max_delay_ms / 1000.0
         self.name = name
+        # intake bound (QoS plane): beyond this many waiting request
+        # batches, submit() fast-fails with a typed QueueFull the engine
+        # maps to 429 — an unbounded queue only converts overload into
+        # client timeouts after the device burned steps on them.  0 = off.
+        self.maxsize = (
+            int(maxsize)
+            if maxsize is not None
+            else int(os.environ.get("SCT_BATCH_QUEUE_MAX", "2048"))
+        )
         self._dispatch = getattr(runner, "dispatch", None)
         self._fetch = getattr(runner, "fetch", None)
         # only dispatch/fetch runners (CompiledModel) are promised to be
@@ -118,20 +131,37 @@ class BatchQueue:
         await asyncio.gather(*self._inflight, return_exceptions=True)
         err = RuntimeError(f"BatchQueue {self.name!r} closed")
         while not self._queue.empty():
-            _, fut, _ = self._queue.get_nowait()
+            _, fut, _, _, _ = self._queue.get_nowait()
             if not fut.done():
                 fut.set_exception(err)
         self._pool.shutdown(wait=False)
 
     # ------------------------------------------------------------- interface
     async def submit(self, x: np.ndarray) -> np.ndarray:
-        """Submit one request batch (rows stay together); returns its rows."""
+        """Submit one request batch (rows stay together); returns its rows.
+
+        Raises :class:`~seldon_core_tpu.qos.QueueFull` when the bounded
+        intake is at capacity, and :class:`~seldon_core_tpu.qos.
+        DeadlineExceeded` when the request's deadline expires before its
+        device step dispatches.  A caller that goes away (client
+        disconnect cancels the awaiting task) leaves a cancelled future
+        the step loop skips, so abandoned work never reaches the device."""
         if self._closed:
             raise RuntimeError("BatchQueue is closed")
         self._ensure_running()
         x = np.asarray(x)
+        if self.maxsize and self._queue.qsize() >= self.maxsize:
+            raise QueueFull(
+                f"batch queue {self.name!r} is full "
+                f"({self._queue.qsize()} waiting, cap {self.maxsize})"
+            )
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((x, fut, time.perf_counter()))
+        # the request's QoS deadline + live span ride the queue item so the
+        # step loop can drop expired work (and say why, on the trace)
+        # without re-entering this task's context
+        await self._queue.put(
+            (x, fut, time.perf_counter(), get_deadline(), current_span())
+        )
         self._m_queue_depth.set(self._queue.qsize())
         res = await fut
         timing = getattr(fut, "_sct_timing", None)
@@ -157,6 +187,28 @@ class BatchQueue:
     def _rows(x: np.ndarray) -> int:
         return x.shape[0] if x.ndim > 1 else 1
 
+    def _viable(self, item) -> bool:
+        """Pre-dispatch QoS gate: skip requests whose client is gone
+        (cancelled future) and fail ones whose deadline already expired —
+        a device step must never be spent on work nobody can use."""
+        _x, fut, t_enq, deadline, span = item
+        if fut.done():
+            return False
+        if deadline is not None and time.monotonic() >= deadline:
+            fut.set_exception(
+                DeadlineExceeded(
+                    f"deadline expired after "
+                    f"{time.perf_counter() - t_enq:.3f}s waiting in batch "
+                    f"queue {self.name!r}"
+                )
+            )
+            DEFAULT_METRICS.qos_deadline_miss.labels(self.name, "batch-queue").inc()
+            note_deadline_miss("batch-queue")
+            if span is not None:
+                span.event("qos-drop", reason="deadline", stage="batch-queue")
+            return False
+        return True
+
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         pending: collections.deque = collections.deque()  # misfits, served first
@@ -164,6 +216,8 @@ class BatchQueue:
         try:
             while True:
                 first = pending.popleft() if pending else await self._queue.get()
+                if not self._viable(first):
+                    continue
                 t_collect0 = loop.time()  # batch-assembly stage starts here
                 group = [first]
                 key = self._key(first[0])
@@ -174,6 +228,8 @@ class BatchQueue:
                         break
                     if self._key(item[0]) == key:
                         pending.remove(item)
+                        if not self._viable(item):
+                            continue
                         group.append(item)
                         rows += self._rows(item[0])
 
@@ -191,6 +247,8 @@ class BatchQueue:
                             # is served right after this step, not starved
                             # behind a dominant-shape stream
                             pending.append(item)
+                            continue
+                        if not self._viable(item):
                             continue
                         group.append(item)
                         total += self._rows(item[0])
@@ -211,6 +269,8 @@ class BatchQueue:
                     if self._key(item[0]) != key:
                         pending.append(item)
                         continue
+                    if not self._viable(item):
+                        continue
                     group.append(item)
                     rows += self._rows(item[0])
                     rows = drain(rows)  # absorb any burst that came with it
@@ -225,17 +285,24 @@ class BatchQueue:
                 group = []
         except asyncio.CancelledError:
             err = RuntimeError(f"BatchQueue {self.name!r} closed")
-            for _, fut, _ in list(group) + list(pending):
+            for _, fut, _, _, _ in list(group) + list(pending):
                 if not fut.done():
                     fut.set_exception(err)
             raise
 
     async def _step(self, loop, group) -> None:
-        xs = [np.atleast_2d(x) for x, _, _ in group]
+        # final sweep at the device boundary: the collection window may
+        # have outlived a deadline, and a 504 from the queue is strictly
+        # cheaper than a device step for a client that stopped waiting
+        group = [item for item in group if self._viable(item)]
+        if not group:
+            self._sem.release()
+            return
+        xs = [np.atleast_2d(x) for x, _, _, _, _ in group]
         batch = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
         t_step0 = time.perf_counter()
         waits = []
-        for _, _, t_enq in group:
+        for _, _, t_enq, _, _ in group:
             qw = t_step0 - t_enq
             waits.append(qw)
             RECORDER.record_stage(STAGE_QUEUE_WAIT, qw)
@@ -259,12 +326,12 @@ class BatchQueue:
                     out = await loop.run_in_executor(self._pool, self.runner, batch)
             except asyncio.CancelledError:
                 err: BaseException = RuntimeError(f"BatchQueue {self.name!r} closed")
-                for _, fut, _ in group:
+                for _, fut, _, _, _ in group:
                     if not fut.done():
                         fut.set_exception(err)
                 raise
             except Exception as exc:  # propagate to every waiter
-                for _, fut, _ in group:
+                for _, fut, _, _, _ in group:
                     if not fut.done():
                         fut.set_exception(exc)
                 return
@@ -281,7 +348,7 @@ class BatchQueue:
             self.rows += batch.shape[0]
             out = np.asarray(out)
             offset = 0
-            for (x, fut, _), rows, qw in zip(
+            for (x, fut, _, _, _), rows, qw in zip(
                 group, (x.shape[0] for x in xs), waits
             ):
                 if not fut.done():

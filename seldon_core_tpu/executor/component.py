@@ -32,6 +32,7 @@ class JaxModelComponent(SeldonComponent):
         batching: bool = True,
         max_batch: int = 64,
         max_delay_ms: float = 2.0,
+        max_queue: int | None = None,
         warmup_example: np.ndarray | None = None,
     ):
         self.model = model
@@ -39,7 +40,10 @@ class JaxModelComponent(SeldonComponent):
         if class_names is not None:
             self.class_names = class_names
         self._queue = (
-            BatchQueue(model, max_batch=max_batch, max_delay_ms=max_delay_ms, name=model.name)
+            BatchQueue(
+                model, max_batch=max_batch, max_delay_ms=max_delay_ms,
+                name=model.name, maxsize=max_queue,
+            )
             if batching
             else None
         )

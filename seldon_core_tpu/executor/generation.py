@@ -39,6 +39,7 @@ from typing import Any, AsyncIterator, Callable
 import jax
 import numpy as np
 
+from seldon_core_tpu import qos
 from seldon_core_tpu.graph.units import GraphUnitError, SeldonComponent
 from seldon_core_tpu.obs import RECORDER, STAGE_DEVICE_STEP, STAGE_TTFT
 from seldon_core_tpu.parallel.sharding import (
@@ -627,7 +628,7 @@ class GenerativeModel:
         self._exec_reset({})
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)  # identity eq: fields hold arrays/futures
 class _Request:
     prompt: np.ndarray
     max_new_tokens: int
@@ -645,15 +646,36 @@ class _Request:
     # first-token lands on it as an event even though the scheduler loop
     # runs outside the request's contextvar scope
     span: Any = None
+    # QoS: priority class + absolute monotonic deadline (None = no SLO),
+    # captured from the request context at submit
+    priority: str = qos.PRIO_INTERACTIVE
+    deadline: float | None = None
 
 
 class GenerationScheduler:
     """Continuous-batching front: admits requests into free slots while
-    decode steps keep running for in-flight ones."""
+    decode steps keep running for in-flight ones.
 
-    def __init__(self, model: GenerativeModel):
+    QoS: the intake is BOUNDED (``maxsize``, env ``SCT_GEN_QUEUE_MAX``) —
+    overflow raises a typed :class:`~seldon_core_tpu.qos.QueueFull` the
+    engine maps to 429; batch-priority work may only fill half the bound so
+    it can never starve interactive admission.  Queue pops are
+    priority-ordered, expired requests are failed with a 504 *before* a
+    prefill or decode step is spent on them, and a client that disconnects
+    before its slot is assigned is withdrawn from the queue entirely."""
+
+    def __init__(self, model: GenerativeModel, *, maxsize: int | None = None):
         self.model = model
-        self._queue: asyncio.Queue[_Request] = asyncio.Queue()
+        # waiting requests (priority-sorted at pop time) + a wake event the
+        # run loop parks on when fully idle
+        self._waiting: list[_Request] = []
+        self._wake = asyncio.Event()
+        self._maxsize = (
+            int(maxsize)
+            if maxsize is not None
+            else int(os.environ.get("SCT_GEN_QUEUE_MAX", "256"))
+        )
+        self._batch_cap = max(1, self._maxsize // 2) if self._maxsize else 0
         # requests admitted to a slot but not to the KV pool (OutOfKVBlocks):
         # retried ahead of the queue as completions free blocks
         self._overflow: list[_Request] = []
@@ -705,19 +727,45 @@ class GenerationScheduler:
         max_new_tokens = min(
             int(max_new_tokens), self.model.cfg.max_seq - int(prompt.size)
         )
+        # brownout: under sustained overload the active admission
+        # controller clamps answer length before availability degrades
+        max_new_tokens = qos.clamp_max_new_tokens(max_new_tokens)
+        priority = qos.get_priority()
+        depth = len(self._waiting) + len(self._overflow)
+        cap = (
+            self._maxsize
+            if priority == qos.PRIO_INTERACTIVE
+            else self._batch_cap
+        )
+        if self._maxsize and depth >= cap:
+            raise qos.QueueFull(
+                f"generation queue is full ({depth} waiting, cap {cap} "
+                f"for {priority})"
+            )
         if self._task is None or self._task.done():
             self._task = asyncio.get_running_loop().create_task(self._run())
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         from seldon_core_tpu.obs import current_span
 
-        await self._queue.put(
-            _Request(
-                prompt, max_new_tokens, float(temperature), eos_id, fut,
-                on_token=on_token, t0=time.perf_counter(),
-                span=current_span(),
-            )
+        req = _Request(
+            prompt, max_new_tokens, float(temperature), eos_id, fut,
+            on_token=on_token, t0=time.perf_counter(),
+            span=current_span(),
+            priority=priority, deadline=qos.get_deadline(),
         )
-        return await fut
+        self._waiting.append(req)
+        self._wake.set()
+        try:
+            return await fut
+        except asyncio.CancelledError:
+            # cancel-on-disconnect: the client is gone — withdraw before a
+            # slot/prefill is spent (in-slot requests are reaped by the run
+            # loop's sweep via the now-cancelled future)
+            if req in self._waiting:
+                self._waiting.remove(req)
+            if req in self._overflow:
+                self._overflow.remove(req)
+            raise
 
     async def close(self) -> None:
         self._closed = True
@@ -728,10 +776,10 @@ class GenerationScheduler:
             except asyncio.CancelledError:
                 pass
         err = RuntimeError("GenerationScheduler closed")
-        while not self._queue.empty():
-            req = self._queue.get_nowait()
+        for req in self._waiting:
             if not req.future.done():
                 req.future.set_exception(err)
+        self._waiting.clear()
 
     # ---------------------------------------------------------------- loop
 
@@ -766,6 +814,61 @@ class GenerationScheduler:
             return True
         return len(req.out) >= req.max_new_tokens
 
+    def _reap_queues(self) -> None:
+        """Pre-admission QoS sweep: drop abandoned requests (client gone →
+        cancelled future) and fail expired ones with a 504 from the queue,
+        BEFORE a prefill is spent on them."""
+        now = time.monotonic()
+        for q in (self._waiting, self._overflow):
+            keep = []
+            for req in q:
+                if req.future.done():
+                    continue  # cancelled before admission: nothing to undo
+                if req.deadline is not None and now >= req.deadline:
+                    req.future.set_exception(qos.DeadlineExceeded(
+                        f"deadline expired after "
+                        f"{time.perf_counter() - req.t0:.3f}s waiting in the "
+                        "generation queue"
+                    ))
+                    DEFAULT_METRICS.qos_deadline_miss.labels(
+                        self.model.name, "generation-queue"
+                    ).inc()
+                    qos.note_deadline_miss("generation-queue", req.priority)
+                    if req.span is not None:
+                        req.span.event(
+                            "qos-drop", reason="deadline",
+                            stage="generation-queue",
+                        )
+                    continue
+                keep.append(req)
+            q[:] = keep
+
+    def _reap_slots(self, slots, active) -> None:
+        """In-flight QoS sweep: a slot whose client vanished or whose
+        deadline passed must stop consuming decode steps mid-generation."""
+        now = time.monotonic()
+        for i in range(len(slots)):
+            req = slots[i]
+            if req is None or not active[i]:
+                continue
+            expired = req.deadline is not None and now >= req.deadline
+            if not expired and not req.future.done():
+                continue
+            if expired and not req.future.done():
+                req.future.set_exception(qos.DeadlineExceeded(
+                    f"deadline expired mid-generation after "
+                    f"{len(req.out)} tokens"
+                ))
+                DEFAULT_METRICS.qos_deadline_miss.labels(
+                    self.model.name, "decode"
+                ).inc()
+                qos.note_deadline_miss("decode", req.priority)
+                if req.span is not None:
+                    req.span.event("qos-drop", reason="deadline", stage="decode")
+            slots[i] = None
+            active[i] = False
+            self.model.release_slot(i)
+
     async def _run(self) -> None:
         S = self.model.n_slots
         slots: list[_Request | None] = [None] * S
@@ -774,23 +877,32 @@ class GenerationScheduler:
         active = np.zeros(S, bool)
         try:
             while True:
+                self._reap_queues()
+                if not active.any() and not self._overflow and not self._waiting:
+                    # fully idle: park until a submit wakes us (no await
+                    # between the emptiness check and clear, so a submit
+                    # landing now still sets the event we wait on)
+                    self._wake.clear()
+                    await self._wake.wait()
+                    self._reap_queues()
                 batch: list[_Request] = []
-                if not active.any() and not self._overflow:
-                    # fully idle: park on the queue
-                    batch.append(await self._queue.get())
                 # admit whatever is waiting into remaining free slots —
-                # block-starved overflow first, then the queue; all prefills
-                # dispatch back-to-back and their first tokens are fetched
-                # in ONE device round trip
+                # block-starved overflow first, then the wait list in
+                # (priority, arrival) order so batch traffic can never
+                # starve interactive; all prefills dispatch back-to-back
+                # and their first tokens are fetched in ONE device round
+                # trip
                 while self._overflow and int(active.sum()) + len(batch) < S:
                     batch.append(self._overflow.pop(0))
-                while (
-                    not self._queue.empty()
-                    and int(active.sum()) + len(batch) < S
-                ):
-                    batch.append(self._queue.get_nowait())
+                if self._waiting and int(active.sum()) + len(batch) < S:
+                    self._waiting.sort(
+                        key=lambda r: (qos.priority_rank(r.priority), r.t0)
+                    )
+                    while self._waiting and int(active.sum()) + len(batch) < S:
+                        batch.append(self._waiting.pop(0))
                 if batch:
                     await self._admit_batch(batch, slots, cur, temps, active)
+                self._reap_slots(slots, active)
                 if not active.any():
                     if self._overflow:
                         # nothing in flight can ever free blocks: these
@@ -948,9 +1060,10 @@ class GenerativeComponent(SeldonComponent):
         max_new_tokens: int = 32,
         temperature: float = 0.0,
         eos_id: int | None = None,
+        queue_max: int | None = None,
     ):
         self.model = model
-        self.scheduler = GenerationScheduler(model)
+        self.scheduler = GenerationScheduler(model, maxsize=queue_max)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_id = eos_id

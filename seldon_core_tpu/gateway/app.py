@@ -38,6 +38,7 @@ from seldon_core_tpu.gateway.store import (
     load_store_from_env,
 )
 from seldon_core_tpu.gateway.tap import RequestResponseTap, tap_from_env
+from seldon_core_tpu import qos
 from seldon_core_tpu.obs import RECORDER, STAGE_GATEWAY_RELAY, configure_exporters_from_env
 from seldon_core_tpu.utils.tracectx import (
     TRACE_RESPONSE_HEADER,
@@ -55,8 +56,12 @@ def _error_bytes(status: int, reason: str) -> bytes:
     return json.dumps(failure_status_dict(status, reason)).encode()
 
 
-def _error(status: int, reason: str) -> web.Response:
-    return web.json_response(failure_status_dict(status, reason), status=status)
+def _error(status: int, reason: str, retry_after: str | None = None) -> web.Response:
+    # 503-while-paused and every QoS 429 tell the client WHEN to come back
+    headers = {"Retry-After": retry_after} if retry_after else None
+    return web.json_response(
+        failure_status_dict(status, reason), status=status, headers=headers
+    )
 
 
 class _UpstreamError(Exception):
@@ -101,12 +106,21 @@ class GatewayApp:
         self._pools: dict[str, "H1Pool"] = {}
         self._loop: asyncio.AbstractEventLoop | None = None
         self._paused = False
+        # QoS plane: per-deployment admission (SCT_GW_QOS_* env knobs; off
+        # unless configured — the engine's controller is the default line
+        # of defense) + the deadline the gateway stamps on requests whose
+        # client sent no x-sct-deadline-ms of their own
+        self._qos: dict[str, "qos.AdmissionController"] = {}
+        self.default_deadline_ms = float(
+            os.environ.get("SCT_DEFAULT_DEADLINE_MS", "0") or 0.0
+        )
         # removed deployments lose their live tokens immediately
         store.add_listener(self._on_deployment_event)
 
     def _on_deployment_event(self, event: str, rec: DeploymentRecord) -> None:
         if event == "removed":
             self.tokens.revoke_for_key(rec.oauth_key)
+            self._qos.pop(rec.oauth_key, None)
         if event in ("removed", "updated"):
             pool = self._pools.pop(rec.oauth_key, None)
             if pool is not None:
@@ -127,6 +141,18 @@ class GatewayApp:
             pool = H1Pool(host, rec.engine_rest_port)
             self._pools[rec.oauth_key] = pool
         return pool
+
+    def qos_for(self, rec: DeploymentRecord) -> "qos.AdmissionController":
+        """Per-deployment gateway admission controller (one isolated
+        budget per deployment, so one tenant's flood cannot shed another's
+        traffic).  Inert unless SCT_GW_QOS / SCT_GW_QOS_* env is set."""
+        ctl = self._qos.get(rec.oauth_key)
+        if ctl is None:
+            ctl = qos.AdmissionController.from_env(
+                rec.name, prefix="SCT_GW_QOS", default_enabled=False
+            )
+            self._qos[rec.oauth_key] = ctl
+        return ctl
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -153,6 +179,7 @@ class GatewayApp:
         r.add_get("/prometheus", self.prometheus)
         r.add_get("/stats/spans", self.stats_spans)
         r.add_get("/stats/breakdown", self.stats_breakdown)
+        r.add_get("/stats/qos", self.stats_qos)
 
         async def _startup(app_: web.Application) -> None:
             await self.start()
@@ -233,7 +260,11 @@ class GatewayApp:
 
         idempotent = "feedback" not in path
         pool = self._pool(rec)
-        fwd_headers = outgoing_headers() or None
+        from seldon_core_tpu.qos.context import outgoing_qos_headers
+
+        # traceparent + the decremented deadline budget / priority class
+        # cross the gateway->engine hop
+        fwd_headers = {**outgoing_headers(), **outgoing_qos_headers()} or None
 
         async def attempt(i: int) -> tuple[int, bytes]:
             try:
@@ -263,7 +294,7 @@ class GatewayApp:
         # drained traffic must not get a free 256MB buffer (ingress_core
         # re-checks both; this is the cheap early exit)
         if self._paused:
-            return _error(503, "gateway is paused")
+            return _error(503, "gateway is paused", retry_after="1")
         try:
             self._principal(request)
         except AuthError as e:
@@ -275,6 +306,8 @@ class GatewayApp:
             raw,
             path,
             service,
+            deadline_header=request.headers.get(qos.DEADLINE_HEADER),
+            priority_header=request.headers.get(qos.PRIORITY_HEADER),
         )
         # echo the trace id (the puid of the tracing world) so clients can
         # quote it to operators; ingress_core set/minted it in this context
@@ -282,6 +315,9 @@ class GatewayApp:
         tid = current_trace_id()
         if tid:
             headers[TRACE_RESPONSE_HEADER] = tid
+        if code in (429, 503):
+            # shed/drained traffic tells the client when to come back
+            headers["Retry-After"] = qos.get_retry_after() or "1"
         return web.Response(
             body=body, status=code, content_type="application/json",
             headers=headers,
@@ -294,10 +330,14 @@ class GatewayApp:
         raw: bytes,
         path: str,
         service: str,
+        deadline_header: str | None = None,
+        priority_header: str | None = None,
     ) -> tuple[int, bytes]:
-        """Transport-independent ingress: auth, validate, forward, tap,
-        metrics.  Returns (status, JSON body bytes) — shared by the aiohttp
-        front end and the h1 splice front end's fallback path."""
+        """Transport-independent ingress: auth, QoS admission, validate,
+        forward, tap, metrics.  Returns (status, JSON body bytes) — shared
+        by the aiohttp front end and the h1 splice front end's fallback
+        path.  A 429/503 leaves a Retry-After hint in the qos context for
+        the front end to surface."""
         if self._paused:
             # drained traffic still counts: a 503 storm during a rollout
             # must be visible in the ingress histogram
@@ -309,11 +349,20 @@ class GatewayApp:
         # seed the hop's trace context; a trace-naive client gets a minted
         # root here so the engine's spans still stitch into one trace
         set_traceparent(traceparent)
+        # seed the QoS context: the client's deadline budget, or the
+        # per-deployment default the gateway stamps for SLO-naive clients
+        budget_ms, priority = qos.seed_from_headers(
+            deadline_header, priority_header
+        )
+        if budget_ms is None and self.default_deadline_ms:
+            budget_ms = self.default_deadline_ms
+            qos.set_budget_ms(budget_ms)
         with RECORDER.span(
             "gateway.ingress", service=service, stage=STAGE_GATEWAY_RELAY
         ) as sp:
             code, reply = await self._ingress_inner(
                 auth_header, raw, path, service, start,
+                priority=priority, budget_ms=budget_ms,
             )
             if sp is not None:
                 sp.set_attr("code", code)
@@ -328,14 +377,25 @@ class GatewayApp:
         path: str,
         service: str,
         start: float,
+        priority: str = qos.PRIO_INTERACTIVE,
+        budget_ms: float | None = None,
     ) -> tuple[int, bytes]:
         principal = "anonymous"
         deployment_name = "unknown"
         code = 200
+        ticket = None
         try:
             rec = self._principal_from_header(auth_header)
             principal = rec.oauth_key
             deployment_name = rec.name
+            try:
+                ticket = self.qos_for(rec).admit(
+                    priority, budget_s=budget_ms / 1e3 if budget_ms else None
+                )
+            except qos.QosRejection as e:
+                qos.set_retry_after(e.retry_after_header())
+                code = e.status
+                return e.status, _error_bytes(e.status, str(e))
             # the body is forwarded untouched either way (like the
             # reference's apife, RestClientController.java:136-144), so a
             # full json.loads here is pure overhead unless something
@@ -376,6 +436,8 @@ class GatewayApp:
             code = e.status
             return e.status, _error_bytes(e.status, str(e))
         finally:
+            if ticket is not None:
+                ticket.release()
             self.metrics.ingress_requests.labels(
                 principal,
                 deployment_name,
@@ -443,6 +505,19 @@ class GatewayApp:
 
     async def stats_breakdown(self, request: web.Request) -> web.Response:
         return web.json_response({"stages": RECORDER.breakdown()})
+
+    def qos_snapshot(self) -> dict:
+        """Per-deployment gateway admission state (shared by both REST
+        front ends' /stats/qos)."""
+        return {
+            "default_deadline_ms": self.default_deadline_ms or None,
+            "deployments": {
+                key: ctl.snapshot() for key, ctl in self._qos.items()
+            },
+        }
+
+    async def stats_qos(self, request: web.Request) -> web.Response:
+        return web.json_response({"qos": self.qos_snapshot()})
 
 
 def main(argv: list[str] | None = None) -> None:

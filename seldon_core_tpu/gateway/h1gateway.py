@@ -43,6 +43,7 @@ from typing import Optional
 
 from seldon_core_tpu.contract import failure_status_dict
 from seldon_core_tpu.gateway.auth import AuthError
+from seldon_core_tpu import qos
 from seldon_core_tpu.obs import RECORDER, STAGE_GATEWAY_RELAY, configure_exporters_from_env
 from seldon_core_tpu.utils.tracectx import (
     TRACE_RESPONSE_HEADER,
@@ -57,7 +58,8 @@ log = logging.getLogger(__name__)
 
 _REASONS = {
     200: b"OK", 400: b"Bad Request", 401: b"Unauthorized", 404: b"Not Found",
-    405: b"Method Not Allowed", 411: b"Length Required", 502: b"Bad Gateway",
+    405: b"Method Not Allowed", 411: b"Length Required",
+    429: b"Too Many Requests", 502: b"Bad Gateway",
     503: b"Service Unavailable", 504: b"Gateway Timeout",
 }
 
@@ -93,8 +95,14 @@ def _response(
     )
 
 
-def _error_response(status: int, reason: str) -> bytes:
-    return _response(status, json.dumps(failure_status_dict(status, reason)).encode())
+def _error_response(status: int, reason: str, retry_after: str | None = None) -> bytes:
+    # QoS 429s and the paused 503 tell the client when to come back
+    extra = b"retry-after: %s\r\n" % retry_after.encode() if retry_after else b""
+    return _response(
+        status,
+        json.dumps(failure_status_dict(status, reason)).encode(),
+        extra_headers=extra,
+    )
 
 
 class _Job:
@@ -511,9 +519,17 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
         self.echo_trace_id: bytes | None = None
         self._sent_continue = False
         self._tasks: set[asyncio.Task] = set()
+        # the in-flight spliced request's QoS admission ticket (released on
+        # completion, failure, timeout reap, or client disconnect)
+        self._qos_ticket = None
         # write coalescing: response head + body (and any same-iteration
         # writes) leave in one syscall
         self._init_coalescer(frontend.loop)
+
+    def _release_qos(self) -> None:
+        ticket, self._qos_ticket = self._qos_ticket, None
+        if ticket is not None:
+            ticket.release()
 
     def write(self, data: bytes) -> None:
         self.queue_write(data)
@@ -537,6 +553,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
 
     def connection_lost(self, exc) -> None:
         self.frontend._conns.discard(self)
+        self._release_qos()  # cancel-on-disconnect frees the admission slot
         job, self.job = self.job, None
         if job is not None:
             # client went away: abandon the job — its response (if any)
@@ -580,7 +597,8 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                         cache.clear()  # self-healing, never stop-on-full
                     cache[head] = parsed
             (method, route, content_length, auth, traceparent,
-             chunked, expect, close_after, rewritten_head) = parsed
+             deadline_ms, priority, chunked, expect, close_after,
+             rewritten_head) = parsed
             if chunked:
                 # nothing we serve needs chunked uploads; keep the parser
                 # simple and honest
@@ -612,7 +630,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                 # through the fallback would not be.
                 service = None
             if service is None:
-                head_headers = (auth, traceparent)
+                head_headers = (auth, traceparent, deadline_ms, priority)
                 body = bytes(buf[idx + 4 : total])
                 del buf[:total]
                 self.awaiting = True
@@ -626,22 +644,32 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
             # trace context: forward a client-sent (valid) traceparent
             # verbatim; mint a spec-valid root and INJECT it into the
             # spliced head when the client is trace-naive, so the engine's
-            # spans always have a trace to join
+            # spans always have a trace to join.  The same rebuild stamps
+            # the per-deployment default deadline for SLO-naive clients
+            # (a client-sent x-sct-deadline-ms splices through verbatim —
+            # the microseconds spent here are not worth a rewrite).
             minted = None
             tp_parsed = parse_traceparent(traceparent)
             if tp_parsed is None:
                 minted = new_traceparent(sampled=self.frontend.recorder.should_sample())
                 tp_parsed = parse_traceparent(minted)
-            if rewritten_head is not None or minted is not None:
+            inject_deadline = None
+            if deadline_ms is None and self.gateway.default_deadline_ms:
+                inject_deadline = self.gateway.default_deadline_ms
+                deadline_ms = inject_deadline
+            if rewritten_head is not None or minted is not None or inject_deadline:
                 # hop-by-hop headers stripped / HTTP/1.0 line upgraded /
-                # traceparent minted: rebuild the head for the shared
-                # upstream conn (RFC 9112 §7.6.1)
+                # traceparent minted / deadline stamped: rebuild the head
+                # for the shared upstream conn (RFC 9112 §7.6.1)
                 head_out = rewritten_head if rewritten_head is not None else head
+                inject = b""
                 if minted is not None:
-                    head_out = (
-                        head_out[:-2]
-                        + b"traceparent: " + minted.encode() + b"\r\n\r\n"
+                    inject += b"traceparent: " + minted.encode() + b"\r\n"
+                if inject_deadline:
+                    inject += b"x-sct-deadline-ms: %s\r\n" % (
+                        str(round(inject_deadline, 3)).encode()
                     )
+                head_out = head_out[:-2] + inject + b"\r\n"
                 raw = head_out + bytes(buf[idx + 4 : total])
             else:
                 raw = bytes(buf[:total])
@@ -660,11 +688,31 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                 self.frontend.observe(
                     rec.oauth_key, rec.name, service, 503, 0.0
                 )
-                self.write(_error_response(503, "gateway is paused"))
+                self.write(_error_response(503, "gateway is paused", retry_after="1"))
                 if self.close_after:
                     self._close()
                     return
                 continue
+            # QoS admission (per-deployment; inert unless SCT_GW_QOS_* is
+            # configured): shed HERE, before any engine socket is touched
+            try:
+                ticket = self.gateway.qos_for(rec).admit(
+                    priority,
+                    budget_s=deadline_ms / 1e3 if deadline_ms else None,
+                )
+            except qos.QosRejection as e:
+                self.frontend.observe(
+                    rec.oauth_key, rec.name, service, e.status, 0.0
+                )
+                self.write(_error_response(
+                    e.status, str(e),
+                    retry_after=e.retry_after_header() if e.status == 429 else None,
+                ))
+                if self.close_after:
+                    self._close()
+                    return
+                continue
+            self._qos_ticket = ticket
             streaming = service == "predictions_stream"
             self.rec = rec
             self.service = service
@@ -707,6 +755,8 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
         content_length = None
         auth = ""
         traceparent = None
+        deadline_ms = None
+        priority = qos.PRIO_INTERACTIVE
         chunked = False
         expect = False
         close_after = version == b"HTTP/1.0"
@@ -736,6 +786,10 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                 auth = value.strip().decode("latin-1")
             elif name == b"traceparent":
                 traceparent = value.strip().decode("latin-1")
+            elif name == b"x-sct-deadline-ms":
+                deadline_ms = qos.parse_deadline_ms(value.strip())
+            elif name == b"x-sct-priority":
+                priority = qos.parse_priority(value.strip())
             elif name == b"transfer-encoding":
                 chunked = b"chunked" in value.lower()
             elif name == b"expect":
@@ -758,7 +812,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
             )
         return (
             method, route, content_length or 0, auth, traceparent,
-            chunked, expect, close_after, rewritten,
+            deadline_ms, priority, chunked, expect, close_after, rewritten,
         )
 
     # -- splice callbacks ---------------------------------------------------
@@ -802,6 +856,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
 
     def upstream_done(self, status: int) -> None:
         self.job = None
+        self._release_qos()
         rec = self.rec
         dt = time.perf_counter() - self.t0
         self._finish_trace(status, dt)
@@ -816,6 +871,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
 
     def upstream_failed(self, reason: str, forwarded: bool) -> None:
         self.job = None
+        self._release_qos()
         rec = self.rec
         dt = time.perf_counter() - self.t0
         self._finish_trace(503, dt)
@@ -850,10 +906,11 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
     # -- fallback (full-parse) path -----------------------------------------
 
     async def _fallback(self, method: bytes, route: bytes, meta, body: bytes) -> None:
-        auth, traceparent = meta
+        auth, traceparent, deadline_ms, priority = meta
         try:
             status, payload, ctype = await self.frontend.handle_fallback(
-                method, route, auth, traceparent, body
+                method, route, auth, traceparent, body,
+                deadline_ms=deadline_ms, priority=priority,
             )
         except asyncio.CancelledError:
             raise
@@ -872,6 +929,12 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                 extra = (
                     TRACE_RESPONSE_HEADER.encode() + b": "
                     + parsed[0].encode() + b"\r\n"
+                )
+            if status in (429, 503):
+                # ingress_core left a precise hint in the qos context when
+                # it shed; the drain-paused 503 gets the 1s default
+                extra += b"retry-after: %s\r\n" % (
+                    (qos.get_retry_after() or "1").encode()
                 )
             self.write(_response(status, payload, ctype, extra_headers=extra))
         self._next()
@@ -946,6 +1009,7 @@ class H1SpliceFrontend:
         for conn in list(self._conns):
             if conn.awaiting and conn.deadline and now >= conn.deadline:
                 job, conn.job = conn.job, None
+                conn._release_qos()
                 if job is not None:
                     job.down = None  # discard whatever the engine returns
                 # the timeout is a real 504: ingress metrics + the relay
@@ -986,17 +1050,32 @@ class H1SpliceFrontend:
     # -- fallback routing ---------------------------------------------------
 
     async def handle_fallback(
-        self, method: bytes, route: bytes, auth: str, traceparent: str | None, body: bytes
+        self,
+        method: bytes,
+        route: bytes,
+        auth: str,
+        traceparent: str | None,
+        body: bytes,
+        deadline_ms: float | None = None,
+        priority: str = qos.PRIO_INTERACTIVE,
     ) -> tuple[int, bytes, bytes]:
         gw = self.gateway
+        # ingress_core re-parses header VALUES, so hand the already-parsed
+        # ones back in wire form
+        qos_kw = dict(
+            deadline_header=str(deadline_ms) if deadline_ms else None,
+            priority_header=priority,
+        )
         if route == b"/api/v0.1/predictions" and method == b"POST":
             status, payload = await gw.ingress_core(
-                auth, traceparent, body, "/api/v0.1/predictions", "predictions"
+                auth, traceparent, body, "/api/v0.1/predictions", "predictions",
+                **qos_kw,
             )
             return status, payload, b"application/json"
         if route == b"/api/v0.1/feedback" and method == b"POST":
             status, payload = await gw.ingress_core(
-                auth, traceparent, body, "/api/v0.1/feedback", "feedback"
+                auth, traceparent, body, "/api/v0.1/feedback", "feedback",
+                **qos_kw,
             )
             return status, payload, b"application/json"
         if route == b"/oauth/token" and method == b"POST":
@@ -1035,6 +1114,8 @@ class H1SpliceFrontend:
             return 200, json.dumps(self.recorder.stats(n=20)).encode(), b"application/json"
         if route == b"/stats/breakdown":
             return 200, json.dumps({"stages": self.recorder.breakdown()}).encode(), b"application/json"
+        if route == b"/stats/qos":
+            return 200, json.dumps({"qos": gw.qos_snapshot()}).encode(), b"application/json"
         return 404, json.dumps(
             failure_status_dict(404, f"no route {route.decode('latin-1')}")
         ).encode(), b"application/json"

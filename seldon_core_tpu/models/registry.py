@@ -155,6 +155,7 @@ def build_component(
     batching: bool = True,
     max_batch: int = 64,
     max_delay_ms: float = 2.0,
+    max_queue: int | None = None,
     input_dtype: str | None = None,
     **kwargs,
 ) -> JaxModelComponent:
@@ -182,6 +183,7 @@ def build_component(
         batching=batching,
         max_batch=max_batch,
         max_delay_ms=max_delay_ms,
+        max_queue=max_queue,
         warmup_example=warmup,
     )
 
@@ -213,6 +215,7 @@ def build_generative_component(
     decode_block: int = 8,
     kv_block_size: int = 16,
     kv_blocks: int | None = None,
+    queue_max: int | None = None,
     **overrides,
 ):
     """Build a continuous-batching generative graph unit (JAX_GENERATIVE).
@@ -262,4 +265,5 @@ def build_generative_component(
         max_new_tokens=max_new_tokens,
         temperature=temperature,
         eos_id=eos_id,
+        queue_max=queue_max,
     )

@@ -301,6 +301,20 @@ class SpanRecorder:
 
     # -- reading -----------------------------------------------------------
 
+    def stage_ewma(self, stage: str, n: int = 64, alpha: float = 0.2) -> float | None:
+        """EWMA over the stage ring's last ``n`` samples (None when the
+        stage has no data yet).  Feeds the QoS plane's time-to-completion
+        estimate at admission (qos/admission.py) — recent samples dominate
+        so the estimate tracks load shifts within a few steps."""
+        ring = self._stages.get(stage)
+        if not ring:
+            return None
+        vals = list(ring)[-max(1, n):]
+        est = vals[0]
+        for v in vals[1:]:
+            est = alpha * v + (1.0 - alpha) * est
+        return est
+
     def breakdown(self) -> dict:
         """Aggregated per-stage latency over the ring window:
         ``{stage: {count, window, total_ms, p50_ms, p90_ms, p99_ms,

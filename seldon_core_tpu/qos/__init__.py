@@ -1,0 +1,47 @@
+"""QoS plane: admission control, deadline propagation, and SLO-aware load
+shedding for the serving hot path (docs/QOS.md).
+
+Threaded through gateway -> engine -> graph walker -> batcher -> generation
+scheduler: the gateway stamps ``x-sct-deadline-ms`` (client header or
+per-deployment default), every downstream hop decrements it, and the
+batching layers drop already-expired requests BEFORE dispatching a device
+step.  The :class:`AdmissionController` fast-fails overload with 429 +
+``Retry-After`` instead of queueing unboundedly.
+"""
+
+from __future__ import annotations
+
+from seldon_core_tpu.qos.admission import (  # noqa: F401
+    AdmissionController,
+    BrownoutShed,
+    DeadlineExceeded,
+    PredictedSloMiss,
+    QosRejection,
+    QueueFull,
+    RateLimited,
+    TokenBucket,
+    active_controller,
+    clamp_max_new_tokens,
+    note_deadline_miss,
+    set_active_controller,
+)
+from seldon_core_tpu.qos.context import (  # noqa: F401
+    DEADLINE_HEADER,
+    PRIO_BATCH,
+    PRIO_INTERACTIVE,
+    PRIORITY_HEADER,
+    expired,
+    get_deadline,
+    get_priority,
+    get_retry_after,
+    set_retry_after,
+    outgoing_qos_headers,
+    parse_deadline_ms,
+    parse_priority,
+    priority_rank,
+    remaining_s,
+    seed_from_headers,
+    set_budget_ms,
+    set_deadline,
+    set_priority,
+)

@@ -1,0 +1,147 @@
+"""Request-scoped QoS context: deadline + priority propagation.
+
+A client (or the gateway, from a per-deployment default) stamps
+``x-sct-deadline-ms`` — the REMAINING time budget in milliseconds — and
+optionally ``x-sct-priority`` (``interactive`` | ``batch``).  Ingress
+converts the budget into an ABSOLUTE monotonic deadline held in a
+contextvar; every downstream stage (graph walker fan-out, batching queue,
+generation scheduler) reads the same deadline with no signature plumbing,
+exactly like the traceparent in ``utils/tracectx.py``.  Outgoing hops
+re-serialize the header with the budget DECREMENTED by the time already
+spent, so a 3-hop graph never promises a unit more time than the client
+is still willing to wait.
+
+asyncio tasks inherit contextvars, so the walker's gather fan-out and the
+transport calls all see the ingress deadline for free.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+
+DEADLINE_HEADER = "x-sct-deadline-ms"
+PRIORITY_HEADER = "x-sct-priority"
+
+PRIO_INTERACTIVE = "interactive"
+PRIO_BATCH = "batch"
+_PRIORITIES = (PRIO_INTERACTIVE, PRIO_BATCH)
+
+# absolute time.monotonic() deadline of the current request (None = no SLO)
+_deadline: contextvars.ContextVar[float | None] = contextvars.ContextVar(
+    "sct_qos_deadline", default=None
+)
+_priority: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "sct_qos_priority", default=PRIO_INTERACTIVE
+)
+# Retry-After hint (delta-seconds string) set where a shed decision was
+# made, read where the wire response is built — the two sites live in
+# different layers (ingress core vs front end) that share only the status
+_retry_after: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "sct_qos_retry_after", default=None
+)
+
+
+def set_retry_after(value: str | None) -> None:
+    _retry_after.set(value)
+
+
+def get_retry_after() -> str | None:
+    return _retry_after.get()
+
+
+def parse_deadline_ms(value) -> float | None:
+    """Strict parse of an ``x-sct-deadline-ms`` header value: a positive
+    finite number of milliseconds, else None (a malformed SLO must degrade
+    to "no SLO", never to a crash or an instant 504)."""
+    if value is None:
+        return None
+    if isinstance(value, bytes):
+        value = value.decode("latin-1", "replace")
+    try:
+        ms = float(value)
+    except (TypeError, ValueError):
+        return None
+    if not (ms > 0.0) or ms != ms or ms == float("inf"):
+        return None
+    return ms
+
+
+def parse_priority(value) -> str:
+    """``interactive`` unless the client explicitly says ``batch`` —
+    unknown classes must not land in a lower tier by typo."""
+    if isinstance(value, bytes):
+        value = value.decode("latin-1", "replace")
+    if isinstance(value, str) and value.strip().lower() == PRIO_BATCH:
+        return PRIO_BATCH
+    return PRIO_INTERACTIVE
+
+
+def priority_rank(priority: str) -> int:
+    """Lower rank pops first."""
+    return 0 if priority == PRIO_INTERACTIVE else 1
+
+
+def set_budget_ms(budget_ms: float | None) -> None:
+    """Seed this request's absolute deadline from a remaining-ms budget
+    (None clears — a fresh ingress must not inherit a stale deadline)."""
+    if budget_ms is None:
+        _deadline.set(None)
+    else:
+        _deadline.set(time.monotonic() + budget_ms / 1e3)
+
+
+def set_deadline(deadline: float | None) -> None:
+    _deadline.set(deadline)
+
+
+def get_deadline() -> float | None:
+    return _deadline.get()
+
+
+def remaining_s(deadline: float | None = None) -> float | None:
+    """Seconds left on ``deadline`` (default: the context's), None = no SLO.
+    May be negative: callers distinguish "expired" from "unbounded"."""
+    d = _deadline.get() if deadline is None else deadline
+    if d is None:
+        return None
+    return d - time.monotonic()
+
+
+def expired(deadline: float | None = None) -> bool:
+    r = remaining_s(deadline)
+    return r is not None and r <= 0.0
+
+
+def set_priority(priority: str) -> None:
+    _priority.set(priority if priority in _PRIORITIES else PRIO_INTERACTIVE)
+
+
+def get_priority() -> str:
+    return _priority.get()
+
+
+def seed_from_headers(deadline_value, priority_value) -> tuple[float | None, str]:
+    """Ingress helper: parse + seed both contextvars in one shot (and
+    clear any stale Retry-After hint); returns ``(budget_ms, priority)``
+    as parsed."""
+    budget_ms = parse_deadline_ms(deadline_value)
+    priority = parse_priority(priority_value)
+    set_budget_ms(budget_ms)
+    set_priority(priority)
+    _retry_after.set(None)
+    return budget_ms, priority
+
+
+def outgoing_qos_headers() -> dict[str, str]:
+    """Headers for a downstream hop: the deadline header re-stamped with
+    the budget REMAINING now (never below 1ms — a 0/negative header would
+    parse as "no SLO" downstream), plus the priority class when it is not
+    the default.  {} when the request carries no SLO."""
+    out: dict[str, str] = {}
+    r = remaining_s()
+    if r is not None:
+        out[DEADLINE_HEADER] = str(max(1.0, round(r * 1e3, 3)))
+    if _priority.get() == PRIO_BATCH:
+        out[PRIORITY_HEADER] = PRIO_BATCH
+    return out

@@ -141,6 +141,40 @@ class MetricsRegistry:
             ["model_name"],
             registry=self.registry,
         )
+        # QoS plane vocabulary (fed by qos/admission.py and the bounded
+        # queues in executor/batcher.py + executor/generation.py)
+        self.qos_admitted = Counter(
+            "seldon_qos_admitted_total",
+            "Requests admitted past QoS admission control",
+            ["name", "priority"],
+            registry=self.registry,
+        )
+        self.qos_shed = Counter(
+            "seldon_qos_shed_total",
+            "Requests shed by QoS admission control, by reason",
+            ["name", "reason", "priority"],
+            registry=self.registry,
+        )
+        self.qos_deadline_miss = Counter(
+            "seldon_qos_deadline_miss_total",
+            "Requests dropped by a queue because their deadline expired "
+            "before a device step was spent on them",
+            ["name", "stage"],
+            registry=self.registry,
+        )
+        self.qos_inflight = Gauge(
+            "seldon_qos_inflight",
+            "Requests currently admitted (running + queued) per deployment",
+            ["name"],
+            registry=self.registry,
+        )
+        self.qos_brownout = Gauge(
+            "seldon_qos_brownout",
+            "1 while the deployment rides out sustained overload in "
+            "brownout mode (batch shed, max_new_tokens clamped)",
+            ["name"],
+            registry=self.registry,
+        )
 
     @contextmanager
     def time_server_request(
