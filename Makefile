@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -28,6 +28,15 @@ obs-check:
 # beats QoS-off on completions-within-deadline (same test runs in tier-1)
 qos-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_qos.py -q -k qos_check
+
+# perf-attribution plane gate: wire byte counters + /stats/wire shape +
+# profiler start/stop lifecycle + always-on probes, then a smoke of the
+# loopback big-payload bench control (device-free, CPU-safe)
+profile-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_obs.py -q \
+		-k "WireAccounting or ProfilerLifecycle or AlwaysOnProbes"
+	JAX_PLATFORMS=cpu BENCH_ONLY=loopback BENCH_SECONDS=1 BENCH_RUNS=2 \
+		BENCH_LOOPBACK_ROWS=32 $(PYTHON) bench.py
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -14,11 +14,24 @@ constant-returning stub, no model at all, on a 16-core engine node.  This
 box is ONE CPU core and one tunnel-attached TPU chip (~100 ms device round
 trip); stub and latency numbers below carry that context.
 
-Stages (each skippable via env):
+Stages (each skippable via env; ``BENCH_ONLY=name`` runs one stage):
   mlp   (headline)     BENCH_SKIP_MLP    batched bf16 rawTensor wire serving
   stub                 BENCH_SKIP_STUB   1-row SIMPLE_MODEL REST + gRPC
   bert                 BENCH_SKIP_BERT   BERT-base bf16, seq 128, wire
   llm                  BENCH_SKIP_LLM    llama-tiny generative over the wire
+  loopback             BENCH_SKIP_LOOPBACK  big-payload localhost control
+
+Credibility discipline (round-5 postmortem — the headline swung 4.5x with
+this file byte-identical and nothing could attribute it):
+
+* the headline stage runs **median-of-N** (``BENCH_RUNS``, default 3) with
+  the run-to-run spread recorded, MLPerf-style;
+* every load stage records **achieved wire MB/s** (client-side request
+  math AND the server's own ``GET /stats/wire`` accounting), so a
+  bandwidth-bound stage is distinguishable from a framework regression;
+* the **loopback control** serves the same big payloads through a
+  device-free graph with engine and loadgen co-located, pinning the
+  framework's wire ceiling independent of any TPU tunnel.
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "pred/s", "vs_baseline": N,
@@ -124,6 +137,54 @@ def _token_payload(rows: int, seq: int, vocab: int) -> bytes:
     ).encode()
 
 
+def _sig(x, digits: int = 4):
+    """Round to ``digits`` significant digits — a nonzero metric must never
+    report as 0.0 (round 5's `llm_mfu 0.0` was actually 0.0004)."""
+    if not isinstance(x, (int, float)):
+        return x
+    return float(f"{x:.{digits}g}")
+
+
+def _stats_wire(port: int) -> dict:
+    """Server-side wire accounting snapshot (GET /stats/wire): per-edge
+    request/response bytes and achieved MB/s, plus event-loop lag and
+    host-sync counters — the attribution data round 5 lacked."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats/wire", timeout=5
+        ) as r:
+            return json.loads(r.read())
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _req_mb_s(result, payload_bytes: int) -> float:
+    """Client-side achieved request-direction wire MB/s."""
+    return _sig(result.rps * payload_bytes / 1e6)
+
+
+def _median_of(run, n: int | None = None):
+    """Median-of-n (on rps) with the full spread recorded — the variance
+    discipline MLPerf requires of a headline number.  Returns
+    (median_result, variance_dict); a clean run outranks a failing run for
+    the median pick so failures can't inflate the headline."""
+    if n is None:
+        n = int(os.environ.get("BENCH_RUNS", "3"))
+    results = [run() for _ in range(n)]
+    ranked = sorted(results, key=lambda r: (not r.failures, r.rps))
+    median = ranked[len(ranked) // 2]
+    rps = sorted(r.rps for r in results)
+    med_rps = rps[len(rps) // 2]
+    return median, {
+        "runs": n,
+        "runs_rps": [_sig(r.rps) for r in results],
+        "median_rps": _sig(med_rps),
+        "min_rps": _sig(rps[0]),
+        "max_rps": _sig(rps[-1]),
+        "spread_pct": _sig((rps[-1] - rps[0]) / med_rps * 100) if med_rps else None,
+    }
+
+
 def _breakdown(port: int) -> dict:
     """Per-stage latency flight recorder snapshot (GET /stats/breakdown):
     says WHERE the wall time of the preceding load run went (gateway-relay /
@@ -179,11 +240,13 @@ def _wire_mfu(
     units_per_s: float, device: dict, key: str = "flops_per_row", digits: int = 4
 ) -> float | None:
     """End-to-end MFU: achieved wire throughput x per-unit FLOPs over peak
-    (``key`` picks rows for model stages, tokens for generative ones)."""
+    (``key`` picks rows for model stages, tokens for generative ones).
+    Significant-digit rounding: a tiny-model MFU must report as 4e-04, not
+    collapse to 0.0."""
     fpu, peak = device.get(key), device.get("peak_tflops")
     if not fpu or not peak:
         return None
-    return round(units_per_s * fpu / (peak * 1e12), digits)
+    return _sig(units_per_s * fpu / (peak * 1e12), digits)
 
 
 def _best_of(run, n: int = 2):
@@ -223,16 +286,21 @@ def stage_mlp(detail: dict) -> float | None:
     }
     with engine(graph, 18800, 18801):
         url = "http://127.0.0.1:18800/api/v0.1/predictions"
-        # best of two: the tunnel's device-fetch throughput swings several
-        # fold between minutes; a single sample under-reports the system
-        r = _best_of(
-            lambda: run_load(url, [_raw_tensor_payload(rows, 784)],
-                             concurrency=conc, duration_s=SECONDS)
+        payload = _raw_tensor_payload(rows, 784)
+        # median-of-N with recorded spread: the tunnel's throughput swings
+        # several-fold between minutes — a single sample is not a credible
+        # headline, and the spread itself is the tunnel-vs-framework signal
+        r, variance = _median_of(
+            lambda: run_load(url, [payload], concurrency=conc,
+                             duration_s=SECONDS)
         )
-        pred_s = r.rps * rows
+        pred_s = variance["median_rps"] * rows
         detail["mlp_wire"] = {
             **r.summary(), "rows_per_request": rows,
             "predictions_per_s": round(pred_s, 1),
+            "variance": variance,
+            "request_bytes": len(payload),
+            "req_mb_s": _req_mb_s(r, len(payload)),
             "device": dev,
             "model": "mlp 784-512-512-10, bf16 rawTensor wire, TPU batched",
         }
@@ -256,6 +324,8 @@ def stage_mlp(detail: dict) -> float | None:
         detail["mlp_grpc_wire"] = {
             **g.summary(), "rows_per_request": rows,
             "predictions_per_s": round(grpc_pred_s, 1),
+            "request_bytes": len(grpc_payload),
+            "req_mb_s": _req_mb_s(g, len(grpc_payload)),
             "model": "same mlp, bf16 rawTensor over the h2 gRPC data plane",
         }
         # latency-bounded operating point: minimal queueing
@@ -268,6 +338,9 @@ def stage_mlp(detail: dict) -> float | None:
                     "program sub-ms (see BucketSpec warmup)",
         }
         detail["mlp_wire"]["breakdown"] = _breakdown(18800)
+        # the engine's own wire accounting for the whole stage (both
+        # transports): request/response bytes + achieved MB/s per edge
+        detail["mlp_wire"]["stats_wire"] = _stats_wire(18800)
         if r.failures:
             return None
         return max(pred_s, grpc_pred_s if not g.failures else 0.0)
@@ -344,16 +417,20 @@ def stage_bert(detail: dict) -> None:
             {"name": "max_delay_ms", "value": "5.0", "type": "FLOAT"},
         ],
     }
+    body = _token_payload(rows, 128, 30000)
     with engine(graph, 18820, 18821, ready_timeout=420.0):
         r = _best_of(lambda: run_load(
             "http://127.0.0.1:18820/api/v0.1/predictions",
-            [_token_payload(rows, 128, 30000)],
+            [body],
             concurrency=48, duration_s=SECONDS,
         ))
+        wire_snap = _stats_wire(18820)
     seq_s = r.rps * rows
     detail["bert_base_wire"] = {
         **r.summary(), "rows_per_request": rows,
         "sequences_per_s": round(seq_s, 1),
+        "req_mb_s": _req_mb_s(r, len(body)),
+        "stats_wire": wire_snap,
         "mfu": _wire_mfu(seq_s, dev),
         "device": dev,
         "split_note": (
@@ -483,9 +560,11 @@ def stage_llm_1b(detail: dict) -> None:
             "http://127.0.0.1:18860/api/v0.1/predictions/stream",
             json.dumps({"tokens": [5, 9, 2, 17, 3, 8, 11, 4]}).encode(),
         )
+        wire_snap = _stats_wire(18860)
     tok_s = r.rps * max_new
     detail["llm_1b_wire"] = {
         **r.summary(),
+        "stats_wire": wire_snap,
         "generated_tokens_per_s": round(tok_s, 1),
         "mfu": _wire_mfu(tok_s, dev, key="flops_per_token", digits=6),
         "device": dev,
@@ -533,16 +612,55 @@ def stage_resnet(detail: dict) -> None:
             "127.0.0.1:18841", [wire_msg], grpc=True,
             concurrency=16, duration_s=SECONDS,
         ))
+        wire_snap = _stats_wire(18840)
     img_s = r.rps * rows
     detail["resnet50_wire"] = {
         **r.summary(), "rows_per_request": rows,
         "images_per_s": round(img_s, 1),
+        "req_mb_s": _req_mb_s(r, len(wire_msg)),
+        "stats_wire": wire_snap,
         "mfu": _wire_mfu(img_s, dev),
         "device": dev,
         "wire_bytes_per_request": len(wire_msg),
         "wire_bytes_per_image": round(len(wire_msg) / rows),
         "model": "resnet-50 25M bf16, uint8 224x224x3 rawTensor over "
                  "binary gRPC, normalized on device",
+    }
+
+
+def stage_loopback(detail: dict) -> None:
+    """Localhost-loopback big-payload control: the SAME ~400KB request
+    bodies as the headline MLP stage, served by a device-free SIMPLE_MODEL
+    graph with engine and loadgen co-located on this host.
+
+    This number contains codec + HTTP + batching framework cost and ZERO
+    tunnel or device time, so comparing it against the headline stage
+    separates "the tunnel/chip degraded" from "the framework regressed" —
+    exactly the attribution BENCH_r05's 4.5x collapse lacked.  Runs
+    median-of-N like the headline (it IS a headline-attribution stage)."""
+    from seldon_core_tpu.testing.loadtest import run_load
+
+    rows = int(os.environ.get("BENCH_LOOPBACK_ROWS", "256"))
+    conc = int(os.environ.get("BENCH_CONCURRENCY", "64"))
+    body = _raw_tensor_payload(rows, 784)
+    secs = min(SECONDS, 6.0)
+    with engine(None, 18890, 18891):  # default graph = SIMPLE_MODEL, no device
+        r, variance = _median_of(lambda: run_load(
+            "http://127.0.0.1:18890/api/v0.1/predictions", [body],
+            concurrency=conc, duration_s=secs,
+        ))
+        wire_snap = _stats_wire(18890)
+    detail["loopback_control"] = {
+        **r.summary(),
+        "variance": variance,
+        "rows_per_request": rows,
+        "request_bytes": len(body),
+        "req_mb_s": _req_mb_s(r, len(body)),
+        "predictions_per_s": round(variance["median_rps"] * rows, 1),
+        "stats_wire": wire_snap,
+        "note": "device-free loopback ceiling for the headline payload "
+                "shape: headline/loopback ratio isolates tunnel+device "
+                "cost from framework cost",
     }
 
 
@@ -732,9 +850,12 @@ def stage_gateway(detail: dict) -> None:
             ))
             gw_breakdown = _breakdown(18870)
             engine_breakdown = _breakdown(18860)
+            gw_wire = _stats_wire(18870)
         detail["gateway_breakdown"] = {
             "gateway": gw_breakdown,
             "engine": engine_breakdown,
+            # per-edge bytes+MB/s through the gateway (h1 splice + relay)
+            "gateway_wire": gw_wire,
         }
         detail["gateway_rest"] = {
             **rest.summary(),
@@ -775,11 +896,15 @@ def main() -> None:
         ("LLM", "BENCH_SKIP_LLM", stage_llm),
         ("LLM1B", "BENCH_SKIP_LLM1B", stage_llm_1b),
         ("RESNET", "BENCH_SKIP_RESNET", stage_resnet),
+        ("LOOPBACK", "BENCH_SKIP_LOOPBACK", stage_loopback),
         ("AB", "BENCH_SKIP_AB", stage_ab),
         ("GATEWAY", "BENCH_SKIP_GATEWAY", stage_gateway),
         ("OVERLOAD", "BENCH_SKIP_OVERLOAD", stage_overload),
     ]
+    only = os.environ.get("BENCH_ONLY", "").upper()
     for name, skip_env, fn in stages:
+        if only and name != only:
+            continue
         if os.environ.get(skip_env) == "1":
             continue
         try:
@@ -817,7 +942,10 @@ def main() -> None:
 # (stage key in detail, field, compact name) — one headline number per stage
 _STAGE_HEADLINES = (
     ("mlp_wire", "rps", "mlp_rest_rps"),
+    ("mlp_wire", "req_mb_s", "mlp_req_mb_s"),
     ("mlp_grpc_wire", "rps", "mlp_grpc_rps"),
+    ("loopback_control", "rps", "loopback_rps"),
+    ("loopback_control", "req_mb_s", "loopback_req_mb_s"),
     ("stub_rest", "rps", "stub_rest_rps"),
     ("stub_grpc", "rps", "stub_grpc_rps"),
     ("bert_base_wire", "sequences_per_s", "bert_seq_s"),
@@ -841,7 +969,13 @@ def _compact_stages(detail: dict) -> dict:
     for key, field, name in _STAGE_HEADLINES:
         v = detail.get(key, {})
         if isinstance(v, dict) and isinstance(v.get(field), (int, float)):
-            out[name] = round(v[field], 4)
+            # significant digits, not decimal places: `llm_mfu 0.0004` must
+            # survive the compact line (VERDICT r5 weak-finding 7)
+            out[name] = _sig(v[field])
+    # headline variance: the spread IS the credibility signal
+    var = (detail.get("mlp_wire") or {}).get("variance") or {}
+    if isinstance(var.get("spread_pct"), (int, float)):
+        out["mlp_spread_pct"] = _sig(var["spread_pct"])
     return out
 
 

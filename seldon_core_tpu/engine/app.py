@@ -32,7 +32,15 @@ from seldon_core_tpu.contract import (
 from seldon_core_tpu import qos
 from seldon_core_tpu.engine.service import PredictionService, load_predictor_spec
 from seldon_core_tpu.graph.units import GraphUnitError
-from seldon_core_tpu.obs import RECORDER, STAGE_STREAM_FLUSH, configure_exporters_from_env
+from seldon_core_tpu.obs import (
+    LOOP_LAG,
+    RECORDER,
+    STAGE_STREAM_FLUSH,
+    WIRE,
+    WIRE_ENGINE_REST,
+    configure_exporters_from_env,
+    wire_stats_payload,
+)
 from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
 
 log = logging.getLogger(__name__)
@@ -78,7 +86,28 @@ class EngineApp:
         self._profile_dir: str | None = None
 
     def build(self) -> web.Application:
-        app = web.Application(client_max_size=256 * 1024 * 1024)
+        # wire-throughput accounting on the whole REST surface: request
+        # bytes from the framing header, response bytes from the prepared
+        # body, duration wall-clocked around the handler (obs/wire.py)
+        wire = WIRE.counter(WIRE_ENGINE_REST, self.service.deployment_name)
+
+        @web.middleware
+        async def _wire_mw(request: web.Request, handler):
+            import time as _time
+
+            t0 = _time.perf_counter()
+            resp = await handler(request)
+            body = getattr(resp, "body", None)
+            wire.record(
+                bytes_in=request.content_length or 0,
+                bytes_out=len(body) if isinstance(body, (bytes, bytearray)) else 0,
+                duration_s=_time.perf_counter() - t0,
+            )
+            return resp
+
+        app = web.Application(
+            client_max_size=256 * 1024 * 1024, middlewares=[_wire_mw]
+        )
 
         # which SO_REUSEPORT worker answered — lets operators (and the
         # multi-worker test) see the kernel's accept balancing.  Resolved at
@@ -111,6 +140,8 @@ class EngineApp:
         r.add_get("/stats/breakdown", self.stats_breakdown)
         # QoS plane state: admission/shed counters, brownout, estimates
         r.add_get("/stats/qos", self.stats_qos)
+        # wire-throughput accounting + always-on perf probes
+        r.add_get("/stats/wire", self.stats_wire)
         # XLA/device profiling (SURVEY §5: the reference had only JMX):
         # POST /profile/start {"dir": "/tmp/sct-profile"} ... /profile/stop
         # then open the trace in TensorBoard / xprof
@@ -122,6 +153,7 @@ class EngineApp:
 
     async def _startup(self, app: web.Application) -> None:
         configure_exporters_from_env()
+        LOOP_LAG.start("engine")
         await self.service.start()
         if self.mesh_worker:
             # worker host of a multi-host slice: the same units (and hence
@@ -450,6 +482,11 @@ class EngineApp:
         deadline-miss ledger, brownout, predicted completion time."""
         return web.json_response({"qos": self.qos.snapshot()})
 
+    async def stats_wire(self, request: web.Request) -> web.Response:
+        """Wire-throughput accounting (per-edge bytes + achieved MB/s) and
+        the always-on probes: event-loop lag, host syncs per model."""
+        return web.json_response(wire_stats_payload())
+
     async def profile_start(self, request: web.Request) -> web.Response:
         import jax
 
@@ -467,6 +504,9 @@ class EngineApp:
             )
         self._profile_dir = out_dir
         try:
+            # the capture dir must exist up front: operators tail it while
+            # the trace runs, and a bad path should 500 HERE, not at stop
+            os.makedirs(out_dir, exist_ok=True)
             jax.profiler.start_trace(out_dir)
         except Exception as e:
             self._profile_dir = None

@@ -33,6 +33,9 @@ log = logging.getLogger(__name__)
 class SeldonGrpc:
     def __init__(self, service: PredictionService):
         self.service = service
+        from seldon_core_tpu.obs import WIRE, WIRE_ENGINE_GRPC
+
+        self._wire = WIRE.counter(WIRE_ENGINE_GRPC, service.deployment_name)
 
     @staticmethod
     def _seed_trace(context) -> None:
@@ -50,18 +53,28 @@ class SeldonGrpc:
 
     @unary_guard
     async def Predict(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
+        import time as _time
+
         self._seed_trace(context)
+        t0 = _time.perf_counter()
         out = await self.service.predict(payload_from_proto(request))
         msg = payload_to_proto(out)
         msg.status.code = 200
         msg.status.status = pb.Status.SUCCESS
+        self._wire.record(
+            bytes_in=request.ByteSize(),
+            bytes_out=msg.ByteSize(),
+            duration_s=_time.perf_counter() - t0,
+        )
         return msg
 
     @unary_guard
     async def SendFeedback(self, request: pb.Feedback, context) -> pb.SeldonMessage:
         self._seed_trace(context)
         await self.service.send_feedback(feedback_from_proto(request))
-        return payload_to_proto(Payload())
+        msg = payload_to_proto(Payload())
+        self._wire.record(bytes_in=request.ByteSize(), bytes_out=msg.ByteSize())
+        return msg
 
     async def stream_predict_raw(self, payload: bytes):
         """Server-streaming token generation on the fast plane (no grpcio

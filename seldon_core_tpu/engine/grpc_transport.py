@@ -103,6 +103,11 @@ class GrpcNodeClient:
         self._transformer = _stub(ch, "Transformer")
         self._output_transformer = _stub(ch, "OutputTransformer")
         self._combiner = _stub(ch, "Combiner")
+        from seldon_core_tpu.obs import WIRE, WIRE_ENGINE_NODE
+
+        # wire accounting (client-edge orientation: out = request sent to
+        # the unit, in = reply received), same edge label as the REST client
+        self._wire = WIRE.counter(WIRE_ENGINE_NODE, spec.name)
 
     async def _call(self, method, request, idempotent: bool = True) -> Payload:
         """Unary call with bounded retry mirroring RestNodeClient: transient
@@ -163,7 +168,15 @@ class GrpcNodeClient:
                     )
                 ) from e
 
+        import time
+
+        t0 = time.perf_counter()
         reply = await retry_loop(attempt, idempotent=idempotent)
+        self._wire.record(
+            bytes_in=reply.ByteSize(),
+            bytes_out=request.ByteSize(),
+            duration_s=time.perf_counter() - t0,
+        )
         if reply.HasField("status") and reply.status.status == pb.Status.FAILURE:
             raise RemoteUnitError(
                 f"unit {self.spec.name!r} gRPC failure: {reply.status.info}"

@@ -96,6 +96,11 @@ class RestNodeClient:
         self.timeout = aiohttp.ClientTimeout(total=timeout_s)
         ep = spec.endpoint
         self.base = f"http://{ep.service_host}:{ep.service_port}"
+        from seldon_core_tpu.obs import WIRE, WIRE_ENGINE_NODE
+
+        # wire accounting for this unit hop: bytes_out = request sent
+        # upstream, bytes_in = reply received (client-edge orientation)
+        self._wire = WIRE.counter(WIRE_ENGINE_NODE, spec.name)
 
     async def _post(
         self, path: str, body: dict[str, Any], idempotent: bool = True
@@ -109,21 +114,37 @@ class RestNodeClient:
         )
 
     async def _post_once(self, path: str, body: dict[str, Any]) -> dict[str, Any]:
+        import time
+
         from seldon_core_tpu.qos.context import outgoing_qos_headers
         from seldon_core_tpu.utils.tracectx import outgoing_headers
 
         # trace context + the request's REMAINING deadline budget (qos
         # plane: every hop decrements x-sct-deadline-ms by the time already
         # spent) ride every unit hop
-        headers = {**outgoing_headers(), **outgoing_qos_headers()}
+        headers = {
+            **outgoing_headers(),
+            **outgoing_qos_headers(),
+            "Content-Type": "application/json",
+        }
+        # serialize here (identical bytes to aiohttp's json=) so the hop's
+        # wire accounting sees the exact payload size
+        raw = json.dumps(body).encode()
+        t0 = time.perf_counter()
         try:
             async with self.session.post(
                 self.base + path,
-                json=body,
+                data=raw,
                 timeout=self.timeout,
-                headers=headers or None,
+                headers=headers,
             ) as resp:
-                data = await resp.json(content_type=None)
+                reply = await resp.read()
+                self._wire.record(
+                    bytes_in=len(reply),
+                    bytes_out=len(raw),
+                    duration_s=time.perf_counter() - t0,
+                )
+                data = json.loads(reply)
                 if resp.status in RETRYABLE_HTTP:
                     raise _RetryableSent(
                         RemoteUnitError(

@@ -320,6 +320,9 @@ class GenerativeModel:
         scheduler's worker thread; all sinks are thread-safe)."""
         RECORDER.record_stage(STAGE_DEVICE_STEP, step_s)
         self._m_device_step.observe(step_s)
+        from seldon_core_tpu.obs import record_host_sync
+
+        record_host_sync(self.name)  # sampled tokens materialized on host
         if tokens_emitted and step_s > 0:
             from seldon_core_tpu.executor.batcher import _chip_peak
 

@@ -39,7 +39,15 @@ from seldon_core_tpu.gateway.store import (
 )
 from seldon_core_tpu.gateway.tap import RequestResponseTap, tap_from_env
 from seldon_core_tpu import qos
-from seldon_core_tpu.obs import RECORDER, STAGE_GATEWAY_RELAY, configure_exporters_from_env
+from seldon_core_tpu.obs import (
+    LOOP_LAG,
+    RECORDER,
+    STAGE_GATEWAY_RELAY,
+    WIRE,
+    WIRE_GATEWAY_REST,
+    configure_exporters_from_env,
+    wire_stats_payload,
+)
 from seldon_core_tpu.utils.tracectx import (
     TRACE_RESPONSE_HEADER,
     current_trace_id,
@@ -158,6 +166,7 @@ class GatewayApp:
 
     async def start(self) -> None:
         configure_exporters_from_env()
+        LOOP_LAG.start("gateway")
         return None  # pools connect lazily per deployment
 
     async def close(self) -> None:
@@ -180,6 +189,7 @@ class GatewayApp:
         r.add_get("/stats/spans", self.stats_spans)
         r.add_get("/stats/breakdown", self.stats_breakdown)
         r.add_get("/stats/qos", self.stats_qos)
+        r.add_get("/stats/wire", self.stats_wire)
 
         async def _startup(app_: web.Application) -> None:
             await self.start()
@@ -260,6 +270,8 @@ class GatewayApp:
 
         idempotent = "feedback" not in path
         pool = self._pool(rec)
+        wire = WIRE.counter(WIRE_GATEWAY_REST, rec.name)
+        t_wire0 = time.perf_counter()
         from seldon_core_tpu.qos.context import outgoing_qos_headers
 
         # traceparent + the decremented deadline budget / priority class
@@ -285,9 +297,18 @@ class GatewayApp:
                 raise _RetryableSent(e) from e
 
         try:
-            return await retry_loop(attempt, idempotent=idempotent)
+            status, body = await retry_loop(attempt, idempotent=idempotent)
         except _UpstreamError as e:
-            return e.status, e.body
+            status, body = e.status, e.body
+        # wire accounting: the client body forwards verbatim and the
+        # engine reply returns verbatim, so these lengths ARE the ingress
+        # payload bytes (obs/wire.py)
+        wire.record(
+            bytes_in=len(raw),
+            bytes_out=len(body),
+            duration_s=time.perf_counter() - t_wire0,
+        )
+        return status, body
 
     async def _ingress(self, request: web.Request, path: str, service: str) -> web.Response:
         # auth and paused-check BEFORE buffering the body: anonymous or
@@ -518,6 +539,11 @@ class GatewayApp:
 
     async def stats_qos(self, request: web.Request) -> web.Response:
         return web.json_response({"qos": self.qos_snapshot()})
+
+    async def stats_wire(self, request: web.Request) -> web.Response:
+        """Per-edge wire byte/MB-s counters + always-on probes (shared
+        payload with the engine and the h1 front end's fallback route)."""
+        return web.json_response(wire_stats_payload())
 
 
 def main(argv: list[str] | None = None) -> None:

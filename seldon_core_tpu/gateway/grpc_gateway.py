@@ -40,7 +40,7 @@ from seldon_core_tpu.proto.grpc_defs import (
     failure_message,
     use_grpcio,
 )
-from seldon_core_tpu.obs import RECORDER, STAGE_GATEWAY_RELAY
+from seldon_core_tpu.obs import RECORDER, STAGE_GATEWAY_RELAY, WIRE, WIRE_GATEWAY_GRPC
 from seldon_core_tpu.utils.tracectx import (
     ensure_traceparent,
     new_traceparent,
@@ -250,6 +250,12 @@ class FastGatewayGrpc(_ChannelCacheBase):
                 conn.relay_cancels.pop(stream_id, None)
                 dt = time.perf_counter() - t0
                 RECORDER.record_stage(STAGE_GATEWAY_RELAY, dt)
+                # wire accounting: the framed request forwards verbatim and
+                # the framed reply returns verbatim — these lengths are the
+                # relay's exact payload bytes (obs/wire.py)
+                WIRE.counter(WIRE_GATEWAY_GRPC, rec.name).record(
+                    bytes_in=len(framed), bytes_out=len(body), duration_s=dt
+                )
                 RECORDER.record_span(
                     f"gateway.grpc.{method}",
                     trace_id=trace_id,

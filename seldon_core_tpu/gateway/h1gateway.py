@@ -44,7 +44,15 @@ from typing import Optional
 from seldon_core_tpu.contract import failure_status_dict
 from seldon_core_tpu.gateway.auth import AuthError
 from seldon_core_tpu import qos
-from seldon_core_tpu.obs import RECORDER, STAGE_GATEWAY_RELAY, configure_exporters_from_env
+from seldon_core_tpu.obs import (
+    LOOP_LAG,
+    RECORDER,
+    STAGE_GATEWAY_RELAY,
+    WIRE,
+    WIRE_GATEWAY_H1,
+    configure_exporters_from_env,
+    wire_stats_payload,
+)
 from seldon_core_tpu.utils.tracectx import (
     TRACE_RESPONSE_HEADER,
     get_traceparent,
@@ -81,6 +89,19 @@ _MAX_BODY = int(_os.environ.get("GATEWAY_MAX_BODY", str(256 * 1024 * 1024)))
 # hop-by-hop headers an intermediary must not forward (RFC 9112 §7.6.1)
 _HOP_BY_HOP = (b"connection", b"keep-alive", b"proxy-connection", b"upgrade")
 
+# RFC 7230 token characters — the only bytes legal in a header field NAME.
+# The raw head splices onto a SHARED pipelined engine connection, so a name
+# like "Transfer-Encoding : chunked" (whitespace before the colon) that this
+# parser skips but a tolerant upstream honors would desync the pipeline:
+# request smuggling.  Reject anything else before splicing.
+_TOKEN_CHARS = frozenset(b"!#$%&'*+-.^_`|~0123456789"
+                         b"ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+                         b"abcdefghijklmnopqrstuvwxyz")
+
+
+def _is_token(name: bytes) -> bool:
+    return bool(name) and all(c in _TOKEN_CHARS for c in name)
+
 
 def _response(
     status: int,
@@ -105,15 +126,22 @@ def _error_response(status: int, reason: str, retry_after: str | None = None) ->
     )
 
 
+# upstream replay budget: a request the engine answers by closing the
+# connection gets this many fresh-connection retries before a 502 — without
+# the cap a poisoned request connect/close-loops until the deadline reaper
+_MAX_REPLAYS = 2
+
+
 class _Job:
     """One spliced request in an upstream FIFO."""
 
-    __slots__ = ("down", "raw", "streaming")
+    __slots__ = ("down", "raw", "streaming", "replays")
 
     def __init__(self, down: "_DownConn", raw: bytes, streaming: bool):
         self.down: "_DownConn | None" = down  # None once abandoned/failed
         self.raw: bytes = raw  # retained until its response starts (replay)
         self.streaming = streaming
+        self.replays = 0  # connection-loss replays consumed so far
 
 
 # ---------------------------------------------------------------------------
@@ -385,12 +413,22 @@ class _UpConn(WriteCoalescer, asyncio.Protocol):
                 )
             head_active = False
         # everything else was never answered: replay (predictions are
-        # idempotent; feedback never rides the splice)
+        # idempotent; feedback never rides the splice) — but only within
+        # the replay budget: an engine that consistently closes on this
+        # request would otherwise connect/close-loop to the deadline reaper
         for job in jobs:
             if job.down is None:
                 continue
-            if job.raw:
+            if job.raw and job.replays < _MAX_REPLAYS:
+                job.replays += 1
                 self.pool.spawn_send(job)
+            elif job.raw:
+                job.down.upstream_failed(
+                    f"engine closed the connection {job.replays + 1} times "
+                    "without responding",
+                    forwarded=False,
+                    status=502,
+                )
             else:
                 job.down.upstream_failed(f"engine connection lost: {exc}", forwarded=False)
 
@@ -461,7 +499,16 @@ class _UpstreamPool:
             if counted:
                 self._connecting -= 1
             if self.closed:
+                # pool evicted while this connect was in flight (deployment
+                # removed/updated): the job must fail NOW with a prompt 503,
+                # not hang silently until the 504 reaper
                 conn.close()
+                if job.down is not None:
+                    job.down.upstream_failed("deployment removed", forwarded=False)
+                while self.pending:
+                    p = self.pending.popleft()
+                    if p.down is not None:
+                        p.down.upstream_failed("deployment removed", forwarded=False)
                 return
             if job.streaming:
                 conn.streaming = True
@@ -513,6 +560,10 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
         self.rec = None
         self.forwarded = False  # response bytes already written downstream
         self.close_after = False
+        # wire accounting for the in-flight spliced request (obs/wire.py):
+        # request head+body bytes, and response bytes as they forward
+        self._req_bytes = 0
+        self._resp_bytes = 0
         # (trace_id, span_id, parent_id, sampled, epoch_start) of the
         # in-flight spliced request; trace id echoed on the response head
         self._trace: tuple | None = None
@@ -735,6 +786,8 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                 self.gateway.stream_timeout_s if streaming else self.gateway.timeout_s
             )
             self.deadline = self.frontend.loop.time() + timeout
+            self._req_bytes = len(raw)
+            self._resp_bytes = 0
             job = _Job(self, raw, streaming)
             self.job = job
             self.frontend.pool_for(rec).submit(job)
@@ -763,7 +816,17 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
         needs_rewrite = version != b"HTTP/1.1"
         kept_lines = []
         for line in head[line_end + 2 : -4].split(b"\r\n"):
-            name, _, value = line.partition(b":")
+            if not line:
+                continue  # zero-header request: the slice is one empty string
+            name, sep, value = line.partition(b":")
+            # strict field-name grammar (RFC 7230 §3.2): no colon at all,
+            # obs-fold continuations (leading SP/HTAB), and names with
+            # whitespace/control/non-token bytes are smuggling vectors on
+            # the shared spliced upstream — reject the request outright
+            if not sep or not _is_token(name):
+                self.write(_error_response(400, "bad header field name"))
+                self._close()
+                return None
             name = name.lower()
             if name in _HOP_BY_HOP:
                 needs_rewrite = True
@@ -819,6 +882,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
 
     def forward(self, data: bytes) -> None:
         self.forwarded = True
+        self._resp_bytes += len(data)
         self.write(data)
 
     def forward_head(self, head: bytes) -> None:
@@ -828,14 +892,22 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
         echo = self.echo_trace_id
         if echo:
             head = head[:-2] + TRACE_RESPONSE_HEADER.encode() + b": " + echo + b"\r\n\r\n"
+        self._resp_bytes += len(head)
         self.write(head)
 
     def _finish_trace(self, status: int, dt: float) -> None:
-        """Record the relay stage + root span for one spliced request
-        (span assembled by hand: the splice lives in protocol callbacks,
-        not in one task's contextvar scope)."""
+        """Record the relay stage, wire bytes, and root span for one
+        spliced request (span assembled by hand: the splice lives in
+        protocol callbacks, not in one task's contextvar scope)."""
         rec = self.frontend.recorder
         rec.record_stage(STAGE_GATEWAY_RELAY, dt)
+        self.frontend.wire_for(self.rec).record(
+            bytes_in=self._req_bytes,
+            bytes_out=self._resp_bytes,
+            duration_s=dt,
+        )
+        self._req_bytes = 0
+        self._resp_bytes = 0
         tr, self._trace = self._trace, None
         self.echo_trace_id = None
         if tr is None:
@@ -869,17 +941,17 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
         )
         self._next()
 
-    def upstream_failed(self, reason: str, forwarded: bool) -> None:
+    def upstream_failed(self, reason: str, forwarded: bool, status: int = 503) -> None:
         self.job = None
         self._release_qos()
         rec = self.rec
         dt = time.perf_counter() - self.t0
-        self._finish_trace(503, dt)
+        self._finish_trace(status, dt)
         self.frontend.observe(
             rec.oauth_key if rec else "anonymous",
             rec.name if rec else "unknown",
             self.service,
-            503,
+            status,
             dt,
         )
         if self.transport is None or self.transport.is_closing():
@@ -889,7 +961,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
             # cut the connection so the client sees a broken response
             self._close()
             return
-        self.write(_error_response(503, reason))
+        self.write(_error_response(status, reason))
         self._next()
 
     def _next(self) -> None:
@@ -957,6 +1029,7 @@ class H1SpliceFrontend:
         self._pools: dict[str, _UpstreamPool] = {}
         self.req_head_cache: dict[bytes, tuple] = {}  # request-head parse memo
         self._metric_children: dict[tuple, object] = {}
+        self._wire_children: dict[str, object] = {}  # per-deployment counters
         self._reap_handle: asyncio.TimerHandle | None = None
         self.bound_port = 0
         gateway.store.add_listener(self._on_deployment_event)
@@ -975,6 +1048,16 @@ class H1SpliceFrontend:
             self._pools[rec.oauth_key] = pool
         return pool
 
+    def wire_for(self, rec) -> "object":
+        """Per-deployment wire byte counter for the splice path (cached —
+        the WIRE registry lock must stay off the per-request path)."""
+        name = rec.name if rec is not None else "unknown"
+        counter = self._wire_children.get(name)
+        if counter is None:
+            counter = WIRE.counter(WIRE_GATEWAY_H1, name)
+            self._wire_children[name] = counter
+        return counter
+
     def observe(self, principal: str, name: str, service: str, code: int, dt: float) -> None:
         key = (principal, name, service, code)
         child = self._metric_children.get(key)
@@ -991,6 +1074,7 @@ class H1SpliceFrontend:
     async def start(self, port: int, host: str | None = None) -> int:
         self.loop = asyncio.get_running_loop()
         configure_exporters_from_env()
+        LOOP_LAG.start("gateway")
         if host is None:
             sock = _dual_stack_socket(port, reuse_port=False)
             self._server = await self.loop.create_server(
@@ -1116,6 +1200,8 @@ class H1SpliceFrontend:
             return 200, json.dumps({"stages": self.recorder.breakdown()}).encode(), b"application/json"
         if route == b"/stats/qos":
             return 200, json.dumps({"qos": gw.qos_snapshot()}).encode(), b"application/json"
+        if route == b"/stats/wire":
+            return 200, json.dumps(wire_stats_payload()).encode(), b"application/json"
         return 404, json.dumps(
             failure_status_dict(404, f"no route {route.decode('latin-1')}")
         ).encode(), b"application/json"

@@ -47,6 +47,7 @@ STAGE_NODE = "node"
 STAGE_QUEUE_WAIT = "queue-wait"
 STAGE_BATCH_ASSEMBLY = "batch-assembly"
 STAGE_DEVICE_STEP = "device-step"
+STAGE_DEVICE_DISPATCH = "device-dispatch"
 STAGE_STREAM_FLUSH = "stream-flush"
 STAGE_TTFT = "ttft"
 
@@ -57,6 +58,7 @@ STAGES = (
     STAGE_QUEUE_WAIT,
     STAGE_BATCH_ASSEMBLY,
     STAGE_DEVICE_STEP,
+    STAGE_DEVICE_DISPATCH,
     STAGE_STREAM_FLUSH,
     STAGE_TTFT,
 )

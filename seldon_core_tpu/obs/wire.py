@@ -1,0 +1,172 @@
+"""Wire-throughput accounting: per-deployment, per-stage byte counters.
+
+Round 5's headline collapsed 4.5x with `bench.py` byte-identical and
+nothing in the repo could attribute the swing — the spans plane says WHERE
+latency went, but not whether a stage was bandwidth-bound.  This module is
+the missing layer: every transport edge records request/response bytes and
+(where the transfer is timed) an achieved-MB/s EWMA, so "the tunnel
+degraded" and "the framework regressed" become distinguishable live.
+
+Edges (the ``stage`` vocabulary, one :class:`WireCounter` per
+``(stage, deployment)``):
+
+    gateway-h1      h1 splice front end (gateway/h1gateway.py)
+    gateway-rest    aiohttp gateway front end (gateway/app.py ingress_core)
+    gateway-grpc    raw-bytes gRPC relay (gateway/grpc_gateway.py)
+    engine-rest     engine aiohttp ingress (engine/app.py middleware)
+    engine-grpc     engine Seldon gRPC service (engine/grpc_app.py)
+    engine-node     engine -> remote graph unit hops (engine/transport.py)
+
+Everything is O(1) per transfer (int adds + one deque append) and bounded
+by construction — the same discipline as the span recorder.  Served by
+``GET /stats/wire`` on the engine and both gateway REST front ends, and
+exported as ``seldon_wire_*`` Prometheus metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+# the wire-accounting stage vocabulary (docs/OBSERVABILITY.md)
+WIRE_GATEWAY_H1 = "gateway-h1"
+WIRE_GATEWAY_REST = "gateway-rest"
+WIRE_GATEWAY_GRPC = "gateway-grpc"
+WIRE_ENGINE_REST = "engine-rest"
+WIRE_ENGINE_GRPC = "engine-grpc"
+WIRE_ENGINE_NODE = "engine-node"
+
+WIRE_STAGES = (
+    WIRE_GATEWAY_H1,
+    WIRE_GATEWAY_REST,
+    WIRE_GATEWAY_GRPC,
+    WIRE_ENGINE_REST,
+    WIRE_ENGINE_GRPC,
+    WIRE_ENGINE_NODE,
+)
+
+_EWMA_ALPHA = 0.2
+_WINDOW_S = 10.0  # achieved-rate window for the live MB/s view
+
+
+def sig4(x: float | None) -> float | None:
+    """Round to 4 significant digits — never collapses a nonzero metric to
+    0.0 (the `llm_mfu 0.0` failure mode VERDICT weak-finding 7 calls out)."""
+    if x is None:
+        return None
+    return float(f"{x:.4g}")
+
+
+class WireCounter:
+    """Byte accounting for one (stage, deployment) transport edge."""
+
+    __slots__ = (
+        "stage", "name", "requests", "bytes_in", "bytes_out",
+        "_events", "_ewma_mb_s", "_m_in", "_m_out", "_m_reqs", "_m_mb_s",
+    )
+
+    def __init__(self, stage: str, name: str):
+        self.stage = stage
+        self.name = name
+        self.requests = 0
+        self.bytes_in = 0  # bytes RECEIVED on this edge (request direction)
+        self.bytes_out = 0  # bytes SENT on this edge (response direction)
+        # (monotonic_ts, total_bytes) ring for the windowed live rate
+        self._events: deque[tuple[float, int]] = deque(maxlen=8192)
+        self._ewma_mb_s: float | None = None
+        from seldon_core_tpu.utils.metrics import DEFAULT
+
+        self._m_in = DEFAULT.wire_bytes.labels(stage, name, "in")
+        self._m_out = DEFAULT.wire_bytes.labels(stage, name, "out")
+        self._m_reqs = DEFAULT.wire_requests.labels(stage, name)
+        self._m_mb_s = DEFAULT.wire_mb_s.labels(stage, name)
+
+    def record(
+        self, bytes_in: int = 0, bytes_out: int = 0,
+        duration_s: float | None = None,
+    ) -> None:
+        """One transfer.  ``duration_s`` (when the edge times the transfer)
+        feeds the per-transfer MB/s EWMA; the windowed rate needs only the
+        timestamp.  Never raises, never blocks."""
+        self.requests += 1
+        self.bytes_in += bytes_in
+        self.bytes_out += bytes_out
+        total = bytes_in + bytes_out
+        self._events.append((time.monotonic(), total))
+        if bytes_in:
+            self._m_in.inc(bytes_in)
+        if bytes_out:
+            self._m_out.inc(bytes_out)
+        self._m_reqs.inc()
+        if duration_s and duration_s > 0 and total:
+            inst = total / duration_s / 1e6
+            self._ewma_mb_s = (
+                inst
+                if self._ewma_mb_s is None
+                else _EWMA_ALPHA * inst + (1.0 - _EWMA_ALPHA) * self._ewma_mb_s
+            )
+            self._m_mb_s.set(self._ewma_mb_s)
+
+    def window_mb_s(self, window_s: float = _WINDOW_S) -> float:
+        """Achieved MB/s over the trailing window (wall-clock rate: total
+        bytes moved / window — the live "is this edge bandwidth-bound"
+        number)."""
+        cutoff = time.monotonic() - window_s
+        total = 0
+        for ts, n in reversed(self._events):
+            if ts < cutoff:
+                break
+            total += n
+        return total / window_s / 1e6
+
+    def snapshot(self) -> dict:
+        return {
+            "requests": self.requests,
+            "bytes_in": self.bytes_in,
+            "bytes_out": self.bytes_out,
+            # per-transfer achieved rate (bytes moved / transfer duration)
+            "ewma_mb_s": sig4(self._ewma_mb_s),
+            # wall-clock achieved rate over the last window
+            "window_mb_s": sig4(self.window_mb_s()),
+        }
+
+
+class WireRecorder:
+    """Process-wide registry of :class:`WireCounter`s (mirrors
+    ``obs.RECORDER``).  ``counter()`` is called once per edge at steady
+    state (the child is cached by the caller) but is safe per-request."""
+
+    def __init__(self):
+        self._counters: dict[tuple[str, str], WireCounter] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, stage: str, name: str = "") -> WireCounter:
+        key = (stage, name)
+        c = self._counters.get(key)
+        if c is None:
+            with self._lock:
+                c = self._counters.get(key)
+                if c is None:
+                    c = WireCounter(stage, name)
+                    self._counters[key] = c
+        return c
+
+    def snapshot(self) -> dict:
+        """The ``GET /stats/wire`` payload body: per-stage, per-deployment
+        counters plus per-stage totals."""
+        stages: dict[str, dict] = {}
+        for (stage, name), c in list(self._counters.items()):
+            stages.setdefault(stage, {})[name or "_"] = c.snapshot()
+        totals = {}
+        for stage, by_name in stages.items():
+            totals[stage] = {
+                "requests": sum(v["requests"] for v in by_name.values()),
+                "bytes_in": sum(v["bytes_in"] for v in by_name.values()),
+                "bytes_out": sum(v["bytes_out"] for v in by_name.values()),
+            }
+        return {"stages": stages, "totals": totals}
+
+
+# default process-wide wire recorder (mirrors obs.RECORDER)
+WIRE = WireRecorder()

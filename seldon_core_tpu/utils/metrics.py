@@ -175,6 +175,63 @@ class MetricsRegistry:
             ["name"],
             registry=self.registry,
         )
+        # Wire-throughput accounting (fed by obs/wire.py WireCounters on
+        # every transport edge; docs/OBSERVABILITY.md "wire accounting")
+        self.wire_bytes = Counter(
+            "seldon_wire_bytes",
+            "Bytes moved per transport edge (server edges: in=request, "
+            "out=response; client edges: out=request sent, in=reply)",
+            ["stage", "name", "direction"],
+            registry=self.registry,
+        )
+        self.wire_requests = Counter(
+            "seldon_wire_requests",
+            "Transfers per transport edge",
+            ["stage", "name"],
+            registry=self.registry,
+        )
+        self.wire_mb_s = Gauge(
+            "seldon_wire_mb_per_s",
+            "Achieved wire MB/s EWMA per transport edge (per-transfer "
+            "bytes/duration where the edge times the transfer)",
+            ["stage", "name"],
+            registry=self.registry,
+        )
+        # Always-on perf probes (obs/probes.py)
+        self.eventloop_lag = Gauge(
+            "seldon_eventloop_lag_seconds",
+            "Serving event-loop lag EWMA (scheduled-vs-actual callback "
+            "delta; a saturated loop shows here first)",
+            ["service"],
+            registry=self.registry,
+        )
+        self.host_syncs = Counter(
+            "seldon_executor_host_syncs",
+            "Host<->device synchronization points (result materializations) "
+            "— divide by device steps for syncs/step",
+            ["model_name"],
+            registry=self.registry,
+        )
+        self.device_frac = Gauge(
+            "seldon_executor_step_device_frac",
+            "Fraction of the last device step spent waiting on the device "
+            "(fetch) vs host-side dispatch work",
+            ["model_name"],
+            registry=self.registry,
+        )
+        self.obs_spans = Gauge(
+            "seldon_obs_spans",
+            "Span recorder counters (state: recorded / ring / sampled_out)",
+            ["state"],
+            registry=self.registry,
+        )
+        self.obs_export = Gauge(
+            "seldon_obs_span_export",
+            "Span exporter totals across configured exporters "
+            "(result: exported / dropped)",
+            ["result"],
+            registry=self.registry,
+        )
 
     @contextmanager
     def time_server_request(

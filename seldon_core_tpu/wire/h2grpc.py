@@ -527,6 +527,15 @@ class _ServerConn(_Conn):
     def _on_closed(self, exc: Exception | None) -> None:
         for t in self._tasks:
             t.cancel()
+        # a dead downstream connection must cancel in-flight inline relays
+        # upstream too (an RST does this per-stream; full connection loss
+        # would otherwise leave the engine computing to the channel reaper)
+        cancels, self.relay_cancels = self.relay_cancels, {}
+        for cancel in cancels.values():
+            try:
+                cancel()
+            except Exception:
+                log.exception("relay cancel failed on connection loss")
         self._streams.clear()
         self._stream_tasks.clear()
         if self._conns is not None:

@@ -287,3 +287,144 @@ class TestChunkedResponseSplice:
         status, body = run(go())
         assert status == 200
         assert body.count(b"data: tok") == 3
+
+
+class TestUpstreamReplayCap:
+    def test_engine_that_always_closes_yields_502(self):
+        """ADVICE finding 3: an engine that answers by closing the
+        connection must exhaust the replay budget (2) and fail the client
+        with 502 — not connect/close-loop until the deadline reaper."""
+
+        async def go():
+            connects = []
+
+            async def handle(reader, writer):
+                connects.append(1)
+                await reader.read(64)  # the request reached the engine
+                writer.close()
+
+            server = await asyncio.start_server(handle, "127.0.0.1", 0)
+            eport = server.sockets[0].getsockname()[1]
+            frontend, gw, port = await _frontend(eport)
+            async with aiohttp.ClientSession() as s:
+                tok = await _token(s, port)
+                r = await s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0]]}},
+                    headers={"Authorization": f"Bearer {tok}"},
+                )
+                status = r.status
+                body = await r.json()
+            await frontend.stop()
+            server.close()
+            await server.wait_closed()
+            return status, body, len(connects)
+
+        status, body, connects = run(go())
+        assert status == 502
+        assert body["status"]["code"] == 502
+        # initial attempt + exactly 2 replays
+        assert connects == 3
+
+
+class TestEvictedPoolFailsFast:
+    def test_spawn_send_on_closed_pool_fails_job_promptly(self):
+        """ADVICE finding 4: a connect that lands after the pool was
+        evicted (deployment removed) must fail the downstream with a
+        prompt 503, not silently drop the job until the 504 reaper."""
+        from seldon_core_tpu.gateway.h1gateway import _Job, _UpstreamPool
+
+        async def go():
+            async def handle(reader, writer):
+                await asyncio.sleep(5)
+
+            server = await asyncio.start_server(handle, "127.0.0.1", 0)
+            eport = server.sockets[0].getsockname()[1]
+            fails = []
+
+            class Down:
+                def upstream_failed(self, reason, forwarded, status=503):
+                    fails.append((reason, forwarded, status))
+
+            pool = _UpstreamPool("127.0.0.1", eport, asyncio.get_running_loop())
+            pool.closed = True  # evicted while the job was being dispatched
+            job = _Job(Down(), b"POST /x HTTP/1.1\r\ncontent-length: 0\r\n\r\n", False)
+            pending = _Job(Down(), b"POST /y HTTP/1.1\r\ncontent-length: 0\r\n\r\n", False)
+            pool.pending.append(pending)
+            pool.spawn_send(job)
+            for _ in range(100):
+                if len(fails) >= 2:
+                    break
+                await asyncio.sleep(0.02)
+            server.close()
+            await server.wait_closed()
+            return fails
+
+        fails = run(go())
+        assert len(fails) == 2, f"job+pending must both fail promptly: {fails}"
+        for reason, forwarded, _status in fails:
+            assert reason == "deployment removed" and forwarded is False
+
+
+class TestHeaderFieldNameStrictness:
+    """ADVICE finding 1: the raw head splices onto a SHARED pipelined
+    engine connection — header names that are not RFC 7230 tokens (and
+    obs-fold continuations) are smuggling vectors and must be 400'd."""
+
+    async def _raw_request(self, port: int, head_and_body: bytes) -> bytes:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(head_and_body)
+        await writer.drain()
+        data = await asyncio.wait_for(reader.read(4096), timeout=5)
+        writer.close()
+        return data
+
+    def test_whitespace_before_colon_rejected(self):
+        async def go():
+            engine = await _engine_client()
+            frontend, gw, port = await _frontend(engine.server.port)
+            resp = await self._raw_request(
+                port,
+                b"POST /api/v0.1/predictions HTTP/1.1\r\n"
+                b"Content-Length : 2\r\n\r\n{}",
+            )
+            await frontend.stop()
+            await engine.close()
+            return resp
+
+        resp = run(go())
+        assert resp.startswith(b"HTTP/1.1 400"), resp[:64]
+
+    def test_obs_fold_continuation_rejected(self):
+        async def go():
+            engine = await _engine_client()
+            frontend, gw, port = await _frontend(engine.server.port)
+            resp = await self._raw_request(
+                port,
+                b"POST /api/v0.1/predictions HTTP/1.1\r\n"
+                b"x-first: a\r\n"
+                b" folded-continuation\r\n"
+                b"content-length: 2\r\n\r\n{}",
+            )
+            await frontend.stop()
+            await engine.close()
+            return resp
+
+        resp = run(go())
+        assert resp.startswith(b"HTTP/1.1 400"), resp[:64]
+
+    def test_control_chars_in_name_rejected(self):
+        async def go():
+            engine = await _engine_client()
+            frontend, gw, port = await _frontend(engine.server.port)
+            resp = await self._raw_request(
+                port,
+                b"POST /api/v0.1/predictions HTTP/1.1\r\n"
+                b"x\x01bad: a\r\ncontent-length: 2\r\n\r\n{}",
+            )
+            await frontend.stop()
+            await engine.close()
+            return resp
+
+        resp = run(go())
+        assert resp.startswith(b"HTTP/1.1 400"), resp[:64]

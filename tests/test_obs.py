@@ -1,9 +1,11 @@
 """Observability layer tests: W3C trace-context generation/propagation
 (gateway -> engine REST and gRPC hops, walker fan-out contextvar
-inheritance), the span recorder + flight recorder, bounded exporters, and
-the obs-check acceptance gate (`make obs-check`): gateway -> engine ->
-2-node graph -> batcher yields one trace with >= 4 spans and a breakdown
-whose stages account for the measured wall time."""
+inheritance), the span recorder + flight recorder, bounded exporters,
+the perf-attribution plane (wire byte counters on every transport edge,
+`/stats/wire`, the jax profiler start/stop lifecycle, event-loop lag +
+export drop gauges), and the obs-check acceptance gate (`make obs-check`):
+gateway -> engine -> 2-node graph -> batcher yields one trace with >= 4
+spans and a breakdown whose stages account for the measured wall time."""
 
 import asyncio
 import json
@@ -493,6 +495,247 @@ class TestExporters:
         for s in self._spans(3):
             exp.offer(s)  # no running loop: must not raise
         assert exp.dropped == 3
+
+
+class TestWireAccounting:
+    """The perf-attribution plane's byte counters: every transport edge
+    must account request/response bytes that match the payloads actually
+    sent (the attribution BENCH_r05's 4.5x collapse lacked)."""
+
+    def test_h1_splice_counts_request_and_response_bytes(self):
+        from seldon_core_tpu.obs import WIRE, WIRE_GATEWAY_H1
+
+        async def go():
+            engine_client = await _engine_client()
+            frontend, gw, port = await _frontend(engine_client.server.port)
+            counter = WIRE.counter(WIRE_GATEWAY_H1, "dep")
+            base = (counter.requests, counter.bytes_in, counter.bytes_out)
+            body = json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0]]}}).encode()
+            resp_sizes = []
+            async with aiohttp.ClientSession() as s:
+                tok = await _token(s, port)
+                for _ in range(3):
+                    r = await s.post(
+                        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                        data=body,
+                        headers={
+                            "Authorization": f"Bearer {tok}",
+                            "Content-Type": "application/json",
+                        },
+                    )
+                    assert r.status == 200
+                    resp_sizes.append(len(await r.read()))
+            await frontend.stop()
+            await engine_client.close()
+            return counter, base, body, resp_sizes
+
+        counter, base, body, resp_sizes = run(go())
+        d_reqs = counter.requests - base[0]
+        d_in = counter.bytes_in - base[1]
+        d_out = counter.bytes_out - base[2]
+        assert d_reqs == 3
+        # bytes_in is the spliced head+body: at least the 3 bodies, at most
+        # bodies plus a sane head allowance
+        assert 3 * len(body) <= d_in <= 3 * (len(body) + 2048)
+        # bytes_out covers the engine's heads+bodies the client received
+        assert d_out >= sum(resp_sizes)
+
+    def test_aiohttp_gateway_counts_exact_payload_bytes(self):
+        from seldon_core_tpu.obs import WIRE, WIRE_GATEWAY_REST
+
+        async def go():
+            async def pred(req):
+                return web.json_response({"data": {"ndarray": [[1.0]]}})
+
+            eng = web.Application()
+            eng.router.add_post("/api/v0.1/predictions", pred)
+            eng_server = TestServer(eng)
+            await eng_server.start_server()
+            store = DeploymentStore()
+            store.put(DeploymentRecord(
+                name="wiredep", oauth_key="k", oauth_secret="s",
+                engine_host="127.0.0.1", engine_rest_port=eng_server.port,
+            ))
+            gw = GatewayApp(store, metrics=MetricsRegistry())
+            client = TestClient(TestServer(gw.build()))
+            await client.start_server()
+            counter = WIRE.counter(WIRE_GATEWAY_REST, "wiredep")
+            base = (counter.requests, counter.bytes_in, counter.bytes_out)
+            body = json.dumps({"data": {"ndarray": [[1.0, 2.0]]}}).encode()
+            try:
+                r = await client.post(
+                    "/oauth/token", data={"client_id": "k", "client_secret": "s"}
+                )
+                tok = (await r.json())["access_token"]
+                replies = []
+                for _ in range(2):
+                    r = await client.post(
+                        "/api/v0.1/predictions", data=body,
+                        headers={"Authorization": f"Bearer {tok}",
+                                 "Content-Type": "application/json"},
+                    )
+                    assert r.status == 200
+                    replies.append(len(await r.read()))
+            finally:
+                await client.close()
+                await eng_server.close()
+            return counter, base, body, replies
+
+        counter, base, body, replies = run(go())
+        # the aiohttp front forwards the raw body verbatim and returns the
+        # engine reply verbatim: the counters must match EXACTLY
+        assert counter.requests - base[0] == 2
+        assert counter.bytes_in - base[1] == 2 * len(body)
+        assert counter.bytes_out - base[2] == sum(replies)
+
+    def test_grpc_relay_counts_framed_bytes(self):
+        from seldon_core_tpu.gateway.grpc_gateway import FastGatewayGrpc
+        from seldon_core_tpu.obs import WIRE, WIRE_GATEWAY_GRPC
+
+        reply_body = b"\x00\x00\x00\x00\x05hello"
+
+        class FakeChannel:
+            def try_call_framed(self, path, framed, done, timeout=None, metadata=()):
+                done(0, "", reply_body)
+                return lambda: None
+
+            async def close(self):
+                pass
+
+        class FakeConn:
+            def __init__(self):
+                self.relay_cancels: dict = {}
+                self.responses: list = []
+
+            def write_unary_response(self, stream_id, body):
+                self.responses.append((stream_id, body))
+
+        async def go():
+            store = DeploymentStore()
+            store.put(DeploymentRecord(
+                name="grpcdep", oauth_key="k", oauth_secret="s",
+                engine_host="127.0.0.1", engine_rest_port=1,
+            ))
+            gw = GatewayApp(store, metrics=MetricsRegistry())
+            handler = FastGatewayGrpc(gw)
+            handler._channels["k"] = FakeChannel()
+            tok, _ = gw.tokens.issue("k")
+            relay = handler.make_relay("Predict")
+            conn = FakeConn()
+            counter = WIRE.counter(WIRE_GATEWAY_GRPC, "grpcdep")
+            base = (counter.requests, counter.bytes_in, counter.bytes_out)
+            framed = b"\x00\x00\x00\x00\x03abc"
+            relay(conn, 1, [(b"oauth_token", tok.encode())], framed)
+            await handler.close()
+            return counter, base, framed, conn
+
+        counter, base, framed, conn = run(go())
+        assert conn.responses, "relay did not answer"
+        assert counter.requests - base[0] == 1
+        assert counter.bytes_in - base[1] == len(framed)
+        assert counter.bytes_out - base[2] == len(reply_body)
+
+    def test_stats_wire_shape_on_engine_and_both_gateway_fronts(self):
+        """GET /stats/wire serves the same payload shape everywhere: wire
+        stage/deployment counters + loop-lag probe + host-sync counts."""
+
+        async def go():
+            stub = BatchedStub()
+            engine_client = await _engine_client(
+                TWO_NODE, components={"root": IdentityRoot(), "batched": stub}
+            )
+            frontend, gw, port = await _frontend(engine_client.server.port)
+            # aiohttp gateway front end (same GatewayApp core, own server)
+            aio_client = TestClient(TestServer(gw.build()))
+            await aio_client.start_server()
+            async with aiohttp.ClientSession() as s:
+                tok = await _token(s, port)
+                r = await s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json={"data": {"ndarray": [[1.0, 2.0]]}},
+                    headers={"Authorization": f"Bearer {tok}"},
+                )
+                assert r.status == 200
+                h1 = await (await s.get(f"http://127.0.0.1:{port}/stats/wire")).json()
+            eng = await (await engine_client.get("/stats/wire")).json()
+            aio = await (await aio_client.get("/stats/wire")).json()
+            await aio_client.close()
+            await frontend.stop()
+            await engine_client.close()
+            return h1, eng, aio
+
+        h1, eng, aio = run(go())
+        for payload in (h1, eng, aio):
+            assert set(payload) >= {"wire", "loop_lag", "host_syncs"}
+            assert "stages" in payload["wire"] and "totals" in payload["wire"]
+            assert "interval_s" in payload["loop_lag"]
+        # the h1 splice edge accounted the request we just sent
+        h1_edge = h1["wire"]["stages"].get("gateway-h1", {}).get("dep")
+        assert h1_edge and h1_edge["requests"] >= 1 and h1_edge["bytes_in"] > 0
+        # the engine's REST middleware accounted its ingress
+        assert "engine-rest" in eng["wire"]["stages"]
+        # the batcher's fetch recorded a host sync for the stub queue
+        assert eng["host_syncs"].get("stub", 0) >= 1
+
+
+class TestProfilerLifecycle:
+    def test_profile_start_stop_and_conflict(self, tmp_path):
+        """POST /profile/start drives jax.profiler into a capture dir
+        (created up front); a second start is a 409; stop tears down and a
+        second stop is a 409."""
+        import os
+
+        target = str(tmp_path / "capture" / "run1")
+
+        async def go():
+            client = await _engine_client()
+            try:
+                r1 = await client.post("/profile/start", json={"dir": target})
+                b1 = await r1.json()
+                exists_during = os.path.isdir(target)
+                r2 = await client.post("/profile/start", json={"dir": target})
+                r3 = await client.post("/profile/stop")
+                b3 = await r3.json()
+                r4 = await client.post("/profile/stop")
+            finally:
+                await client.close()
+            return r1.status, b1, exists_during, r2.status, r3.status, b3, r4.status
+
+        s1, b1, exists_during, s2, s3, b3, s4 = run(go())
+        assert s1 == 200 and b1["status"] == "profiling" and b1["dir"] == target
+        assert exists_during, "capture dir must exist while the trace runs"
+        assert s2 == 409, "second start must conflict"
+        assert s3 == 200 and b3["dir"] == target
+        assert s4 == 409, "stop without a running trace must conflict"
+        # the capture actually wrote a trace under the dir
+        captured = []
+        for root, _dirs, files in os.walk(target):
+            captured.extend(files)
+        assert captured, "jax.profiler produced no trace files"
+
+
+class TestAlwaysOnProbes:
+    def test_eventloop_lag_and_drop_gauges_in_prometheus(self):
+        """The always-on counters are scrapeable: event-loop lag gauge
+        (ticking), span ring/export gauges (pull-time set_function)."""
+        from seldon_core_tpu.obs import LOOP_LAG
+
+        async def go():
+            client = await _engine_client()
+            # let the lag probe tick at least once (interval 0.25s)
+            await asyncio.sleep(0.35)
+            prom = (await (await client.get("/prometheus")).text())
+            wire = await (await client.get("/stats/wire")).json()
+            await client.close()
+            return prom, wire
+
+        prom, wire = run(go())
+        assert "seldon_eventloop_lag_seconds" in prom
+        assert "seldon_obs_spans" in prom
+        assert "seldon_obs_span_export" in prom
+        assert "seldon_wire_bytes" in prom
+        assert LOOP_LAG.samples >= 1
+        assert wire["loop_lag"]["samples"] >= 1
 
 
 class TestErrorCodeAudit:

@@ -757,3 +757,29 @@ class TestServerStreaming:
             assert conn._send_queue == []
 
         run(go())
+
+
+class TestServerConnLossCancelsRelays:
+    def test_on_closed_pops_and_invokes_relay_cancels(self):
+        """ADVICE finding 5: a dead downstream gRPC connection must cancel
+        in-flight inline relays upstream — full connection loss gets the
+        same treatment a per-stream RST already had."""
+        from seldon_core_tpu.wire.h2grpc import _ServerConn
+
+        async def go():
+            conn = _ServerConn({})
+            called = []
+            conn.relay_cancels[1] = lambda: called.append(1)
+            conn.relay_cancels[3] = lambda: called.append(3)
+
+            def boom():
+                called.append(5)
+                raise RuntimeError("cancel blew up")
+
+            conn.relay_cancels[5] = boom
+            conn._on_closed(ConnectionError("client went away"))
+            return conn, called
+
+        conn, called = asyncio.run(go())
+        assert sorted(called) == [1, 3, 5], "every relay cancel must run"
+        assert conn.relay_cancels == {}, "cancels must be popped, not re-run"
