@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -37,6 +37,15 @@ profile-check:
 		-k "WireAccounting or ProfilerLifecycle or AlwaysOnProbes"
 	JAX_PLATFORMS=cpu BENCH_ONLY=loopback BENCH_SECONDS=1 BENCH_RUNS=2 \
 		BENCH_LOOPBACK_ROWS=32 $(PYTHON) bench.py
+
+# caching & reuse plane gate (docs/CACHING.md): cache/collapse/prefix unit
+# + integration tests (zero-device-step hits, pinned-equal prefix reuse,
+# spec-hash invalidation), then a CPU smoke of the bench cache stage
+# (device-free stub graph: hit-rate sweep + collapsed herd)
+cache-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_cache.py -q
+	JAX_PLATFORMS=cpu BENCH_ONLY=cache BENCH_SECONDS=2 \
+		BENCH_CACHE_GRAPH=stub BENCH_CACHE_LLM=0 $(PYTHON) bench.py
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

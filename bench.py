@@ -20,6 +20,8 @@ Stages (each skippable via env; ``BENCH_ONLY=name`` runs one stage):
   bert                 BENCH_SKIP_BERT   BERT-base bf16, seq 128, wire
   llm                  BENCH_SKIP_LLM    llama-tiny generative over the wire
   loopback             BENCH_SKIP_LOOPBACK  big-payload localhost control
+  cache                BENCH_SKIP_CACHE  hit-rate sweep + collapsed herd +
+                                         KV prefix-reuse prefill comparison
 
 Credibility discipline (round-5 postmortem — the headline swung 4.5x with
 this file byte-identical and nothing could attribute it):
@@ -664,6 +666,172 @@ def stage_loopback(detail: dict) -> None:
     }
 
 
+def _stats_cache(port: int) -> dict:
+    """Caching-plane snapshot (GET /stats/cache): per-tier hit rates,
+    single-flight collapse counters, KV prefix-reuse index."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats/cache", timeout=5
+        ) as r:
+            return json.loads(r.read()).get("cache", {})
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def stage_cache(detail: dict) -> None:
+    """Caching & reuse plane (docs/CACHING.md): hot/cold hit-rate sweep
+    (exact-repeat traffic vs all-unique traffic against the same cached
+    engine), a collapsed thundering herd (TTL 0 forces every repeat to
+    collapse onto the in-flight leader instead of hitting), and the
+    shared-system-prompt LLM prefill comparison (KV prefix reuse on/off).
+    ``BENCH_CACHE_GRAPH=stub`` swaps in the device-free stub graph (CPU
+    smoke / make cache-check); ``BENCH_CACHE_LLM=0`` skips the LLM leg."""
+    from seldon_core_tpu.testing.loadtest import run_load
+
+    secs = min(SECONDS, 6.0)
+    conc = int(os.environ.get("BENCH_CACHE_CONCURRENCY", "32"))
+    if os.environ.get("BENCH_CACHE_GRAPH") == "stub":
+        # device-free but NOT inline-sync: the combiner hops to the thread
+        # pool, so concurrent identical requests actually overlap and the
+        # single-flight collapse window exists (a pure SIMPLE_MODEL graph
+        # completes atomically per event-loop turn and can never collapse)
+        child = lambda n: {  # noqa: E731
+            "name": n, "type": "MODEL", "implementation": "SIMPLE_MODEL",
+        }
+        graph = {
+            "name": "avg", "type": "COMBINER",
+            "implementation": "AVERAGE_COMBINER",
+            "children": [child("stub-a"), child("stub-b")],
+        }
+        hot = [json.dumps({"data": {"ndarray": [[1.0, 2.0, 3.0]]}}).encode()]
+        cold = [
+            json.dumps({"data": {"ndarray": [[float(i), 2.0, 3.0]]}}).encode()
+            for i in range(512)
+        ]
+    else:
+        rows = int(os.environ.get("BENCH_CACHE_ROWS", "64"))
+        graph = {
+            "name": "mlp", "type": "MODEL", "implementation": "JAX_MODEL",
+            "parameters": [
+                {"name": "family", "value": "mlp", "type": "STRING"},
+                {"name": "dtype", "value": "bfloat16", "type": "STRING"},
+                {"name": "buckets", "value": "64,256", "type": "STRING"},
+                {"name": "max_batch", "value": "256", "type": "INT"},
+                {"name": "max_delay_ms", "value": "3.0", "type": "FLOAT"},
+            ],
+        }
+        import ml_dtypes
+
+        def body(seed: int) -> bytes:
+            arr = np.random.default_rng(seed).normal(size=(rows, 784))
+            buf = arr.astype(ml_dtypes.bfloat16).view(np.uint16).tobytes()
+            return json.dumps(
+                {"rawTensor": {"shape": [rows, 784], "dtype": "bfloat16",
+                               "data": base64.b64encode(buf).decode()}}
+            ).encode()
+
+        hot = [body(0)]
+        cold = [body(i) for i in range(128)]
+    # hot vs cold sweep: same engine, caching on — the acceptance gate is
+    # hit p50 >= 10x under miss p50 with ZERO device steps on hits
+    with engine(graph, 18896, 18897, extra_env={"SCT_CACHE": "1"}):
+        url = "http://127.0.0.1:18896/api/v0.1/predictions"
+        r_cold = run_load(url, cold, concurrency=conc, duration_s=secs)
+        r_hot = run_load(url, hot, concurrency=conc, duration_s=secs)
+        sweep_stats = _stats_cache(18896)
+        sweep_wire = _stats_wire(18896)
+    hit_speedup = (
+        _sig(r_cold.percentile_ms(50) / r_hot.percentile_ms(50))
+        if r_hot.percentile_ms(50) > 0
+        else None
+    )
+    detail["cache_sweep"] = {
+        "cold": r_cold.summary(),
+        "hot": r_hot.summary(),
+        "hit_speedup_p50": hit_speedup,
+        "stats_cache": sweep_stats,
+        "host_syncs": (sweep_wire or {}).get("host_syncs"),
+        "note": "cold cycles 128+ unique payloads (all misses); hot repeats "
+                "ONE payload (hits after the first): the p50 ratio is the "
+                "cache's latency win, host_syncs stays flat through the hot "
+                "run (zero device steps on hits)",
+    }
+    # collapsed herd: TTL 0 means a repeat can never HIT, only collapse
+    # onto the identical in-flight leader -> N concurrent = 1 upstream
+    with engine(
+        graph, 18898, 18899,
+        extra_env={"SCT_CACHE": "1", "SCT_CACHE_TTL_S": "0"},
+    ):
+        r_herd = run_load(
+            "http://127.0.0.1:18898/api/v0.1/predictions", hot,
+            concurrency=conc, duration_s=secs,
+        )
+        herd_stats = _stats_cache(18898)
+    collapse = (herd_stats or {}).get("collapse", {})
+    detail["cache_collapse"] = {
+        **r_herd.summary(),
+        "leaders": collapse.get("leaders"),
+        "collapsed": collapse.get("collapsed"),
+        "collapse_ratio": (
+            _sig(collapse["collapsed"] / max(1, r_herd.requests))
+            if isinstance(collapse.get("collapsed"), int) and r_herd.requests
+            else None
+        ),
+        "stats_cache": herd_stats,
+    }
+    if os.environ.get("BENCH_CACHE_LLM") == "0":
+        return
+    # shared-system-prompt LLM prefill: the same 160-token system prefix
+    # ahead of unique 2-token suffixes, KV prefix reuse off vs on — reuse
+    # prefills only the suffix, so prefill device time collapses while
+    # outputs stay bit-identical (tests/test_cache.py pinned-equal)
+    prefix = [(7 + i) % 250 + 1 for i in range(160)]
+    llm_bodies = [
+        json.dumps({"strData": json.dumps(
+            {"tokens": prefix + [(11 + i) % 250 + 1, (29 + i) % 250 + 1]}
+        )}).encode()
+        for i in range(64)
+    ]
+
+    def llm_graph(reuse: bool) -> dict:
+        return {
+            "name": "gen", "type": "MODEL", "implementation": "JAX_GENERATIVE",
+            "parameters": [
+                {"name": "family", "value": "llama", "type": "STRING"},
+                {"name": "preset", "value": "tiny", "type": "STRING"},
+                {"name": "n_slots", "value": "4", "type": "INT"},
+                {"name": "max_new_tokens", "value": "4", "type": "INT"},
+                {"name": "decode_block", "value": "4", "type": "INT"},
+                {"name": "max_seq", "value": "256", "type": "INT"},
+                {"name": "kv_prefix_reuse",
+                 "value": "true" if reuse else "false", "type": "BOOL"},
+            ],
+        }
+
+    llm = {}
+    for label, reuse in (("off", False), ("on", True)):
+        with engine(llm_graph(reuse), 18900, 18901, extra_env={"SCT_CACHE": "1"}):
+            r = run_load(
+                "http://127.0.0.1:18900/api/v0.1/predictions", llm_bodies,
+                concurrency=4, duration_s=secs,
+            )
+            snap = _stats_cache(18900)
+        llm[label] = {**r.summary(), "stats_cache": snap}
+    p_off = llm["off"].get("p50_ms") or 0
+    p_on = llm["on"].get("p50_ms") or 0
+    prefix_snap = (llm["on"].get("stats_cache") or {}).get("prefix") or {}
+    first_model = next(iter(prefix_snap.values()), {})
+    detail["cache_prefix"] = {
+        "off": llm["off"],
+        "on": llm["on"],
+        "p50_speedup": _sig(p_off / p_on) if p_on else None,
+        "tokens_reused": first_model.get("tokens_reused"),
+        "prefills_reused": first_model.get("prefills_reused"),
+        "model": "llama-tiny, 160-token shared system prompt + unique "
+                 "2-token suffixes, 4 new tokens",
+    }
+
+
 def stage_ab(detail: dict) -> None:
     """Epsilon-greedy A/B graph across two models — BASELINE config #3's
     bandit routing shape, served in-process (router + 2 JAX units)."""
@@ -900,6 +1068,7 @@ def main() -> None:
         ("AB", "BENCH_SKIP_AB", stage_ab),
         ("GATEWAY", "BENCH_SKIP_GATEWAY", stage_gateway),
         ("OVERLOAD", "BENCH_SKIP_OVERLOAD", stage_overload),
+        ("CACHE", "BENCH_SKIP_CACHE", stage_cache),
     ]
     only = os.environ.get("BENCH_ONLY", "").upper()
     for name, skip_env, fn in stages:
@@ -961,6 +1130,11 @@ _STAGE_HEADLINES = (
     ("gateway_grpc", "rps", "gateway_grpc_rps"),
     ("overload_qos_on", "hit_rate", "overload_hit_rate_on"),
     ("overload_qos_off", "hit_rate", "overload_hit_rate_off"),
+    ("cache_sweep", "hit_speedup_p50", "cache_hit_speedup_p50"),
+    ("cache_collapse", "collapse_ratio", "cache_collapse_ratio"),
+    ("cache_collapse", "rps", "cache_herd_rps"),
+    ("cache_prefix", "p50_speedup", "cache_prefix_speedup_p50"),
+    ("cache_prefix", "tokens_reused", "cache_prefix_tokens_reused"),
 )
 
 

@@ -1,0 +1,34 @@
+"""Caching & reuse plane (docs/CACHING.md).
+
+Three tiers threaded through gateway -> engine -> walker -> generation:
+
+* **content-addressed response cache** (:mod:`cache.content`): SHA-256
+  over (route, deployment spec-hash, canonical payload) -> response
+  bytes; LRU + TTL + byte bound; flushed per deployment when the
+  gateway's CR watch sees a spec change (and unhittable across updates
+  anyway — the spec-hash is IN the key);
+* **request collapsing** (:mod:`cache.singleflight`): concurrent
+  identical in-flight requests share one upstream computation;
+* **KV prefix reuse** (:mod:`cache.prefix`): token-prefix radix index
+  over the paged KV pool — shared-prefix prompts skip prefill for the
+  blocks a previous request already produced.
+
+Cache hits are served BEFORE QoS admission (they consume no admission
+slot, no queue position, no deadline budget) and record ``cache.hit`` /
+``cache.miss`` span events plus ``seldon_cache_*`` metrics.
+"""
+
+from __future__ import annotations
+
+from seldon_core_tpu.cache.content import (  # noqa: F401
+    ResponseCache,
+    cache_deployments,
+    cache_enabled,
+    canonical_body,
+    payload_cache_key,
+    request_key,
+    response_cache_from_env,
+    spec_hash,
+)
+from seldon_core_tpu.cache.prefix import PrefixIndex  # noqa: F401
+from seldon_core_tpu.cache.singleflight import SingleFlight  # noqa: F401

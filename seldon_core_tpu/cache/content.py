@@ -1,0 +1,270 @@
+"""Content-addressed response cache: LRU + TTL + byte bound.
+
+The cache key is a SHA-256 over (route, deployment spec-hash, canonical
+request payload) — content addressing makes "is this the same request"
+exact, and folding the spec-hash into the key makes a rolling update
+UNHITTABLE by construction even before the invalidation listener flushes
+the old entries (docs/CACHING.md "two-layer invalidation").
+
+Entries are namespaced per deployment so a deployment event can flush
+exactly that deployment's entries.  Everything is O(1) per op under one
+lock (store events fire on operator/poller threads, serving on the event
+loop), and memory is bounded by BOTH an entry count and a byte budget —
+a burst of huge responses evicts oldest, never grows.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any
+
+from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
+
+# -- keying ------------------------------------------------------------------
+
+
+def spec_hash(spec: Any) -> str:
+    """Deterministic short hash of a deployment/predictor spec (dict or
+    pydantic model).  Any observable spec change — image, graph shape,
+    parameters, ports — changes the hash, which changes every cache key
+    derived from it."""
+    if hasattr(spec, "model_dump"):
+        spec = spec.model_dump(mode="json")
+    blob = json.dumps(spec, sort_keys=True, separators=(",", ":"), default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def request_key(route: str, spec_hash_: str, body: bytes) -> str:
+    """Content address of one request: route + spec-hash + payload bytes."""
+    h = hashlib.sha256()
+    h.update(route.encode())
+    h.update(b"\x00")
+    h.update(spec_hash_.encode())
+    h.update(b"\x00")
+    h.update(body)
+    return h.hexdigest()
+
+
+def canonical_body(body: Any) -> bytes:
+    """Canonical JSON bytes of a parsed request body: key ordering and
+    whitespace differences must not defeat content addressing where the
+    body is already parsed (engine ingress)."""
+    return json.dumps(body, sort_keys=True, separators=(",", ":")).encode()
+
+
+def payload_cache_key(p: Any) -> str | None:
+    """Content address of a graph Payload (walker node tier): array bytes +
+    shape + dtype + names for numeric kinds, raw data for string/bytes
+    kinds.  None when the payload carries nothing hashable."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    kind = getattr(p, "kind", None)
+    if kind is not None:
+        h.update(str(kind).encode())
+        h.update(b"\x00")
+    data = getattr(p, "data", None)
+    if isinstance(data, np.ndarray):
+        arr = np.ascontiguousarray(data)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    elif isinstance(data, (bytes, bytearray)):
+        h.update(bytes(data))
+    elif isinstance(data, str):
+        h.update(data.encode())
+    else:
+        return None
+    for n in getattr(p, "names", []) or []:
+        h.update(b"\x00")
+        h.update(str(n).encode())
+    return h.hexdigest()
+
+
+# -- the cache ---------------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = ("value", "nbytes", "expires", "status")
+
+    def __init__(self, value: Any, nbytes: int, expires: float, status: int):
+        self.value = value
+        self.nbytes = nbytes
+        self.expires = expires
+        self.status = status
+
+
+class ResponseCache:
+    """Namespaced LRU with TTL and a byte budget.
+
+    ``tier`` labels the metrics ("gateway" / "engine" / "node"); the
+    namespace is the deployment (or node) the entry belongs to, so
+    :meth:`flush` can drop one deployment's entries on a spec change
+    without touching its neighbours.
+    """
+
+    def __init__(
+        self,
+        tier: str,
+        max_entries: int = 4096,
+        max_bytes: int = 64 * 1024 * 1024,
+        ttl_s: float = 60.0,
+    ):
+        self.tier = tier
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple[str, str], _Entry]" = OrderedDict()
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.expirations = 0
+        self.flushes = 0
+
+    # metrics children are cached per namespace: the registry lock must
+    # stay off the per-request path
+    def _m(self, metric, *labels):
+        try:
+            return metric.labels(self.tier, *labels)
+        except Exception:  # metrics must never fail a request
+            return None
+
+    def get(self, namespace: str, key: str) -> _Entry | None:
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get((namespace, key))
+            if entry is None:
+                self.misses += 1
+                m = self._m(DEFAULT_METRICS.cache_misses, namespace)
+                if m is not None:
+                    m.inc()
+                return None
+            if now >= entry.expires:
+                del self._entries[(namespace, key)]
+                self.bytes -= entry.nbytes
+                self.expirations += 1
+                self.misses += 1
+                m = self._m(DEFAULT_METRICS.cache_misses, namespace)
+                if m is not None:
+                    m.inc()
+                return None
+            self._entries.move_to_end((namespace, key))
+            self.hits += 1
+            m = self._m(DEFAULT_METRICS.cache_hits, namespace)
+            if m is not None:
+                m.inc()
+            return entry
+
+    def put(
+        self,
+        namespace: str,
+        key: str,
+        value: Any,
+        nbytes: int | None = None,
+        status: int = 200,
+    ) -> None:
+        if nbytes is None:
+            nbytes = len(value) if isinstance(value, (bytes, bytearray)) else 0
+        if nbytes > self.max_bytes:
+            return  # a response bigger than the whole budget is uncacheable
+        entry = _Entry(value, int(nbytes), time.monotonic() + self.ttl_s, status)
+        with self._lock:
+            old = self._entries.pop((namespace, key), None)
+            if old is not None:
+                self.bytes -= old.nbytes
+            self._entries[(namespace, key)] = entry
+            self.bytes += entry.nbytes
+            while self._entries and (
+                len(self._entries) > self.max_entries or self.bytes > self.max_bytes
+            ):
+                _, evicted = self._entries.popitem(last=False)
+                self.bytes -= evicted.nbytes
+                self.evictions += 1
+            self._set_gauges()
+
+    def flush(self, namespace: str | None = None) -> int:
+        """Drop one namespace's entries (spec-hash change / deployment
+        removal), or everything when ``namespace`` is None."""
+        with self._lock:
+            if namespace is None:
+                n = len(self._entries)
+                self._entries.clear()
+                self.bytes = 0
+            else:
+                doomed = [k for k in self._entries if k[0] == namespace]
+                n = len(doomed)
+                for k in doomed:
+                    self.bytes -= self._entries.pop(k).nbytes
+            if n:
+                self.flushes += 1
+            self._set_gauges()
+            return n
+
+    def _set_gauges(self) -> None:
+        try:
+            DEFAULT_METRICS.cache_entries.labels(self.tier).set(len(self._entries))
+            DEFAULT_METRICS.cache_bytes.labels(self.tier).set(self.bytes)
+        except Exception:
+            pass
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "tier": self.tier,
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+                "ttl_s": self.ttl_s,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else None,
+                "evictions": self.evictions,
+                "expirations": self.expirations,
+                "flushes": self.flushes,
+            }
+
+
+# -- env config --------------------------------------------------------------
+
+
+def cache_enabled(environ: dict | None = None) -> bool:
+    env = environ if environ is not None else os.environ
+    return env.get("SCT_CACHE", "0") == "1"
+
+
+def cache_deployments(environ: dict | None = None) -> frozenset[str] | None:
+    """SCT_CACHE_DEPLOYMENTS: comma-separated deployment names the cache
+    applies to; unset/empty = every deployment (the SCT_CACHE master
+    switch is the opt-in)."""
+    env = environ if environ is not None else os.environ
+    raw = env.get("SCT_CACHE_DEPLOYMENTS", "").strip()
+    if not raw:
+        return None
+    return frozenset(s.strip() for s in raw.split(",") if s.strip())
+
+
+def response_cache_from_env(
+    tier: str, environ: dict | None = None
+) -> ResponseCache | None:
+    """A configured ResponseCache, or None when the plane is off
+    (``SCT_CACHE`` unset).  Knobs: ``SCT_CACHE_TTL_S`` (default 60),
+    ``SCT_CACHE_MAX_BYTES`` (default 64MiB), ``SCT_CACHE_MAX_ENTRIES``
+    (default 4096)."""
+    env = environ if environ is not None else os.environ
+    if not cache_enabled(env):
+        return None
+    return ResponseCache(
+        tier,
+        max_entries=int(env.get("SCT_CACHE_MAX_ENTRIES", "4096")),
+        max_bytes=int(env.get("SCT_CACHE_MAX_BYTES", str(64 * 1024 * 1024))),
+        ttl_s=float(env.get("SCT_CACHE_TTL_S", "60")),
+    )

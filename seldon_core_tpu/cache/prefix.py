@@ -1,0 +1,201 @@
+"""KV prefix reuse index: token-id prefixes -> paged KV blocks.
+
+RadixAttention-style sharing (SGLang) on top of the repo's paged KV pool
+(executor/generation.py): prompts that share a prefix — the shared system
+prompt of a chat deployment, few-shot preambles — reuse the KV blocks the
+prefix already produced instead of re-prefilling them, so prefill device
+time scales with the NOVEL suffix only.
+
+Design constraints that keep it exact:
+
+* only FULL blocks are shared (prefix length rounded down to the block
+  size): K/V at position ``i`` depends causally on tokens ``<= i`` alone,
+  so a full block of identical leading tokens has bit-identical K/V no
+  matter what follows — sharing it cannot change any output;
+* shared blocks are IMMUTABLE by construction: decode writes at
+  positions >= the full prompt length, which always land in the slot's
+  own (non-shared) blocks, so "copy-on-write on divergence" degenerates
+  to "diverging requests simply never share the diverging block";
+* entries are ref-counted while a slot uses them and LRU-evicted only at
+  zero refs, deepest-extension-first so a chain never orphans its tail.
+
+The index is host-side state on the scheduler (coordinator) process; the
+physical block ids it hands out ride the same driven-step payloads the
+multihost follower loop already replays, so the tp-sharded cache layout
+needs no new collective.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class _PrefixEntry:
+    __slots__ = ("block", "refs", "tick", "depth")
+
+    def __init__(self, block: int, tick: int, depth: int):
+        self.block = int(block)
+        self.refs = 0
+        self.tick = tick
+        self.depth = depth  # chain level (1-based block count)
+
+
+class PrefixIndex:
+    """token-prefix chain -> physical KV block ids, ref-counted.
+
+    Keys are the raw bytes of ``tokens[:k * block_size]`` for each chain
+    level ``k`` — a flattened radix trie: the longest match is the largest
+    ``k`` whose key is present (levels are only ever inserted bottom-up
+    and evicted top-down, so presence of level ``k`` implies 1..k-1)."""
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self._entries: dict[bytes, _PrefixEntry] = {}
+        self._lock = threading.Lock()
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.inserted = 0
+        self.evicted = 0
+        self.tokens_reused = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def blocks_held(self) -> int:
+        """Blocks owned by the index (evictable when refs drop to 0)."""
+        return len(self._entries)
+
+    def _key(self, tokens: np.ndarray, k: int) -> bytes:
+        return np.ascontiguousarray(tokens[: k * self.block_size], np.int32).tobytes()
+
+    # -- lookup --------------------------------------------------------------
+
+    def match(self, tokens: np.ndarray, max_blocks: int) -> list[int]:
+        """Longest chain of full prefix blocks for ``tokens`` (capped at
+        ``max_blocks``); ref-counts every matched entry.  Pair each call
+        with exactly one :meth:`release` for the same tokens/length."""
+        tokens = np.asarray(tokens, np.int32).ravel()
+        blocks: list[int] = []
+        with self._lock:
+            self._tick += 1
+            for k in range(1, max_blocks + 1):
+                e = self._entries.get(self._key(tokens, k))
+                if e is None:
+                    break
+                blocks.append(e.block)
+            for k in range(1, len(blocks) + 1):
+                e = self._entries[self._key(tokens, k)]
+                e.refs += 1
+                e.tick = self._tick
+            if blocks:
+                self.hits += 1
+                self.tokens_reused += len(blocks) * self.block_size
+            else:
+                self.misses += 1
+        return blocks
+
+    def release(self, tokens: np.ndarray, n_blocks: int) -> None:
+        """Drop the refs :meth:`match` took (entries stay, evictable)."""
+        tokens = np.asarray(tokens, np.int32).ravel()
+        with self._lock:
+            for k in range(1, n_blocks + 1):
+                e = self._entries.get(self._key(tokens, k))
+                if e is not None and e.refs > 0:
+                    e.refs -= 1
+
+    # -- insertion -----------------------------------------------------------
+
+    def insert(
+        self, tokens: np.ndarray, blocks: list[int], start_level: int
+    ) -> list[int]:
+        """Register chain levels ``start_level+1 .. start_level+len(blocks)``
+        (0-based ``start_level`` = blocks already in the index) with the
+        given physical blocks.  Returns the blocks the index did NOT absorb
+        (level already present from a concurrent identical prompt) — the
+        caller returns those duplicates to the free pool."""
+        tokens = np.asarray(tokens, np.int32).ravel()
+        rejected: list[int] = []
+        with self._lock:
+            self._tick += 1
+            level = start_level
+            for block in blocks:
+                level += 1
+                key = self._key(tokens, level)
+                if key in self._entries:
+                    rejected.append(int(block))
+                    continue
+                # a gap below this level (concurrent eviction) would orphan
+                # the entry — only chain onto a present parent
+                if level > 1 and self._key(tokens, level - 1) not in self._entries:
+                    rejected.append(int(block))
+                    continue
+                self._entries[key] = _PrefixEntry(block, self._tick, level)
+                self.inserted += 1
+        return rejected
+
+    # -- eviction ------------------------------------------------------------
+
+    def evict(self, need: int) -> list[int]:
+        """Free up to ``need`` blocks from zero-ref entries, oldest chain
+        first.  Evicting an entry also evicts every entry that EXTENDS it
+        (extensions of a zero-ref entry are provably zero-ref themselves:
+        a slot holding level k holds refs on 1..k), so a chain never
+        orphans its tail."""
+        freed: list[int] = []
+        with self._lock:
+            if need <= 0 or not self._entries:
+                return freed
+            candidates = sorted(
+                (
+                    (e.tick, -e.depth, key)
+                    for key, e in self._entries.items()
+                    if e.refs == 0
+                ),
+            )
+            doomed: set[bytes] = set()
+            for _tick, _negdepth, key in candidates:
+                if len(freed) >= need:
+                    break
+                if key in doomed:
+                    continue
+                exts = [
+                    k for k in self._entries if k != key and k.startswith(key)
+                ]
+                for k in (*exts, key):
+                    if k in doomed:
+                        continue
+                    doomed.add(k)
+                    freed.append(self._entries[k].block)
+            for k in doomed:
+                del self._entries[k]
+            self.evicted += len(doomed)
+        return freed
+
+    def flush(self) -> list[int]:
+        """Drop every ZERO-REF entry (model reset / manual flush); returns
+        the freed blocks.  Referenced entries stay — their slots still
+        read them."""
+        with self._lock:
+            doomed = [k for k, e in self._entries.items() if e.refs == 0]
+            freed = [self._entries.pop(k).block for k in doomed]
+            self.evicted += len(doomed)
+            return freed
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "block_size": self.block_size,
+                "entries": len(self._entries),
+                "referenced": sum(1 for e in self._entries.values() if e.refs),
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else None,
+                "inserted": self.inserted,
+                "evicted": self.evicted,
+                "tokens_reused": self.tokens_reused,
+            }

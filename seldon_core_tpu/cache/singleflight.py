@@ -1,0 +1,86 @@
+"""Request collapsing: concurrent identical in-flight requests share ONE
+upstream computation.
+
+A thundering herd of N identical requests (cache cold, or a popular key
+just expired) would otherwise cost N device steps; with single-flight the
+first request is the *leader* and computes, the other N-1 are *followers*
+that await the leader's future and fan the result out — one device step
+total.  This is the in-flight complement of the response cache: the cache
+answers "we computed this recently", single-flight answers "we are
+computing this right now".
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable
+
+
+class SingleFlight:
+    """Per-event-loop collapser.  ``do(key, fn)`` runs ``fn`` once per key
+    at a time; concurrent callers with the same key share the result.
+
+    Failure semantics: the leader's exception propagates to every
+    follower (they were going to hit the same failing upstream).  A
+    CANCELLED leader (client disconnect) also fails its followers — they
+    are expected to retry; promoting a follower mid-flight would re-enter
+    upstream admission from a context that already released its budget.
+    """
+
+    def __init__(self):
+        self._inflight: dict[Any, asyncio.Future] = {}
+        self.leaders = 0
+        self.collapsed = 0
+        self.collapsed_errors = 0
+
+    @property
+    def inflight(self) -> int:
+        return len(self._inflight)
+
+    async def do(self, key: Any, fn: Callable[[], Awaitable[Any]]) -> Any:
+        existing = self._inflight.get(key)
+        if existing is not None:
+            self.collapsed += 1
+            try:
+                # shield: one follower's disconnect must not cancel the
+                # shared computation out from under the others
+                return await asyncio.shield(existing)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.collapsed_errors += 1
+                raise
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        # consume the exception even when no follower ever awaited it —
+        # an unretrieved future exception warns at GC time
+        fut.add_done_callback(
+            lambda f: f.exception() if not f.cancelled() else None
+        )
+        self._inflight[key] = fut
+        self.leaders += 1
+        try:
+            result = await fn()
+        except BaseException as e:
+            if not fut.done():
+                if isinstance(e, asyncio.CancelledError):
+                    fut.set_exception(
+                        asyncio.CancelledError("single-flight leader cancelled")
+                    )
+                else:
+                    fut.set_exception(e)
+            raise
+        else:
+            if not fut.done():
+                fut.set_result(result)
+            return result
+        finally:
+            self._inflight.pop(key, None)
+
+    def snapshot(self) -> dict:
+        return {
+            "inflight": len(self._inflight),
+            "leaders": self.leaders,
+            "collapsed": self.collapsed,
+            "collapsed_errors": self.collapsed_errors,
+        }

@@ -84,6 +84,11 @@ class EngineApp:
         self._warmup_error: BaseException | None = None
         self._warmup_task: asyncio.Task | None = None
         self._profile_dir: str | None = None
+        # ingress-tier response cache: bound at startup, and ONLY when the
+        # whole graph is deterministic (a randomized router poisons
+        # whole-response cacheability; node-tier caching still applies to
+        # its deterministic MODEL children)
+        self._resp_cache = None
 
     def build(self) -> web.Application:
         # wire-throughput accounting on the whole REST surface: request
@@ -142,6 +147,8 @@ class EngineApp:
         r.add_get("/stats/qos", self.stats_qos)
         # wire-throughput accounting + always-on perf probes
         r.add_get("/stats/wire", self.stats_wire)
+        # caching & reuse plane state (docs/CACHING.md)
+        r.add_get("/stats/cache", self.stats_cache)
         # XLA/device profiling (SURVEY §5: the reference had only JMX):
         # POST /profile/start {"dir": "/tmp/sct-profile"} ... /profile/stop
         # then open the trace in TensorBoard / xprof
@@ -155,6 +162,8 @@ class EngineApp:
         configure_exporters_from_env()
         LOOP_LAG.start("engine")
         await self.service.start()
+        if self.service.response_cache is not None and self.service.graph_deterministic():
+            self._resp_cache = self.service.response_cache
         if self.mesh_worker:
             # worker host of a multi-host slice: the same units (and hence
             # the same registered SPMD step fns) were just built; execute the
@@ -247,21 +256,58 @@ class EngineApp:
             # the client's trace, or overload debugging goes dark exactly
             # when it matters
             set_traceparent(request.headers.get("traceparent"))
+            # cache lookup BEFORE admission (docs/CACHING.md): an exact
+            # repeat of a deterministic graph's request is served from the
+            # content-addressed cache with zero device steps, consuming no
+            # admission slot, no queue position, and no deadline budget
+            body = None
+            cache_key = None
+            if self._resp_cache is not None:
+                from seldon_core_tpu.cache import canonical_body, request_key
+
+                try:
+                    body = await self._json(request)
+                except CodecError as e:
+                    h["code"] = "400"
+                    return web.json_response(_status_body(400, str(e)), status=400)
+                cache_key = request_key(
+                    "predictions", self.service.spec_hash, canonical_body(body)
+                )
+                entry = self._resp_cache.get(dep, cache_key)
+                if entry is not None:
+                    with RECORDER.span("engine.cache", service=dep) as sp:
+                        if sp is not None:
+                            sp.event("cache.hit", tier="engine")
+                    return web.Response(
+                        body=entry.value,
+                        content_type="application/json",
+                        headers={"x-sct-cache": "hit"},
+                    )
             try:
                 ticket = self._admit(request)
             except qos.QosRejection as e:
                 h["code"] = str(e.status)
                 return self._qos_reject(e)
             try:
-                body = await self._json(request)
-                payload = payload_from_dict(body)
+                if body is None:
+                    body = await self._json(request)
                 # opt-in per-node wall timings (meta.tags.sct_trace_ms) —
                 # request-scoped tracing the reference only had as logs
                 trace = request.headers.get("X-Seldon-Trace", "") == "1"
-                out = await self.service.predict(payload, trace=trace)
-                resp = payload_to_dict(out)
-                resp["status"] = {"code": 200, "status": "SUCCESS"}
-                return web.json_response(resp)
+                if cache_key is not None:
+                    # single-flight: a thundering herd of identical
+                    # requests (cache cold) costs ONE graph walk; the
+                    # followers fan the leader's bytes out
+                    raw = await self.service.collapse.do(
+                        cache_key,
+                        lambda: self._predict_json_bytes(body, trace),
+                    )
+                    self._resp_cache.put(dep, cache_key, raw)
+                    return web.Response(
+                        body=raw, content_type="application/json"
+                    )
+                raw = await self._predict_json_bytes(body, trace)
+                return web.Response(body=raw, content_type="application/json")
             except qos.QosRejection as e:
                 # shed below admission: bounded queue overflow (429) or a
                 # deadline that expired in a queue (504 — answered without
@@ -289,6 +335,17 @@ class EngineApp:
                 # handler when the client drops, the batching layers skip
                 # the cancelled future, and the admission slot frees here
                 ticket.release()
+
+    async def _predict_json_bytes(self, body: dict, trace: bool) -> bytes:
+        """One graph walk -> the response's JSON bytes (the unit the
+        response cache stores and the collapser shares)."""
+        import json
+
+        payload = payload_from_dict(body)
+        out = await self.service.predict(payload, trace=trace)
+        resp = payload_to_dict(out)
+        resp["status"] = {"code": 200, "status": "SUCCESS"}
+        return json.dumps(resp).encode()
 
     async def predictions_stream(self, request: web.Request) -> web.StreamResponse:
         """Server-sent-events token streaming for a generative graph.
@@ -486,6 +543,11 @@ class EngineApp:
         """Wire-throughput accounting (per-edge bytes + achieved MB/s) and
         the always-on probes: event-loop lag, host syncs per model."""
         return web.json_response(wire_stats_payload())
+
+    async def stats_cache(self, request: web.Request) -> web.Response:
+        """Caching & reuse plane state: response/node cache hit rates,
+        single-flight collapse counters, KV prefix-reuse index."""
+        return web.json_response({"cache": self.service.cache_snapshot()})
 
     async def profile_start(self, request: web.Request) -> web.Response:
         import jax

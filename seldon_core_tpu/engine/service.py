@@ -75,6 +75,26 @@ class PredictionService:
         self._components = components or {}
         self.walker: GraphWalker | None = None
         self.warmup_report: dict[str, int] | None = None
+        # caching & reuse plane (docs/CACHING.md): the predictor spec-hash
+        # is folded into every cache key, so a redeployed spec can never
+        # serve another spec's entries; the node/engine caches + the
+        # single-flight collapser exist only when SCT_CACHE opts in and
+        # SCT_CACHE_DEPLOYMENTS (if set) names this deployment
+        from seldon_core_tpu.cache import (
+            SingleFlight,
+            cache_deployments,
+            response_cache_from_env,
+            spec_hash,
+        )
+
+        self.spec_hash = spec_hash(predictor)
+        allowed = cache_deployments()
+        cache_on = allowed is None or self.deployment_name in allowed
+        self.node_cache = response_cache_from_env("node") if cache_on else None
+        self.response_cache = (
+            response_cache_from_env("engine") if cache_on else None
+        )
+        self.collapse = SingleFlight()
 
     async def start(self) -> None:
         await self.transports.start()
@@ -83,6 +103,7 @@ class PredictionService:
             components=self._components,
             client_factory=self.transports.client_factory,
             feedback_hook=self._on_feedback,
+            node_cache=self.node_cache,
         )
 
     def warmable_units(self) -> list[str]:
@@ -148,3 +169,32 @@ class PredictionService:
     async def send_feedback(self, fb: FeedbackPayload) -> None:
         assert self.walker is not None, "PredictionService.start() not called"
         await self.walker.send_feedback(fb)
+
+    def graph_deterministic(self) -> bool:
+        """Whole-graph determinism — the gate for ingress-level response
+        caching (walker.deterministic; requires start())."""
+        return self.walker is not None and self.walker.deterministic()
+
+    def cache_snapshot(self) -> dict:
+        """``GET /stats/cache`` payload: per-tier response caches, the
+        collapser, and each generative unit's prefix-reuse index."""
+        out: dict = {
+            "spec_hash": self.spec_hash,
+            "graph_deterministic": (
+                self.walker.deterministic() if self.walker is not None else None
+            ),
+            "collapse": self.collapse.snapshot(),
+        }
+        if self.response_cache is not None:
+            out["response"] = self.response_cache.snapshot()
+        if self.node_cache is not None:
+            out["node"] = self.node_cache.snapshot()
+        prefix = {}
+        if self.walker is not None:
+            for unit in self.generative_units():
+                snap = unit.model.prefix_snapshot()
+                if snap is not None:
+                    prefix[unit.model.name] = snap
+        if prefix:
+            out["prefix"] = prefix
+        return out

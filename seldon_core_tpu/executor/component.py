@@ -23,6 +23,10 @@ class JaxModelComponent(SeldonComponent):
     # without this opt-out the walker's annotation lock would serialize the
     # whole batching pipeline (see walker.make_annotation_lock)
     SAFE_ANNOTATIONS = True
+    # a compiled forward is a pure function of its input: same tokens/rows
+    # -> same scores, so the walker may serve exact repeats from the
+    # response cache without a device step (docs/CACHING.md)
+    DETERMINISTIC = True
 
     def __init__(
         self,

@@ -95,6 +95,7 @@ class GenerativeModel:
         driver: Any = None,
         kv_block_size: int = 16,
         kv_blocks: int | None = None,
+        prefix_reuse: bool | None = None,
     ):
         if family_mod is None:
             from seldon_core_tpu.models import llama as family_mod
@@ -175,6 +176,29 @@ class GenerativeModel:
             )
         self._free_blocks: list[int] = list(range(1, self.kv_blocks))
         self._slot_blocks: dict[int, list[int]] = {}
+        # KV prefix reuse (cache/prefix.py; docs/CACHING.md): a radix index
+        # over token-id prefixes -> ref-counted blocks in this pool, so
+        # prompts sharing a prefix (system prompts, few-shot preambles)
+        # prefill only their novel suffix.  Opt-in per deployment via the
+        # ``kv_prefix_reuse`` graph parameter or SCT_CACHE_PREFIX=1; needs
+        # the family to provide the suffix-prefill program.
+        if prefix_reuse is None:
+            prefix_reuse = os.environ.get("SCT_CACHE_PREFIX", "0") == "1"
+        if prefix_reuse and not hasattr(family_mod, "prefill_suffix_paged"):
+            log.warning(
+                "generative model %r: family %s has no prefill_suffix_paged; "
+                "KV prefix reuse disabled", name, family_mod,
+            )
+            prefix_reuse = False
+        self.prefix_index = None
+        if prefix_reuse:
+            from seldon_core_tpu.cache.prefix import PrefixIndex
+
+            self.prefix_index = PrefixIndex(kv_block_size)
+        # per-slot reuse bookkeeping: the prompt (for index insertion at
+        # release) and how many leading blocks were matched (shared refs)
+        self._slot_prompt: dict[int, np.ndarray] = {}
+        self._slot_matched: dict[int, int] = {}
 
         cache_dtype = dtype if dtype is not None else np.float32
         cache = family_mod.init_paged_cache(
@@ -268,9 +292,27 @@ class GenerativeModel:
 
             return fn
 
+        def _prefill_suffix(pw):
+            """Suffix-only prefill against a reused KV prefix (one compiled
+            program per (suffix bucket, prefix window))."""
+
+            def fn(params, tokens, prefix_len, length, slot, blocks_row,
+                   suffix_blocks, temperature, seed, cache):
+                logits, cache = fam.prefill_suffix_paged(
+                    params, tokens, prefix_len, length, slot, blocks_row,
+                    suffix_blocks, cache, cfg, prefix_window=pw,
+                )
+                key = jax.random.PRNGKey(seed)
+                tok = fam.sample_tokens(logits[None], temperature[None], key)[0]
+                return _replicate(tok), cache
+
+            return fn
+
         # cache buffers are donated: each step reuses the previous buffers
         # in place instead of holding two live copies of a multi-GB cache
         self._prefill = jax.jit(_prefill, donate_argnums=(7,))
+        self._prefill_suffix_factory = _prefill_suffix
+        self._prefill_suffix_jit: dict[tuple[int, int], Any] = {}
         self._decode_factory = _decode
         self._decode_jit: dict[int, Any] = {}  # window -> jitted step
         self._decode_k_factory = _decode_k
@@ -287,6 +329,9 @@ class GenerativeModel:
             # rides the payload so any block size stays in lockstep
             self._mh_prefill_key = self.driver.register_unique(
                 f"gen:{name}:prefill", self._exec_prefill
+            )
+            self._mh_prefill_suffix_key = self.driver.register_unique(
+                f"gen:{name}:prefill_suffix", self._exec_prefill_suffix
             )
             self._mh_decode_key = self.driver.register_unique(
                 f"gen:{name}:decode", self._exec_decode
@@ -305,6 +350,7 @@ class GenerativeModel:
         # observability
         self.steps = 0
         self.prefills = 0
+        self.prefills_reused = 0  # prefills that skipped a reused prefix
         # decode FLOPs ≈ 2·params per token (roofline's estimate) — feeds
         # the MFU gauge from measured step round trips
         self.flops_per_token = 2.0 * sum(
@@ -363,25 +409,85 @@ class GenerativeModel:
         prompt+generation will reach ``total_tokens``; returns the slot's
         zero-padded table row.  Raises :class:`OutOfKVBlocks` when the pool
         cannot cover it right now (the scheduler queues the request)."""
+        row, _ = self.reserve_for_prompt(slot, None, total_tokens)
+        return row
+
+    def reserve_for_prompt(
+        self, slot: int, prompt: "np.ndarray | None", total_tokens: int
+    ) -> tuple[np.ndarray, int]:
+        """Prompt-aware reservation: with prefix reuse enabled, the longest
+        chain of full prompt blocks already in the index is REFERENCED
+        (shared, immutable) instead of allocated, and only the remainder
+        comes from the free pool.  Returns ``(table row, prefix_len)`` —
+        ``prefix_len`` tokens of prefill are skipped by the caller."""
         total = min(int(total_tokens), self.cfg.max_seq)
         need = -(-total // self.kv_block_size)
         self.release_slot(slot)  # a stale reservation on this slot is dead
-        if len(self._free_blocks) < need:
-            raise OutOfKVBlocks(
-                f"need {need} KV blocks, {len(self._free_blocks)} free"
+        matched: list[int] = []
+        if self.prefix_index is not None and prompt is not None:
+            # never reuse the WHOLE prompt: the suffix program needs at
+            # least one real token to produce the first sampled logits
+            max_reuse = (int(prompt.size) - 1) // self.kv_block_size
+            if max_reuse > 0:
+                matched = self.prefix_index.match(prompt, min(max_reuse, need))
+        own_need = need - len(matched)
+        if len(self._free_blocks) < own_need and self.prefix_index is not None:
+            # reclaim unreferenced index blocks before failing admission
+            self._free_blocks.extend(
+                self.prefix_index.evict(own_need - len(self._free_blocks))
             )
-        got = self._free_blocks[-need:]
-        del self._free_blocks[-need:]
+        if len(self._free_blocks) < own_need:
+            if matched:
+                self.prefix_index.release(prompt, len(matched))
+            raise OutOfKVBlocks(
+                f"need {own_need} KV blocks, {len(self._free_blocks)} free"
+            )
+        got = self._free_blocks[-own_need:] if own_need else []
+        if own_need:
+            del self._free_blocks[-own_need:]
         self._slot_blocks[slot] = got
+        if self.prefix_index is not None and prompt is not None:
+            self._slot_prompt[slot] = np.asarray(prompt, np.int32).copy()
+            self._slot_matched[slot] = len(matched)
         row = np.zeros(self.max_blocks_per_slot, np.int32)
-        row[:need] = got
-        return row
+        row[: len(matched)] = matched
+        row[len(matched):need] = got
+        if matched:
+            DEFAULT_METRICS.prefix_tokens_reused.labels(self.name).inc(
+                len(matched) * self.kv_block_size
+            )
+        return row, len(matched) * self.kv_block_size
 
     def release_slot(self, slot: int) -> None:
-        """Return ``slot``'s reserved blocks to the pool (idempotent)."""
-        blocks = self._slot_blocks.pop(int(slot), None)
+        """Return ``slot``'s owned blocks to the pool and drop its shared-
+        prefix refs (idempotent).  With prefix reuse on, the completed
+        prompt's FULL blocks are absorbed into the index (zero-ref,
+        LRU-evictable) instead of freed, so the next shared-prefix prompt
+        finds them."""
+        slot = int(slot)
+        matched = self._slot_matched.pop(slot, 0)
+        prompt = self._slot_prompt.pop(slot, None)
+        blocks = self._slot_blocks.pop(slot, None)
+        if matched and prompt is not None and self.prefix_index is not None:
+            self.prefix_index.release(prompt, matched)
         if blocks:
+            if self.prefix_index is not None and prompt is not None:
+                # owned blocks are table positions [matched, need); the
+                # first (full_prompt_blocks - matched) of them hold ONLY
+                # complete prompt K/V -> shareable
+                full = int(prompt.size) // self.kv_block_size
+                insertable = blocks[: max(0, full - matched)]
+                if insertable:
+                    rejected = self.prefix_index.insert(
+                        prompt, insertable, matched
+                    )
+                    absorbed = set(insertable) - set(rejected)
+                    blocks = [b for b in blocks if b not in absorbed]
             self._free_blocks.extend(blocks)
+        if self.prefix_index is not None:
+            DEFAULT_METRICS.prefix_blocks.labels(self.name).set(
+                len(self.prefix_index)
+            )
 
     @property
     def free_block_count(self) -> int:
@@ -405,10 +511,40 @@ class GenerativeModel:
         L = prompt.shape[0]
         if L < 1:
             raise GraphUnitError("empty prompt")
+        blocks_row, prefix_len = self.reserve_for_prompt(
+            slot, prompt, L + max(0, int(reserve_tokens))
+        )
+        self._pos_ceiling[int(slot)] = L  # prefill wrote rows [0, L)
+        if prefix_len > 0:
+            # KV prefix reuse: prefill only the novel suffix; the reused
+            # blocks already hold K/V for [0, prefix_len)
+            suffix = prompt[prefix_len:]
+            bucket = self.fit_bucket(suffix.size)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : suffix.size] = suffix
+            bs = self.kv_block_size
+            pb = prefix_len // bs
+            lb = bucket // bs
+            suffix_blocks = np.zeros(lb, np.int32)
+            avail = blocks_row[pb : pb + lb]
+            suffix_blocks[: avail.size] = avail  # overflow pads -> sink 0
+            payload = {
+                "padded": padded,
+                "prefix_len": prefix_len,
+                "length": L,
+                "slot": int(slot),
+                "blocks": blocks_row,
+                "suffix_blocks": suffix_blocks,
+                "window": self._prefix_window(prefix_len),
+                "temperature": float(temperature),
+                "seed": int(seed),
+            }
+            if self.driver is not None:
+                return self.driver.lead(self._mh_prefill_suffix_key, payload)
+            return self._exec_prefill_suffix(payload)
         bucket = self.fit_bucket(L)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :L] = prompt
-        blocks_row = self.reserve_blocks(slot, L + max(0, int(reserve_tokens)))
         payload = {
             "padded": padded,
             "length": L,
@@ -417,10 +553,46 @@ class GenerativeModel:
             "temperature": float(temperature),
             "seed": int(seed),
         }
-        self._pos_ceiling[int(slot)] = L  # prefill wrote rows [0, L)
         if self.driver is not None:
             return self.driver.lead(self._mh_prefill_key, payload)
         return self._exec_prefill(payload)
+
+    def _prefix_window(self, prefix_len: int) -> int:
+        """Smallest power-of-two multiple of the block size covering
+        ``prefix_len`` (static per compiled suffix program), capped at
+        max_seq."""
+        w = self.kv_block_size
+        while w < prefix_len:
+            w *= 2
+        return min(w, self.cfg.max_seq)
+
+    def _exec_prefill_suffix(self, payload: dict):
+        """Symmetric suffix-prefill body (runs on every slice process)."""
+        bucket = int(payload["padded"].shape[1])
+        window = int(payload["window"])
+        key = (bucket, window)
+        fn = self._prefill_suffix_jit.get(key)
+        if fn is None:
+            fn = jax.jit(
+                self._prefill_suffix_factory(window), donate_argnums=(9,)
+            )
+            self._prefill_suffix_jit[key] = fn
+        with self._lock:
+            tok, self._cache = fn(
+                self.params,
+                payload["padded"],
+                np.int32(payload["prefix_len"]),
+                np.int32(payload["length"]),
+                np.int32(payload["slot"]),
+                np.asarray(payload["blocks"], np.int32),
+                np.asarray(payload["suffix_blocks"], np.int32),
+                np.float32(payload["temperature"]),
+                np.int32(payload["seed"]),
+                self._cache,
+            )
+            self.prefills += 1
+            self.prefills_reused += 1
+        return tok
 
     def admit(
         self,
@@ -625,10 +797,26 @@ class GenerativeModel:
         self._pos_ceiling[:] = 0
         for slot in list(self._slot_blocks):
             self.release_slot(slot)
+        if self.prefix_index is not None:
+            # drop everything release_slot absorbed (warmup admits garbage
+            # prompts; a reset must leave the index empty) — zero-ref only,
+            # and after the release loop every entry IS zero-ref
+            self._free_blocks.extend(self.prefix_index.flush())
         if self.driver is not None:
             self.driver.lead(self._mh_reset_key, {})
             return
         self._exec_reset({})
+
+    def prefix_snapshot(self) -> dict | None:
+        """The KV prefix-reuse index state for ``GET /stats/cache``."""
+        if self.prefix_index is None:
+            return None
+        snap = self.prefix_index.snapshot()
+        snap["free_blocks"] = len(self._free_blocks)
+        snap["pool_blocks"] = self.kv_blocks - 1
+        snap["prefills"] = self.prefills
+        snap["prefills_reused"] = self.prefills_reused
+        return snap
 
 
 @dataclasses.dataclass(eq=False)  # identity eq: fields hold arrays/futures
@@ -1078,10 +1266,17 @@ class GenerativeComponent(SeldonComponent):
         await self.scheduler.close()
 
     def metrics(self) -> list[dict[str, Any]]:
-        return [
+        out = [
             {"key": f"{self.model.name}_decode_steps", "type": "GAUGE", "value": self.model.steps},
             {"key": f"{self.model.name}_prefills", "type": "GAUGE", "value": self.model.prefills},
         ]
+        if self.model.prefix_index is not None:
+            out.append({
+                "key": f"{self.model.name}_prefills_reused",
+                "type": "GAUGE",
+                "value": self.model.prefills_reused,
+            })
+        return out
 
     async def _generate_rows(
         self,

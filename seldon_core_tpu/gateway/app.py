@@ -122,13 +122,35 @@ class GatewayApp:
         self.default_deadline_ms = float(
             os.environ.get("SCT_DEFAULT_DEADLINE_MS", "0") or 0.0
         )
+        # caching & reuse plane (docs/CACHING.md): content-addressed
+        # response cache + single-flight collapser, inert unless SCT_CACHE
+        # opts in; keys fold in each record's spec_hash and the deployment
+        # listener below flushes a deployment's entries on update/removal
+        from seldon_core_tpu.cache import (
+            SingleFlight,
+            cache_deployments,
+            response_cache_from_env,
+        )
+
+        self.cache = response_cache_from_env("gateway")
+        self._cache_deployments = cache_deployments()
+        self.collapse = SingleFlight()
         # removed deployments lose their live tokens immediately
         store.add_listener(self._on_deployment_event)
+
+    def cache_enabled_for(self, rec: DeploymentRecord) -> bool:
+        return self.cache is not None and (
+            self._cache_deployments is None or rec.name in self._cache_deployments
+        )
 
     def _on_deployment_event(self, event: str, rec: DeploymentRecord) -> None:
         if event == "removed":
             self.tokens.revoke_for_key(rec.oauth_key)
             self._qos.pop(rec.oauth_key, None)
+        if event in ("removed", "updated") and self.cache is not None:
+            # rolling update / teardown: stale responses must be
+            # unservable the moment the new spec is observed
+            self.cache.flush(rec.oauth_key)
         if event in ("removed", "updated"):
             pool = self._pools.pop(rec.oauth_key, None)
             if pool is not None:
@@ -190,6 +212,7 @@ class GatewayApp:
         r.add_get("/stats/breakdown", self.stats_breakdown)
         r.add_get("/stats/qos", self.stats_qos)
         r.add_get("/stats/wire", self.stats_wire)
+        r.add_get("/stats/cache", self.stats_cache)
 
         async def _startup(app_: web.Application) -> None:
             await self.start()
@@ -409,6 +432,24 @@ class GatewayApp:
             rec = self._principal_from_header(auth_header)
             principal = rec.oauth_key
             deployment_name = rec.name
+            # content-addressed cache lookup BEFORE admission: a hit is
+            # served here — no admission slot, no queue position, no
+            # deadline budget, no engine hop (docs/CACHING.md)
+            cache_key = None
+            if service == "predictions" and self.cache_enabled_for(rec):
+                from seldon_core_tpu.cache import request_key
+                from seldon_core_tpu.obs import current_span
+
+                cache_key = request_key(path, rec.spec_hash, raw)
+                entry = self.cache.get(rec.oauth_key, cache_key)
+                sp = current_span()
+                if entry is not None:
+                    if sp is not None:
+                        sp.event("cache.hit", tier="gateway")
+                    code = entry.status
+                    return entry.status, entry.value
+                if sp is not None:
+                    sp.event("cache.miss", tier="gateway")
             try:
                 ticket = self.qos_for(rec).admit(
                     priority, budget_s=budget_ms / 1e3 if budget_ms else None
@@ -443,7 +484,18 @@ class GatewayApp:
                 code = 400
                 return 400, _error_bytes(400, "body must be a JSON object")
             try:
-                code, reply = await self._forward(rec, path, raw)
+                if cache_key is not None:
+                    # single-flight: a thundering herd of identical
+                    # requests costs ONE engine hop; followers share the
+                    # leader's reply
+                    code, reply = await self.collapse.do(
+                        cache_key,
+                        lambda: self._forward(rec, path, raw),
+                    )
+                    if code == 200:
+                        self.cache.put(rec.oauth_key, cache_key, reply)
+                else:
+                    code, reply = await self._forward(rec, path, raw)
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:
                 code = 503
                 return 503, _error_bytes(503, f"engine unreachable for {rec.name}: {e}")
@@ -544,6 +596,22 @@ class GatewayApp:
         """Per-edge wire byte/MB-s counters + always-on probes (shared
         payload with the engine and the h1 front end's fallback route)."""
         return web.json_response(wire_stats_payload())
+
+    def cache_snapshot(self) -> dict:
+        """Caching-plane state (shared by both REST front ends'
+        /stats/cache)."""
+        out: dict = {
+            "enabled": self.cache is not None,
+            "collapse": self.collapse.snapshot(),
+        }
+        if self.cache is not None:
+            out["response"] = self.cache.snapshot()
+        if self._cache_deployments is not None:
+            out["deployments"] = sorted(self._cache_deployments)
+        return out
+
+    async def stats_cache(self, request: web.Request) -> web.Response:
+        return web.json_response({"cache": self.cache_snapshot()})
 
 
 def main(argv: list[str] | None = None) -> None:

@@ -245,6 +245,33 @@ class FastGatewayGrpc(_ChannelCacheBase):
                     grpc_frame(failure_message(str(e), e.status).SerializeToString()),
                 )
                 return
+            # content-addressed response cache on the relay (predictions
+            # only — feedback mutates bandit state): the framed request
+            # bytes ARE the canonical payload, the framed reply replays
+            # verbatim (docs/CACHING.md)
+            cache_key = None
+            if method == "Predict" and gateway.cache_enabled_for(rec):
+                from seldon_core_tpu.cache import request_key
+
+                cache_key = request_key("grpc:Predict", rec.spec_hash, framed)
+                entry = gateway.cache.get(rec.oauth_key, cache_key)
+                if entry is not None:
+                    dt = time.perf_counter() - t0
+                    RECORDER.record_stage(STAGE_GATEWAY_RELAY, dt)
+                    RECORDER.record_span(
+                        f"gateway.grpc.{method}",
+                        trace_id=trace_id,
+                        span_id=peer_span if minted is not None else None,
+                        parent_id=None if minted is not None else peer_span,
+                        start=t0_wall,
+                        duration_s=dt,
+                        service=rec.name,
+                        status="OK",
+                        attrs={"grpc_status": 0, "cache": "hit"},
+                        sampled=bool(flags & 0x01),
+                    )
+                    conn.write_unary_response(stream_id, entry.value)
+                    return
 
             def done(status: int, message: str, body: bytes) -> None:
                 conn.relay_cancels.pop(stream_id, None)
@@ -269,6 +296,8 @@ class FastGatewayGrpc(_ChannelCacheBase):
                     sampled=bool(flags & 0x01),
                 )
                 if status == 0:
+                    if cache_key is not None and gateway.cache is not None:
+                        gateway.cache.put(rec.oauth_key, cache_key, body)
                     conn.write_unary_response(stream_id, body)
                 elif status == 14 and "unreachable" in message:
                     conn.write_unary_response(

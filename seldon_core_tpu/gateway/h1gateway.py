@@ -86,6 +86,11 @@ _MAX_UPSTREAM_CONNS = int(_os.environ.get("SCT_GW_UPSTREAM_CONNS", "8"))
 # request body ceiling (aiohttp front-end parity: client_max_size)
 _MAX_BODY = int(_os.environ.get("GATEWAY_MAX_BODY", str(256 * 1024 * 1024)))
 
+# downstream read-ahead cap while a response is in flight: a client
+# pipelining (or flooding) past this parks in the KERNEL buffer via
+# pause_reading instead of growing our bytearray unboundedly
+_PIPELINE_BUF = int(_os.environ.get("SCT_GW_PIPELINE_BUF", str(1 << 16)))
+
 # hop-by-hop headers an intermediary must not forward (RFC 9112 §7.6.1)
 _HOP_BY_HOP = (b"connection", b"keep-alive", b"proxy-connection", b"upgrade")
 
@@ -135,13 +140,14 @@ _MAX_REPLAYS = 2
 class _Job:
     """One spliced request in an upstream FIFO."""
 
-    __slots__ = ("down", "raw", "streaming", "replays")
+    __slots__ = ("down", "raw", "streaming", "replays", "up")
 
     def __init__(self, down: "_DownConn", raw: bytes, streaming: bool):
         self.down: "_DownConn | None" = down  # None once abandoned/failed
         self.raw: bytes = raw  # retained until its response starts (replay)
         self.streaming = streaming
         self.replays = 0  # connection-loss replays consumed so far
+        self.up: "_UpConn | None" = None  # the conn carrying this job
 
 
 # ---------------------------------------------------------------------------
@@ -191,6 +197,7 @@ class _UpConn(WriteCoalescer, asyncio.Protocol):
     # -- request side -------------------------------------------------------
 
     def send_request(self, job: _Job) -> None:
+        job.up = self
         self.fifo.append(job)
         self.queue_write(job.raw)
 
@@ -573,9 +580,55 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
         # the in-flight spliced request's QoS admission ticket (released on
         # completion, failure, timeout reap, or client disconnect)
         self._qos_ticket = None
+        # caching & reuse plane (docs/CACHING.md): the in-flight request's
+        # cache key (leader of a potential collapse group), the response-
+        # body capture, and — for a parked follower — the group key
+        self._cache_key: str | None = None
+        self._cap_buf: bytearray | None = None
+        self._cap_status = 0
+        self._collapse_key: str | None = None
+        # backpressure: did we pause our own reads (pipelining client) /
+        # the upstream conn's reads (slow client on a fast stream)?
+        self._read_paused = False
+        self._write_paused = False
+        self._up_paused: "_UpConn | None" = None
         # write coalescing: response head + body (and any same-iteration
         # writes) leave in one syscall
         self._init_coalescer(frontend.loop)
+
+    # -- flow control -------------------------------------------------------
+
+    def pause_writing(self) -> None:
+        """Downstream socket buffer is full (slow client).  Stop reading
+        from the engine conn whose response is streaming to us, or a fast
+        SSE stream buffers unboundedly in our transport (ADVICE r5.2)."""
+        self._write_paused = True
+        job = self.job
+        up = job.up if job is not None else None
+        if (
+            up is not None
+            and up.fifo
+            and up.fifo[0] is job
+            and up.transport is not None
+            and not up.transport.is_closing()
+        ):
+            try:
+                up.transport.pause_reading()
+                self._up_paused = up
+            except RuntimeError:
+                pass
+
+    def resume_writing(self) -> None:
+        self._write_paused = False
+        self._resume_upstream()
+
+    def _resume_upstream(self) -> None:
+        up, self._up_paused = self._up_paused, None
+        if up is not None and up.transport is not None and not up.transport.is_closing():
+            try:
+                up.transport.resume_reading()
+            except RuntimeError:
+                pass
 
     def _release_qos(self) -> None:
         ticket, self._qos_ticket = self._qos_ticket, None
@@ -605,6 +658,14 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
     def connection_lost(self, exc) -> None:
         self.frontend._conns.discard(self)
         self._release_qos()  # cancel-on-disconnect frees the admission slot
+        self._resume_upstream()  # a dead client must not wedge the engine conn
+        if self._collapse_key is not None:
+            self.frontend.collapse_discard(self)
+        key, self._cache_key = self._cache_key, None
+        if key is not None:
+            # the collapse leader vanished; its followers fail fast and
+            # retry rather than waiting out the 504 reaper
+            self.frontend.collapse_fail(key, 503, "collapsed leader disconnected")
         job, self.job = self.job, None
         if job is not None:
             # client went away: abandon the job — its response (if any)
@@ -620,6 +681,19 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
         self.buf += data
         if not self.awaiting:
             self._process()
+        elif (
+            len(self.buf) > _PIPELINE_BUF
+            and not self._read_paused
+            and self.transport is not None
+        ):
+            # a client pipelining (or flooding a body) ahead of its
+            # in-flight response parks in the kernel buffer, not ours
+            # (ADVICE r5.2: bounded read-ahead while awaiting)
+            try:
+                self.transport.pause_reading()
+                self._read_paused = True
+            except RuntimeError:
+                pass
 
     def _process(self) -> None:
         while not self.awaiting:
@@ -744,6 +818,64 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                     self._close()
                     return
                 continue
+            # content-addressed cache + collapse BEFORE QoS admission
+            # (docs/CACHING.md): a hit costs no admission slot, no queue
+            # position, no deadline budget, no engine socket; an identical
+            # in-flight request parks as a follower of the one computing
+            cache_key = None
+            if service == "predictions" and self.gateway.cache_enabled_for(rec):
+                from seldon_core_tpu.cache import request_key
+
+                body_bytes = (
+                    raw[len(raw) - content_length:] if content_length else b""
+                )
+                cache_key = request_key(
+                    "/api/v0.1/predictions", rec.spec_hash, body_bytes
+                )
+                entry = self.gateway.cache.get(rec.oauth_key, cache_key)
+                echo = tp_parsed[0].encode()
+                if entry is not None:
+                    self.frontend.observe(
+                        rec.oauth_key, rec.name, service, entry.status, 0.0
+                    )
+                    self.write(_response(
+                        entry.status, entry.value,
+                        extra_headers=(
+                            TRACE_RESPONSE_HEADER.encode() + b": " + echo
+                            + b"\r\nx-sct-cache: hit\r\n"
+                        ),
+                    ))
+                    if self.close_after:
+                        self._close()
+                        return
+                    continue
+                if not self.frontend.collapse_claim(cache_key, self):
+                    # follower: the leader's response fans out to us on
+                    # completion; the reaper 504s us if it never lands
+                    from seldon_core_tpu.utils.metrics import DEFAULT as _M
+
+                    _M.cache_collapsed.labels(rec.name).inc()
+                    self._collapse_key = cache_key
+                    self.rec = rec
+                    self.service = service
+                    self.awaiting = True
+                    self.forwarded = False
+                    self.t0 = time.perf_counter()
+                    trace_id, peer_span, flags = tp_parsed
+                    self._trace = (
+                        trace_id,
+                        peer_span if minted is not None else None,
+                        None if minted is not None else peer_span,
+                        bool(flags & 0x01),
+                        time.time(),
+                    )
+                    self.echo_trace_id = trace_id.encode()
+                    self._req_bytes = len(raw)
+                    self._resp_bytes = 0
+                    self.deadline = (
+                        self.frontend.loop.time() + self.gateway.timeout_s
+                    )
+                    return
             # QoS admission (per-deployment; inert unless SCT_GW_QOS_* is
             # configured): shed HERE, before any engine socket is touched
             try:
@@ -788,6 +920,11 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
             self.deadline = self.frontend.loop.time() + timeout
             self._req_bytes = len(raw)
             self._resp_bytes = 0
+            # collapse leader: capture the response body for the cache and
+            # for follower fan-out (forward_head validates the framing)
+            self._cache_key = cache_key
+            self._cap_buf = None
+            self._cap_status = 0
             job = _Job(self, raw, streaming)
             self.job = job
             self.frontend.pool_for(rec).submit(job)
@@ -883,12 +1020,29 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
     def forward(self, data: bytes) -> None:
         self.forwarded = True
         self._resp_bytes += len(data)
+        if self._cap_buf is not None:
+            self._cap_buf += data
         self.write(data)
 
     def forward_head(self, head: bytes) -> None:
         """Forward the engine's (final) response head, echoing the trace id
         so the client can correlate without parsing spans."""
         self.forwarded = True
+        if self._cache_key is not None:
+            # capture only content-length-framed bodies: chunked/close-
+            # framed responses forward with framing bytes interleaved and
+            # are not replayable to cache hits or collapse followers
+            try:
+                self._cap_status = int(head.split(b" ", 2)[1])
+            except (ValueError, IndexError):
+                self._cap_status = 0
+            hl = head.lower()
+            replayable = (
+                b"transfer-encoding" not in hl
+                and b"connection: close" not in hl
+                and b"content-length" in hl
+            )
+            self._cap_buf = bytearray() if replayable else None
         echo = self.echo_trace_id
         if echo:
             head = head[:-2] + TRACE_RESPONSE_HEADER.encode() + b": " + echo + b"\r\n\r\n"
@@ -929,6 +1083,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
     def upstream_done(self, status: int) -> None:
         self.job = None
         self._release_qos()
+        self._resume_upstream()
         rec = self.rec
         dt = time.perf_counter() - self.t0
         self._finish_trace(status, dt)
@@ -939,11 +1094,32 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
             status,
             dt,
         )
+        key, self._cache_key = self._cache_key, None
+        if key is not None:
+            body = (
+                bytes(self._cap_buf)
+                if self._cap_buf is not None and self._cap_status == status
+                else None
+            )
+            self._cap_buf = None
+            if (
+                body is not None
+                and status == 200
+                and rec is not None
+                and self.gateway.cache is not None
+            ):
+                self.gateway.cache.put(rec.oauth_key, key, body)
+            self.frontend.collapse_done(key, status, body)
         self._next()
 
     def upstream_failed(self, reason: str, forwarded: bool, status: int = 503) -> None:
         self.job = None
         self._release_qos()
+        self._resume_upstream()
+        key, self._cache_key = self._cache_key, None
+        self._cap_buf = None
+        if key is not None:
+            self.frontend.collapse_fail(key, status, reason)
         rec = self.rec
         dt = time.perf_counter() - self.t0
         self._finish_trace(status, dt)
@@ -972,8 +1148,51 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
         if self.close_after:
             self._close()
             return
+        if self._read_paused:
+            # response delivered: drain whatever the client pipelined
+            # behind it out of the kernel buffer
+            self._read_paused = False
+            try:
+                self.transport.resume_reading()
+            except RuntimeError:
+                pass
         if self.buf:
             self._process()
+
+    def collapse_resolve(
+        self, status: int, body: bytes | None, reason: str | None = None
+    ) -> None:
+        """A parked follower receives the collapse leader's outcome: the
+        captured response verbatim (own trace id echoed), or the leader's
+        failure status."""
+        self._collapse_key = None
+        rec = self.rec
+        dt = time.perf_counter() - self.t0
+        self._resp_bytes = len(body) if body is not None else 0
+        self._finish_trace(status, dt)
+        self.frontend.observe(
+            rec.oauth_key if rec else "anonymous",
+            rec.name if rec else "unknown",
+            self.service,
+            status,
+            dt,
+        )
+        if self.transport is None or self.transport.is_closing():
+            return
+        if body is not None:
+            echo = self.echo_trace_id or b""
+            self.write(_response(
+                status, body,
+                extra_headers=(
+                    TRACE_RESPONSE_HEADER.encode() + b": " + echo
+                    + b"\r\nx-sct-cache: collapsed\r\n"
+                ),
+            ))
+        else:
+            self.write(_error_response(
+                status, reason or "collapsed upstream response not replayable"
+            ))
+        self._next()
 
     # -- fallback (full-parse) path -----------------------------------------
 
@@ -1027,6 +1246,10 @@ class H1SpliceFrontend:
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[_DownConn] = set()
         self._pools: dict[str, _UpstreamPool] = {}
+        # collapse groups: cache key -> parked follower conns (the leader
+        # is the conn whose _cache_key matches; docs/CACHING.md)
+        self._collapse: dict[str, list[_DownConn]] = {}
+        self.collapsed = 0  # lifetime follower count (stats/cache)
         self.req_head_cache: dict[bytes, tuple] = {}  # request-head parse memo
         self._metric_children: dict[tuple, object] = {}
         self._wire_children: dict[str, object] = {}  # per-deployment counters
@@ -1057,6 +1280,43 @@ class H1SpliceFrontend:
             counter = WIRE.counter(WIRE_GATEWAY_H1, name)
             self._wire_children[name] = counter
         return counter
+
+    # -- request collapsing --------------------------------------------------
+
+    def collapse_claim(self, key: str, conn: _DownConn) -> bool:
+        """True -> ``conn`` leads the group for ``key`` and proceeds
+        upstream; False -> it parked as a follower."""
+        followers = self._collapse.get(key)
+        if followers is None:
+            self._collapse[key] = []
+            return True
+        followers.append(conn)
+        self.collapsed += 1
+        return False
+
+    def collapse_done(self, key: str, status: int, body: bytes | None) -> None:
+        followers = self._collapse.pop(key, None)
+        if not followers:
+            return
+        if body is None and status < 400:
+            # the leader's response wasn't replayable (chunked/close-framed)
+            status = 502
+        for f in followers:
+            f.collapse_resolve(status, body)
+
+    def collapse_fail(self, key: str, status: int, reason: str) -> None:
+        followers = self._collapse.pop(key, None)
+        if not followers:
+            return
+        for f in followers:
+            f.collapse_resolve(status, None, reason)
+
+    def collapse_discard(self, conn: _DownConn) -> None:
+        """A parked follower went away (disconnect / reap)."""
+        key, conn._collapse_key = conn._collapse_key, None
+        followers = self._collapse.get(key) if key is not None else None
+        if followers is not None and conn in followers:
+            followers.remove(conn)
 
     def observe(self, principal: str, name: str, service: str, code: int, dt: float) -> None:
         key = (principal, name, service, code)
@@ -1094,6 +1354,13 @@ class H1SpliceFrontend:
             if conn.awaiting and conn.deadline and now >= conn.deadline:
                 job, conn.job = conn.job, None
                 conn._release_qos()
+                conn._resume_upstream()
+                if conn._collapse_key is not None:
+                    self.collapse_discard(conn)  # timed-out follower
+                key, conn._cache_key = conn._cache_key, None
+                if key is not None:
+                    # timed-out leader: its followers 504 with it
+                    self.collapse_fail(key, 504, "engine timed out")
                 if job is not None:
                     job.down = None  # discard whatever the engine returns
                 # the timeout is a real 504: ingress metrics + the relay
@@ -1202,6 +1469,13 @@ class H1SpliceFrontend:
             return 200, json.dumps({"qos": gw.qos_snapshot()}).encode(), b"application/json"
         if route == b"/stats/wire":
             return 200, json.dumps(wire_stats_payload()).encode(), b"application/json"
+        if route == b"/stats/cache":
+            snap = gw.cache_snapshot()
+            snap["h1_collapse"] = {
+                "groups_inflight": len(self._collapse),
+                "collapsed": self.collapsed,
+            }
+            return 200, json.dumps({"cache": snap}).encode(), b"application/json"
         return 404, json.dumps(
             failure_status_dict(404, f"no route {route.decode('latin-1')}")
         ).encode(), b"application/json"

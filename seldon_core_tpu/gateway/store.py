@@ -33,6 +33,28 @@ class DeploymentRecord:
     engine_rest_port: int = DEFAULT_ENGINE_REST_PORT
     engine_grpc_port: int = DEFAULT_ENGINE_GRPC_PORT
     annotations: dict[str, str] = dataclasses.field(default_factory=dict)
+    # identity of the deployment's SPEC, folded into every response-cache
+    # key (docs/CACHING.md): a rolling update changes the hash, so stale
+    # entries become unhittable even before the "updated" event flushes
+    # them.  The CR watch stamps a hash over the full spec; records built
+    # directly derive one from their own fields.
+    spec_hash: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.spec_hash:
+            from seldon_core_tpu.cache.content import spec_hash as _spec_hash
+
+            self.spec_hash = _spec_hash(
+                {
+                    "name": self.name,
+                    "oauth_key": self.oauth_key,
+                    "oauth_secret": self.oauth_secret,
+                    "engine_host": self.engine_host,
+                    "engine_rest_port": self.engine_rest_port,
+                    "engine_grpc_port": self.engine_grpc_port,
+                    "annotations": self.annotations,
+                }
+            )
 
     @property
     def rest_base(self) -> str:
@@ -54,6 +76,7 @@ class DeploymentRecord:
             engine_rest_port=int(d.get("engine_rest_port", DEFAULT_ENGINE_REST_PORT)),
             engine_grpc_port=int(d.get("engine_grpc_port", DEFAULT_ENGINE_GRPC_PORT)),
             annotations=dict(d.get("annotations", {})),
+            spec_hash=str(d.get("spec_hash", "")),
         )
 
 

@@ -123,7 +123,17 @@ class GatewayWatcher:
         name = meta.get("name") or spec.get("name")
         if not name:
             return None
+        # spec-hash over the FULL CR spec (+ routing annotations): ANY
+        # rolling-update change — image, graph, parameters — changes it,
+        # which both re-keys the response cache and (via the != compare in
+        # _apply emitting "updated") flushes the old entries
+        from seldon_core_tpu.cache.content import spec_hash as _spec_hash
+
+        cr_hash = _spec_hash(
+            {"spec": spec, "annotations": meta.get("annotations", {})}
+        )
         return DeploymentRecord(
+            spec_hash=cr_hash,
             name=name,
             oauth_key=spec.get("oauth_key") or name,
             oauth_secret=spec.get("oauth_secret", ""),

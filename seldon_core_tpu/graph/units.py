@@ -56,6 +56,12 @@ class SimpleModel(SeldonComponent):
     (reference: engine/.../predictors/SimpleModelUnit.java:33-46)."""
 
     INLINE_SYNC = True  # microseconds of python math; skip the executor hop
+    # DETERMINISTIC marks a component whose output is a pure function of
+    # its input — the caching plane (docs/CACHING.md) only ever serves a
+    # MODEL node from the response cache when the component declares it.
+    # Stateful (Mahalanobis), randomized (RandomABTest), and feedback-
+    # driven (bandit routers) components must NOT carry the mark.
+    DETERMINISTIC = True
 
     values = np.array([0.1, 0.9, 0.5])
     class_names = ["class0", "class1", "class2"]
@@ -70,6 +76,7 @@ class SimpleRouter(SeldonComponent):
     (reference: engine/.../predictors/SimpleRouterUnit.java:28-31)."""
 
     INLINE_SYNC = True  # microseconds of python math; skip the executor hop
+    DETERMINISTIC = True  # always child 0
 
     def route(self, X: np.ndarray, names: list[str]) -> int:
         return 0
@@ -97,6 +104,8 @@ class AverageCombiner(SeldonComponent):
     NOT inline-sync: the stack+mean copies scale with arbitrary child
     payload sizes — milliseconds of numpy on big batches belongs on the
     thread pool, not the event loop."""
+
+    DETERMINISTIC = True  # pure element-wise mean
 
     def aggregate(self, Xs: list[np.ndarray], features: list[list[str]]) -> np.ndarray:
         if not Xs:

@@ -256,13 +256,20 @@ class _NodeState:
     """Runtime node: spec + client + children, built once at startup
     (reference analogue: PredictiveUnitState, but cached)."""
 
-    __slots__ = ("spec", "client", "children", "methods")
+    __slots__ = ("spec", "client", "children", "methods", "deterministic")
 
     def __init__(self, spec: PredictiveUnitSpec, client: NodeClient, children: list["_NodeState"]):
         self.spec = spec
         self.client = client
         self.children = children
         self.methods = set(spec.resolved_methods())
+        # a node is response-cacheable only when its component DECLARES
+        # determinism (graph/units.py DETERMINISTIC) — remote endpoints and
+        # stateful/randomized components never are
+        comp = getattr(client, "component", None)
+        self.deterministic = bool(
+            comp is not None and getattr(comp, "DETERMINISTIC", False)
+        )
 
 
 def default_client_factory(spec: PredictiveUnitSpec) -> NodeClient:
@@ -295,12 +302,29 @@ class GraphWalker:
         components: dict[str, Any] | None = None,
         client_factory: ClientFactory | None = None,
         feedback_hook: Callable[[str, FeedbackPayload], None] | None = None,
+        node_cache: Any = None,
     ):
         self.spec = spec
         self._components = components or {}
         self._factory = client_factory or default_client_factory
         self._feedback_hook = feedback_hook
+        # node-tier response cache (cache/content.py ResponseCache or
+        # None): MODEL nodes marked deterministic serve exact input repeats
+        # without touching the component — zero device steps on a hit
+        self.node_cache = node_cache
         self.root = self._build(spec)
+
+    def deterministic(self) -> bool:
+        """True when EVERY node's component declares determinism — the
+        gate for whole-response caching at the engine ingress (a single
+        randomized router poisons the whole graph's cacheability)."""
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            if not node.deterministic:
+                return False
+            stack.extend(node.children)
+        return True
 
     def _build(self, spec: PredictiveUnitSpec) -> _NodeState:
         if spec.name in self._components:
@@ -396,7 +420,14 @@ class GraphWalker:
     ) -> Payload:
         methods = node.methods
         if Method.TRANSFORM_INPUT in methods:
-            p = await node.client.transform_input(p)
+            if (
+                self.node_cache is not None
+                and node.deterministic
+                and node.spec.type == UnitType.MODEL
+            ):
+                p = await self._model_cached(node, p)
+            else:
+                p = await node.client.transform_input(p)
 
         if node.children:
             branch = ROUTE_ALL
@@ -430,6 +461,40 @@ class GraphWalker:
         if Method.TRANSFORM_OUTPUT in methods:
             p = await node.client.transform_output(p)
         return p
+
+    async def _model_cached(self, node: _NodeState, p: Payload) -> Payload:
+        """Serve a deterministic MODEL node from the node-tier response
+        cache: exact input repeats skip the component (and its device
+        step) entirely.  Only numeric/string payloads are addressable;
+        anything else falls through to the component."""
+        from seldon_core_tpu.cache.content import payload_cache_key
+        from seldon_core_tpu.obs import current_span
+
+        key = payload_cache_key(p)
+        if key is None:
+            return await node.client.transform_input(p)
+        ns = node.spec.name
+        entry = self.node_cache.get(ns, key)
+        sp = current_span()
+        if entry is not None:
+            if sp is not None:
+                sp.event("cache.hit", tier="node", unit=ns)
+            data, names, kind = entry.value
+            p.meta.request_path.setdefault(ns, "cache")
+            out = Payload(data=data, names=list(names), kind=kind, meta=p.meta)
+            return out
+        if sp is not None:
+            sp.event("cache.miss", tier="node", unit=ns)
+        out = await node.client.transform_input(p)
+        nbytes = (
+            out.data.nbytes
+            if isinstance(out.data, np.ndarray)
+            else len(out.data or b"")
+        )
+        self.node_cache.put(
+            ns, key, (out.data, list(out.names), out.kind), nbytes=nbytes
+        )
+        return out
 
     # -- feedback walk ----------------------------------------------------
 

@@ -423,6 +423,104 @@ def prefill_slot_paged(
     return h @ params["head"], cache
 
 
+def prefill_suffix_paged(
+    params: dict,
+    tokens: jax.Array,
+    prefix_len: jax.Array,
+    length: jax.Array,
+    slot: jax.Array,
+    blocks_row: jax.Array,
+    suffix_blocks: jax.Array,
+    cache: dict,
+    cfg: Config,
+    *,
+    prefix_window: int,
+) -> tuple[jax.Array, dict]:
+    """Prefill only the SUFFIX of a prompt whose first ``prefix_len``
+    tokens already have K/V in the slot's table blocks (KV prefix reuse,
+    cache/prefix.py).
+
+    ``tokens`` is ``(1, Ls)`` — the suffix right-padded to a bucket that is
+    a multiple of the block size; ``prefix_len`` is the reused length (a
+    multiple of the block size, traced); ``length`` the TOTAL true prompt
+    length; ``blocks_row`` the slot's full table row whose first
+    ``prefix_len // bs`` entries are the shared prefix blocks;
+    ``suffix_blocks`` ``(Ls // bs,)`` the physical blocks the suffix K/V
+    scatters into.  ``prefix_window`` (STATIC; one compiled program per
+    (suffix bucket, window)) bounds how many prefix rows attention reads —
+    the smallest block-multiple covering ``prefix_len``.
+
+    Numerics: suffix queries attend over [gathered prefix K/V ++ suffix
+    K/V] with the same einsum/mask/softmax shapes as the full-prefill
+    attention, and K/V at a position depends causally only on tokens at or
+    before it — so generation from a reused prefix is bit-identical to a
+    cold prefill (pinned-equal test in tests/test_cache.py).
+    """
+    bs = cache["k"].shape[2]
+    ls = tokens.shape[1]
+    pw = int(prefix_window)
+    pb = max(1, pw // bs)
+    lb = ls // bs
+    kvh, hd = cfg.n_kv_heads, cfg.head_dim
+    x = params["tok_emb"][tokens]  # (1, Ls, E)
+    positions = prefix_len + jnp.arange(ls)[None, :]  # (1, Ls) global positions
+    read_idx = blocks_row[:pb]  # (pb,) physical prefix blocks
+    # mask: prefix col j visible iff j < prefix_len; suffix col j iff j <= i
+    prefix_valid = jnp.arange(pb * bs)[None, :] < prefix_len  # (1, P)
+    causal = jnp.arange(ls)[:, None] >= jnp.arange(ls)[None, :]  # (Ls, Ls)
+    mask = jnp.concatenate(
+        [jnp.broadcast_to(prefix_valid, (ls, pb * bs)), causal], axis=1
+    )  # (Ls, P + Ls)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    def body(carry, inputs):
+        x, ck, cv = carry
+        li, lp = inputs
+        h = _rmsnorm(x, lp["ln_att"], cfg.norm_eps)
+        q = jnp.einsum("ble,ehd->blhd", h, lp["wq"])
+        k = jnp.einsum("ble,ehd->blhd", h, lp["wk"])
+        v = jnp.einsum("ble,ehd->blhd", h, lp["wv"])
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        ckl = jax.lax.dynamic_index_in_dim(ck, li, 0, keepdims=False)
+        cvl = jax.lax.dynamic_index_in_dim(cv, li, 0, keepdims=False)
+        kp = ckl[read_idx].reshape(1, pb * bs, kvh, hd).astype(k.dtype)
+        vp = cvl[read_idx].reshape(1, pb * bs, kvh, hd).astype(v.dtype)
+        k_all = jnp.concatenate([kp, k], axis=1)  # (1, P+Ls, kv, hd)
+        v_all = jnp.concatenate([vp, v], axis=1)
+        kf = _gqa_repeat(k_all, cfg.n_heads)
+        vf = _gqa_repeat(v_all, cfg.n_heads)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, kf) * scale
+        s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, vf)
+        x = x + jnp.einsum("blhd,hde->ble", o, lp["wo"])
+        h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
+        mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+        ksb = k[0].reshape(lb, bs, kvh, hd)
+        vsb = v[0].reshape(lb, bs, kvh, hd)
+        ck = ck.at[li, suffix_blocks].set(ksb.astype(ck.dtype))
+        cv = cv.at[li, suffix_blocks].set(vsb.astype(cv.dtype))
+        return (x + mlp, ck, cv), None
+
+    (x, new_k, new_v), _ = jax.lax.scan(
+        body,
+        (x, cache["k"], cache["v"]),
+        (jnp.arange(cfg.n_layers), params["layers"]),
+    )
+    cache = {
+        "k": new_k,
+        "v": new_v,
+        "pos": cache["pos"].at[slot].set(length),
+        "table": cache["table"].at[slot].set(blocks_row),
+    }
+    h = jax.lax.dynamic_index_in_dim(
+        x[0], length - prefix_len - 1, axis=0, keepdims=False
+    )
+    h = _rmsnorm(h, params["ln_f"], cfg.norm_eps)
+    return h @ params["head"], cache
+
+
 def decode_slots_paged(
     params: dict,
     tokens: jax.Array,

@@ -216,6 +216,7 @@ def build_generative_component(
     kv_block_size: int = 16,
     kv_blocks: int | None = None,
     queue_max: int | None = None,
+    kv_prefix_reuse: bool | None = None,
     **overrides,
 ):
     """Build a continuous-batching generative graph unit (JAX_GENERATIVE).
@@ -259,6 +260,7 @@ def build_generative_component(
         decode_block=decode_block,
         kv_block_size=kv_block_size,
         kv_blocks=kv_blocks,
+        prefix_reuse=kv_prefix_reuse,
     )
     return GenerativeComponent(
         model,

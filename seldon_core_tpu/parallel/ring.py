@@ -23,8 +23,22 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.5: top-level export, replication check via ``check_vma``
+    from jax import shard_map as _shard_map
+
+    def _shard_map_unchecked(f, *, mesh, in_specs, out_specs):
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+except ImportError:  # jax 0.4.x: experimental module, kwarg named check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _shard_map_unchecked(f, *, mesh, in_specs, out_specs):
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
 
 
 def _block_attend(q, k, v, q_offset, k_offset, causal, scale):
@@ -126,11 +140,10 @@ def ring_self_attention(
     # batch stays dp-sharded through the ring; heads are gathered (ring+tp
     # jointly would need head-sharded specs — future kernel work)
     spec = P(("dp", "fsdp"), seq_axis, None, None)
-    wrapped = shard_map(
+    wrapped = _shard_map_unchecked(
         functools.partial(fn, axis_name=seq_axis, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
     )
     return wrapped(q, k, v)

@@ -1,0 +1,32 @@
+"""Version-guarded stdlib/toolchain shims.
+
+Tier-1 runs on the floor interpreter (3.10) while production images track
+newer runtimes; anything that needs an API that moved between versions
+goes through here so call sites stay clean and the guard lives in ONE
+place.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import sys
+from typing import Any, Coroutine
+
+
+def create_task_in_context(
+    loop: asyncio.AbstractEventLoop,
+    coro: Coroutine[Any, Any, Any],
+    ctx: contextvars.Context,
+) -> asyncio.Task:
+    """``loop.create_task(coro, context=ctx)`` with a 3.10 fallback.
+
+    The ``context=`` kwarg landed in 3.11.  On 3.10 a Task snapshots the
+    context ACTIVE at creation (``contextvars.copy_context()``), so
+    creating the task from inside ``ctx.run`` pins the same context the
+    kwarg would — the handler runs with ``ctx``'s values and writes never
+    leak into the caller's context.
+    """
+    if sys.version_info >= (3, 11):
+        return loop.create_task(coro, context=ctx)
+    return ctx.run(loop.create_task, coro)

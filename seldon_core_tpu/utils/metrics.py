@@ -219,6 +219,51 @@ class MetricsRegistry:
             ["model_name"],
             registry=self.registry,
         )
+        # Caching & reuse plane vocabulary (fed by cache/content.py,
+        # cache/singleflight.py, cache/prefix.py; docs/CACHING.md)
+        self.cache_hits = Counter(
+            "seldon_cache_hits",
+            "Response-cache hits per tier (gateway/engine/node) and namespace",
+            ["tier", "name"],
+            registry=self.registry,
+        )
+        self.cache_misses = Counter(
+            "seldon_cache_misses",
+            "Response-cache misses per tier and namespace",
+            ["tier", "name"],
+            registry=self.registry,
+        )
+        self.cache_entries = Gauge(
+            "seldon_cache_entries",
+            "Live response-cache entries per tier",
+            ["tier"],
+            registry=self.registry,
+        )
+        self.cache_bytes = Gauge(
+            "seldon_cache_bytes",
+            "Bytes held by the response cache per tier",
+            ["tier"],
+            registry=self.registry,
+        )
+        self.cache_collapsed = Counter(
+            "seldon_cache_collapsed",
+            "Requests collapsed onto an identical in-flight computation "
+            "(single-flight followers; leaders are regular requests)",
+            ["name"],
+            registry=self.registry,
+        )
+        self.prefix_tokens_reused = Counter(
+            "seldon_cache_prefix_tokens_reused",
+            "Prompt tokens whose prefill was skipped via KV prefix reuse",
+            ["model_name"],
+            registry=self.registry,
+        )
+        self.prefix_blocks = Gauge(
+            "seldon_cache_prefix_blocks",
+            "KV pool blocks currently held by the prefix-reuse index",
+            ["model_name"],
+            registry=self.registry,
+        )
         self.obs_spans = Gauge(
             "seldon_obs_spans",
             "Span recorder counters (state: recorded / ring / sampled_out)",

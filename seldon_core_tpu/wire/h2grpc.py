@@ -639,7 +639,9 @@ class _ServerConn(_Conn):
                     stream_id, GRPC_STATUS_UNKNOWN, f"bad request metadata: {e}"
                 )
                 return
-            task = asyncio.get_running_loop().create_task(coro, context=ctx)
+            from seldon_core_tpu.utils.compat import create_task_in_context
+
+            task = create_task_in_context(asyncio.get_running_loop(), coro, ctx)
         else:
             task = asyncio.ensure_future(coro)
         self._tasks.add(task)

@@ -1,0 +1,664 @@
+"""Caching & reuse plane tests (docs/CACHING.md).
+
+Covers the three tiers — content-addressed response cache, single-flight
+request collapsing, KV prefix reuse — plus the acceptance gates: a cache
+hit spends ZERO device steps (host-sync counters from obs/probes.py), N
+concurrent identical requests collapse to one upstream computation, KV
+prefix reuse is pinned-equal (bit-identical generations) including under
+a tp-sharded mesh, and a spec-hash change observed through the gateway
+watch makes a stale hit impossible.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu.cache import (
+    PrefixIndex,
+    ResponseCache,
+    SingleFlight,
+    canonical_body,
+    request_key,
+    spec_hash,
+)
+from seldon_core_tpu.engine.app import EngineApp
+from seldon_core_tpu.engine.service import PredictionService
+from seldon_core_tpu.gateway.app import GatewayApp
+from seldon_core_tpu.gateway.h1gateway import H1SpliceFrontend
+from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
+from seldon_core_tpu.gateway.watch import GatewayWatcher
+from seldon_core_tpu.graph.spec import PredictorSpec
+
+run = asyncio.run
+
+SIMPLE = {
+    "name": "p",
+    "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+}
+
+
+# ---------------------------------------------------------------------------
+# unit: content-addressed cache
+# ---------------------------------------------------------------------------
+
+
+class TestResponseCache:
+    def test_lru_and_byte_bounds(self):
+        c = ResponseCache("t", max_entries=3, max_bytes=1000, ttl_s=60)
+        for i in range(5):
+            c.put("ns", f"k{i}", b"x" * 10)
+        assert c.get("ns", "k0") is None and c.get("ns", "k1") is None
+        assert c.get("ns", "k4").value == b"x" * 10
+        assert c.evictions == 2
+        # byte budget evicts independently of the entry cap
+        c.put("ns", "big", b"y" * 990)
+        assert c.bytes <= 1000
+
+    def test_oversized_entry_rejected(self):
+        c = ResponseCache("t", max_bytes=100)
+        c.put("ns", "k", b"z" * 101)
+        assert c.get("ns", "k") is None
+
+    def test_ttl_expiry(self):
+        c = ResponseCache("t", ttl_s=0.0)
+        c.put("ns", "k", b"v")
+        assert c.get("ns", "k") is None
+        assert c.expirations == 1
+
+    def test_namespace_flush_is_scoped(self):
+        c = ResponseCache("t")
+        c.put("a", "k", b"1")
+        c.put("b", "k", b"2")
+        assert c.flush("a") == 1
+        assert c.get("a", "k") is None
+        assert c.get("b", "k").value == b"2"
+
+    def test_keying(self):
+        body = {"b": 1, "a": [1, 2]}
+        # canonicalization defeats key-order / whitespace differences
+        assert canonical_body(body) == canonical_body({"a": [1, 2], "b": 1})
+        k1 = request_key("predictions", "h1", canonical_body(body))
+        assert k1 == request_key("predictions", "h1", canonical_body(body))
+        assert k1 != request_key("predictions", "h2", canonical_body(body))
+        assert k1 != request_key("grpc:Predict", "h1", canonical_body(body))
+
+    def test_spec_hash_changes_with_spec(self):
+        a = spec_hash({"graph": {"implementation": "SIMPLE_MODEL"}})
+        b = spec_hash({"graph": {"implementation": "JAX_MODEL"}})
+        assert a != b
+        # pydantic specs hash like their dict form
+        assert spec_hash(PredictorSpec.model_validate(SIMPLE)) == spec_hash(
+            PredictorSpec.model_validate(SIMPLE)
+        )
+
+
+# ---------------------------------------------------------------------------
+# unit: single-flight
+# ---------------------------------------------------------------------------
+
+
+class TestSingleFlight:
+    def test_collapses_concurrent_identical(self):
+        async def go():
+            sf = SingleFlight()
+            calls = []
+
+            async def work():
+                calls.append(1)
+                await asyncio.sleep(0.05)
+                return 42
+
+            results = await asyncio.gather(*(sf.do("k", work) for _ in range(9)))
+            return results, calls, sf.snapshot()
+
+        results, calls, snap = run(go())
+        assert results == [42] * 9
+        assert len(calls) == 1
+        assert snap["leaders"] == 1 and snap["collapsed"] == 8
+
+    def test_distinct_keys_do_not_collapse(self):
+        async def go():
+            sf = SingleFlight()
+            calls = []
+
+            async def work(i):
+                calls.append(i)
+                await asyncio.sleep(0.02)
+                return i
+
+            out = await asyncio.gather(*(sf.do(i, lambda i=i: work(i)) for i in range(4)))
+            return out, calls
+
+        out, calls = run(go())
+        assert sorted(out) == [0, 1, 2, 3] and len(calls) == 4
+
+    def test_leader_error_propagates_to_followers(self):
+        async def go():
+            sf = SingleFlight()
+
+            async def boom():
+                await asyncio.sleep(0.02)
+                raise ValueError("upstream down")
+
+            results = await asyncio.gather(
+                *(sf.do("k", boom) for _ in range(3)), return_exceptions=True
+            )
+            return results, sf.collapsed_errors
+
+        results, errs = run(go())
+        assert all(isinstance(r, ValueError) for r in results)
+        assert errs == 2  # both followers saw the leader's error
+
+
+# ---------------------------------------------------------------------------
+# unit: prefix index
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixIndex:
+    def test_match_release_insert_roundtrip(self):
+        idx = PrefixIndex(4)
+        toks = np.arange(100, 116, dtype=np.int32)  # 4 full blocks
+        assert idx.match(toks, 4) == []
+        assert idx.insert(toks, [7, 8, 9], 0) == []
+        got = idx.match(toks, 4)
+        assert got == [7, 8, 9]
+        # referenced entries refuse eviction
+        assert idx.evict(10) == []
+        idx.release(toks, 3)
+        assert sorted(idx.evict(10)) == [7, 8, 9]
+
+    def test_partial_prefix_match(self):
+        idx = PrefixIndex(4)
+        a = np.array([1, 2, 3, 4, 5, 6, 7, 8], np.int32)
+        idx.insert(a, [11, 12], 0)
+        idx.release(a, 0)
+        b = np.array([1, 2, 3, 4, 9, 9, 9, 9], np.int32)  # diverges block 2
+        assert idx.match(b, 2) == [11]
+        idx.release(b, 1)
+
+    def test_duplicate_insert_rejected(self):
+        idx = PrefixIndex(4)
+        toks = np.arange(8, dtype=np.int32)
+        assert idx.insert(toks, [3, 4], 0) == []
+        # a concurrent identical prompt completing second gets its blocks back
+        assert idx.insert(toks, [5, 6], 0) == [5, 6]
+
+    def test_eviction_trims_deepest_first_never_orphans(self):
+        idx = PrefixIndex(2)
+        toks = np.arange(8, dtype=np.int32)  # 4 levels
+        idx.insert(toks, [1, 2, 3, 4], 0)
+        # same-tick chain: eviction trims from the TAIL (deepest level),
+        # leaving a still-valid shorter chain — never an orphaned tail
+        assert idx.evict(1) == [4]
+        got = idx.match(toks, 4)
+        assert got == [1, 2, 3]
+        idx.release(toks, 3)
+        assert sorted(idx.evict(10)) == [1, 2, 3]
+        assert len(idx) == 0
+
+
+# ---------------------------------------------------------------------------
+# engine integration: zero-device-step hits + herd collapse
+# ---------------------------------------------------------------------------
+
+
+def _mlp_graph() -> dict:
+    return {
+        "name": "p",
+        "graph": {
+            "name": "mlp", "type": "MODEL", "implementation": "JAX_MODEL",
+            "parameters": [
+                {"name": "family", "value": "mlp", "type": "STRING"},
+                {"name": "buckets", "value": "8", "type": "STRING"},
+                {"name": "max_batch", "value": "8", "type": "INT"},
+                {"name": "max_delay_ms", "value": "1.0", "type": "FLOAT"},
+            ],
+        },
+    }
+
+
+async def _engine_client(spec, *, cache_env=True) -> TestClient:
+    service = PredictionService(PredictorSpec.model_validate(spec))
+    if cache_env:
+        # explicit wiring (no env mutation): response + node caches on
+        service.response_cache = ResponseCache("engine")
+        service.node_cache = ResponseCache("node")
+    app = EngineApp(service).build()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class TestEngineCacheZeroDeviceSteps:
+    def test_exact_hit_spends_no_device_step(self):
+        """Acceptance: an exact-match hit is served with ZERO device steps,
+        asserted via the host-sync counters (obs/probes.py)."""
+        from seldon_core_tpu.obs import host_sync_snapshot
+
+        async def go():
+            client = await _engine_client(_mlp_graph())
+            body = {"data": {"ndarray": [[float(i) for i in range(784)]]}}
+            r1 = await client.post("/api/v0.1/predictions", json=body)
+            b1 = await r1.json()
+            s_after_miss = dict(host_sync_snapshot())
+            r2 = await client.post("/api/v0.1/predictions", json=body)
+            b2 = await r2.json()
+            hit = r2.headers.get("x-sct-cache")
+            s_after_hit = dict(host_sync_snapshot())
+            stats = (await (await client.get("/stats/cache")).json())["cache"]
+            await client.close()
+            return r1.status, b1, r2.status, b2, hit, s_after_miss, s_after_hit, stats
+
+        st1, b1, st2, b2, hit, s_miss, s_hit, stats = run(go())
+        assert (st1, st2) == (200, 200)
+        assert hit == "hit"
+        assert b1["data"] == b2["data"]
+        # the hit added NO host<->device syncs for the model
+        assert s_hit == s_miss, (s_miss, s_hit)
+        assert stats["response"]["hits"] == 1
+        assert stats["graph_deterministic"] is True
+
+    def test_herd_collapses_to_one_computation(self):
+        """Acceptance: N concurrent identical requests collapse to 1
+        upstream computation (host-sync/step counters stay at one
+        computation's worth; collapse counters account for N-1)."""
+        from seldon_core_tpu.obs import host_sync_snapshot
+
+        async def go():
+            client = await _engine_client(_mlp_graph())
+            body = {"data": {"ndarray": [[1.0] * 784]}}
+            # warm the compile so the herd timing is about collapsing, not XLA
+            await client.post("/api/v0.1/predictions", json=body)
+            s0 = dict(host_sync_snapshot())
+            herd_body = {"data": {"ndarray": [[2.0] * 784]}}
+            n = 8
+            rs = await asyncio.gather(*(
+                client.post("/api/v0.1/predictions", json=herd_body)
+                for _ in range(n)
+            ))
+            bodies = [await r.json() for r in rs]
+            s1 = dict(host_sync_snapshot())
+            stats = (await (await client.get("/stats/cache")).json())["cache"]
+            await client.close()
+            return rs, bodies, s0, s1, stats, n
+
+        rs, bodies, s0, s1, stats, n = run(go())
+        assert all(r.status == 200 for r in rs)
+        assert all(b["data"] == bodies[0]["data"] for b in bodies)
+        # one computation's worth of device syncs, not N
+        mlp_key = next((k for k in s1 if "mlp" in k), None)
+        assert mlp_key is not None
+        delta = s1.get(mlp_key, 0) - s0.get(mlp_key, 0)
+        assert delta <= 2, (s0, s1)  # one batcher fetch (+slack), never N
+        # cache hits + collapsed followers account for the other N-1
+        served_free = stats["response"]["hits"] + stats["collapse"]["collapsed"]
+        assert served_free >= n - 1, stats
+
+    def test_nondeterministic_graph_refuses_response_cache(self):
+        async def go():
+            spec = {
+                "name": "p",
+                "graph": {
+                    "name": "ab", "type": "ROUTER",
+                    "implementation": "RANDOM_ABTEST",
+                    "children": [
+                        {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                        {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    ],
+                },
+            }
+            client = await _engine_client(spec)
+            body = {"data": {"ndarray": [[1.0, 2.0]]}}
+            hit = None
+            # several requests: the seeded router alternates children, so
+            # each child's node cache warms within a few calls
+            for _ in range(6):
+                r = await client.post("/api/v0.1/predictions", json=body)
+                hit = hit or r.headers.get("x-sct-cache")
+            stats = (await (await client.get("/stats/cache")).json())["cache"]
+            await client.close()
+            return hit, stats
+
+        hit, stats = run(go())
+        assert hit is None
+        assert stats["graph_deterministic"] is False
+        assert stats["response"]["hits"] == 0
+        # ...but the deterministic MODEL children still node-cache
+        assert stats["node"]["hits"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# KV prefix reuse: pinned-equal + pool accounting
+# ---------------------------------------------------------------------------
+
+
+def _build_tiny(prefix_reuse: bool, mesh=None, n_slots: int = 2):
+    import jax
+
+    from seldon_core_tpu.executor.generation import GenerativeModel
+    from seldon_core_tpu.models import llama
+
+    cfg = llama.Config.tiny(max_seq=128)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return GenerativeModel(
+        cfg,
+        params,
+        n_slots=n_slots,
+        kv_block_size=16,
+        prefix_reuse=prefix_reuse,
+        mesh=mesh,
+        param_axes=llama.param_logical_axes(params) if mesh is not None else None,
+        name="t",
+    )
+
+
+def _generate_all(model, prompts, max_new=8):
+    from seldon_core_tpu.executor.generation import GenerationScheduler
+
+    outs = []
+
+    async def go():
+        s = GenerationScheduler(model)
+        for p in prompts:
+            outs.append(
+                await s.submit(
+                    np.asarray(p, np.int32), max_new_tokens=max_new,
+                    temperature=0.0,
+                )
+            )
+        await s.close()
+
+    run(go())
+    return outs
+
+
+class TestPrefixReusePinnedEqual:
+    PREFIX = list(range(7, 39))  # 32 tokens = 2 full 16-token blocks
+
+    def _prompts(self):
+        return [self.PREFIX + [40 + i, 41 + i, 42 + i] for i in range(3)]
+
+    def test_bit_identical_generations(self):
+        """Acceptance: shared-prefix generations are BIT-IDENTICAL to the
+        no-reuse path, and reuse actually happened."""
+        base = _generate_all(_build_tiny(False), self._prompts())
+        model = _build_tiny(True)
+        reused = _generate_all(model, self._prompts())
+        for a, b in zip(base, reused):
+            assert np.array_equal(a, b), (a.tolist(), b.tolist())
+        assert model.prefills_reused == 2
+        snap = model.prefix_snapshot()
+        assert snap["tokens_reused"] == 64  # 2 hits x 2 blocks x 16 tokens
+
+    def test_bit_identical_under_tp_sharded_mesh(self):
+        """The tp-sharded KV layout (kv heads on the tp axis) must not
+        change reuse results — the layout the multichip dryrun exercises."""
+        from seldon_core_tpu.parallel import best_mesh
+
+        mesh = best_mesh(2, tp=2)
+        base = _generate_all(_build_tiny(False, mesh=mesh), self._prompts())
+        model = _build_tiny(True, mesh=mesh)
+        reused = _generate_all(model, self._prompts())
+        for a, b in zip(base, reused):
+            assert np.array_equal(a, b), (a.tolist(), b.tolist())
+        assert model.prefills_reused == 2
+
+    def test_pool_pressure_evicts_index_before_failing(self):
+        """Index-held blocks are reclaimed under pool pressure instead of
+        starving admission."""
+        model = _build_tiny(True, n_slots=2)
+        prompts = [
+            [10 + i] * 16 + [60 + i, 61 + i] for i in range(8)
+        ]  # 8 distinct 1-block prefixes fill the index over time
+        outs = _generate_all(model, prompts, max_new=4)
+        assert len(outs) == 8
+        snap = model.prefix_snapshot()
+        # every request completed; whatever the index holds plus the free
+        # list accounts for the whole pool (no leaked blocks)
+        assert snap["free_blocks"] + snap["entries"] == snap["pool_blocks"]
+
+    def test_reset_flushes_index(self):
+        model = _build_tiny(True)
+        _generate_all(model, self._prompts())
+        assert len(model.prefix_index) > 0
+        model.reset()
+        assert len(model.prefix_index) == 0
+        assert len(model._free_blocks) == model.kv_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# gateway: h1 splice cache/collapse + spec-hash invalidation via the watch
+# ---------------------------------------------------------------------------
+
+
+async def _gateway_stack(engine_port: int):
+    """Gateway + h1 frontend with an explicitly-wired cache (no env)."""
+    store = DeploymentStore()
+    gw = GatewayApp(store)
+    gw.cache = ResponseCache("gateway")
+    gw._cache_deployments = None
+    frontend = H1SpliceFrontend(gw)
+    port = await frontend.start(0, host="127.0.0.1")
+    return store, gw, frontend, port
+
+
+def _cr(engine_port: int, version: str) -> dict:
+    return {
+        "metadata": {
+            "name": "dep",
+            "annotations": {
+                "seldon.io/engine-host": "127.0.0.1",
+                "seldon.io/engine-rest-port": str(engine_port),
+            },
+        },
+        "spec": {
+            "oauth_key": "key1",
+            "oauth_secret": "sec1",
+            "predictors": [{"graph": {"implementation": "SIMPLE_MODEL",
+                                      "version": version}}],
+        },
+    }
+
+
+async def _h1_token(port: int) -> str:
+    async with aiohttp.ClientSession() as s:
+        resp = await s.post(
+            f"http://127.0.0.1:{port}/oauth/token",
+            data={"grant_type": "client_credentials",
+                  "client_id": "key1", "client_secret": "sec1"},
+        )
+        assert resp.status == 200
+        return (await resp.json())["access_token"]
+
+
+class TestH1SpliceCache:
+    def test_hit_and_stats_route(self):
+        async def go():
+            service = PredictionService(PredictorSpec.model_validate(SIMPLE))
+            engine = TestClient(TestServer(EngineApp(service).build()))
+            await engine.start_server()
+            store, gw, frontend, port = await _gateway_stack(engine.server.port)
+            store.put(DeploymentRecord(
+                name="dep", oauth_key="key1", oauth_secret="sec1",
+                engine_host="127.0.0.1", engine_rest_port=engine.server.port,
+            ))
+            tok = await _h1_token(port)
+            async with aiohttp.ClientSession() as s:
+                hdrs = {"Authorization": f"Bearer {tok}"}
+                body = {"data": {"ndarray": [[1.0, 2.0]]}}
+                r1 = await s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json=body, headers=hdrs,
+                )
+                b1 = await r1.json()
+                h1 = r1.headers.get("x-sct-cache")
+                r2 = await s.post(
+                    f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                    json=body, headers=hdrs,
+                )
+                b2 = await r2.json()
+                h2 = r2.headers.get("x-sct-cache")
+                trace2 = r2.headers.get("x-sct-trace-id")
+                stats = await (
+                    await s.get(f"http://127.0.0.1:{port}/stats/cache")
+                ).json()
+            await frontend.stop()
+            await engine.close()
+            return (r1.status, h1), (r2.status, h2, trace2), b1, b2, stats
+
+        first, second, b1, b2, stats = run(go())
+        assert first == (200, None)
+        assert second[0] == 200 and second[1] == "hit"
+        assert second[2]  # hits still echo a per-request trace id
+        assert b1 == b2
+        assert stats["cache"]["response"]["hits"] == 1
+
+    def test_herd_collapses_on_splice(self):
+        async def go():
+            release = asyncio.Event()
+            hits = []
+
+            async def slow_predict(request):
+                hits.append(1)
+                await release.wait()
+                from aiohttp import web
+
+                return web.json_response({"data": {"ndarray": [[1.0]]}})
+
+            from aiohttp import web
+
+            app = web.Application()
+            app.router.add_post("/api/v0.1/predictions", slow_predict)
+            engine = TestClient(TestServer(app))
+            await engine.start_server()
+            store, gw, frontend, port = await _gateway_stack(engine.server.port)
+            store.put(DeploymentRecord(
+                name="dep", oauth_key="key1", oauth_secret="sec1",
+                engine_host="127.0.0.1", engine_rest_port=engine.server.port,
+            ))
+            tok = await _h1_token(port)
+            async with aiohttp.ClientSession() as s:
+                hdrs = {"Authorization": f"Bearer {tok}",
+                        "Content-Type": "application/json"}
+                body = json.dumps({"data": {"ndarray": [[5.0]]}}).encode()
+                tasks = [
+                    asyncio.create_task(s.post(
+                        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                        data=body, headers=hdrs,
+                    ))
+                    for _ in range(6)
+                ]
+                # wait until the leader reached the engine, then release
+                for _ in range(100):
+                    if hits:
+                        break
+                    await asyncio.sleep(0.01)
+                await asyncio.sleep(0.05)  # let followers park
+                release.set()
+                rs = await asyncio.gather(*tasks)
+                marks = sorted(
+                    (r.headers.get("x-sct-cache") or "leader") for r in rs
+                )
+                statuses = [r.status for r in rs]
+            collapsed = frontend.collapsed
+            await frontend.stop()
+            await engine.close()
+            return statuses, marks, len(hits), collapsed
+
+        statuses, marks, engine_hits, collapsed = run(go())
+        assert statuses == [200] * 6
+        assert engine_hits == 1, "herd must reach the engine exactly once"
+        assert collapsed == 5
+        assert marks.count("collapsed") == 5
+
+
+class TestSpecHashInvalidation:
+    def test_watch_observed_update_flushes_and_rekeys(self):
+        """Satellite acceptance: a spec-hash change observed via
+        gateway/watch.py must flush that deployment's entries — no stale
+        response is servable across a rolling update."""
+
+        async def go():
+            from aiohttp import web
+
+            version = {"v": "one"}
+
+            async def predict(request):
+                return web.json_response({"data": {"ndarray": [[version["v"]]]}})
+
+            app = web.Application()
+            app.router.add_post("/api/v0.1/predictions", predict)
+            engine = TestClient(TestServer(app))
+            await engine.start_server()
+            eport = engine.server.port
+            store, gw, frontend, port = await _gateway_stack(eport)
+            watcher = GatewayWatcher(None, store)
+            watcher._apply("ADDED", _cr(eport, "v1"))
+            tok = await _h1_token(port)
+            async with aiohttp.ClientSession() as s:
+                hdrs = {"Authorization": f"Bearer {tok}"}
+                body = {"data": {"ndarray": [[1.0]]}}
+                url = f"http://127.0.0.1:{port}/api/v0.1/predictions"
+                r1 = await s.post(url, json=body, headers=hdrs)
+                b1 = await r1.json()
+                r2 = await s.post(url, json=body, headers=hdrs)
+                h2 = r2.headers.get("x-sct-cache")
+                # rolling update: the model now answers differently AND the
+                # CR spec changed; the watch applies the new spec
+                version["v"] = "two"
+                watcher._apply("MODIFIED", _cr(eport, "v2"))
+                r3 = await s.post(url, json=body, headers=hdrs)
+                b3 = await r3.json()
+                h3 = r3.headers.get("x-sct-cache")
+            flushes = gw.cache.flushes
+            await frontend.stop()
+            await engine.close()
+            return b1, h2, b3, h3, flushes
+
+        b1, h2, b3, h3, flushes = run(go())
+        assert b1["data"]["ndarray"] == [["one"]]
+        assert h2 == "hit"  # pre-update repeat served from cache
+        # post-update: NOT a hit, and the NEW model's answer — a stale
+        # "one" here would be the rolling-update poison this test pins
+        assert h3 is None
+        assert b3["data"]["ndarray"] == [["two"]]
+        assert flushes >= 1
+
+    def test_removed_deployment_flushes(self):
+        c = ResponseCache("gateway")
+        store = DeploymentStore()
+        gw = GatewayApp(store)
+        gw.cache = c
+        rec = DeploymentRecord(name="dep", oauth_key="k", oauth_secret="s")
+        store.put(rec)
+        c.put(rec.oauth_key, "some-key", b"stale")
+        store.remove(rec.oauth_key)
+        assert c.get(rec.oauth_key, "some-key") is None
+
+
+class TestDeploymentRecordSpecHash:
+    def test_record_changes_rekey(self):
+        a = DeploymentRecord(name="d", oauth_key="k", oauth_secret="s")
+        b = DeploymentRecord(
+            name="d", oauth_key="k", oauth_secret="s",
+            annotations={"img": "v2"},
+        )
+        assert a.spec_hash and b.spec_hash
+        assert a.spec_hash != b.spec_hash
+        # identical fields -> identical hash (records compare equal)
+        assert a == DeploymentRecord(name="d", oauth_key="k", oauth_secret="s")
+
+    def test_watch_hash_covers_graph_spec(self):
+        w = GatewayWatcher(None, DeploymentStore())
+        r1 = w._record({"metadata": {"name": "d"},
+                        "spec": {"oauth_key": "k",
+                                 "predictors": [{"graph": {"version": "1"}}]}})
+        r2 = w._record({"metadata": {"name": "d"},
+                        "spec": {"oauth_key": "k",
+                                 "predictors": [{"graph": {"version": "2"}}]}})
+        assert r1.spec_hash != r2.spec_hash

@@ -428,3 +428,135 @@ class TestHeaderFieldNameStrictness:
 
         resp = run(go())
         assert resp.startswith(b"HTTP/1.1 400"), resp[:64]
+
+
+class TestSpliceBackpressure:
+    """ADVICE r5 item 2: bounded buffering in BOTH directions of the
+    splice — a client pipelining ahead of its response parks in the
+    kernel buffer (pause_reading), and a fast engine stream toward a slow
+    client pauses the ENGINE conn's reads instead of buffering unboundedly
+    in the gateway."""
+
+    def test_pipelined_flood_pauses_downstream_reads(self):
+        async def go():
+            release = asyncio.Event()
+
+            async def handle(reader, writer):
+                await reader.readuntil(b"\r\n\r\n")
+                await release.wait()
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\ncontent-type: application/json\r\n"
+                    b"content-length: 2\r\n\r\n{}"
+                )
+                await writer.drain()
+
+            server = await asyncio.start_server(handle, "127.0.0.1", 0)
+            eport = server.sockets[0].getsockname()[1]
+            frontend, gw, port = await _frontend(eport)
+            async with aiohttp.ClientSession() as s:
+                tok = await _token(s, port)
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(
+                b"POST /api/v0.1/predictions HTTP/1.1\r\n"
+                + f"authorization: Bearer {tok}\r\n".encode()
+                + b"content-length: 2\r\n\r\n{}"
+            )
+            await writer.drain()
+            # flood 1MB of pipelined bytes while the response is pending
+            junk = b"X" * (1 << 20)
+            writer.write(junk)
+            paused_conn = None
+            for _ in range(200):
+                await asyncio.sleep(0.01)
+                for conn in frontend._conns:
+                    if conn._read_paused:
+                        paused_conn = conn
+                        break
+                if paused_conn is not None:
+                    break
+            buffered = len(paused_conn.buf) if paused_conn is not None else -1
+            release.set()
+            data = await asyncio.wait_for(reader.read(200), timeout=5)
+            writer.close()
+            await frontend.stop()
+            server.close()
+            await server.wait_closed()
+            return paused_conn is not None, buffered, data
+
+        paused, buffered, data = run(go())
+        assert paused, "flooded conn never paused its reads"
+        # the gateway buffered at most the cap + one read chunk, not the 1MB
+        assert 0 <= buffered < (1 << 19), buffered
+        assert data.startswith(b"HTTP/1.1 200")
+
+    def test_fast_engine_stream_pauses_upstream_reads(self):
+        async def go():
+            total = 4 * (1 << 20)  # 4MB content-length-framed response
+
+            async def handle(reader, writer):
+                await reader.readuntil(b"\r\n\r\n")
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\ncontent-type: application/octet-stream\r\n"
+                    + b"content-length: %d\r\n\r\n" % total
+                )
+                chunk = b"Y" * (1 << 16)
+                for _ in range(total // len(chunk)):
+                    writer.write(chunk)
+                    await writer.drain()
+                await writer.drain()
+
+            server = await asyncio.start_server(handle, "127.0.0.1", 0)
+            eport = server.sockets[0].getsockname()[1]
+            frontend, gw, port = await _frontend(eport)
+            async with aiohttp.ClientSession() as s:
+                tok = await _token(s, port)
+            import socket as _socket
+
+            sock = _socket.socket()
+            # tiny client receive buffer: the kernel must not absorb the
+            # whole stream, or the gateway-side pause never has to fire
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 8192)
+            sock.connect(("127.0.0.1", port))
+            reader, writer = await asyncio.open_connection(sock=sock, limit=1 << 16)
+            writer.write(
+                b"POST /api/v0.1/predictions HTTP/1.1\r\n"
+                + f"authorization: Bearer {tok}\r\n".encode()
+                + b"content-length: 2\r\n\r\n{}"
+            )
+            await writer.drain()
+            # force the downstream transport to signal fullness early
+            for _ in range(100):
+                await asyncio.sleep(0.01)
+                if frontend._conns:
+                    for c in frontend._conns:
+                        if c.transport is not None:
+                            c.transport.set_write_buffer_limits(high=4096)
+                    break
+            # do NOT read: the gateway's downstream buffer must fill and
+            # propagate the pause to the ENGINE connection
+            saw_pause = False
+            for _ in range(500):
+                await asyncio.sleep(0.01)
+                if any(c._write_paused for c in frontend._conns):
+                    saw_pause = True
+                    break
+            # now drain everything; the stream must complete intact
+            got = 0
+            head = await asyncio.wait_for(
+                reader.readuntil(b"\r\n\r\n"), timeout=10
+            )
+            while got < total:
+                blob = await asyncio.wait_for(reader.read(1 << 20), timeout=10)
+                if not blob:
+                    break
+                got += len(blob)
+            writer.close()
+            await frontend.stop()
+            server.close()
+            await server.wait_closed()
+            return saw_pause, head, got
+
+        saw_pause, head, got = run(go())
+        assert saw_pause, "fast engine stream never paused upstream reads"
+        assert head.startswith(b"HTTP/1.1 200")
+        assert got == 4 * (1 << 20), got
