@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -46,6 +46,13 @@ cache-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_cache.py -q
 	JAX_PLATFORMS=cpu BENCH_ONLY=cache BENCH_SECONDS=2 \
 		BENCH_CACHE_GRAPH=stub BENCH_CACHE_LLM=0 $(PYTHON) bench.py
+
+# hot-path perf gate (docs/PERFORMANCE.md), CPU-safe: overlap smoke
+# asserting ZERO per-token host syncs in steady-state decode (one fetch per
+# fused block), the /stats/warmup attribution endpoint, and the warm-start
+# p99 bound on the stub graph (same tests run in tier-1)
+perf-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_perf.py -q
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -200,6 +200,18 @@ def _breakdown(port: int) -> dict:
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _stats_warmup(port: int) -> dict:
+    """Compile-warmup plane snapshot (GET /stats/warmup): per-unit programs
+    compiled + seconds — proves no first-touch compile can land mid-run."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats/warmup", timeout=5
+        ) as r:
+            return json.loads(r.read()).get("warmup", {})
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _stats_qos(port: int) -> dict:
     """QoS plane snapshot (GET /stats/qos): admitted/shed counters by
     reason, deadline-miss ledger, brownout state."""
@@ -563,16 +575,26 @@ def stage_llm_1b(detail: dict) -> None:
             json.dumps({"tokens": [5, 9, 2, 17, 3, 8, 11, 4]}).encode(),
         )
         wire_snap = _stats_wire(18860)
+        warmup_snap = _stats_warmup(18860)
     tok_s = r.rps * max_new
+    # the acceptance ratios (ISSUE r6): device decode vs the module's OWN
+    # HBM roofline, and wire delivery vs device — each names its limiter
+    dev_tok = (dev or {}).get("tokens_per_s_device")
+    hbm_tok = (dev or {}).get("hbm_roofline_tok_s")
     detail["llm_1b_wire"] = {
         **r.summary(),
         "stats_wire": wire_snap,
+        "warmup": warmup_snap,
         "generated_tokens_per_s": round(tok_s, 1),
+        "device_frac_of_hbm_roofline": (
+            _sig(dev_tok / hbm_tok) if dev_tok and hbm_tok else None
+        ),
+        "wire_frac_of_device": _sig(tok_s / dev_tok) if dev_tok else None,
         "mfu": _wire_mfu(tok_s, dev, key="flops_per_token", digits=6),
         "device": dev,
         "stream": stream,
-        "model": "llama 1.1B bf16 (llama3-1b shape), 8-slot continuous "
-                 f"batching, {max_new} new tokens per request",
+        "model": "llama 1.1B bf16 (llama3-1b shape), overlapped decode "
+                 f"pipeline, {max_new} new tokens per request",
     }
 
 
@@ -857,9 +879,16 @@ def stage_ab(detail: dict) -> None:
             concurrency=16, duration_s=SECONDS,
         )
         bd = _breakdown(18850)
+        warmup_snap = _stats_warmup(18850)
+    p95, p99 = r.percentile_ms(95), r.percentile_ms(99)
     detail["ab_graph"] = {
         **r.summary(), "rows_per_request": rows,
         "predictions_per_s": round(r.rps * rows, 1),
+        # warmup-plane acceptance: with every (bucket, program) pair
+        # compiled before readiness, the p95->p99 cliff must be queueing
+        # noise (<= 2x), not a mid-run XLA compile (r5 saw 4.7x)
+        "p99_over_p95": _sig(p99 / p95) if p95 > 0 else None,
+        "warmup": warmup_snap,
         "breakdown": bd,
         "graph": "EPSILON_GREEDY router over 2 mlp JAX units, in-process",
     }
@@ -1029,8 +1058,15 @@ def stage_gateway(detail: dict) -> None:
             **rest.summary(),
             "direct_engine_rps": direct.rps,
             "vs_direct": round(rest.rps / direct.rps, 4) if direct.rps else None,
+            # splice fast-path acceptance targets (ISSUE r6): p50 < 15ms,
+            # vs_direct >= 0.85 (parity with the gRPC relay)
+            "meets_p50_target_15ms": rest.percentile_ms(50) < 15.0,
+            "meets_vs_direct_target_085": (
+                rest.rps / direct.rps >= 0.85 if direct.rps else None
+            ),
             "note": "zero-parse forward on the hot path (body object only "
-                    "materialized for tap/feedback)",
+                    "materialized for tap/feedback; memoized head parse + "
+                    "preassembled response-head fragments)",
         }
         detail["gateway_grpc"] = {
             **grpc_r.summary(),
@@ -1123,6 +1159,11 @@ _STAGE_HEADLINES = (
     ("llm_generative_wire", "mfu", "llm_mfu"),
     ("llm_1b_wire", "generated_tokens_per_s", "llm1b_tok_s"),
     ("llm_1b_wire", "mfu", "llm1b_mfu"),
+    ("llm_1b_wire", "device_frac_of_hbm_roofline", "llm1b_device_hbm_frac"),
+    ("llm_1b_wire", "wire_frac_of_device", "llm1b_wire_device_frac"),
+    ("ab_graph", "p99_over_p95", "ab_p99_over_p95"),
+    ("gateway_rest", "p50_ms", "gateway_rest_p50_ms"),
+    ("gateway_rest", "vs_direct", "gateway_rest_vs_direct"),
     ("resnet50_wire", "images_per_s", "resnet_img_s"),
     ("resnet50_wire", "mfu", "resnet_mfu"),
     ("ab_graph", "predictions_per_s", "ab_pred_s"),

@@ -83,6 +83,7 @@ class EngineApp:
         self.warmed = False
         self._warmup_error: BaseException | None = None
         self._warmup_task: asyncio.Task | None = None
+        self._warmup_total_s: float | None = None
         self._profile_dir: str | None = None
         # ingress-tier response cache: bound at startup, and ONLY when the
         # whole graph is deterministic (a randomized router poisons
@@ -149,6 +150,9 @@ class EngineApp:
         r.add_get("/stats/wire", self.stats_wire)
         # caching & reuse plane state (docs/CACHING.md)
         r.add_get("/stats/cache", self.stats_cache)
+        # compile-warmup plane: programs compiled + seconds per unit
+        # (docs/PERFORMANCE.md) — the readiness-tail attribution
+        r.add_get("/stats/warmup", self.stats_warmup)
         # XLA/device profiling (SURVEY §5: the reference had only JMX):
         # POST /profile/start {"dir": "/tmp/sct-profile"} ... /profile/stop
         # then open the trace in TensorBoard / xprof
@@ -194,9 +198,15 @@ class EngineApp:
             self._warmup_task = asyncio.create_task(self._warm())
 
     async def _warm(self) -> None:
+        import time as _time
+
+        t0 = _time.perf_counter()
         try:
             report = await self.service.warmup()
-            log.info("warmup complete: %s", report)
+            self._warmup_total_s = round(_time.perf_counter() - t0, 3)
+            log.info(
+                "warmup complete in %.1fs: %s", self._warmup_total_s, report
+            )
             self.warmed = True
         except asyncio.CancelledError:
             raise
@@ -543,6 +553,23 @@ class EngineApp:
         """Wire-throughput accounting (per-edge bytes + achieved MB/s) and
         the always-on probes: event-loop lag, host syncs per model."""
         return web.json_response(wire_stats_payload())
+
+    async def stats_warmup(self, request: web.Request) -> web.Response:
+        """Compile-warmup plane state: readiness, per-unit programs
+        compiled + wall seconds, total warmup time.  Readiness stays 503
+        until every (bucket, program) pair is compiled, so a user request
+        can never pay a first-touch XLA compile."""
+        snap = self.service.warmup_snapshot()
+        snap.update(
+            warmed=self.warmed,
+            error=(
+                str(self._warmup_error)
+                if self._warmup_error is not None
+                else None
+            ),
+            total_seconds=self._warmup_total_s,
+        )
+        return web.json_response({"warmup": snap})
 
     async def stats_cache(self, request: web.Request) -> web.Response:
         """Caching & reuse plane state: response/node cache hit rates,

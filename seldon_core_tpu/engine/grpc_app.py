@@ -77,8 +77,16 @@ class SeldonGrpc:
         return msg
 
     async def stream_predict_raw(self, payload: bytes):
-        """Server-streaming token generation on the fast plane (no grpcio
-        analogue in the reference; REST twin: engine/app.py
+        """Raw-bytes adapter for the fast h2 plane: parse once, serialize
+        each streamed message."""
+        req = pb.SeldonMessage()
+        req.ParseFromString(payload)
+        async for msg in self.stream_predict(req):
+            yield msg.SerializeToString()
+
+    async def stream_predict(self, req: pb.SeldonMessage):
+        """Server-streaming token generation (``rpc Seldon.StreamPredict``
+        in proto/prediction.proto; REST twin: engine/app.py
         predictions_stream).  Request: SeldonMessage strData
         ``{"tokens": [...], ...}``.  Responses: one SeldonMessage strData
         ``{"token": id}`` per generated token, then ``{"done": true,
@@ -95,8 +103,6 @@ class SeldonGrpc:
                 "streaming needs exactly one generative unit in the graph "
                 f"(found {len(units)})",
             )
-        req = pb.SeldonMessage()
-        req.ParseFromString(payload)
         if not req.strData:
             raise GrpcCallError(3, "StreamPredict takes strData JSON")
         try:
@@ -117,10 +123,10 @@ class SeldonGrpc:
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             raise GrpcCallError(3, f"bad stream request: {e}") from e
 
-        def msg(obj: dict) -> bytes:
+        def msg(obj: dict) -> pb.SeldonMessage:
             out = pb.SeldonMessage()
             out.strData = json.dumps(obj)
-            return out.SerializeToString()
+            return out
 
         tokens: list[int] = []
         try:
@@ -172,8 +178,9 @@ async def start_engine_grpc(
             {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback},
         ),
         on_request_headers=seed_trace_context,
-        # fast-plane-only extension (grpcio fallback serves unary only):
-        # token streaming for generative graphs
+        # token streaming for generative graphs — declared in the contract
+        # (rpc Seldon.StreamPredict) and served by BOTH transports (the
+        # grpcio fallback registers the same core in _start_grpcio)
         stream_handlers={
             "/seldon.protos.Seldon/StreamPredict": handler.stream_predict_raw
         },
@@ -182,6 +189,15 @@ async def start_engine_grpc(
     server.bound_port = bound
     log.info("engine gRPC (Seldon service, h2 data plane) on :%d", bound)
     return server
+
+
+def _status_code(code: int) -> grpc.StatusCode:
+    """Numeric grpc-status -> grpc.StatusCode (grpcio abort() wants the
+    enum; the fast plane speaks raw integers)."""
+    for sc in grpc.StatusCode:
+        if sc.value[0] == code:
+            return sc
+    return grpc.StatusCode.UNKNOWN
 
 
 async def _start_grpcio(
@@ -196,7 +212,25 @@ async def _start_grpcio(
             (k, 1 if k == "grpc.so_reuseport" else v) for k, v in SERVER_OPTIONS
         ]
     server = grpc.aio.server(options=options)
-    add_service(server, "Seldon", {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback})
+
+    async def _stream_predict(request, context):
+        # the grpcio twin of the fast plane's stream handler: declared in
+        # the published contract (rpc Seldon.StreamPredict), so a stock
+        # grpcio-codegen client streams tokens from either transport
+        from seldon_core_tpu.wire import GrpcCallError
+
+        try:
+            async for msg in handler.stream_predict(request):
+                yield msg
+        except GrpcCallError as e:
+            await context.abort(_status_code(e.status), e.message)
+
+    add_service(
+        server,
+        "Seldon",
+        {"Predict": handler.Predict, "SendFeedback": handler.SendFeedback},
+        stream_handlers={"StreamPredict": _stream_predict},
+    )
     bound = await bind_insecure_port(server, port)
     await server.start()
     server.bound_port = bound  # real port when asked for :0 (tests)

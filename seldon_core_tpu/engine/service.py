@@ -116,6 +116,19 @@ class PredictionService:
         self.warmup_report = await self.walker.warmup()
         return self.warmup_report
 
+    def warmup_snapshot(self) -> dict[str, Any]:
+        """Warmup-plane state for ``GET /stats/warmup``: programs compiled
+        and wall seconds per unit — the attribution for a slow readiness
+        tail or (its absence proving) a mid-serving first-touch compile."""
+        return {
+            "programs": self.warmup_report,
+            "seconds": (
+                dict(self.walker.warmup_seconds)
+                if self.walker is not None
+                else None
+            ),
+        }
+
     async def close(self) -> None:
         if self.walker is not None:
             await self.walker.aclose()

@@ -67,11 +67,17 @@ class BatchQueue:
         *,
         max_batch: int = 64,
         max_delay_ms: float = 2.0,
-        pipeline_depth: int = 8,
+        pipeline_depth: int | None = None,
         name: str = "model",
         maxsize: int | None = None,
     ):
         self.runner = runner
+        if pipeline_depth is None:
+            # in-flight device steps the stream keeps dispatched ahead of
+            # the fetches (overlap depth): each step's fetch is ONE host
+            # sync for the whole batch, and deeper pipelining hides more of
+            # the per-step round trip behind device compute
+            pipeline_depth = int(os.environ.get("SCT_BATCH_PIPELINE", "8"))
         self.max_batch = int(max_batch)
         self.max_delay = max_delay_ms / 1000.0
         self.name = name

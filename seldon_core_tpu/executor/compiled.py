@@ -206,14 +206,37 @@ class CompiledModel:
         The reference warms nothing — first-request latency spikes are
         visible in its max-latency numbers (docs/benchmarking.md:42-45,
         max 5071 ms).  Here rollout warms all shapes before readiness.
+
+        Single-host, the bucket compiles run CONCURRENTLY on a small thread
+        pool (XLA compilation releases the GIL, and the jit cache is
+        thread-safe), so the readiness tail approaches the slowest bucket's
+        compile instead of the ladder's sum.  Multi-host slices keep the
+        sequential dispatch path: every bucket must broadcast to the
+        followers in a deterministic order.
         """
-        for b in self.buckets.sizes:
+        import concurrent.futures
+        import os
+
+        def _one(b: int) -> None:
             x = np.zeros((b,) + tuple(feature_shape), dtype=dtype)
             # warm through the dispatch path so multi-host slices compile
             # each bucket on every process (workers get the same steps via
             # the follower broadcast)
             out, _ = self.dispatch(x)
             jax.block_until_ready(out)
+
+        workers = int(os.environ.get("SCT_WARMUP_CONCURRENCY", "4"))
+        if self.driver is not None or workers <= 1 or len(self.buckets.sizes) <= 1:
+            for b in self.buckets.sizes:
+                _one(b)
+        else:
+            with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(workers, len(self.buckets.sizes)),
+                thread_name_prefix=f"warmup-{self.name}",
+            ) as pool:
+                # surface the first compile failure, not a swallowed future
+                for f in [pool.submit(_one, b) for b in self.buckets.sizes]:
+                    f.result()
         return len(self.buckets.sizes)
 
     def aot_lower(self, feature_shape: tuple[int, ...], dtype: Any = np.float32):

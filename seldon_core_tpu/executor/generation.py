@@ -15,8 +15,17 @@ Design (vLLM-style slots, XLA-flavored):
 * **decode** advances ALL active slots one token per device step with a
   single compiled program (static shapes, per-slot position masks) — new
   requests join between steps without stalling in-flight ones;
-* sampling happens on device (``sample_tokens``): only ``(S,)`` token ids
-  cross the host boundary per step, never ``(S, vocab)`` logits.
+* sampling happens on device (``sample_tokens``, fused greedy/top-k): only
+  ``(S,)`` token ids cross the host boundary per step, never ``(S, vocab)``
+  logits;
+* **overlapped pipeline** (docs/PERFORMANCE.md): the fused k-step decode
+  program returns its final ``(tokens, active, remaining)`` carry as device
+  arrays, so in steady state block N+1 dispatches straight from block N's
+  on-device carry *before* the host fetches block N's tokens — the host
+  consumes results while the chip is already computing the next block, and
+  the per-block host round trip vanishes from the critical path.  Any
+  host-side state change (admission, deadline reap, disconnect) marks the
+  carry dirty and forces one synchronous dispatch rebuilt from host state.
 
 ``GenerationScheduler`` is the asyncio front: ``submit(prompt) ->
 generated ids``; per-request ``max_new_tokens`` / ``temperature`` /
@@ -91,11 +100,12 @@ class GenerativeModel:
         dtype: Any = None,
         seq_impl: str = "dense",
         name: str = "generative",
-        decode_block: int = 8,
+        decode_block: int = 16,
         driver: Any = None,
         kv_block_size: int = 16,
         kv_blocks: int | None = None,
         prefix_reuse: bool | None = None,
+        top_k: int = 0,
     ):
         if family_mod is None:
             from seldon_core_tpu.models import llama as family_mod
@@ -224,6 +234,24 @@ class GenerativeModel:
 
         fam = family_mod
 
+        # fused on-device sampling: greedy or top-k, inside the compiled
+        # step — the host never sees logits.  top_k is STATIC (one program
+        # per value), validated here so a typo fails at build, not in jit.
+        self.top_k = int(top_k or 0)
+        if self.top_k:
+            import inspect
+
+            if "top_k" not in inspect.signature(fam.sample_tokens).parameters:
+                raise GraphUnitError(
+                    f"generative family {fam.__name__} does not support "
+                    "on-device top-k sampling (sample_tokens lacks top_k)"
+                )
+            import functools
+
+            _sample = functools.partial(fam.sample_tokens, top_k=self.top_k)
+        else:
+            _sample = fam.sample_tokens
+
         def _replicate(x):
             """Token outputs replicate across the slice so the coordinator
             can read the full result locally (no-op single-host)."""
@@ -239,7 +267,7 @@ class GenerativeModel:
                 mesh=mesh, seq_impl=seq_impl,
             )
             key = jax.random.PRNGKey(seed)
-            tok = fam.sample_tokens(logits[None], temperature[None], key)[0]
+            tok = _sample(logits[None], temperature[None], key)[0]
             return _replicate(tok), cache
 
         def _decode(window):
@@ -248,7 +276,7 @@ class GenerativeModel:
                     params, tokens, cache, active, cfg, window=window
                 )
                 key = jax.random.PRNGKey(seed)
-                toks = fam.sample_tokens(logits, temperature, key)
+                toks = _sample(logits, temperature, key)
                 return _replicate(toks), cache
 
             return fn
@@ -258,7 +286,14 @@ class GenerativeModel:
             per-slot eos/budget early exit ON DEVICE.  One host round trip
             per k tokens instead of per token — the difference between 30
             tok/s and real throughput when the chip sits behind a network
-            tunnel, and one dispatch overhead instead of k on local chips."""
+            tunnel, and one dispatch overhead instead of k on local chips.
+
+            Returns the per-step ``(k, S)`` tokens/active-mask AND the final
+            ``(tokens, active, remaining)`` carry as device arrays: the
+            overlapped pipeline feeds the carry straight into the next
+            block's dispatch so steady-state decode never waits on a host
+            round trip (the carry args are donated — each block consumes
+            its predecessor's buffers in place)."""
             from jax import lax
             import jax.numpy as jnp
 
@@ -278,7 +313,7 @@ class GenerativeModel:
                         params, tokens, cache, active, cfg, window=window
                     )
                     key = jax.random.fold_in(base_key, i)
-                    toks = fam.sample_tokens(logits, temperature, key)
+                    toks = _sample(logits, temperature, key)
                     toks = jnp.where(active, toks, tokens)
                     remaining = jnp.where(active, remaining - 1, remaining)
                     done = (toks == eos) | (remaining <= 0)
@@ -288,7 +323,14 @@ class GenerativeModel:
                 (tokens, active, remaining, cache), (toks_seq, act_seq) = lax.scan(
                     body, (tokens, active, remaining, cache), jnp.arange(k)
                 )
-                return _replicate(toks_seq), _replicate(act_seq), cache
+                return (
+                    _replicate(toks_seq),
+                    _replicate(act_seq),
+                    _replicate(tokens),
+                    _replicate(active),
+                    _replicate(remaining),
+                    cache,
+                )
 
             return fn
 
@@ -303,7 +345,7 @@ class GenerativeModel:
                     suffix_blocks, cache, cfg, prefix_window=pw,
                 )
                 key = jax.random.PRNGKey(seed)
-                tok = fam.sample_tokens(logits[None], temperature[None], key)[0]
+                tok = _sample(logits[None], temperature[None], key)[0]
                 return _replicate(tok), cache
 
             return fn
@@ -317,6 +359,13 @@ class GenerativeModel:
         self._decode_jit: dict[int, Any] = {}  # window -> jitted step
         self._decode_k_factory = _decode_k
         self._decode_k_jit: dict[tuple[int, int], Any] = {}  # (k, window)
+        # overlapped-pipeline state: the last dispatched block's final
+        # (tokens, active, remaining) as DEVICE arrays, plus the host-side
+        # (temperature, eos) the block ran with — a continue-dispatch feeds
+        # these straight back into the next block without a host sync
+        self._carry: tuple | None = None
+        self._carry_aux: tuple | None = None
+        self.overlapped = 0  # blocks dispatched from the on-device carry
         # host-side per-slot position CEILING (>= true device position; the
         # device may stop early on eos).  Drives the attention-window bucket:
         # decode reads only cache rows [0, window) — the bandwidth bill once
@@ -338,6 +387,11 @@ class GenerativeModel:
             )
             self._mh_decode_k_key = self.driver.register_unique(
                 f"gen:{name}:decode_k", self._exec_decode_k
+            )
+            # overlap continue: payload carries only (k, window, seed) —
+            # every process feeds its own locally-stored device carry
+            self._mh_decode_cont_key = self.driver.register_unique(
+                f"gen:{name}:decode_cont", self._exec_decode_cont
             )
             # reset writes the pos vector with a cross-process sharding —
             # a device_put every process must participate in, so it's a
@@ -683,6 +737,30 @@ class GenerativeModel:
         are real.  ``eos`` is per-slot (-1 = none), ``remaining`` the
         per-slot token budget — both enforced on device so a slot stops
         consuming cache the step it finishes."""
+        return self.step_k_fetch(
+            self.step_k_dispatch(
+                tokens, active, temperature, seed, eos, remaining, k,
+                window=window,
+            )
+        )
+
+    def step_k_dispatch(
+        self,
+        tokens: np.ndarray,
+        active: np.ndarray,
+        temperature: np.ndarray,
+        seed: int,
+        eos: np.ndarray,
+        remaining: np.ndarray,
+        k: int,
+        window: int | None = None,
+    ) -> tuple:
+        """Enqueue one k-step decode block WITHOUT fetching its tokens (JAX
+        dispatch is async: this returns device arrays immediately).  The
+        handle goes to :meth:`step_k_fetch`; between the two the host is
+        free to deliver the previous block's tokens — and, in steady state,
+        to dispatch the NEXT block from the on-device carry
+        (:meth:`step_k_continue`) so the chip never idles on the host."""
         payload = {
             "tokens": np.asarray(tokens, np.int32),
             "active": np.asarray(active, bool),
@@ -699,32 +777,102 @@ class GenerativeModel:
         else:
             toks_seq, act_seq = self._exec_decode_k(payload)
         self._pos_ceiling[np.asarray(active, bool)] += k
-        # ONE device_get for both arrays: two separate fetches would pay two
-        # host round trips per block on a tunnel-attached chip
+        return (toks_seq, act_seq, t0)
+
+    def step_k_continue(
+        self, active: np.ndarray, seed: int, k: int, window: int | None = None
+    ) -> tuple:
+        """Dispatch the next k-step block straight from the previous
+        block's on-device ``(tokens, active, remaining)`` carry — no host
+        round trip touches the critical path.  The caller guarantees no
+        host-side state changed since that block was dispatched (no
+        admission, no reap, no slot release); eos/budget transitions are
+        already device-visible, so a slot that finished mid-block simply
+        rides along inactive (its writes go to the sink block)."""
+        payload = {
+            "k": int(k),
+            "seed": int(seed),
+            "window": window or self._window_for(active, k),
+        }
+        t0 = time.perf_counter()
+        if self.driver is not None:
+            toks_seq, act_seq = self.driver.lead(self._mh_decode_cont_key, payload)
+        else:
+            toks_seq, act_seq = self._exec_decode_cont(payload)
+        self._pos_ceiling[np.asarray(active, bool)] += k
+        self.overlapped += 1
+        return (toks_seq, act_seq, t0)
+
+    def step_k_fetch(self, handle: tuple) -> tuple[np.ndarray, np.ndarray]:
+        """Materialize a dispatched block's ``(k, S)`` tokens + active mask.
+        ONE device_get for both arrays: two separate fetches would pay two
+        host round trips per block on a tunnel-attached chip."""
+        toks_seq, act_seq, t0 = handle
         toks_np, act_np = jax.device_get((toks_seq, act_seq))
         act_np = np.asarray(act_np)
         self._record_step(time.perf_counter() - t0, int(act_np.sum()))
         return np.asarray(toks_np), act_np
 
-    def _exec_decode_k(self, payload: dict):
-        k = int(payload["k"])
-        window = int(payload.get("window") or self.cfg.max_seq)
+    def _decode_k_fn(self, k: int, window: int):
         key = (k, window)
         fn = self._decode_k_jit.get(key)
         if fn is None:
-            fn = jax.jit(self._decode_k_factory(k, window), donate_argnums=(7,))
+            # donate the carry args (tokens/active/remaining) along with the
+            # cache: each block consumes its predecessor's buffers in place,
+            # so the overlapped pipeline holds one live carry, not two
+            fn = jax.jit(
+                self._decode_k_factory(k, window), donate_argnums=(1, 2, 6, 7)
+            )
             self._decode_k_jit[key] = fn
+        return fn
+
+    def _exec_decode_k(self, payload: dict):
+        k = int(payload["k"])
+        window = int(payload.get("window") or self.cfg.max_seq)
+        fn = self._decode_k_fn(k, window)
         with self._lock:
-            toks_seq, act_seq, self._cache = fn(
+            temps = np.asarray(payload["temperature"], np.float32)
+            eos = np.asarray(payload["eos"], np.int32)
+            (toks_seq, act_seq, tok_c, act_c, rem_c, self._cache) = fn(
                 self.params,
                 np.asarray(payload["tokens"], np.int32),
                 np.asarray(payload["active"], bool),
-                np.asarray(payload["temperature"], np.float32),
+                temps,
                 np.int32(payload["seed"]),
-                np.asarray(payload["eos"], np.int32),
+                eos,
                 np.asarray(payload["remaining"], np.int32),
                 self._cache,
             )
+            self._carry = (tok_c, act_c, rem_c)
+            self._carry_aux = (temps, eos)
+            self.steps += k
+        return toks_seq, act_seq
+
+    def _exec_decode_cont(self, payload: dict):
+        """Symmetric continue body (runs on every slice process): the next
+        block's inputs are THIS process's stored device carry."""
+        k = int(payload["k"])
+        window = int(payload.get("window") or self.cfg.max_seq)
+        fn = self._decode_k_fn(k, window)
+        with self._lock:
+            if self._carry is None or self._carry_aux is None:
+                raise RuntimeError(
+                    f"generative model {self.name!r}: decode continue "
+                    "without a carried block"
+                )
+            tok_c, act_c, rem_c = self._carry
+            temps, eos = self._carry_aux
+            (toks_seq, act_seq, tok_c, act_c, rem_c, self._cache) = fn(
+                self.params,
+                tok_c,
+                act_c,
+                temps,
+                np.int32(payload["seed"]),
+                eos,
+                rem_c,
+                self._cache,
+            )
+            self._carry = (tok_c, act_c, rem_c)
             self.steps += k
         return toks_seq, act_seq
 
@@ -771,9 +919,55 @@ class GenerativeModel:
                         window=w,
                     )
                 n += 1
+            # KV prefix reuse on: the suffix-prefill program for each
+            # prefix window would otherwise first-compile on the first
+            # shared-prefix request mid-serving (seconds on a big model).
+            # Warm the canonical shape — smallest suffix bucket per window
+            # (the "long system prompt + short novel question" pattern);
+            # other suffix buckets compile organically.  Garbage K/V lands
+            # in the reserved sink block 0, never read; the prefill
+            # counters are restored so reuse accounting stays honest.
+            if (
+                self.prefix_index is not None
+                and os.environ.get("SCT_WARMUP_SUFFIX", "1") != "0"
+            ):
+                bucket = self.prefill_buckets[0]
+                pf, pfr = self.prefills, self.prefills_reused
+                for pw in self._prefix_windows():
+                    payload = {
+                        "padded": np.zeros((1, bucket), np.int32),
+                        "prefix_len": pw,
+                        "length": pw,
+                        "slot": 0,
+                        "blocks": np.zeros(self.max_blocks_per_slot, np.int32),
+                        "suffix_blocks": np.zeros(
+                            bucket // self.kv_block_size, np.int32
+                        ),
+                        "window": pw,
+                        "temperature": 0.0,
+                        "seed": 0,
+                    }
+                    if self.driver is not None:
+                        self.driver.lead(self._mh_prefill_suffix_key, payload)
+                    else:
+                        self._exec_prefill_suffix(payload)
+                    n += 1
+                self.prefills, self.prefills_reused = pf, pfr
             # warmup wrote garbage into slot 0 and advanced nothing real
             self.reset()
             return n
+
+    def _prefix_windows(self) -> list[int]:
+        """Every window :meth:`_prefix_window` can return: block-size
+        powers-of-two up to max_seq (bounded — 8 values at max_seq 2048
+        with 16-token blocks)."""
+        out = []
+        w = self.kv_block_size
+        while w < self.cfg.max_seq:
+            out.append(w)
+            w *= 2
+        out.append(self.cfg.max_seq)
+        return out
 
     def _window_buckets(self) -> list[int]:
         out = []
@@ -855,8 +1049,21 @@ class GenerationScheduler:
     prefill or decode step is spent on them, and a client that disconnects
     before its slot is assigned is withdrawn from the queue entirely."""
 
-    def __init__(self, model: GenerativeModel, *, maxsize: int | None = None):
+    def __init__(
+        self,
+        model: GenerativeModel,
+        *,
+        maxsize: int | None = None,
+        overlap: bool | None = None,
+    ):
         self.model = model
+        # overlapped pipeline (docs/PERFORMANCE.md): dispatch block N+1
+        # from the device carry before consuming block N's tokens.  On by
+        # default for fused blocks; SCT_GEN_OVERLAP=0 (or the ``overlap``
+        # graph parameter) restores the strictly sequential loop.
+        if overlap is None:
+            overlap = os.environ.get("SCT_GEN_OVERLAP", "1") != "0"
+        self.overlap = bool(overlap) and model.decode_block > 1
         # waiting requests (priority-sorted at pop time) + a wake event the
         # run loop parks on when fully idle
         self._waiting: list[_Request] = []
@@ -1034,9 +1241,13 @@ class GenerationScheduler:
                 keep.append(req)
             q[:] = keep
 
-    def _reap_slots(self, slots, active) -> None:
+    def _reap_slots(self, slots, active) -> int:
         """In-flight QoS sweep: a slot whose client vanished or whose
-        deadline passed must stop consuming decode steps mid-generation."""
+        deadline passed must stop consuming decode steps mid-generation.
+        Returns the number of slots reaped — a host-side reap invalidates
+        the device carry (the chip still thinks the slot is active), so the
+        overlap pipeline must rebuild its next dispatch from host state."""
+        reaped = 0
         now = time.monotonic()
         for i in range(len(slots)):
             req = slots[i]
@@ -1059,6 +1270,37 @@ class GenerationScheduler:
             slots[i] = None
             active[i] = False
             self.model.release_slot(i)
+            reaped += 1
+        return reaped
+
+    def _deliver(self, toks_seq, act_seq, slots, cur, active) -> None:
+        """Fan one fetched block's ``(k, S)`` tokens out to their requests.
+        Completions here (eos / budget) are DEVICE-visible transitions —
+        the chip flipped the slot inactive at the same step — so the device
+        carry stays consistent and the overlap pipeline keeps running; the
+        freed slot's blocks are only re-reserved at the next sync point."""
+        S = len(slots)
+        for step_i in range(toks_seq.shape[0]):
+            for i in range(S):
+                if not act_seq[step_i, i] or slots[i] is None:
+                    continue
+                req = slots[i]
+                tok = int(toks_seq[step_i, i])
+                cur[i] = tok
+                if self._token_done(req, tok):
+                    self._complete(req)
+                    slots[i] = None
+                    active[i] = False
+                    self.model.release_slot(i)
+
+    def _fail_inflight(self, slots, active, exc: BaseException) -> None:
+        """A failed device step poisons every in-flight request."""
+        for i in range(len(slots)):
+            if slots[i] is not None and not slots[i].future.done():
+                slots[i].future.set_exception(exc)
+            slots[i] = None
+            self.model.release_slot(i)
+        active[:] = False
 
     async def _run(self) -> None:
         S = self.model.n_slots
@@ -1066,109 +1308,174 @@ class GenerationScheduler:
         cur = np.zeros(S, np.int32)
         temps = np.zeros(S, np.float32)
         active = np.zeros(S, bool)
+        k = self.model.decode_block
+        # overlapped pipeline state: the dispatched-but-unfetched block, and
+        # whether the device carry still matches host bookkeeping (a reap or
+        # admission makes the next dispatch rebuild from host arrays)
+        pending: tuple | None = None
+        carry_dirty = True
         try:
             while True:
                 self._reap_queues()
-                if not active.any() and not self._overflow and not self._waiting:
+                if (
+                    pending is None
+                    and not active.any()
+                    and not self._overflow
+                    and not self._waiting
+                ):
                     # fully idle: park until a submit wakes us (no await
                     # between the emptiness check and clear, so a submit
                     # landing now still sets the event we wait on)
                     self._wake.clear()
                     await self._wake.wait()
                     self._reap_queues()
-                batch: list[_Request] = []
-                # admit whatever is waiting into remaining free slots —
-                # block-starved overflow first, then the wait list in
-                # (priority, arrival) order so batch traffic can never
-                # starve interactive; all prefills dispatch back-to-back
-                # and their first tokens are fetched in ONE device round
-                # trip
-                while self._overflow and int(active.sum()) + len(batch) < S:
-                    batch.append(self._overflow.pop(0))
-                if self._waiting and int(active.sum()) + len(batch) < S:
-                    self._waiting.sort(
-                        key=lambda r: (qos.priority_rank(r.priority), r.t0)
-                    )
-                    while self._waiting and int(active.sum()) + len(batch) < S:
-                        batch.append(self._waiting.pop(0))
-                if batch:
-                    await self._admit_batch(batch, slots, cur, temps, active)
-                self._reap_slots(slots, active)
-                if not active.any():
-                    if self._overflow:
-                        # nothing in flight can ever free blocks: these
-                        # requests exceed the pool outright
-                        err = GraphUnitError(
-                            "request KV reservation exceeds the configured "
-                            f"pool ({self.model.kv_blocks - 1} blocks of "
-                            f"{self.model.kv_block_size})"
+                if pending is None:
+                    # sync point: admissions and dispatch only happen with
+                    # no block in flight — a prefill (or a freed block's
+                    # reuse) must never race a dispatched decode.
+                    # Admit whatever is waiting into remaining free slots —
+                    # block-starved overflow first, then the wait list in
+                    # (priority, arrival) order so batch traffic can never
+                    # starve interactive; all prefills dispatch back-to-back
+                    # and their first tokens are fetched in ONE device
+                    # round trip
+                    batch: list[_Request] = []
+                    while self._overflow and int(active.sum()) + len(batch) < S:
+                        batch.append(self._overflow.pop(0))
+                    if self._waiting and int(active.sum()) + len(batch) < S:
+                        self._waiting.sort(
+                            key=lambda r: (qos.priority_rank(r.priority), r.t0)
                         )
-                        for req in self._overflow:
-                            if not req.future.done():
-                                req.future.set_exception(err)
-                        self._overflow.clear()
-                    continue
-                seed = self._next_seed()
-                k = self.model.decode_block
-                try:
+                        while self._waiting and int(active.sum()) + len(batch) < S:
+                            batch.append(self._waiting.pop(0))
+                    if batch:
+                        await self._admit_batch(batch, slots, cur, temps, active)
+                    self._reap_slots(slots, active)
+                    if not active.any():
+                        if self._overflow:
+                            # nothing in flight can ever free blocks: these
+                            # requests exceed the pool outright
+                            err = GraphUnitError(
+                                "request KV reservation exceeds the configured "
+                                f"pool ({self.model.kv_blocks - 1} blocks of "
+                                f"{self.model.kv_block_size})"
+                            )
+                            for req in self._overflow:
+                                if not req.future.done():
+                                    req.future.set_exception(err)
+                            self._overflow.clear()
+                        continue
+                    seed = self._next_seed()
                     if k <= 1:
-                        toks = await asyncio.to_thread(
-                            self.model.step, cur, active, temps, seed
+                        # single-step path (decode_block=1): dispatch, fetch
+                        # and deliver inline — no fused block to overlap
+                        try:
+                            toks = await asyncio.to_thread(
+                                self.model.step, cur, active, temps, seed
+                            )
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception as exc:
+                            log.exception(
+                                "decode step failed; failing %d in-flight requests",
+                                int(active.sum()),
+                            )
+                            self._fail_inflight(slots, active, exc)
+                            continue
+                        self._deliver(toks[None], active.copy()[None], slots, cur, active)
+                        self._reap_slots(slots, active)
+                        continue
+                    # one dispatch yields up to k tokens per slot; the
+                    # device enforces per-slot eos + budget so finished
+                    # slots stop touching the cache mid-block
+                    eos = np.array(
+                        [
+                            slots[i].eos_id
+                            if slots[i] is not None and slots[i].eos_id is not None
+                            else -1
+                            for i in range(S)
+                        ],
+                        np.int32,
+                    )
+                    remaining = np.array(
+                        [
+                            max(0, slots[i].max_new_tokens - len(slots[i].out))
+                            if slots[i] is not None
+                            else 0
+                            for i in range(S)
+                        ],
+                        np.int32,
+                    )
+                    try:
+                        pending = await asyncio.to_thread(
+                            self.model.step_k_dispatch,
+                            cur, active, temps, seed, eos, remaining, k,
                         )
-                        toks_seq = toks[None]
-                        act_seq = active.copy()[None]
-                    else:
-                        # one dispatch yields up to k tokens per slot; the
-                        # device enforces per-slot eos + budget so finished
-                        # slots stop touching the cache mid-block
-                        eos = np.array(
-                            [
-                                slots[i].eos_id
-                                if slots[i] is not None and slots[i].eos_id is not None
-                                else -1
-                                for i in range(S)
-                            ],
-                            np.int32,
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:
+                        log.exception(
+                            "decode dispatch failed; failing %d in-flight requests",
+                            int(active.sum()),
                         )
-                        remaining = np.array(
-                            [
-                                max(0, slots[i].max_new_tokens - len(slots[i].out))
-                                if slots[i] is not None
-                                else 0
-                                for i in range(S)
-                            ],
-                            np.int32,
+                        self._fail_inflight(slots, active, exc)
+                        continue
+                    carry_dirty = False
+                    continue
+                # fetch phase — THE overlap: while block N's results are in
+                # flight, dispatch block N+1 straight from the on-device
+                # carry, so the chip starts the next block before the host
+                # has even seen this one.  Only in steady state: waiting
+                # work needs a sync point (admission), and a dirty carry
+                # (host-side reap) must be rebuilt from host arrays.
+                nxt: tuple | None = None
+                if (
+                    self.overlap
+                    and not carry_dirty
+                    and active.any()
+                    and not self._waiting
+                    and not self._overflow
+                ):
+                    try:
+                        nxt = await asyncio.to_thread(
+                            self.model.step_k_continue, active, self._next_seed(), k
                         )
-                        toks_seq, act_seq = await asyncio.to_thread(
-                            self.model.step_k, cur, active, temps, seed, eos, remaining, k
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:
+                        log.exception(
+                            "overlapped dispatch failed; falling back to sequential"
                         )
+                        nxt = None
+                        carry_dirty = True
+                try:
+                    toks_seq, act_seq = await asyncio.to_thread(
+                        self.model.step_k_fetch, pending
+                    )
                 except asyncio.CancelledError:
                     raise
                 except Exception as exc:
-                    # a failed device step poisons every in-flight request;
-                    # log it too — clients see the error, operators need it
-                    # in the pod logs
-                    log.exception("decode step failed; failing %d in-flight requests",
-                                  int(active.sum()))
-                    for i in range(S):
-                        if slots[i] is not None and not slots[i].future.done():
-                            slots[i].future.set_exception(exc)
-                        slots[i] = None
-                        self.model.release_slot(i)
-                    active[:] = False
+                    log.exception(
+                        "decode step failed; failing %d in-flight requests",
+                        int(active.sum()),
+                    )
+                    if nxt is not None:
+                        # drain the speculative block too (its carry chained
+                        # off the failed one; a dangling fetch helps nobody)
+                        try:
+                            await asyncio.to_thread(self.model.step_k_fetch, nxt)
+                        except Exception:
+                            pass
+                    pending = None
+                    carry_dirty = True
+                    self._fail_inflight(slots, active, exc)
                     continue
-                for step_i in range(toks_seq.shape[0]):
-                    for i in range(S):
-                        if not act_seq[step_i, i] or slots[i] is None:
-                            continue
-                        req = slots[i]
-                        tok = int(toks_seq[step_i, i])
-                        cur[i] = tok
-                        if self._token_done(req, tok):
-                            self._complete(req)
-                            slots[i] = None
-                            active[i] = False
-                            self.model.release_slot(i)
+                pending = nxt
+                self._deliver(toks_seq, act_seq, slots, cur, active)
+                if self._reap_slots(slots, active):
+                    # host-side reap: the chip still thinks those slots are
+                    # live — the next dispatch must rebuild from host state
+                    carry_dirty = True
         except asyncio.CancelledError:
             err = RuntimeError("GenerationScheduler closed")
             for i, req in enumerate(slots):
@@ -1252,9 +1559,12 @@ class GenerativeComponent(SeldonComponent):
         temperature: float = 0.0,
         eos_id: int | None = None,
         queue_max: int | None = None,
+        overlap: bool | None = None,
     ):
         self.model = model
-        self.scheduler = GenerationScheduler(model, maxsize=queue_max)
+        self.scheduler = GenerationScheduler(
+            model, maxsize=queue_max, overlap=overlap
+        )
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_id = eos_id
@@ -1269,6 +1579,7 @@ class GenerativeComponent(SeldonComponent):
         out = [
             {"key": f"{self.model.name}_decode_steps", "type": "GAUGE", "value": self.model.steps},
             {"key": f"{self.model.name}_prefills", "type": "GAUGE", "value": self.model.prefills},
+            {"key": f"{self.model.name}_overlapped_blocks", "type": "GAUGE", "value": self.model.overlapped},
         ]
         if self.model.prefix_index is not None:
             out.append({

@@ -22,17 +22,26 @@ The reference has no analogue — no model there ever spans processes
 (reference: SURVEY.md §2.7: replica Deployments behind a Service are the
 only scale-out).
 
-Wire format: a fixed 64 KiB header buffer (op + pickled metadata + inline
-payload when it fits), optionally followed by a second broadcast of the
-payload rounded up to 1 MiB granularity — bounded distinct shapes keep the
-number of compiled broadcast programs small.
+Wire format: a fixed 64 KiB header buffer (op + framed step metadata +
+inline payload when it fits), optionally followed by a second broadcast of
+the payload rounded up to 1 MiB granularity — bounded distinct shapes keep
+the number of compiled broadcast programs small.
+
+Step metadata is length-prefixed JSON + raw little-endian ndarray segments
+(:func:`encode_step` / :func:`decode_step`) — the same framing discipline
+``taplog.py`` uses on its wire.  The control plane deliberately carries NO
+pickles: a peer that can inject into the slice's broadcast must never be
+able to execute code on every host (checkpoints made the same move in
+``executor/checkpoint.py``); an unregistered key or malformed frame is a
+fail-fast restart, not an RCE.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
-import pickle
+import struct
 import threading
 import time
 from typing import Any, Callable
@@ -47,6 +56,85 @@ CHUNK_BYTES = 1024 * 1024  # payload broadcasts round up to this granularity
 _OP_NOOP = 0
 _OP_STEP = 1
 _OP_EXIT = 2
+
+_HDR_LEN = struct.Struct("<I")
+
+
+def encode_step(key: str, payload: dict) -> bytes:
+    """Frame one SPMD step as length-prefixed JSON + raw ndarray segments.
+
+    ``payload`` must be a flat dict whose values are JSON scalars (str /
+    int / float / bool / None), lists of scalars, or numpy ndarrays —
+    exactly what the step bodies ship.  Anything else raises ``TypeError``
+    at the COORDINATOR (the sender), never a deserialization surprise at a
+    follower.  Arrays travel as raw little-endian bytes after the header:
+
+        <u32 header_len> <json header> <array 0 bytes> <array 1 bytes> ...
+
+    with the header recording each array's name/dtype/shape in order.
+    """
+    if not isinstance(payload, dict):
+        raise TypeError(f"step payload must be a dict, got {type(payload).__name__}")
+    plain: dict[str, Any] = {}
+    # (name, contiguous buffer, true shape): ascontiguousarray promotes
+    # 0-d arrays to 1-d, so the shape is captured from the original
+    arrays: list[tuple[str, np.ndarray, list[int]]] = []
+    for k, v in payload.items():
+        if isinstance(v, np.ndarray):
+            arrays.append((k, np.ascontiguousarray(v), list(v.shape)))
+        elif isinstance(v, np.generic):
+            plain[k] = v.item()
+        elif isinstance(v, (str, int, float, bool)) or v is None:
+            plain[k] = v
+        elif isinstance(v, (list, tuple)):
+            if any(not isinstance(e, (str, int, float, bool)) and e is not None for e in v):
+                raise TypeError(
+                    f"step payload field {k!r}: lists may hold scalars only"
+                )
+            plain[k] = list(v)
+        else:
+            raise TypeError(
+                f"step payload field {k!r} has unframeable type "
+                f"{type(v).__name__} (ndarray / JSON scalar / scalar list only)"
+            )
+    header = json.dumps(
+        {
+            "key": key,
+            "plain": plain,
+            "arrays": [
+                {"name": k, "dtype": a.dtype.str, "shape": shape}
+                for k, a, shape in arrays
+            ],
+        },
+        separators=(",", ":"),
+    ).encode()
+    parts = [_HDR_LEN.pack(len(header)), header]
+    parts.extend(a.tobytes() for _, a, _shape in arrays)
+    return b"".join(parts)
+
+
+def decode_step(buf: bytes) -> tuple[str, dict]:
+    """Inverse of :func:`encode_step`; raises ``ValueError`` on a torn or
+    malformed frame (the follower loop treats that as fatal version skew)."""
+    if len(buf) < _HDR_LEN.size:
+        raise ValueError("step frame shorter than its length prefix")
+    (n,) = _HDR_LEN.unpack_from(buf, 0)
+    if len(buf) < _HDR_LEN.size + n:
+        raise ValueError("step frame truncated before header end")
+    header = json.loads(buf[_HDR_LEN.size : _HDR_LEN.size + n])
+    payload: dict[str, Any] = dict(header["plain"])
+    off = _HDR_LEN.size + n
+    for d in header["arrays"]:
+        dt = np.dtype(d["dtype"])
+        shape = tuple(int(s) for s in d["shape"])
+        nbytes = dt.itemsize * int(np.prod(shape, dtype=np.int64)) if shape else dt.itemsize
+        if len(buf) < off + nbytes:
+            raise ValueError(f"step frame truncated inside array {d['name']!r}")
+        arr = np.frombuffer(buf, dtype=dt, count=nbytes // dt.itemsize, offset=off)
+        # copy: frombuffer views are read-only and pin the whole frame alive
+        payload[d["name"]] = arr.reshape(shape).copy()
+        off += nbytes
+    return str(header["key"]), payload
 
 
 def _encode_header(op: int, meta: bytes, inline: bool) -> np.ndarray:
@@ -152,7 +240,7 @@ class MultihostDriver:
         if not self.is_coordinator:
             raise RuntimeError("lead() called on a follower process")
         fn = self._fns[key]
-        meta = pickle.dumps((key, payload), protocol=pickle.HIGHEST_PROTOCOL)
+        meta = encode_step(key, payload)
         with self._lock:
             self._send(_OP_STEP, meta)
             self._last_step = time.monotonic()
@@ -207,7 +295,7 @@ class MultihostDriver:
             if op == _OP_NOOP:
                 continue
             try:
-                key, payload = pickle.loads(meta)
+                key, payload = decode_step(meta)
                 fn = self._fns[key]
             except Exception:
                 log.exception(

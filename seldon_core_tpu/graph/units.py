@@ -350,8 +350,10 @@ def _jax_generative(parameters: dict[str, Any]) -> Any:
     """JAX_GENERATIVE implementation: continuous-batching token generation.
 
     Graph parameters: ``family`` (default "llama"), ``preset``, ``n_slots``,
-    ``max_new_tokens``, ``temperature``, ``eos_id``, ``dtype``,
-    ``checkpoint``, ``seq_impl``, plus model-config overrides.
+    ``max_new_tokens``, ``temperature``, ``top_k`` (fused on-device top-k
+    sampling), ``eos_id``, ``dtype``, ``checkpoint``, ``seq_impl``,
+    ``decode_block``, ``overlap`` (overlapped decode pipeline,
+    docs/PERFORMANCE.md), ``kv_prefix_reuse``, plus model-config overrides.
     """
     from seldon_core_tpu.models import registry as model_registry
 

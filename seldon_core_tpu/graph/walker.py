@@ -312,6 +312,10 @@ class GraphWalker:
         # None): MODEL nodes marked deterministic serve exact input repeats
         # without touching the component — zero device steps on a hit
         self.node_cache = node_cache
+        # per-unit warmup wall time (unit name -> seconds), filled by
+        # warmup() — GET /stats/warmup exposes it so a slow readiness tail
+        # is attributable to the unit that compiled longest
+        self.warmup_seconds: dict[str, float] = {}
         self.root = self._build(spec)
 
     def deterministic(self) -> bool:
@@ -352,15 +356,33 @@ class GraphWalker:
         ]
 
     async def warmup(self) -> dict[str, int]:
-        """Pre-compile every JAX unit's bucket ladder off the event loop;
-        returns unit name -> programs compiled.  Serving flips readiness only
-        after this completes (the reference warms nothing and eats a 5s
-        first-request compile spike, docs/benchmarking.md:42-45)."""
+        """Pre-compile every (bucket, program) pair of every JAX unit off
+        the event loop; returns unit name -> programs compiled.  Serving
+        flips readiness only after this completes (the reference warms
+        nothing and eats a 5s first-request compile spike,
+        docs/benchmarking.md:42-45), so first-touch XLA compiles never land
+        on a user request.
+
+        Units warm CONCURRENTLY: a multi-model graph's readiness tail is
+        its slowest unit's compile, not the sum (XLA compilation releases
+        the GIL; device-step serialization happens under each model's own
+        lock, and multihost broadcast order is preserved by the driver
+        lock).  Per-unit wall time lands in :attr:`warmup_seconds`."""
         report: dict[str, int] = {}
-        for name, comp in self.iter_components():
-            fn = getattr(comp, "warmup", None)
-            if callable(fn):
-                report[name] = await asyncio.to_thread(fn)
+        self.warmup_seconds = {}
+
+        async def _one(name: str, fn) -> None:
+            t0 = time.perf_counter()
+            report[name] = await asyncio.to_thread(fn)
+            self.warmup_seconds[name] = round(time.perf_counter() - t0, 3)
+
+        await asyncio.gather(
+            *(
+                _one(name, fn)
+                for name, comp in self.iter_components()
+                if callable(fn := getattr(comp, "warmup", None))
+            )
+        )
         return report
 
     async def aclose(self) -> None:

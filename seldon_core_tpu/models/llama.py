@@ -692,12 +692,26 @@ def decode_slots(
 
 
 def sample_tokens(
-    logits: jax.Array, temperature: jax.Array, key: jax.Array
+    logits: jax.Array, temperature: jax.Array, key: jax.Array, top_k: int = 0
 ) -> jax.Array:
-    """Per-row sampling: ``temperature (S,)`` <= 0 means greedy."""
+    """Per-row sampling, fused into the compiled device step: ``temperature
+    (S,)`` <= 0 means greedy; ``top_k`` (STATIC — one compiled program per
+    value) restricts sampling to the k highest logits.
+
+    This runs inside the jitted prefill/decode programs so only ``(S,)``
+    token ids ever cross the host boundary — never ``(S, vocab)`` logits.
+    ``top_k=1`` reduces to greedy (a pinned-equal test holds it there).
+    """
     greedy = jnp.argmax(logits, axis=-1)
     temp = jnp.maximum(temperature, 1e-6)[:, None]
-    sampled = jax.random.categorical(key, logits.astype(jnp.float32) / temp, axis=-1)
+    f32 = logits.astype(jnp.float32)
+    if top_k and int(top_k) > 0:
+        k = min(int(top_k), logits.shape[-1])
+        vals, idx = jax.lax.top_k(f32, k)  # (S, k) descending
+        local = jax.random.categorical(key, vals / temp, axis=-1)  # (S,)
+        sampled = jnp.take_along_axis(idx, local[:, None], axis=-1)[:, 0]
+    else:
+        sampled = jax.random.categorical(key, f32 / temp, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 
 

@@ -212,11 +212,13 @@ def build_generative_component(
     temperature: float = 0.0,
     eos_id: int | None = None,
     seq_impl: str = "dense",
-    decode_block: int = 8,
+    decode_block: int = 16,
     kv_block_size: int = 16,
     kv_blocks: int | None = None,
     queue_max: int | None = None,
     kv_prefix_reuse: bool | None = None,
+    top_k: int = 0,
+    overlap: bool | None = None,
     **overrides,
 ):
     """Build a continuous-batching generative graph unit (JAX_GENERATIVE).
@@ -261,6 +263,7 @@ def build_generative_component(
         kv_block_size=kv_block_size,
         kv_blocks=kv_blocks,
         prefix_reuse=kv_prefix_reuse,
+        top_k=top_k,
     )
     return GenerativeComponent(
         model,
@@ -268,4 +271,5 @@ def build_generative_component(
         temperature=temperature,
         eos_id=eos_id,
         queue_max=queue_max,
+        overlap=overlap,
     )

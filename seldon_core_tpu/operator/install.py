@@ -10,6 +10,14 @@ rendered YAML is committed under ``deploy/`` for plain ``kubectl apply``
 
     python -m seldon_core_tpu.operator.install --out deploy/
 
+renders (the committed defaults); Helm-values-style parameterization
+(VERDICT r5 #8) comes from flags — ``--namespace``, ``--operator-image /
+--gateway-image / --tap-image``, and ``--gateway-rest-port /
+--gateway-grpc-port / --tap-port`` thread through every manifest (RBAC
+subjects, Deployments, Services, probes, env, the token-store URL), so an
+operator can land the plane in their own namespace/registry/ports without
+hand-editing rendered YAML:
+
 renders:
 
 - ``crd.yaml``        the seldondeployments CRD (also created on operator
@@ -51,18 +59,18 @@ def _meta(name: str, namespace: str | None = NAMESPACE, **labels: str) -> dict[s
     return meta
 
 
-def namespace_manifest() -> dict[str, Any]:
-    return {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": NAMESPACE}}
+def namespace_manifest(namespace: str = NAMESPACE) -> dict[str, Any]:
+    return {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": namespace}}
 
 
-def operator_rbac() -> list[dict[str, Any]]:
+def operator_rbac(namespace: str = NAMESPACE) -> list[dict[str, Any]]:
     """The operator owns CRs cluster-wide plus the workloads it emits
     (Deployments, multi-host StatefulSets, Services, Pods for slice rolls)."""
     return [
         {
             "apiVersion": "v1",
             "kind": "ServiceAccount",
-            "metadata": _meta("seldon-operator"),
+            "metadata": _meta("seldon-operator", namespace=namespace),
         },
         {
             "apiVersion": "rbac.authorization.k8s.io/v1",
@@ -106,18 +114,22 @@ def operator_rbac() -> list[dict[str, Any]]:
                 {
                     "kind": "ServiceAccount",
                     "name": "seldon-operator",
-                    "namespace": NAMESPACE,
+                    "namespace": namespace,
                 }
             ],
         },
     ]
 
 
-def operator_deployment(image: str = OPERATOR_IMAGE, watch_namespace: str = "default") -> dict[str, Any]:
+def operator_deployment(
+    image: str = OPERATOR_IMAGE,
+    watch_namespace: str = "default",
+    namespace: str = NAMESPACE,
+) -> dict[str, Any]:
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
-        "metadata": _meta("seldon-operator", component="operator"),
+        "metadata": _meta("seldon-operator", namespace=namespace, component="operator"),
         "spec": {
             "replicas": 1,
             "selector": {"matchLabels": {"app.kubernetes.io/name": "seldon-operator"}},
@@ -144,13 +156,13 @@ def operator_deployment(image: str = OPERATOR_IMAGE, watch_namespace: str = "def
     }
 
 
-def gateway_rbac() -> list[dict[str, Any]]:
+def gateway_rbac(namespace: str = NAMESPACE) -> list[dict[str, Any]]:
     """The gateway only reads CRs (to register routes + OAuth clients)."""
     return [
         {
             "apiVersion": "v1",
             "kind": "ServiceAccount",
-            "metadata": _meta("seldon-gateway"),
+            "metadata": _meta("seldon-gateway", namespace=namespace),
         },
         {
             "apiVersion": "rbac.authorization.k8s.io/v1",
@@ -177,14 +189,14 @@ def gateway_rbac() -> list[dict[str, Any]]:
                 {
                     "kind": "ServiceAccount",
                     "name": "seldon-gateway",
-                    "namespace": NAMESPACE,
+                    "namespace": namespace,
                 }
             ],
         },
     ]
 
 
-def token_redis_manifests() -> list[dict[str, Any]]:
+def token_redis_manifests(namespace: str = NAMESPACE) -> list[dict[str, Any]]:
     """Memory-only redis backing the gateway's shared token store, so N
     gateway replicas accept each other's OAuth tokens (the reference
     deploys redis for exactly this: redis-memonly/redis-memonly.json.in,
@@ -194,7 +206,7 @@ def token_redis_manifests() -> list[dict[str, Any]]:
             # defense in depth: only gateway pods may reach the store
             "apiVersion": "networking.k8s.io/v1",
             "kind": "NetworkPolicy",
-            "metadata": _meta("seldon-token-redis", component="token-store"),
+            "metadata": _meta("seldon-token-redis", namespace=namespace, component="token-store"),
             "spec": {
                 "podSelector": {
                     "matchLabels": {"app.kubernetes.io/name": "seldon-token-redis"}
@@ -219,7 +231,7 @@ def token_redis_manifests() -> list[dict[str, Any]]:
         {
             "apiVersion": "apps/v1",
             "kind": "Deployment",
-            "metadata": _meta("seldon-token-redis", component="token-store"),
+            "metadata": _meta("seldon-token-redis", namespace=namespace, component="token-store"),
             "spec": {
                 "replicas": 1,
                 "selector": {"matchLabels": {"app.kubernetes.io/name": "seldon-token-redis"}},
@@ -250,7 +262,7 @@ def token_redis_manifests() -> list[dict[str, Any]]:
         {
             "apiVersion": "v1",
             "kind": "Service",
-            "metadata": _meta("seldon-token-redis"),
+            "metadata": _meta("seldon-token-redis", namespace=namespace),
             "spec": {
                 "type": "ClusterIP",
                 "selector": {"app.kubernetes.io/name": "seldon-token-redis"},
@@ -275,13 +287,18 @@ def _redis_password_env() -> dict[str, Any]:
     }
 
 
-def gateway_manifests(image: str = GATEWAY_IMAGE) -> list[dict[str, Any]]:
+def gateway_manifests(
+    image: str = GATEWAY_IMAGE,
+    namespace: str = NAMESPACE,
+    rest_port: int = GATEWAY_REST_PORT,
+    grpc_port: int = GATEWAY_GRPC_PORT,
+) -> list[dict[str, Any]]:
     return [
-        *token_redis_manifests(),
+        *token_redis_manifests(namespace=namespace),
         {
             "apiVersion": "apps/v1",
             "kind": "Deployment",
-            "metadata": _meta("seldon-gateway", component="gateway"),
+            "metadata": _meta("seldon-gateway", namespace=namespace, component="gateway"),
             "spec": {
                 # 2 replicas by default — tokens ride the shared store, so
                 # any replica authenticates any client
@@ -293,7 +310,7 @@ def gateway_manifests(image: str = GATEWAY_IMAGE) -> list[dict[str, Any]]:
                         "annotations": {
                             "prometheus.io/scrape": "true",
                             "prometheus.io/path": "/prometheus",
-                            "prometheus.io/port": str(GATEWAY_REST_PORT),
+                            "prometheus.io/port": str(rest_port),
                         },
                     },
                     "spec": {
@@ -305,23 +322,23 @@ def gateway_manifests(image: str = GATEWAY_IMAGE) -> list[dict[str, Any]]:
                                 "command": ["sct-gateway"],
                                 "args": ["--watch"],
                                 "env": [
-                                    {"name": "GATEWAY_PORT", "value": str(GATEWAY_REST_PORT)},
-                                    {"name": "GATEWAY_GRPC_PORT", "value": str(GATEWAY_GRPC_PORT)},
+                                    {"name": "GATEWAY_PORT", "value": str(rest_port)},
+                                    {"name": "GATEWAY_GRPC_PORT", "value": str(grpc_port)},
                                     _redis_password_env(),
                                     {
                                         "name": "GATEWAY_TOKEN_STORE",
                                         # k8s expands $(REDIS_PASSWORD) from
                                         # the env var defined above
                                         "value": "redis://:$(REDIS_PASSWORD)@"
-                                                 "seldon-token-redis.seldon-system:6379",
+                                                 f"seldon-token-redis.{namespace}:6379",
                                     },
                                 ],
                                 "ports": [
-                                    {"containerPort": GATEWAY_REST_PORT, "name": "rest"},
-                                    {"containerPort": GATEWAY_GRPC_PORT, "name": "grpc"},
+                                    {"containerPort": rest_port, "name": "rest"},
+                                    {"containerPort": grpc_port, "name": "grpc"},
                                 ],
                                 "readinessProbe": {
-                                    "httpGet": {"path": "/ready", "port": GATEWAY_REST_PORT},
+                                    "httpGet": {"path": "/ready", "port": rest_port},
                                     "initialDelaySeconds": 5,
                                     "periodSeconds": 5,
                                 },
@@ -337,27 +354,31 @@ def gateway_manifests(image: str = GATEWAY_IMAGE) -> list[dict[str, Any]]:
         {
             "apiVersion": "v1",
             "kind": "Service",
-            "metadata": _meta("seldon-gateway"),
+            "metadata": _meta("seldon-gateway", namespace=namespace),
             "spec": {
                 "type": "ClusterIP",
                 "selector": {"app.kubernetes.io/name": "seldon-gateway"},
                 "ports": [
-                    {"port": GATEWAY_REST_PORT, "targetPort": GATEWAY_REST_PORT, "name": "rest"},
-                    {"port": GATEWAY_GRPC_PORT, "targetPort": GATEWAY_GRPC_PORT, "name": "grpc"},
+                    {"port": rest_port, "targetPort": rest_port, "name": "rest"},
+                    {"port": grpc_port, "targetPort": grpc_port, "name": "grpc"},
                 ],
             },
         },
     ]
 
 
-def tap_broker_manifests(image: str = TAP_IMAGE) -> list[dict[str, Any]]:
+def tap_broker_manifests(
+    image: str = TAP_IMAGE,
+    namespace: str = NAMESPACE,
+    port: int = TAP_PORT,
+) -> list[dict[str, Any]]:
     """Self-contained request/response tap (replaces the reference's
     Kafka+ZooKeeper install, kafka/kafka.json)."""
     return [
         {
             "apiVersion": "apps/v1",
             "kind": "Deployment",
-            "metadata": _meta("seldon-tap-broker", component="tap"),
+            "metadata": _meta("seldon-tap-broker", namespace=namespace, component="tap"),
             "spec": {
                 "replicas": 1,
                 "selector": {"matchLabels": {"app.kubernetes.io/name": "seldon-tap-broker"}},
@@ -369,8 +390,8 @@ def tap_broker_manifests(image: str = TAP_IMAGE) -> list[dict[str, Any]]:
                                 "name": "tap-broker",
                                 "image": image,
                                 "command": ["sct-tap-broker"],
-                                "args": ["--dir", "/data", "--port", str(TAP_PORT)],
-                                "ports": [{"containerPort": TAP_PORT, "name": "tap"}],
+                                "args": ["--dir", "/data", "--port", str(port)],
+                                "ports": [{"containerPort": port, "name": "tap"}],
                                 "volumeMounts": [{"name": "data", "mountPath": "/data"}],
                                 "resources": {
                                     "requests": {"cpu": "100m", "memory": "128Mi"}
@@ -385,23 +406,44 @@ def tap_broker_manifests(image: str = TAP_IMAGE) -> list[dict[str, Any]]:
         {
             "apiVersion": "v1",
             "kind": "Service",
-            "metadata": _meta("seldon-tap-broker"),
+            "metadata": _meta("seldon-tap-broker", namespace=namespace),
             "spec": {
                 "type": "ClusterIP",
                 "selector": {"app.kubernetes.io/name": "seldon-tap-broker"},
-                "ports": [{"port": TAP_PORT, "targetPort": TAP_PORT, "name": "tap"}],
+                "ports": [{"port": port, "targetPort": port, "name": "tap"}],
             },
         },
     ]
 
 
-def render_all() -> dict[str, list[dict[str, Any]]]:
-    """filename (sans .yaml) -> manifest list."""
+def render_all(
+    *,
+    namespace: str = NAMESPACE,
+    operator_image: str = OPERATOR_IMAGE,
+    gateway_image: str = GATEWAY_IMAGE,
+    tap_image: str = TAP_IMAGE,
+    gateway_rest_port: int = GATEWAY_REST_PORT,
+    gateway_grpc_port: int = GATEWAY_GRPC_PORT,
+    tap_port: int = TAP_PORT,
+    watch_namespace: str = "default",
+) -> dict[str, list[dict[str, Any]]]:
+    """filename (sans .yaml) -> manifest list.  Defaults render the
+    committed ``deploy/`` files byte-identically (golden tests pin that);
+    overrides are the Helm-values equivalent for images/namespace/ports."""
     files = {
         "crd": [crd_manifest()],
-        "operator": [namespace_manifest(), *operator_rbac(), operator_deployment()],
-        "gateway": [*gateway_rbac(), *gateway_manifests()],
-        "tap-broker": tap_broker_manifests(),
+        "operator": [
+            namespace_manifest(namespace),
+            *operator_rbac(namespace),
+            operator_deployment(operator_image, watch_namespace, namespace),
+        ],
+        "gateway": [
+            *gateway_rbac(namespace),
+            *gateway_manifests(
+                gateway_image, namespace, gateway_rest_port, gateway_grpc_port
+            ),
+        ],
+        "tap-broker": tap_broker_manifests(tap_image, namespace, tap_port),
     }
     files["install"] = [m for group in ("crd", "operator", "gateway", "tap-broker") for m in files[group]]
     return files
@@ -421,9 +463,29 @@ def to_yaml(manifests: list[dict[str, Any]]) -> str:
 def main(argv: list[str] | None = None) -> None:
     parser = argparse.ArgumentParser(description="render install manifests")
     parser.add_argument("--out", default="deploy")
+    parser.add_argument("--namespace", default=NAMESPACE,
+                        help="control-plane namespace (default seldon-system)")
+    parser.add_argument("--operator-image", default=OPERATOR_IMAGE)
+    parser.add_argument("--gateway-image", default=GATEWAY_IMAGE)
+    parser.add_argument("--tap-image", default=TAP_IMAGE)
+    parser.add_argument("--gateway-rest-port", type=int, default=GATEWAY_REST_PORT)
+    parser.add_argument("--gateway-grpc-port", type=int, default=GATEWAY_GRPC_PORT)
+    parser.add_argument("--tap-port", type=int, default=TAP_PORT)
+    parser.add_argument("--watch-namespace", default="default",
+                        help="namespace the operator watches for CRs")
     args = parser.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
-    for name, manifests in render_all().items():
+    rendered = render_all(
+        namespace=args.namespace,
+        operator_image=args.operator_image,
+        gateway_image=args.gateway_image,
+        tap_image=args.tap_image,
+        gateway_rest_port=args.gateway_rest_port,
+        gateway_grpc_port=args.gateway_grpc_port,
+        tap_port=args.tap_port,
+        watch_namespace=args.watch_namespace,
+    )
+    for name, manifests in rendered.items():
         path = os.path.join(args.out, f"{name}.yaml")
         with open(path, "w") as f:
             f.write(to_yaml(manifests))

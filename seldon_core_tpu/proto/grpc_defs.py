@@ -66,6 +66,13 @@ SERVICES: dict[str, dict[str, tuple[Any, Any]]] = {
     "Seldon": {"Predict": (_SM, _SM), "SendFeedback": (_FB, _SM)},
 }
 
+# service -> method -> (request type, response type) for SERVER-STREAMING
+# rpcs (proto/prediction.proto `service Seldon`): declared in the published
+# contract so a stock grpcio-codegen client can call streaming generation.
+STREAM_SERVICES: dict[str, dict[str, tuple[Any, Any]]] = {
+    "Seldon": {"StreamPredict": (_SM, _SM)},
+}
+
 
 def full_service_name(service: str) -> str:
     return f"{PACKAGE}.{service}"
@@ -109,14 +116,27 @@ def unary_guard(fn: Callable) -> Callable:
     return wrapped
 
 
-def add_service(server: Any, service: str, handlers: dict[str, Callable]) -> None:
-    """Register ``handlers`` (method name -> unary-unary callable) for a
-    service on a grpc or grpc.aio server."""
+def add_service(
+    server: Any,
+    service: str,
+    handlers: dict[str, Callable],
+    stream_handlers: dict[str, Callable] | None = None,
+) -> None:
+    """Register ``handlers`` (method name -> unary-unary callable) and
+    ``stream_handlers`` (method name -> async-generator callable, from
+    :data:`STREAM_SERVICES`) for a service on a grpc or grpc.aio server."""
     spec = SERVICES[service]
     method_handlers = {}
     for method, fn in handlers.items():
         req, res = spec[method]
         method_handlers[method] = grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req.FromString,
+            response_serializer=res.SerializeToString,
+        )
+    for method, fn in (stream_handlers or {}).items():
+        req, res = STREAM_SERVICES[service][method]
+        method_handlers[method] = grpc.unary_stream_rpc_method_handler(
             fn,
             request_deserializer=req.FromString,
             response_serializer=res.SerializeToString,
@@ -158,7 +178,10 @@ def raw_handlers(service: str, handlers: dict[str, Callable]) -> dict[str, Calla
 
 
 class Stub:
-    """Typed unary-unary stub over any channel: ``Stub(channel, "Model").Predict(msg)``."""
+    """Typed stub over any channel: ``Stub(channel, "Model").Predict(msg)``;
+    server-streaming methods (STREAM_SERVICES) become unary-stream
+    multi-callables — exactly what grpcio codegen would emit for the
+    published proto."""
 
     def __init__(self, channel: Any, service: str):
         self._service = service
@@ -167,6 +190,16 @@ class Stub:
                 self,
                 method,
                 channel.unary_unary(
+                    f"/{full_service_name(service)}/{method}",
+                    request_serializer=req.SerializeToString,
+                    response_deserializer=res.FromString,
+                ),
+            )
+        for method, (req, res) in STREAM_SERVICES.get(service, {}).items():
+            setattr(
+                self,
+                method,
+                channel.unary_stream(
                     f"/{full_service_name(service)}/{method}",
                     request_serializer=req.SerializeToString,
                     response_deserializer=res.FromString,

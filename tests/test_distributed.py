@@ -74,6 +74,86 @@ class TestConfigFromEnv:
             )
 
 
+class TestStepFraming:
+    """The multihost control plane frames steps as length-prefixed JSON +
+    raw ndarray segments (taplog's framing discipline) — NO pickles: a
+    peer that can inject into the slice broadcast must not be able to
+    execute code on every host (VERDICT r5 #7)."""
+
+    def test_round_trip_every_step_shape(self):
+        import numpy as np
+
+        from seldon_core_tpu.executor.multihost import decode_step, encode_step
+
+        payloads = [
+            ("gen:m:prefill#1", {
+                "padded": np.zeros((1, 16), np.int32), "length": 5,
+                "slot": 0, "blocks": np.arange(8, dtype=np.int32),
+                "temperature": 0.7, "seed": 3,
+            }),
+            ("gen:m:decode_k#2", {
+                "tokens": np.ones(4, np.int32),
+                "active": np.array([True, False, True, False]),
+                "temperature": np.zeros(4, np.float32), "seed": 1,
+                "eos": np.full(4, -1, np.int32),
+                "remaining": np.zeros(4, np.int32), "k": 8, "window": 64,
+            }),
+            ("gen:m:decode_cont#3", {"k": 8, "seed": 2, "window": 128}),
+            ("gen:m:reset#4", {}),
+            ("model:mlp#0", {"batch": np.ones((4, 784), np.float32)}),
+            ("z", {
+                "zero_d": np.array(3, np.int64),  # ascontiguousarray trap
+                "f_order": np.asfortranarray(np.arange(12).reshape(3, 4)),
+                "s": "str", "none": None, "flag": True, "lst": [1, 2, 3],
+            }),
+        ]
+        for key, payload in payloads:
+            key2, out = decode_step(encode_step(key, payload))
+            assert key2 == key
+            assert set(out) == set(payload)
+            for k, v in payload.items():
+                if isinstance(v, np.ndarray):
+                    assert out[k].shape == v.shape, k
+                    assert out[k].dtype == v.dtype, k
+                    assert np.array_equal(out[k], v), k
+                    assert out[k].flags.writeable, k  # owns its memory
+                else:
+                    assert out[k] == v, k
+
+    def test_unframeable_payload_fails_at_the_sender(self):
+        import numpy as np
+
+        from seldon_core_tpu.executor.multihost import encode_step
+
+        with pytest.raises(TypeError):
+            encode_step("k", {"obj": object()})
+        with pytest.raises(TypeError):
+            encode_step("k", {"nested": [np.zeros(2)]})
+        with pytest.raises(TypeError):
+            encode_step("k", [1, 2, 3])  # payload must be a dict
+
+    def test_torn_frame_raises_value_error(self):
+        import numpy as np
+
+        from seldon_core_tpu.executor.multihost import decode_step, encode_step
+
+        frame = encode_step("k", {"a": np.zeros(8)})
+        with pytest.raises(ValueError):
+            decode_step(frame[:-4])  # truncated inside the array segment
+        with pytest.raises(ValueError):
+            decode_step(frame[:2])  # shorter than the length prefix
+
+    def test_no_pickle_on_the_wire(self):
+        """The framed bytes must never be loadable as a pickle and the
+        module must not import pickle at all."""
+        import seldon_core_tpu.executor.multihost as mh
+
+        assert not hasattr(mh, "pickle")
+        import inspect
+
+        assert "import pickle" not in inspect.getsource(mh)
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))

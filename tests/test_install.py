@@ -69,6 +69,80 @@ class TestManifestShape:
 
         assert render_all()["crd"] == [crd_manifest()]
 
+    def test_parameterized_render_threads_everywhere(self):
+        """--namespace/--image/--ports (VERDICT r5 #8, the Helm-values
+        equivalent): overrides must reach every manifest — RBAC subjects,
+        Deployments, Services, probes, env, the token-store URL — with no
+        default leaking through."""
+        import json
+
+        rendered = render_all(
+            namespace="edge-ns",
+            operator_image="reg.example/op:9.9",
+            gateway_image="reg.example/gw:9.9",
+            tap_image="reg.example/tap:9.9",
+            gateway_rest_port=9090,
+            gateway_grpc_port=9091,
+            tap_port=7001,
+            watch_namespace="models",
+        )
+        blob = json.dumps(rendered["install"])
+        assert "seldon-system" not in blob  # no default-namespace leak
+        assert "reg.example/op:9.9" in blob
+        assert "reg.example/gw:9.9" in blob
+        assert "reg.example/tap:9.9" in blob
+        install = rendered["install"]
+        gw = next(
+            m for m in install
+            if m["kind"] == "Deployment" and m["metadata"]["name"] == "seldon-gateway"
+        )
+        container = gw["spec"]["template"]["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in container["env"]}
+        assert env["GATEWAY_PORT"] == "9090"
+        assert env["GATEWAY_GRPC_PORT"] == "9091"
+        assert "seldon-token-redis.edge-ns:6379" in env["GATEWAY_TOKEN_STORE"]
+        assert container["readinessProbe"]["httpGet"]["port"] == 9090
+        gw_svc = next(
+            m for m in install
+            if m["kind"] == "Service" and m["metadata"]["name"] == "seldon-gateway"
+        )
+        assert {p["port"] for p in gw_svc["spec"]["ports"]} == {9090, 9091}
+        tap_svc = next(
+            m for m in install
+            if m["kind"] == "Service" and m["metadata"]["name"] == "seldon-tap-broker"
+        )
+        assert tap_svc["spec"]["ports"][0]["port"] == 7001
+        ns = next(m for m in install if m["kind"] == "Namespace")
+        assert ns["metadata"]["name"] == "edge-ns"
+        for binding in (m for m in install if m["kind"] == "ClusterRoleBinding"):
+            assert binding["subjects"][0]["namespace"] == "edge-ns"
+        op = next(
+            m for m in install
+            if m["kind"] == "Deployment" and m["metadata"]["name"] == "seldon-operator"
+        )
+        op_env = {
+            e["name"]: e.get("value")
+            for e in op["spec"]["template"]["spec"]["containers"][0]["env"]
+        }
+        assert op_env["SELDON_NAMESPACE"] == "models"
+
+    def test_cli_flags_parameterize_the_render(self, tmp_path):
+        """The renderer CLI accepts the flags and writes the overridden
+        manifests (the golden defaults stay separate)."""
+        from seldon_core_tpu.operator.install import main
+
+        main([
+            "--out", str(tmp_path),
+            "--namespace", "edge-ns",
+            "--gateway-image", "reg.example/gw:9.9",
+            "--gateway-rest-port", "9090",
+            "--tap-port", "7001",
+        ])
+        text = (tmp_path / "install.yaml").read_text()
+        assert "edge-ns" in text and "seldon-system" not in text
+        assert "reg.example/gw:9.9" in text
+        assert "9090" in text and "7001" in text
+
     def test_service_account_wiring(self):
         install = render_all()["install"]
         op = next(
