@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -53,6 +53,16 @@ cache-check:
 # p99 bound on the stub graph (same tests run in tier-1)
 perf-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_perf.py -q
+
+# disaggregated prefill/decode gate (docs/DISAGGREGATION.md), CPU-safe:
+# role-typed two-engine handoff on the stub mesh, pinned-equal
+# disagg-vs-unified generation, zero-leak handoff failure, the routing
+# policy bars (>=90% warm-replica prefix affinity, p2c skew <= 1.5x), then
+# a smoke of the disagg bench stage (unified vs split TTFT under flood)
+disagg-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_disagg.py -q
+	JAX_PLATFORMS=cpu BENCH_ONLY=disagg BENCH_SECONDS=2 BENCH_RUNS=1 \
+		$(PYTHON) bench.py
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

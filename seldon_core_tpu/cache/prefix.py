@@ -27,9 +27,19 @@ needs no new collective.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 
 import numpy as np
+
+
+def chain_hash(key: bytes) -> str:
+    """Stable 64-bit-hex digest of one prefix-chain key (the raw int32
+    bytes of ``tokens[:k*block_size]``).  Shared with the gateway's
+    prefix-aware router (disagg/router.py): the gateway hashes a request's
+    leading blocks the same way and matches them against the digests each
+    replica publishes, without ever shipping raw token ids off-engine."""
+    return hashlib.sha256(key).hexdigest()[:16]
 
 
 class _PrefixEntry:
@@ -184,6 +194,25 @@ class PrefixIndex:
             freed = [self._entries.pop(k).block for k in doomed]
             self.evicted += len(doomed)
             return freed
+
+    def digest(self, max_entries: int = 4096) -> dict:
+        """Compact routing digest: chain hashes + depths of the entries this
+        index holds (``GET /stats/cache``).  The gateway's prefix-aware
+        router matches request prompts against these; the hash (not the
+        tokens) crosses the wire, and ``max_entries`` bounds the payload —
+        deepest chains first, since those are the matches worth routing
+        for."""
+        with self._lock:
+            items = sorted(
+                self._entries.items(), key=lambda kv: -kv[1].depth
+            )[: max(0, int(max_entries))]
+            return {
+                "block_size": self.block_size,
+                "entries": len(self._entries),
+                "truncated": len(self._entries) > len(items),
+                "hashes": [chain_hash(k) for k, _ in items],
+                "depths": [e.depth for _, e in items],
+            }
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -1,0 +1,83 @@
+"""Disaggregated prefill/decode serving (docs/DISAGGREGATION.md).
+
+DistServe/Splitwise-style pool split: an engine boots as ``prefill``,
+``decode``, or ``unified`` (``SCT_ENGINE_ROLE`` env, or the operator's
+``seldon.io/engine-role`` annotation injecting it).  A prefill engine runs
+bucketed prefill and exports the resulting paged-KV blocks + sampling
+carry over the versioned length-prefixed JSON + raw-ndarray framing the
+multihost control plane speaks (executor/multihost.py); a decode engine
+imports them into its own paged pool and admits the slot at the next sync
+point of the overlapped scheduler.  A failed handoff falls back to
+unified-mode local decode on the sender and leaks nothing — the exported
+blocks stay pinned to the sending slot until the engine releases them.
+
+The gateway side (disagg/router.py) routes across multi-upstream
+deployment records: longest-prefix match against polled per-replica prefix
+digests first, power-of-two-choices on queue-wait EWMA otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_UNIFIED = "unified"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_UNIFIED)
+
+ROLE_ENV = "SCT_ENGINE_ROLE"
+DECODE_UPSTREAMS_ENV = "SCT_DISAGG_DECODE"
+
+
+def resolve_role(value: str | None = None, environ: dict | None = None) -> str:
+    """Engine role: explicit ``value`` wins, then ``SCT_ENGINE_ROLE``, then
+    unified.  An unknown role is a boot-time ValueError — a typo'd role
+    must never silently serve as a unified engine inside a split pool."""
+    env = environ if environ is not None else os.environ
+    role = (value or env.get(ROLE_ENV, "") or ROLE_UNIFIED).strip().lower()
+    if role not in ROLES:
+        raise ValueError(
+            f"engine role {role!r} is not one of {', '.join(ROLES)}"
+        )
+    return role
+
+
+def decode_upstreams(value: str | None = None, environ: dict | None = None) -> list[str]:
+    """The prefill pool's decode peers: ``SCT_DISAGG_DECODE`` is a
+    comma-separated ``host:port`` list (REST ports)."""
+    env = environ if environ is not None else os.environ
+    raw = value if value is not None else env.get(DECODE_UPSTREAMS_ENV, "")
+    return [u.strip() for u in raw.split(",") if u.strip()]
+
+
+from seldon_core_tpu.disagg.handoff import (  # noqa: E402
+    HANDOFF_KEY,
+    HandoffError,
+    decode_handoff,
+    encode_handoff,
+)
+from seldon_core_tpu.disagg.router import (  # noqa: E402
+    ReplicaRouter,
+    RouterPoller,
+    extract_prompt_tokens,
+    prompt_chain_hashes,
+)
+
+__all__ = [
+    "ROLE_PREFILL",
+    "ROLE_DECODE",
+    "ROLE_UNIFIED",
+    "ROLES",
+    "ROLE_ENV",
+    "DECODE_UPSTREAMS_ENV",
+    "resolve_role",
+    "decode_upstreams",
+    "HANDOFF_KEY",
+    "HandoffError",
+    "encode_handoff",
+    "decode_handoff",
+    "ReplicaRouter",
+    "RouterPoller",
+    "extract_prompt_tokens",
+    "prompt_chain_hashes",
+]

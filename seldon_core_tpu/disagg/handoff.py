@@ -1,0 +1,149 @@
+"""KV handoff codec + engine-side helpers (docs/DISAGGREGATION.md).
+
+One handoff frame carries everything a decode engine needs to continue a
+generation another engine prefilled: the prompt token ids, the first
+sampled token (the prefill's on-device sampling carry), the request's
+generation options, and the prompt's paged-KV blocks as raw little-endian
+ndarray segments.  The framing IS the multihost control plane's versioned
+step framing (executor/multihost.py ``encode_step``/``decode_step``:
+magic + version + length-prefixed JSON + raw ndarray segments), under the
+reserved step key :data:`HANDOFF_KEY` — so a pool built from a different
+release fails fast on the version field instead of mis-decoding KV bytes.
+
+bfloat16 caches travel as their uint16 bit patterns (numpy cannot frame
+bf16 natively — same move as executor/checkpoint.py) and are viewed back
+at the importer, so the handoff is bit-exact in every serving dtype.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+import numpy as np
+
+from seldon_core_tpu.executor.multihost import decode_step, encode_step
+
+log = logging.getLogger(__name__)
+
+HANDOFF_KEY = "sct:kv-handoff"
+
+
+class HandoffError(Exception):
+    """A handoff frame that cannot be applied here: wrong key, mismatched
+    pool geometry (block size / model shape), or a malformed frame.  The
+    sender treats this (like any transport failure) as 'fall back to
+    unified-mode local decode'."""
+
+
+def _pack_kv(arr: np.ndarray) -> tuple[np.ndarray, str]:
+    """(frameable array, dtype name) — bf16 rides as uint16 bits."""
+    dtype_name = str(arr.dtype)
+    if dtype_name == "bfloat16":
+        return arr.view(np.uint16), dtype_name
+    return arr, dtype_name
+
+
+def _unpack_kv(arr: np.ndarray, dtype_name: str) -> np.ndarray:
+    if dtype_name == "bfloat16":
+        import ml_dtypes
+
+        return arr.view(ml_dtypes.bfloat16)
+    return arr
+
+
+def encode_handoff(
+    prompt: np.ndarray,
+    first_token: int,
+    k: np.ndarray,
+    v: np.ndarray,
+    *,
+    block_size: int,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    eos_id: int | None = None,
+) -> bytes:
+    """Frame one prefilled request for the engine→engine handoff.
+
+    ``k``/``v`` are ``(layers, n_prompt_blocks, block_size, kv_heads,
+    head_dim)`` — exactly what :meth:`GenerativeModel.export_slot_kv`
+    returns for the slot's prompt blocks."""
+    k, kv_dtype = _pack_kv(np.ascontiguousarray(k))
+    v, _ = _pack_kv(np.ascontiguousarray(v))
+    payload: dict[str, Any] = {
+        "prompt": np.asarray(prompt, np.int32).ravel(),
+        "first_token": int(first_token),
+        "block_size": int(block_size),
+        "max_new_tokens": int(max_new_tokens),
+        "temperature": float(temperature),
+        "eos_id": int(eos_id) if eos_id is not None else None,
+        "kv_dtype": kv_dtype,
+        "k": k,
+        "v": v,
+    }
+    return encode_step(HANDOFF_KEY, payload)
+
+
+def decode_handoff(buf: bytes) -> dict[str, Any]:
+    """Inverse of :func:`encode_handoff`.  Raises :class:`HandoffError` on
+    a frame that is not a KV handoff (``ValueError`` from the shared codec
+    — torn frame, wrong magic, version skew — propagates untouched: the
+    caller maps both to a client error)."""
+    key, payload = decode_step(buf)
+    if key != HANDOFF_KEY:
+        raise HandoffError(f"frame key {key!r} is not a KV handoff")
+    for field in ("prompt", "first_token", "block_size", "k", "v", "kv_dtype"):
+        if field not in payload:
+            raise HandoffError(f"handoff frame missing field {field!r}")
+    kv_dtype = str(payload["kv_dtype"])
+    payload["k"] = _unpack_kv(payload["k"], kv_dtype)
+    payload["v"] = _unpack_kv(payload["v"], kv_dtype)
+    return payload
+
+
+def build_handoff_frame(
+    model: Any,
+    slot: int,
+    prompt: np.ndarray,
+    first_token: int,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    eos_id: int | None = None,
+) -> bytes:
+    """Export ``slot``'s prompt KV from ``model`` and frame the handoff
+    (runs on a worker thread — the export is a device fetch)."""
+    k, v = model.export_slot_kv(slot, int(np.asarray(prompt).size))
+    return encode_handoff(
+        prompt,
+        first_token,
+        k,
+        v,
+        block_size=model.kv_block_size,
+        max_new_tokens=max_new_tokens,
+        temperature=temperature,
+        eos_id=eos_id,
+    )
+
+
+async def apply_handoff(component: Any, payload: dict[str, Any]) -> np.ndarray:
+    """Admit a decoded handoff on this engine's generative unit: import the
+    KV blocks into the paged pool at the scheduler's next sync point and
+    decode to completion.  Returns the FULL generated ids (first sampled
+    token included) — the shape the unified path returns."""
+    model = component.model
+    if int(payload["block_size"]) != model.kv_block_size:
+        raise HandoffError(
+            f"handoff block size {payload['block_size']} != pool block size "
+            f"{model.kv_block_size}; pools must share kv_block_size"
+        )
+    eos = payload.get("eos_id")
+    return await component.scheduler.submit_imported(
+        payload["prompt"],
+        first_token=int(payload["first_token"]),
+        k=payload["k"],
+        v=payload["v"],
+        max_new_tokens=int(payload["max_new_tokens"]),
+        temperature=float(payload.get("temperature", 0.0)),
+        eos_id=int(eos) if eos is not None else None,
+    )

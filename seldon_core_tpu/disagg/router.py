@@ -1,0 +1,379 @@
+"""Prefix-aware + load-aware replica routing for multi-upstream deployments.
+
+Policy (docs/DISAGGREGATION.md):
+
+1. **Prefix-aware** — each engine replica publishes a compact digest of its
+   KV prefix-reuse index on ``GET /stats/cache`` (block-chain hashes +
+   depths, cache/prefix.py ``PrefixIndex.digest``).  The gateway hashes the
+   request's leading token blocks the same way and routes to the replica
+   whose digest matches the LONGEST chain — a request sharing a 160-token
+   system prompt lands on the replica that already holds those KV blocks
+   and prefills only its novel suffix.
+2. **Load-aware fallback** — no token prefix, no digests, or a tie: pick
+   power-of-two-choices on ``(inflight, queue-wait EWMA, picks)``, the
+   queue-wait signal polled from each replica's ``GET /stats/qos``.
+
+State lives on the gateway; the :class:`RouterPoller` refreshes it on a
+period (``SCT_GW_ROUTE_POLL_S``).  Everything degrades safely: a replica
+with no polled state is still pickable (score zero) and a single-upstream
+record bypasses the router entirely.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from seldon_core_tpu.cache.prefix import chain_hash
+
+log = logging.getLogger(__name__)
+
+
+def extract_prompt_tokens(raw: bytes) -> "np.ndarray | None":
+    """Best-effort prompt extraction from a generative request body — the
+    strData contract (``{"strData": "{\\"tokens\\": [...]}"}``) or a direct
+    ``{"tokens": [...]}``.  ``None`` for anything else: non-generative
+    payloads route load-aware, and the fronts only call this when a
+    replica has published digests (ReplicaRouter.has_digests)."""
+    import json
+
+    try:
+        body = json.loads(raw)
+        if not isinstance(body, dict):
+            return None
+        if "strData" in body:
+            body = json.loads(body["strData"])
+            if not isinstance(body, dict):
+                return None
+        toks = body.get("tokens")
+        if (
+            isinstance(toks, (list, tuple))
+            and toks
+            and all(isinstance(t, int) and not isinstance(t, bool) for t in toks)
+        ):
+            return np.asarray(toks, np.int32)
+    except (ValueError, TypeError, KeyError):
+        return None
+    return None
+
+
+def prompt_chain_hashes(
+    tokens: np.ndarray, block_size: int, max_blocks: int = 64
+) -> list[str]:
+    """Chain hashes of the request's leading FULL token blocks — the same
+    key bytes + hash the engine-side ``PrefixIndex.digest`` publishes, so
+    membership at depth k means the replica holds KV for tokens[:k*bs]."""
+    tokens = np.asarray(tokens, np.int32).ravel()
+    bs = int(block_size)
+    if bs < 1:
+        return []
+    n = min(tokens.size // bs, max_blocks)
+    return [
+        chain_hash(np.ascontiguousarray(tokens[: k * bs], np.int32).tobytes())
+        for k in range(1, n + 1)
+    ]
+
+
+class _ReplicaState:
+    __slots__ = (
+        "hashes", "block_size", "queue_wait_ms", "inflight", "picked",
+        "updated",
+    )
+
+    def __init__(self) -> None:
+        self.hashes: set[str] = set()
+        self.block_size: int = 0
+        self.queue_wait_ms: float = 0.0
+        self.inflight: int = 0
+        self.picked: int = 0
+        self.updated: float = 0.0
+
+
+def endpoint_key(ep: Any) -> str:
+    return f"{ep.host}:{ep.rest_port}"
+
+
+class ReplicaRouter:
+    """Per-deployment replica picker.  Thread-safe: the aiohttp front, the
+    h1 splice callbacks, and the poller all touch it."""
+
+    def __init__(self, rng: random.Random | None = None):
+        self._lock = threading.Lock()
+        self._deployments: dict[str, dict[str, _ReplicaState]] = {}
+        self._rng = rng or random.Random()
+        self.prefix_picks = 0
+        self.p2c_picks = 0
+        self.single_picks = 0
+
+    # -- state feeds ---------------------------------------------------------
+
+    def _state(self, dep: str, ep_key: str) -> _ReplicaState:
+        reps = self._deployments.setdefault(dep, {})
+        st = reps.get(ep_key)
+        if st is None:
+            st = reps[ep_key] = _ReplicaState()
+        return st
+
+    def update_replica(
+        self,
+        dep: str,
+        ep_key: str,
+        *,
+        hashes: Iterable[str] | None = None,
+        block_size: int | None = None,
+        queue_wait_ms: float | None = None,
+    ) -> None:
+        with self._lock:
+            st = self._state(dep, ep_key)
+            if hashes is not None:
+                st.hashes = set(hashes)
+            if block_size is not None:
+                st.block_size = int(block_size)
+            if queue_wait_ms is not None:
+                st.queue_wait_ms = float(queue_wait_ms)
+            st.updated = time.monotonic()
+
+    def forget(self, dep: str) -> None:
+        with self._lock:
+            self._deployments.pop(dep, None)
+
+    def note_start(self, dep: str, ep_key: str) -> None:
+        with self._lock:
+            self._state(dep, ep_key).inflight += 1
+
+    def note_done(self, dep: str, ep_key: str) -> None:
+        with self._lock:
+            st = self._state(dep, ep_key)
+            if st.inflight > 0:
+                st.inflight -= 1
+
+    def has_digests(self, dep: str) -> bool:
+        """Cheap guard: is prompt extraction worth doing for this
+        deployment?  (Parsing every body for a digest-less pool would tax
+        the hot path for nothing.)"""
+        with self._lock:
+            reps = self._deployments.get(dep)
+            return bool(reps) and any(st.hashes for st in reps.values())
+
+    # -- the pick ------------------------------------------------------------
+
+    def _score(self, st: _ReplicaState | None) -> tuple:
+        if st is None:
+            return (0, 0.0, 0)
+        return (st.inflight, round(st.queue_wait_ms, 1), st.picked)
+
+    def pick(
+        self,
+        dep: str,
+        endpoints: Sequence[Any],
+        prompt_tokens: np.ndarray | None = None,
+    ) -> Any:
+        """Choose a replica for one request.  Counts the pick so the p2c
+        tiebreak stays balanced even before any state is polled."""
+        if len(endpoints) == 1:
+            self.single_picks += 1
+            return endpoints[0]
+        with self._lock:
+            reps = self._deployments.get(dep, {})
+            chosen = None
+            if prompt_tokens is not None and reps:
+                # longest-prefix match, hashes computed once per distinct
+                # block size across the replica set
+                by_bs: dict[int, list[str]] = {}
+                best_depth = 0
+                best: list[Any] = []
+                for ep in endpoints:
+                    st = reps.get(endpoint_key(ep))
+                    if st is None or not st.hashes or st.block_size < 1:
+                        continue
+                    hs = by_bs.get(st.block_size)
+                    if hs is None:
+                        hs = by_bs[st.block_size] = prompt_chain_hashes(
+                            prompt_tokens, st.block_size
+                        )
+                    depth = 0
+                    for h in hs:
+                        if h not in st.hashes:
+                            break
+                        depth += 1
+                    if depth > best_depth:
+                        best_depth, best = depth, [ep]
+                    elif depth and depth == best_depth:
+                        best.append(ep)
+                if best:
+                    chosen = min(
+                        best, key=lambda ep: self._score(reps.get(endpoint_key(ep)))
+                    )
+                    self.prefix_picks += 1
+            if chosen is None:
+                # power-of-two-choices on (inflight, queue-wait EWMA, picks)
+                a, b = self._rng.sample(range(len(endpoints)), 2)
+                ea, eb = endpoints[a], endpoints[b]
+                sa = self._score(reps.get(endpoint_key(ea)))
+                sb = self._score(reps.get(endpoint_key(eb)))
+                chosen = ea if sa <= sb else eb
+                self.p2c_picks += 1
+            self._state(dep, endpoint_key(chosen)).picked += 1
+            return chosen
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "prefix_picks": self.prefix_picks,
+                "p2c_picks": self.p2c_picks,
+                "single_picks": self.single_picks,
+                "deployments": {
+                    dep: {
+                        ep: {
+                            "digest_entries": len(st.hashes),
+                            "block_size": st.block_size or None,
+                            "queue_wait_ms": round(st.queue_wait_ms, 3),
+                            "inflight": st.inflight,
+                            "picked": st.picked,
+                        }
+                        for ep, st in reps.items()
+                    }
+                    for dep, reps in self._deployments.items()
+                },
+            }
+
+
+class RouterPoller:
+    """Background refresh of per-replica routing state.
+
+    Polls every multi-upstream deployment's replicas: ``GET /stats/cache``
+    for the prefix digest, ``GET /stats/qos`` for the queue-wait EWMA.
+    Single-upstream records are skipped (nothing to choose).  Poll failures
+    clear the replica's digest — a dead or restarted replica must stop
+    attracting prefix traffic — but never raise.
+    """
+
+    def __init__(
+        self,
+        store: Any,
+        router: ReplicaRouter,
+        *,
+        interval_s: float | None = None,
+        timeout_s: float = 2.0,
+    ):
+        self.store = store
+        self.router = router
+        self.interval_s = (
+            float(os.environ.get("SCT_GW_ROUTE_POLL_S", "2") or 2.0)
+            if interval_s is None
+            else float(interval_s)
+        )
+        # SCT_GW_ROUTE_PREFIX=0 turns prefix digests off entirely: every
+        # pick degrades to the p2c load fallback (the acceptance bar's
+        # "digests disabled" mode)
+        self.poll_prefix = os.environ.get("SCT_GW_ROUTE_PREFIX", "1") != "0"
+        self.timeout_s = float(timeout_s)
+        self._task: asyncio.Task | None = None
+        self._session: Any = None
+        self.polls = 0
+        self.errors = 0
+
+    async def _ensure_session(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+            )
+        return self._session
+
+    async def poll_once(self) -> int:
+        """One sweep over every multi-upstream record; returns replicas
+        polled (exposed for tests and for a forced refresh)."""
+        polled = 0
+        for rec in self.store.list():
+            endpoints = rec.replica_endpoints
+            if len(endpoints) < 2:
+                continue
+            for ep in endpoints:
+                await self._poll_replica(rec, ep)
+                polled += 1
+        self.polls += 1
+        return polled
+
+    async def _poll_replica(self, rec: Any, ep: Any) -> None:
+        key = endpoint_key(ep)
+        base = f"http://{ep.host}:{ep.rest_port}"
+        session = await self._ensure_session()
+        try:
+            cache = {}
+            if self.poll_prefix:
+                async with session.get(base + "/stats/cache") as resp:
+                    if resp.status == 200:
+                        cache = (await resp.json()).get("cache", {})
+            queue_wait_ms = None
+            async with session.get(base + "/stats/qos") as resp:
+                if resp.status == 200:
+                    qos_snap = (await resp.json()).get("qos", {})
+                    qw = qos_snap.get("queue_wait_ewma_ms")
+                    if isinstance(qw, (int, float)):
+                        queue_wait_ms = float(qw)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.errors += 1
+            # unreachable replica: drop its digest so prefix routing stops
+            # steering traffic at it; p2c still may (connect errors there
+            # surface as retries/503s with their own handling)
+            self.router.update_replica(rec.oauth_key, key, hashes=())
+            return
+        hashes: set[str] = set()
+        block_size = 0
+        for snap in (cache.get("prefix") or {}).values():
+            digest = (snap or {}).get("digest") or {}
+            hashes.update(digest.get("hashes") or ())
+            block_size = block_size or int(digest.get("block_size") or 0)
+        self.router.update_replica(
+            rec.oauth_key,
+            key,
+            hashes=hashes,
+            block_size=block_size or None,
+            queue_wait_ms=queue_wait_ms,
+        )
+
+    async def _run(self) -> None:
+        while True:
+            try:
+                await self.poll_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.errors += 1
+                log.exception("router poll sweep failed")
+            await asyncio.sleep(self.interval_s)
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def snapshot(self) -> dict:
+        return {
+            "interval_s": self.interval_s,
+            "polls": self.polls,
+            "errors": self.errors,
+            "running": self._task is not None and not self._task.done(),
+        }

@@ -29,6 +29,7 @@ from seldon_core_tpu.contract import (
     payload_from_dict,
     payload_to_dict,
 )
+from seldon_core_tpu import disagg as disagg_mod
 from seldon_core_tpu import qos
 from seldon_core_tpu.engine.service import PredictionService, load_predictor_spec
 from seldon_core_tpu.graph.units import GraphUnitError
@@ -56,10 +57,34 @@ class EngineApp:
         service: PredictionService,
         mesh_worker: bool = False,
         qos_controller: "qos.AdmissionController | None" = None,
+        role: str | None = None,
+        decode_upstreams: list[str] | None = None,
     ):
         self.service = service
         self.paused = False
         self.metrics = service.metrics
+        # disagg plane (docs/DISAGGREGATION.md): the engine's pool role
+        # (prefill / decode / unified, SCT_ENGINE_ROLE) and — for prefill
+        # engines — the decode peers KV handoffs stream to
+        # (SCT_DISAGG_DECODE, comma-separated host:port)
+        self.role = disagg_mod.resolve_role(role)
+        self.decode_upstreams = (
+            list(decode_upstreams)
+            if decode_upstreams is not None
+            else disagg_mod.decode_upstreams()
+        )
+        self._handoff_session = None
+        self._handoff_inflight: dict[str, int] = {}
+        self._handoff_timeout_s = float(
+            os.environ.get("SCT_DISAGG_TIMEOUT_S", "30") or 30.0
+        )
+        self.disagg_stats = {
+            "handoffs_ok": 0,
+            "handoffs_failed": 0,
+            "local_fallbacks": 0,
+            "imports_ok": 0,
+            "imports_failed": 0,
+        }
         # QoS plane (docs/QOS.md): per-deployment admission control +
         # deadline propagation; env-configured (SCT_QOS_*), on by default.
         # Registered process-wide so the generation scheduler's brownout
@@ -158,6 +183,12 @@ class EngineApp:
         # then open the trace in TensorBoard / xprof
         r.add_post("/profile/start", self.profile_start)
         r.add_post("/profile/stop", self.profile_stop)
+        # disaggregated prefill/decode plane (docs/DISAGGREGATION.md):
+        # generate = prefill here + handoff to a decode peer (with unified
+        # local fallback); import = receive a peer's KV handoff and decode
+        r.add_post("/disagg/generate", self.disagg_generate)
+        r.add_post("/disagg/import", self.disagg_import)
+        r.add_get("/stats/disagg", self.stats_disagg)
         app.on_startup.append(self._startup)
         app.on_cleanup.append(self._cleanup)
         return app
@@ -217,6 +248,9 @@ class EngineApp:
     async def _cleanup(self, app: web.Application) -> None:
         if self._warmup_task is not None and not self._warmup_task.done():
             self._warmup_task.cancel()
+        if self._handoff_session is not None:
+            await self._handoff_session.close()
+            self._handoff_session = None
         await self.service.close()
 
     # -- handlers ---------------------------------------------------------
@@ -612,6 +646,255 @@ class EngineApp:
         finally:
             out_dir, self._profile_dir = self._profile_dir, None
         return web.json_response({"status": "stopped", "dir": out_dir})
+
+    # -- disaggregated prefill/decode (docs/DISAGGREGATION.md) -------------
+
+    def _single_generative_unit(self):
+        """The graph's one generative unit, or (None, reason) — disagg
+        serves exactly one (same constraint as token streaming)."""
+        units = self.service.generative_units()
+        if len(units) != 1:
+            reason = (
+                "predictor graph has no generative unit"
+                if not units
+                else f"disagg is ambiguous: graph has {len(units)} "
+                     "generative units"
+            )
+            return None, reason
+        return units[0], None
+
+    @staticmethod
+    def _parse_generate_body(body: dict, unit) -> tuple:
+        """Generative strData contract -> (prompt, max_new, temperature,
+        eos); raises CodecError on malformed input."""
+        import json as _json
+
+        if "strData" in body:
+            body = _json.loads(body["strData"])
+        prompt = body.get("tokens")
+        if not isinstance(prompt, (list, tuple)) or not prompt or isinstance(
+            prompt[0], (list, tuple)
+        ):
+            raise CodecError("disagg generate takes ONE prompt: flat 'tokens' list")
+        try:
+            max_new = body.get("max_new_tokens")
+            max_new = int(max_new) if max_new is not None else unit.max_new_tokens
+            temperature = body.get("temperature")
+            temperature = (
+                float(temperature) if temperature is not None else unit.temperature
+            )
+            eos = body.get("eos_id", unit.eos_id)
+            eos = int(eos) if eos is not None else None
+        except (TypeError, ValueError) as e:
+            raise CodecError(f"bad generate option: {e}") from e
+        return prompt, max_new, temperature, eos
+
+    async def disagg_generate(self, request: web.Request) -> web.Response:
+        """Generate via the disagg topology: prefill HERE, stream the KV
+        handoff to a decode peer, relay its tokens.  Any handoff failure
+        falls back to unified-mode local decode — the request always gets
+        its unified-identical answer; only the topology degrades."""
+        import numpy as np
+
+        dep, pred = self.service.deployment_name, self.service.predictor.name
+        with self.metrics.time_server_request(dep, pred, "disagg_generate", "POST") as h:
+            from seldon_core_tpu.utils.tracectx import set_traceparent
+
+            set_traceparent(request.headers.get("traceparent"))
+            try:
+                ticket = self._admit(request)
+            except qos.QosRejection as e:
+                h["code"] = str(e.status)
+                return self._qos_reject(e)
+            try:
+                unit, reason = self._single_generative_unit()
+                if unit is None:
+                    h["code"] = "400"
+                    return web.json_response(_status_body(400, reason), status=400)
+                try:
+                    prompt, max_new, temperature, eos = self._parse_generate_body(
+                        await self._json(request), unit
+                    )
+                except (CodecError, ValueError, TypeError, KeyError) as e:
+                    h["code"] = "400"
+                    return web.json_response(_status_body(400, str(e)), status=400)
+                prompt = np.asarray(prompt, np.int32)
+                if (
+                    self.role == disagg_mod.ROLE_PREFILL
+                    and self.decode_upstreams
+                    and max_new > 1
+                ):
+                    tokens, mode = await self._prefill_and_handoff(
+                        unit, prompt, max_new, temperature, eos
+                    )
+                else:
+                    out = await unit.scheduler.submit(
+                        prompt, max_new_tokens=max_new,
+                        temperature=temperature, eos_id=eos,
+                    )
+                    tokens, mode = [int(t) for t in out], "unified"
+                return web.json_response({"tokens": tokens, "mode": mode})
+            except qos.QosRejection as e:
+                h["code"] = str(e.status)
+                return self._qos_reject(e)
+            except GraphUnitError as e:
+                h["code"] = "500"
+                return web.json_response(_status_body(500, str(e)), status=500)
+            finally:
+                ticket.release()
+
+    async def _prefill_and_handoff(
+        self, unit, prompt, max_new: int, temperature: float, eos: int | None
+    ) -> tuple[list[int], str]:
+        """Prefill into a pinned slot, export + POST the KV handoff, relay
+        the decode peer's tokens.  The slot releases in every outcome —
+        the zero-leak guarantee — and failure degrades to local decode."""
+        slot, tok1 = await unit.scheduler.submit_prefill(
+            prompt, temperature=temperature
+        )
+        try:
+            from seldon_core_tpu.disagg.handoff import build_handoff_frame
+
+            frame = await asyncio.to_thread(
+                build_handoff_frame, unit.model, slot, prompt, tok1,
+                max_new_tokens=max_new, temperature=temperature, eos_id=eos,
+            )
+            tokens = await self._send_handoff(frame)
+            self.disagg_stats["handoffs_ok"] += 1
+            return tokens, "disagg"
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.disagg_stats["handoffs_failed"] += 1
+            log.warning(
+                "KV handoff failed (%s); falling back to unified local decode", e
+            )
+        finally:
+            unit.scheduler.release_external(slot)
+        self.disagg_stats["local_fallbacks"] += 1
+        out = await unit.scheduler.submit(
+            prompt, max_new_tokens=max_new, temperature=temperature, eos_id=eos
+        )
+        return [int(t) for t in out], "unified-fallback"
+
+    async def _send_handoff(self, frame: bytes) -> list[int]:
+        """POST one handoff frame to a decode peer — power-of-two-choices
+        on outstanding handoffs when several are configured."""
+        ups = self.decode_upstreams
+        if len(ups) == 1:
+            target = ups[0]
+        else:
+            import random
+
+            a, b = random.sample(range(len(ups)), 2)
+            target = min(
+                (ups[a], ups[b]), key=lambda u: self._handoff_inflight.get(u, 0)
+            )
+        if self._handoff_session is None:
+            import aiohttp
+
+            self._handoff_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self._handoff_timeout_s)
+            )
+        from seldon_core_tpu.qos.context import outgoing_qos_headers
+        from seldon_core_tpu.utils.tracectx import outgoing_headers
+
+        headers = {
+            "Content-Type": "application/octet-stream",
+            **outgoing_headers(),
+            **outgoing_qos_headers(),
+        }
+        self._handoff_inflight[target] = self._handoff_inflight.get(target, 0) + 1
+        try:
+            async with self._handoff_session.post(
+                f"http://{target}/disagg/import", data=frame, headers=headers
+            ) as resp:
+                if resp.status != 200:
+                    text = (await resp.text())[:200]
+                    raise RuntimeError(
+                        f"decode upstream {target} answered {resp.status}: {text}"
+                    )
+                body = await resp.json()
+                return [int(t) for t in body["tokens"]]
+        finally:
+            self._handoff_inflight[target] -= 1
+
+    async def disagg_import(self, request: web.Request) -> web.Response:
+        """Receive a prefill engine's KV handoff, import it into the local
+        paged pool at the scheduler's next sync point, decode to
+        completion, and answer with the full token ids."""
+        dep, pred = self.service.deployment_name, self.service.predictor.name
+        with self.metrics.time_server_request(dep, pred, "disagg_import", "POST") as h:
+            from seldon_core_tpu.utils.tracectx import set_traceparent
+
+            set_traceparent(request.headers.get("traceparent"))
+            if self.role == disagg_mod.ROLE_PREFILL:
+                h["code"] = "409"
+                return web.json_response(
+                    _status_body(
+                        409, "prefill-role engine does not import KV handoffs"
+                    ),
+                    status=409,
+                )
+            try:
+                ticket = self._admit(request)
+            except qos.QosRejection as e:
+                h["code"] = str(e.status)
+                return self._qos_reject(e)
+            try:
+                unit, reason = self._single_generative_unit()
+                if unit is None:
+                    h["code"] = "400"
+                    return web.json_response(_status_body(400, reason), status=400)
+                raw = await request.read()
+                from seldon_core_tpu.disagg.handoff import (
+                    HandoffError,
+                    apply_handoff,
+                    decode_handoff,
+                )
+
+                try:
+                    payload = decode_handoff(raw)
+                except (ValueError, HandoffError) as e:
+                    # torn frame / wrong magic / version skew / wrong key:
+                    # fail fast — never guess at KV bytes
+                    self.disagg_stats["imports_failed"] += 1
+                    h["code"] = "400"
+                    return web.json_response(
+                        _status_body(400, f"bad handoff frame: {e}"), status=400
+                    )
+                try:
+                    out = await apply_handoff(unit, payload)
+                except HandoffError as e:
+                    # decodable frame, incompatible pool (block size skew)
+                    self.disagg_stats["imports_failed"] += 1
+                    h["code"] = "409"
+                    return web.json_response(_status_body(409, str(e)), status=409)
+                self.disagg_stats["imports_ok"] += 1
+                return web.json_response({"tokens": [int(t) for t in out]})
+            except qos.QosRejection as e:
+                h["code"] = str(e.status)
+                return self._qos_reject(e)
+            except GraphUnitError as e:
+                self.disagg_stats["imports_failed"] += 1
+                h["code"] = "500"
+                return web.json_response(_status_body(500, str(e)), status=500)
+            finally:
+                ticket.release()
+
+    async def stats_disagg(self, request: web.Request) -> web.Response:
+        """Disagg plane state: this engine's role, its decode peers, and
+        the handoff/import ledger."""
+        return web.json_response(
+            {
+                "disagg": {
+                    "role": self.role,
+                    "decode_upstreams": list(self.decode_upstreams),
+                    "handoff_inflight": dict(self._handoff_inflight),
+                    **self.disagg_stats,
+                }
+            }
+        )
 
 
 def main(argv: list[str] | None = None) -> None:

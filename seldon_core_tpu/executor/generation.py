@@ -43,6 +43,7 @@ import logging
 import os
 import threading
 import time
+from functools import partial
 from typing import Any, AsyncIterator, Callable
 
 import jax
@@ -209,6 +210,9 @@ class GenerativeModel:
         # release) and how many leading blocks were matched (shared refs)
         self._slot_prompt: dict[int, np.ndarray] = {}
         self._slot_matched: dict[int, int] = {}
+        # full table row per reserved slot (shared-prefix blocks included):
+        # the disagg KV export reads the slot's prompt blocks through it
+        self._slot_row: dict[int, np.ndarray] = {}
 
         cache_dtype = dtype if dtype is not None else np.float32
         cache = family_mod.init_paged_cache(
@@ -400,11 +404,18 @@ class GenerativeModel:
             self._mh_reset_key = self.driver.register_unique(
                 f"gen:{name}:reset", self._exec_reset
             )
+            # disagg KV import writes blocks + pos/table on every process
+            # of the slice (payload carries the raw ndarrays), so it is a
+            # driven step like prefill/decode
+            self._mh_import_key = self.driver.register_unique(
+                f"gen:{name}:import", self._exec_import
+            )
 
         # observability
         self.steps = 0
         self.prefills = 0
         self.prefills_reused = 0  # prefills that skipped a reused prefix
+        self.imports = 0  # disagg KV handoffs imported into this pool
         # decode FLOPs ≈ 2·params per token (roofline's estimate) — feeds
         # the MFU gauge from measured step round trips
         self.flops_per_token = 2.0 * sum(
@@ -506,6 +517,7 @@ class GenerativeModel:
         row = np.zeros(self.max_blocks_per_slot, np.int32)
         row[: len(matched)] = matched
         row[len(matched):need] = got
+        self._slot_row[slot] = row.copy()
         if matched:
             DEFAULT_METRICS.prefix_tokens_reused.labels(self.name).inc(
                 len(matched) * self.kv_block_size
@@ -522,6 +534,7 @@ class GenerativeModel:
         matched = self._slot_matched.pop(slot, 0)
         prompt = self._slot_prompt.pop(slot, None)
         blocks = self._slot_blocks.pop(slot, None)
+        self._slot_row.pop(slot, None)
         if matched and prompt is not None and self.prefix_index is not None:
             self.prefix_index.release(prompt, matched)
         if blocks:
@@ -546,6 +559,141 @@ class GenerativeModel:
     @property
     def free_block_count(self) -> int:
         return len(self._free_blocks)
+
+    # -------------------------------------------------- disagg KV handoff
+
+    def export_slot_kv(self, slot: int, prompt_len: int) -> tuple[np.ndarray, np.ndarray]:
+        """Fetch the K/V of ``slot``'s prompt blocks to host for a disagg
+        handoff (docs/DISAGGREGATION.md): ``(layers, ceil(L/bs), bs,
+        kv_heads, head_dim)`` each.  The slot's reservation pins the blocks
+        — shared prefix blocks included — so nothing here can be reclaimed
+        or overwritten until the owner releases the slot, which it only
+        does after the handoff succeeds or is abandoned."""
+        if self._multihost:
+            raise GraphUnitError(
+                "disagg KV export is not supported from a multi-host slice "
+                "(the coordinator cannot address every shard); run the "
+                "prefill pool single-host or serve unified"
+            )
+        slot = int(slot)
+        row = self._slot_row.get(slot)
+        if row is None:
+            raise GraphUnitError(f"slot {slot} holds no reservation to export")
+        nb = -(-int(prompt_len) // self.kv_block_size)
+        phys = np.asarray(row[:nb], np.int32)
+        with self._lock:
+            k = np.asarray(jax.device_get(self._cache["k"][:, phys]))
+            v = np.asarray(jax.device_get(self._cache["v"][:, phys]))
+        return k, v
+
+    def attach_imported(
+        self,
+        slot: int,
+        prompt: np.ndarray,
+        k: np.ndarray,
+        v: np.ndarray,
+        *,
+        reserve_tokens: int = 0,
+    ) -> None:
+        """Install another engine's exported prompt KV into ``slot``:
+        reserve blocks (longest-prefix reuse applies — blocks this pool
+        already holds for the leading prompt blocks are referenced instead
+        of rewritten; identical prefixes have bit-identical K/V so skipping
+        the write preserves exactness), scatter the novel blocks, and set
+        the slot's position/table.  After this the slot decodes exactly as
+        if it had prefilled locally.  Raises :class:`OutOfKVBlocks` like a
+        local admission when the pool cannot cover it."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        L = int(prompt.size)
+        if L < 1:
+            raise GraphUnitError("empty prompt")
+        bs = self.kv_block_size
+        nb = -(-L // bs)
+        k = np.asarray(k)
+        v = np.asarray(v)
+        expect = (self.cfg.n_layers, nb, bs, self.cfg.n_kv_heads, self.cfg.head_dim)
+        if tuple(k.shape) != expect or tuple(v.shape) != expect:
+            raise GraphUnitError(
+                f"imported KV shape {tuple(k.shape)} does not match this "
+                f"pool's {expect} (config or block-size skew)"
+            )
+        row, prefix_len = self.reserve_for_prompt(
+            slot, prompt, L + max(0, int(reserve_tokens))
+        )
+        skip = prefix_len // bs
+        if str(k.dtype) == "bfloat16":
+            # frame-safe transport form; _exec_import views it back
+            k = k.view(np.uint16)
+            v = v.view(np.uint16)
+        payload = {
+            "slot": int(slot),
+            "length": L,
+            "row": np.asarray(row, np.int32),
+            "phys": np.asarray(row[skip:nb], np.int32),
+            "k": np.ascontiguousarray(k[:, skip:]),
+            "v": np.ascontiguousarray(v[:, skip:]),
+        }
+        if self.driver is not None:
+            self.driver.lead(self._mh_import_key, payload)
+        else:
+            self._exec_import(payload)
+        self._pos_ceiling[int(slot)] = L
+        self.imports += 1
+
+    @staticmethod
+    @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def _import_scatter(k, v, pos, table, phys, impk, impv, slot, length, row):
+        """Donated in-place scatter of imported blocks + slot pos/table —
+        one compiled program per novel-block count, no pool copy."""
+        k = k.at[:, phys].set(impk.astype(k.dtype))
+        v = v.at[:, phys].set(impv.astype(v.dtype))
+        pos = pos.at[slot].set(length)
+        table = table.at[slot].set(row)
+        return k, v, pos, table
+
+    def _exec_import(self, payload: dict) -> None:
+        """Symmetric import body (runs on every slice process): scatter the
+        imported blocks and set the slot's pos/table."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            c = self._cache
+            slot = int(payload["slot"])
+            phys = np.asarray(payload["phys"], np.int32)
+            newk, newv = c["k"], c["v"]
+            pos, table = c["pos"], c["table"]
+            k = np.asarray(payload["k"]) if phys.size else None
+            if k is not None and str(newk.dtype) == "bfloat16" and k.dtype == np.uint16:
+                import ml_dtypes
+
+                k = k.view(ml_dtypes.bfloat16)
+                v = np.asarray(payload["v"]).view(ml_dtypes.bfloat16)
+            elif k is not None:
+                v = np.asarray(payload["v"])
+            if phys.size and self.mesh is None:
+                # single-device fast path: donated fused scatter (no pool
+                # copy; the pool buffers update in place)
+                newk, newv, pos, table = GenerativeModel._import_scatter(
+                    newk, newv, pos, table, jnp.asarray(phys),
+                    jnp.asarray(k), jnp.asarray(v),
+                    np.int32(slot), np.int32(payload["length"]),
+                    np.asarray(payload["row"], np.int32),
+                )
+            else:
+                if phys.size:
+                    newk = newk.at[:, phys].set(jnp.asarray(k).astype(newk.dtype))
+                    newv = newv.at[:, phys].set(jnp.asarray(v).astype(newv.dtype))
+                    # the scatter ran outside jit; pin the result back to
+                    # the pool's sharding so the donated decode programs
+                    # keep their compiled layouts
+                    newk = jax.device_put(newk, c["k"].sharding)
+                    newv = jax.device_put(newv, c["v"].sharding)
+                pos = pos.at[slot].set(np.int32(payload["length"]))
+                table = table.at[slot].set(np.asarray(payload["row"], np.int32))
+                if self.mesh is not None:
+                    pos = jax.device_put(pos, c["pos"].sharding)
+                    table = jax.device_put(table, c["table"].sharding)
+            self._cache = {"k": newk, "v": newv, "pos": pos, "table": table}
 
     def admit_dispatch(
         self,
@@ -1010,6 +1158,10 @@ class GenerativeModel:
         snap["pool_blocks"] = self.kv_blocks - 1
         snap["prefills"] = self.prefills
         snap["prefills_reused"] = self.prefills_reused
+        snap["kv_imports"] = self.imports
+        # compact routing digest: the gateway's prefix-aware router polls
+        # this to steer shared-prefix requests at the warm replica
+        snap["digest"] = self.prefix_index.digest()
         return snap
 
 
@@ -1035,6 +1187,12 @@ class _Request:
     # captured from the request context at submit
     priority: str = qos.PRIO_INTERACTIVE
     deadline: float | None = None
+    # disagg (docs/DISAGGREGATION.md): a prefill-only request resolves with
+    # (slot, first_token) after its prefill and PINS the slot for a KV
+    # export; an imported request skips prefill entirely — its KV blocks
+    # and first token arrived from another engine's handoff
+    prefill_only: bool = False
+    imported: dict | None = None
 
 
 class GenerationScheduler:
@@ -1077,6 +1235,12 @@ class GenerationScheduler:
         # requests admitted to a slot but not to the KV pool (OutOfKVBlocks):
         # retried ahead of the queue as completions free blocks
         self._overflow: list[_Request] = []
+        # disagg: slots pinned by a prefill-only admission (KV export in
+        # progress) — excluded from admission until released, and released
+        # only at a sync point so block reuse never races a dispatched
+        # decode block
+        self._external: set[int] = set()
+        self._external_release: list[int] = []
         self._task: asyncio.Task | None = None
         self._closed = False
         # Random base so temperature>0 sampling differs across restarts and
@@ -1164,6 +1328,127 @@ class GenerationScheduler:
             if req in self._overflow:
                 self._overflow.remove(req)
             raise
+
+    # ------------------------------------------------------ disagg entries
+
+    def _validate_prompt(self, prompt: np.ndarray) -> np.ndarray:
+        prompt = np.asarray(prompt, np.int32).ravel()
+        if prompt.size < 1:
+            raise GraphUnitError("empty prompt")
+        vocab = self.model.cfg.vocab_size
+        if prompt.min() < 0 or prompt.max() >= vocab:
+            raise GraphUnitError(
+                f"token ids must be in [0, {vocab}); got "
+                f"[{int(prompt.min())}, {int(prompt.max())}]"
+            )
+        if prompt.size >= self.model.cfg.max_seq:
+            raise GraphUnitError(
+                f"prompt length {prompt.size} must be < max_seq "
+                f"{self.model.cfg.max_seq}"
+            )
+        return prompt
+
+    def _enqueue(self, req: _Request) -> None:
+        depth = len(self._waiting) + len(self._overflow)
+        cap = (
+            self._maxsize
+            if req.priority == qos.PRIO_INTERACTIVE
+            else self._batch_cap
+        )
+        if self._maxsize and depth >= cap:
+            raise qos.QueueFull(
+                f"generation queue is full ({depth} waiting, cap {cap} "
+                f"for {req.priority})"
+            )
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+        self._waiting.append(req)
+        self._wake.set()
+
+    async def _await_withdrawing(self, req: _Request):
+        try:
+            return await req.future
+        except asyncio.CancelledError:
+            if req in self._waiting:
+                self._waiting.remove(req)
+            if req in self._overflow:
+                self._overflow.remove(req)
+            raise
+
+    async def submit_prefill(
+        self, prompt: np.ndarray, *, temperature: float = 0.0
+    ) -> tuple[int, int]:
+        """Disagg prefill-only admission (docs/DISAGGREGATION.md): prefill
+        ``prompt`` into a free slot and return ``(slot, first_token)``
+        WITHOUT decoding.  The slot is PINNED — excluded from later
+        admissions, its blocks unreclaimable — until
+        :meth:`release_external` returns it, so a KV export can read the
+        blocks at leisure and a failed handoff leaks nothing."""
+        if self._closed:
+            raise RuntimeError("GenerationScheduler is closed")
+        prompt = self._validate_prompt(prompt)
+        from seldon_core_tpu.obs import current_span
+
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        req = _Request(
+            prompt, 1, float(temperature), None, fut,
+            t0=time.perf_counter(), span=current_span(),
+            priority=qos.get_priority(), deadline=qos.get_deadline(),
+        )
+        req.prefill_only = True
+        self._enqueue(req)
+        return await self._await_withdrawing(req)
+
+    async def submit_imported(
+        self,
+        prompt: np.ndarray,
+        *,
+        first_token: int,
+        k: np.ndarray,
+        v: np.ndarray,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        eos_id: int | None = None,
+        on_token: "Callable[[int], None] | None" = None,
+    ) -> np.ndarray:
+        """Disagg decode-side admission: continue a generation whose
+        prompt KV (``k``/``v``) and first sampled token arrived from a
+        prefill engine's handoff.  The blocks import into this pool at the
+        scheduler's next sync point; the result (first token included) is
+        exactly what a unified engine returns for the same request."""
+        if self._closed:
+            raise RuntimeError("GenerationScheduler is closed")
+        prompt = self._validate_prompt(prompt)
+        max_new_tokens = min(
+            max(1, int(max_new_tokens)),
+            self.model.cfg.max_seq - int(prompt.size),
+        )
+        max_new_tokens = qos.clamp_max_new_tokens(max_new_tokens)
+        from seldon_core_tpu.obs import current_span
+
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        req = _Request(
+            prompt, max_new_tokens, float(temperature), eos_id, fut,
+            on_token=on_token, t0=time.perf_counter(), span=current_span(),
+            priority=qos.get_priority(), deadline=qos.get_deadline(),
+        )
+        req.imported = {"first_token": int(first_token), "k": k, "v": v}
+        self._enqueue(req)
+        return await self._await_withdrawing(req)
+
+    def release_external(self, slot: int) -> None:
+        """Return a :meth:`submit_prefill`-pinned slot to the pool.  The
+        actual release happens at the run loop's next sync point — block
+        reuse must never race a dispatched decode block — and is idempotent
+        there."""
+        self._external_release.append(int(slot))
+        self._wake.set()
+
+    def _drain_external_releases(self) -> None:
+        while self._external_release:
+            slot = self._external_release.pop()
+            self._external.discard(slot)
+            self.model.release_slot(slot)
 
     async def close(self) -> None:
         self._closed = True
@@ -1317,6 +1602,10 @@ class GenerationScheduler:
         try:
             while True:
                 self._reap_queues()
+                if pending is None and self._external_release:
+                    # handoff slots released with no block in flight: safe
+                    # to return their blocks to the pool right here
+                    self._drain_external_releases()
                 if (
                     pending is None
                     and not active.any()
@@ -1340,19 +1629,21 @@ class GenerationScheduler:
                     # and their first tokens are fetched in ONE device
                     # round trip
                     batch: list[_Request] = []
-                    while self._overflow and int(active.sum()) + len(batch) < S:
+                    # capacity excludes slots pinned by in-flight handoffs
+                    cap_free = S - int(active.sum()) - len(self._external)
+                    while self._overflow and len(batch) < cap_free:
                         batch.append(self._overflow.pop(0))
-                    if self._waiting and int(active.sum()) + len(batch) < S:
+                    if self._waiting and len(batch) < cap_free:
                         self._waiting.sort(
                             key=lambda r: (qos.priority_rank(r.priority), r.t0)
                         )
-                        while self._waiting and int(active.sum()) + len(batch) < S:
+                        while self._waiting and len(batch) < cap_free:
                             batch.append(self._waiting.pop(0))
                     if batch:
                         await self._admit_batch(batch, slots, cur, temps, active)
                     self._reap_slots(slots, active)
                     if not active.any():
-                        if self._overflow:
+                        if self._overflow and not self._external:
                             # nothing in flight can ever free blocks: these
                             # requests exceed the pool outright
                             err = GraphUnitError(
@@ -1364,6 +1655,25 @@ class GenerationScheduler:
                                 if not req.future.done():
                                     req.future.set_exception(err)
                             self._overflow.clear()
+                        elif (
+                            (self._overflow or self._waiting)
+                            and self._external
+                            and not self._external_release
+                        ):
+                            # every admittable slot (or the blocks) is
+                            # pinned by an in-flight handoff: park until a
+                            # release or submit wakes us — spinning here
+                            # would monopolize the event loop and starve
+                            # the very release callback we wait for.  The
+                            # timeout keeps deadline reaping of parked
+                            # queue entries at ~50ms granularity.
+                            self._wake.clear()
+                            try:
+                                await asyncio.wait_for(
+                                    self._wake.wait(), timeout=0.05
+                                )
+                            except asyncio.TimeoutError:
+                                pass
                         continue
                     seed = self._next_seed()
                     if k <= 1:
@@ -1435,6 +1745,8 @@ class GenerationScheduler:
                     and active.any()
                     and not self._waiting
                     and not self._overflow
+                    # a pending handoff release needs a sync point
+                    and not self._external_release
                 ):
                     try:
                         nxt = await asyncio.to_thread(
@@ -1489,7 +1801,11 @@ class GenerationScheduler:
             raise
 
     async def _admit_batch(self, batch, slots, cur, temps, active) -> None:
-        free = [i for i in range(len(slots)) if not active[i]]
+        free = [
+            i
+            for i in range(len(slots))
+            if not active[i] and i not in self._external
+        ]
 
         def dispatch_and_fetch():
             placed = []
@@ -1497,6 +1813,16 @@ class GenerationScheduler:
             starved = []
             for req, slot in zip(batch, free):
                 try:
+                    if req.imported is not None:
+                        # disagg import: the prompt KV arrived from a
+                        # prefill engine — reserve + scatter, no prefill
+                        imp = req.imported
+                        self.model.attach_imported(
+                            slot, req.prompt, imp["k"], imp["v"],
+                            reserve_tokens=req.max_new_tokens,
+                        )
+                        placed.append((req, slot, imp["first_token"]))
+                        continue
                     tok_dev = self.model.admit_dispatch(
                         slot, req.prompt, req.temperature, self._next_seed(),
                         reserve_tokens=req.max_new_tokens,
@@ -1508,7 +1834,8 @@ class GenerationScheduler:
                     starved.append(req)
                 except Exception as exc:  # noqa: BLE001 - routed to the future
                     errors.append((req, exc))
-            # one round trip fetches every admitted first token
+            # one round trip fetches every admitted first token (imported
+            # first tokens are host ints already; device_get passes them)
             toks = jax.device_get([t for _, _, t in placed]) if placed else []
             return placed, toks, errors, starved
 
@@ -1520,6 +1847,16 @@ class GenerationScheduler:
             if not req.future.done():
                 req.future.set_exception(exc)
         for (req, slot, _), tok in zip(placed, toks):
+            if req.prefill_only:
+                # disagg handoff: pin the slot (blocks stay reserved for
+                # the KV export) and hand (slot, first_token) back; a
+                # client that vanished mid-prefill releases immediately
+                if req.future.done():
+                    self.model.release_slot(slot)
+                else:
+                    self._external.add(slot)
+                    req.future.set_result((slot, int(tok)))
+                continue
             if self._token_done(req, int(tok)):
                 self._complete(req)
                 self.model.release_slot(slot)
@@ -1580,6 +1917,7 @@ class GenerativeComponent(SeldonComponent):
             {"key": f"{self.model.name}_decode_steps", "type": "GAUGE", "value": self.model.steps},
             {"key": f"{self.model.name}_prefills", "type": "GAUGE", "value": self.model.prefills},
             {"key": f"{self.model.name}_overlapped_blocks", "type": "GAUGE", "value": self.model.overlapped},
+            {"key": f"{self.model.name}_kv_imports", "type": "GAUGE", "value": self.model.imports},
         ]
         if self.model.prefix_index is not None:
             out.append({

@@ -57,7 +57,16 @@ _OP_NOOP = 0
 _OP_STEP = 1
 _OP_EXIT = 2
 
-_HDR_LEN = struct.Struct("<I")
+# Step frames open with a magic + version prefix so a peer built from a
+# different release can never silently mis-decode a frame: the multihost
+# follower loop AND the disagg KV-handoff codec (disagg/handoff.py) share
+# this framing, and both treat a mismatch as fail-fast version skew rather
+# than reinterpreting raw ndarray bytes under the wrong layout.  Bump
+# FRAME_VERSION whenever the header JSON schema or segment layout changes.
+FRAME_MAGIC = b"SCT1"
+FRAME_VERSION = 1
+
+_HDR_LEN = struct.Struct("<4sHI")  # magic, version, json header length
 
 
 def encode_step(key: str, payload: dict) -> bytes:
@@ -69,9 +78,12 @@ def encode_step(key: str, payload: dict) -> bytes:
     at the COORDINATOR (the sender), never a deserialization surprise at a
     follower.  Arrays travel as raw little-endian bytes after the header:
 
-        <u32 header_len> <json header> <array 0 bytes> <array 1 bytes> ...
+        <4s magic "SCT1"> <u16 version> <u32 header_len> <json header>
+        <array 0 bytes> <array 1 bytes> ...
 
-    with the header recording each array's name/dtype/shape in order.
+    with the header recording each array's name/dtype/shape in order.  The
+    magic/version prefix makes cross-build skew (disagg pools rolled at
+    different times) a fail-fast :class:`ValueError`, never a mis-decode.
     """
     if not isinstance(payload, dict):
         raise TypeError(f"step payload must be a dict, got {type(payload).__name__}")
@@ -108,17 +120,28 @@ def encode_step(key: str, payload: dict) -> bytes:
         },
         separators=(",", ":"),
     ).encode()
-    parts = [_HDR_LEN.pack(len(header)), header]
+    parts = [_HDR_LEN.pack(FRAME_MAGIC, FRAME_VERSION, len(header)), header]
     parts.extend(a.tobytes() for _, a, _shape in arrays)
     return b"".join(parts)
 
 
 def decode_step(buf: bytes) -> tuple[str, dict]:
     """Inverse of :func:`encode_step`; raises ``ValueError`` on a torn or
-    malformed frame (the follower loop treats that as fatal version skew)."""
+    malformed frame, a wrong magic, or a version mismatch (the follower
+    loop treats any of those as fatal version skew)."""
     if len(buf) < _HDR_LEN.size:
         raise ValueError("step frame shorter than its length prefix")
-    (n,) = _HDR_LEN.unpack_from(buf, 0)
+    magic, version, n = _HDR_LEN.unpack_from(buf, 0)
+    if magic != FRAME_MAGIC:
+        raise ValueError(
+            f"step frame magic {magic!r} != {FRAME_MAGIC!r} — peer speaks a "
+            "different protocol (or the stream is corrupt)"
+        )
+    if version != FRAME_VERSION:
+        raise ValueError(
+            f"step frame version {version} != {FRAME_VERSION} — peer built "
+            "from a different release; refusing to decode"
+        )
     if len(buf) < _HDR_LEN.size + n:
         raise ValueError("step frame truncated before header end")
     header = json.loads(buf[_HDR_LEN.size : _HDR_LEN.size + n])

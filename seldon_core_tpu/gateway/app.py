@@ -108,10 +108,11 @@ class GatewayApp:
         self.tap = tap or tap_from_env()
         self.metrics = metrics or DEFAULT_METRICS
         self.timeout_s = timeout_s
-        # lean HTTP/1.1 forward pools, one per engine endpoint (wire/
-        # h1client.py — a general-purpose client costs hundreds of µs of
-        # feature machinery per hop, which is the proxy's entire budget)
-        self._pools: dict[str, "H1Pool"] = {}
+        # lean HTTP/1.1 forward pools, one per (deployment, replica)
+        # endpoint (wire/h1client.py — a general-purpose client costs
+        # hundreds of µs of feature machinery per hop, which is the
+        # proxy's entire budget)
+        self._pools: dict[tuple, "H1Pool"] = {}
         self._loop: asyncio.AbstractEventLoop | None = None
         self._paused = False
         # QoS plane: per-deployment admission (SCT_GW_QOS_* env knobs; off
@@ -135,6 +136,13 @@ class GatewayApp:
         self.cache = response_cache_from_env("gateway")
         self._cache_deployments = cache_deployments()
         self.collapse = SingleFlight()
+        # multi-upstream replica routing (docs/DISAGGREGATION.md): prefix-
+        # aware longest-match against polled per-replica digests, p2c on
+        # queue-wait EWMA otherwise; single-upstream records bypass it
+        from seldon_core_tpu.disagg.router import ReplicaRouter, RouterPoller
+
+        self.router = ReplicaRouter()
+        self.poller = RouterPoller(store, self.router)
         # removed deployments lose their live tokens immediately
         store.add_listener(self._on_deployment_event)
 
@@ -148,12 +156,18 @@ class GatewayApp:
             self.tokens.revoke_for_key(rec.oauth_key)
             self._qos.pop(rec.oauth_key, None)
         if event in ("removed", "updated") and self.cache is not None:
-            # rolling update / teardown: stale responses must be
-            # unservable the moment the new spec is observed
+            # rolling update / teardown: the deployment NAMESPACE flushes —
+            # one namespace per deployment regardless of replica count, so
+            # every replica's cached responses go stale together
             self.cache.flush(rec.oauth_key)
         if event in ("removed", "updated"):
-            pool = self._pools.pop(rec.oauth_key, None)
-            if pool is not None:
+            # the WHOLE replica set's pools evict, not just the primary's:
+            # an updated record may have re-addressed any subset of them
+            doomed = [
+                k for k in self._pools if k[0] == rec.oauth_key
+            ]
+            for k in doomed:
+                pool = self._pools.pop(k)
                 # store events may fire on operator/poller threads; the
                 # pool's StreamWriters belong to the serving loop, so hop
                 # (same hazard the gRPC channel cache documents)
@@ -161,15 +175,22 @@ class GatewayApp:
                     self._loop.call_soon_threadsafe(pool.evict)
                 else:  # no loop yet -> no sockets were ever opened
                     pool.evict()
+            # routing state rebuilds from the next poll sweep
+            self.router.forget(rec.oauth_key)
 
-    def _pool(self, rec: DeploymentRecord) -> "H1Pool":
+    def _pool(self, rec: DeploymentRecord, ep=None) -> "H1Pool":
+        """Forward pool for one replica (``ep``; default the primary).
+        Keyed per (deployment, replica) so a multi-upstream record holds
+        one pool per endpoint."""
         if self._loop is None:
             self._loop = asyncio.get_running_loop()
-        pool = self._pools.get(rec.oauth_key)
+        if ep is None:
+            ep = rec.replica_endpoints[0]
+        key = (rec.oauth_key, ep.key)
+        pool = self._pools.get(key)
         if pool is None:
-            host = rec.engine_host or rec.name
-            pool = H1Pool(host, rec.engine_rest_port)
-            self._pools[rec.oauth_key] = pool
+            pool = H1Pool(ep.host, ep.rest_port)
+            self._pools[key] = pool
         return pool
 
     def qos_for(self, rec: DeploymentRecord) -> "qos.AdmissionController":
@@ -189,9 +210,13 @@ class GatewayApp:
     async def start(self) -> None:
         configure_exporters_from_env()
         LOOP_LAG.start("gateway")
+        # replica-state refresh for multi-upstream records (digest + queue
+        # wait); single-upstream-only stores make every sweep a no-op
+        self.poller.start()
         return None  # pools connect lazily per deployment
 
     async def close(self) -> None:
+        await self.poller.stop()
         pools, self._pools = list(self._pools.values()), {}
         for pool in pools:
             await pool.close()
@@ -213,6 +238,7 @@ class GatewayApp:
         r.add_get("/stats/qos", self.stats_qos)
         r.add_get("/stats/wire", self.stats_wire)
         r.add_get("/stats/cache", self.stats_cache)
+        r.add_get("/stats/route", self.stats_route)
 
         async def _startup(app_: web.Application) -> None:
             await self.start()
@@ -292,7 +318,22 @@ class GatewayApp:
         )
 
         idempotent = "feedback" not in path
-        pool = self._pool(rec)
+        # multi-upstream replica pick (docs/DISAGGREGATION.md): prefix-
+        # aware when any replica has published digests (the prompt parse
+        # costs nothing for digest-less pools), p2c on load otherwise
+        endpoints = rec.replica_endpoints
+        ep = None
+        if len(endpoints) > 1:
+            from seldon_core_tpu.disagg.router import extract_prompt_tokens
+
+            tokens = (
+                extract_prompt_tokens(raw)
+                if self.router.has_digests(rec.oauth_key)
+                else None
+            )
+            ep = self.router.pick(rec.oauth_key, endpoints, tokens)
+            self.router.note_start(rec.oauth_key, ep.key)
+        pool = self._pool(rec, ep)
         wire = WIRE.counter(WIRE_GATEWAY_REST, rec.name)
         t_wire0 = time.perf_counter()
         from seldon_core_tpu.qos.context import outgoing_qos_headers
@@ -323,6 +364,9 @@ class GatewayApp:
             status, body = await retry_loop(attempt, idempotent=idempotent)
         except _UpstreamError as e:
             status, body = e.status, e.body
+        finally:
+            if ep is not None:
+                self.router.note_done(rec.oauth_key, ep.key)
         # wire accounting: the client body forwards verbatim and the
         # engine reply returns verbatim, so these lengths ARE the ingress
         # payload bytes (obs/wire.py)
@@ -612,6 +656,15 @@ class GatewayApp:
 
     async def stats_cache(self, request: web.Request) -> web.Response:
         return web.json_response({"cache": self.cache_snapshot()})
+
+    def route_snapshot(self) -> dict:
+        """Replica-routing state (shared by both REST fronts'
+        /stats/route): per-replica digest sizes, load signals, pick
+        counters, and the poller's sweep ledger."""
+        return {**self.router.snapshot(), "poller": self.poller.snapshot()}
+
+    async def stats_route(self, request: web.Request) -> web.Response:
+        return web.json_response({"route": self.route_snapshot()})
 
 
 def main(argv: list[str] | None = None) -> None:

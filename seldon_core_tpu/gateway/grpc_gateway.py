@@ -91,25 +91,36 @@ class _ChannelCacheBase:
 
     def __init__(self, gateway, loop=None):
         self.gateway = gateway
-        self._channels: dict[str, object] = {}
+        # keyed per (deployment, replica): multi-upstream records hold one
+        # channel per endpoint and pick per call
+        self._channels: dict[tuple, object] = {}
         self._loop = loop or asyncio.get_event_loop()
         self._close_tasks: set[asyncio.Task] = set()
         gateway.store.add_listener(self._on_deployment_event)
 
-    def _new_channel(self, rec: DeploymentRecord):
+    def _new_channel(self, rec: DeploymentRecord, ep):
         raise NotImplementedError
 
     def _channel(self, rec: DeploymentRecord):
-        ch = self._channels.get(rec.oauth_key)
+        # gRPC routes load-aware only (p2c): the proto body would need a
+        # decode to extract prompt tokens, which the raw-bytes relay
+        # deliberately never does — prefix affinity rides the REST fronts
+        endpoints = rec.replica_endpoints
+        ep = endpoints[0]
+        if len(endpoints) > 1:
+            ep = self.gateway.router.pick(rec.oauth_key, endpoints, None)
+        key = (rec.oauth_key, ep.key)
+        ch = self._channels.get(key)
         if ch is None:
-            ch = self._new_channel(rec)
-            self._channels[rec.oauth_key] = ch
+            ch = self._new_channel(rec, ep)
+            self._channels[key] = ch
         return ch
 
     def _on_deployment_event(self, event: str, rec: DeploymentRecord) -> None:
         if event in ("removed", "updated"):
-            ch = self._channels.pop(rec.oauth_key, None)
-            if ch is not None:
+            doomed = [k for k in self._channels if k[0] == rec.oauth_key]
+            for k in doomed:
+                ch = self._channels.pop(k)
                 self._loop.call_soon_threadsafe(self._schedule_close, ch)
 
     def _schedule_close(self, ch) -> None:
@@ -137,8 +148,10 @@ def _aio_rpc_failure(e: "grpc.aio.AioRpcError") -> "pb.SeldonMessage":
 class GatewayGrpc(_ChannelCacheBase):
     """grpcio-transport Seldon proxy (SCT_GRPC_IMPL=grpcio fallback)."""
 
-    def _new_channel(self, rec: DeploymentRecord):
-        return grpc.aio.insecure_channel(rec.grpc_target, options=SERVER_OPTIONS)
+    def _new_channel(self, rec: DeploymentRecord, ep):
+        return grpc.aio.insecure_channel(
+            f"{ep.host}:{ep.grpc_port}", options=SERVER_OPTIONS
+        )
 
     def _resolve(self, context) -> DeploymentRecord:
         md = dict(context.invocation_metadata() or [])
@@ -192,8 +205,8 @@ class FastGatewayGrpc(_ChannelCacheBase):
     gateway does one header scan, one dict auth lookup and two coalesced
     writes; no task, no future, no proto decode, no gRPC re-framing."""
 
-    def _new_channel(self, rec: DeploymentRecord):
-        return FastGrpcChannel(rec.grpc_target)
+    def _new_channel(self, rec: DeploymentRecord, ep):
+        return FastGrpcChannel(f"{ep.host}:{ep.grpc_port}")
 
     def seed_metadata(self, headers: list) -> None:
         """on_request_headers hook: runs inside the handler task's context

@@ -937,7 +937,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
             job = _Job(self, raw, streaming)
             self.job = job
             self.frontend.spliced += 1
-            self.frontend.pool_for(rec).submit(job)
+            self.frontend.pool_for(rec, raw, content_length).submit(job)
             return
 
     def _parse_request_head(self, head: bytes, idx: int) -> tuple | None:
@@ -1274,16 +1274,39 @@ class H1SpliceFrontend:
 
     def _on_deployment_event(self, event: str, rec) -> None:
         if event in ("removed", "updated"):
-            pool = self._pools.pop(rec.oauth_key, None)
-            if pool is not None and self.loop is not None:
-                self.loop.call_soon_threadsafe(pool.evict)
+            # evict the record's WHOLE replica set (pools are keyed per
+            # (deployment, replica)), not just one upstream
+            doomed = [k for k in self._pools if k[0] == rec.oauth_key]
+            for k in doomed:
+                pool = self._pools.pop(k)
+                if self.loop is not None:
+                    self.loop.call_soon_threadsafe(pool.evict)
 
-    def pool_for(self, rec) -> _UpstreamPool:
-        pool = self._pools.get(rec.oauth_key)
+    def pool_for(self, rec, raw: bytes | None = None, content_length: int = 0) -> _UpstreamPool:
+        """Upstream pool for one request.  Single-upstream records (the
+        overwhelmingly common case) cost one dict hit; multi-upstream
+        records pick a replica per request — prefix-aware against polled
+        digests when the request body carries tokens, p2c on load
+        otherwise (disagg/router.py)."""
+        endpoints = rec.replica_endpoints
+        ep = endpoints[0]
+        if len(endpoints) > 1:
+            router = self.gateway.router
+            tokens = None
+            if (
+                raw is not None
+                and content_length
+                and router.has_digests(rec.oauth_key)
+            ):
+                from seldon_core_tpu.disagg.router import extract_prompt_tokens
+
+                tokens = extract_prompt_tokens(raw[len(raw) - content_length:])
+            ep = router.pick(rec.oauth_key, endpoints, tokens)
+        key = (rec.oauth_key, ep.key)
+        pool = self._pools.get(key)
         if pool is None:
-            host = rec.engine_host or rec.name
-            pool = _UpstreamPool(host, rec.engine_rest_port, self.loop)
-            self._pools[rec.oauth_key] = pool
+            pool = _UpstreamPool(ep.host, ep.rest_port, self.loop)
+            self._pools[key] = pool
         return pool
 
     def wire_for(self, rec) -> "object":
@@ -1497,6 +1520,10 @@ class H1SpliceFrontend:
                 "collapsed": self.collapsed,
             }
             return 200, json.dumps({"cache": snap}).encode(), b"application/json"
+        if route == b"/stats/route":
+            return 200, json.dumps(
+                {"route": gw.route_snapshot()}
+            ).encode(), b"application/json"
         return 404, json.dumps(
             failure_status_dict(404, f"no route {route.decode('latin-1')}")
         ).encode(), b"application/json"

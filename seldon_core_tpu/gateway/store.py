@@ -22,6 +22,39 @@ DEFAULT_ENGINE_REST_PORT = 8000
 DEFAULT_ENGINE_GRPC_PORT = 5001
 
 
+@dataclasses.dataclass(frozen=True)
+class Endpoint:
+    """One engine replica a deployment can be reached at."""
+
+    host: str
+    rest_port: int = DEFAULT_ENGINE_REST_PORT
+    grpc_port: int = DEFAULT_ENGINE_GRPC_PORT
+
+    @property
+    def key(self) -> str:
+        """Stable replica identity for pools/router state."""
+        return f"{self.host}:{self.rest_port}"
+
+    @classmethod
+    def parse(cls, v: Any) -> "Endpoint":
+        """Accept ``{"host": ..., "rest_port": ..., "grpc_port": ...}`` or
+        the compact ``"host:rest[:grpc]"`` string form."""
+        if isinstance(v, Endpoint):
+            return v
+        if isinstance(v, str):
+            parts = v.split(":")
+            if not parts[0]:
+                raise ValueError(f"endpoint {v!r} has no host")
+            rest = int(parts[1]) if len(parts) > 1 else DEFAULT_ENGINE_REST_PORT
+            grpc = int(parts[2]) if len(parts) > 2 else DEFAULT_ENGINE_GRPC_PORT
+            return cls(parts[0], rest, grpc)
+        return cls(
+            host=v["host"],
+            rest_port=int(v.get("rest_port", DEFAULT_ENGINE_REST_PORT)),
+            grpc_port=int(v.get("grpc_port", DEFAULT_ENGINE_GRPC_PORT)),
+        )
+
+
 @dataclasses.dataclass
 class DeploymentRecord:
     """What the gateway needs to route to one SeldonDeployment."""
@@ -32,6 +65,11 @@ class DeploymentRecord:
     engine_host: str = ""  # defaults to the deployment's service name
     engine_rest_port: int = DEFAULT_ENGINE_REST_PORT
     engine_grpc_port: int = DEFAULT_ENGINE_GRPC_PORT
+    # the FULL replica set for multi-upstream routing (disagg/router.py);
+    # empty means the single engine_host/port upstream — every single-
+    # endpoint producer keeps working unchanged.  When set, the first
+    # endpoint is the primary (rest_base/grpc_target compatibility).
+    endpoints: tuple = ()
     annotations: dict[str, str] = dataclasses.field(default_factory=dict)
     # identity of the deployment's SPEC, folded into every response-cache
     # key (docs/CACHING.md): a rolling update changes the hash, so stale
@@ -41,6 +79,13 @@ class DeploymentRecord:
     spec_hash: str = ""
 
     def __post_init__(self) -> None:
+        self.endpoints = tuple(Endpoint.parse(e) for e in self.endpoints)
+        if self.endpoints and not self.engine_host:
+            # primary mirrors the first replica so pre-multi-upstream call
+            # sites (rest_base, grpc_target, _pool) stay coherent
+            self.engine_host = self.endpoints[0].host
+            self.engine_rest_port = self.endpoints[0].rest_port
+            self.engine_grpc_port = self.endpoints[0].grpc_port
         if not self.spec_hash:
             from seldon_core_tpu.cache.content import spec_hash as _spec_hash
 
@@ -52,9 +97,26 @@ class DeploymentRecord:
                     "engine_host": self.engine_host,
                     "engine_rest_port": self.engine_rest_port,
                     "engine_grpc_port": self.engine_grpc_port,
+                    "endpoints": [
+                        [e.host, e.rest_port, e.grpc_port] for e in self.endpoints
+                    ],
                     "annotations": self.annotations,
                 }
             )
+
+    @property
+    def replica_endpoints(self) -> tuple:
+        """The replica set to route across: the explicit endpoints list, or
+        the single primary upstream wrapped as one Endpoint."""
+        if self.endpoints:
+            return self.endpoints
+        return (
+            Endpoint(
+                self.engine_host or self.name,
+                self.engine_rest_port,
+                self.engine_grpc_port,
+            ),
+        )
 
     @property
     def rest_base(self) -> str:
@@ -68,6 +130,10 @@ class DeploymentRecord:
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "DeploymentRecord":
+        """Single-endpoint form (``engine_host``/ports) and multi-upstream
+        form (``endpoints`` list of dicts or ``host:rest[:grpc]`` strings)
+        both parse; given both, ``endpoints`` is the replica set and the
+        scalar fields name the primary."""
         return cls(
             name=d["name"],
             oauth_key=d.get("oauth_key", d["name"]),
@@ -75,6 +141,9 @@ class DeploymentRecord:
             engine_host=d.get("engine_host", ""),
             engine_rest_port=int(d.get("engine_rest_port", DEFAULT_ENGINE_REST_PORT)),
             engine_grpc_port=int(d.get("engine_grpc_port", DEFAULT_ENGINE_GRPC_PORT)),
+            endpoints=tuple(
+                Endpoint.parse(e) for e in d.get("endpoints", ())
+            ),
             annotations=dict(d.get("annotations", {})),
             spec_hash=str(d.get("spec_hash", "")),
         )

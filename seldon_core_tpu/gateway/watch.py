@@ -132,6 +132,15 @@ class GatewayWatcher:
         cr_hash = _spec_hash(
             {"spec": spec, "annotations": meta.get("annotations", {})}
         )
+        # multi-upstream replica set (disagg/router.py): comma-separated
+        # "host:rest[:grpc]" list; absent -> the single Service upstream
+        endpoints = tuple(
+            e.strip()
+            for e in meta.get("annotations", {})
+            .get("seldon.io/engine-endpoints", "")
+            .split(",")
+            if e.strip()
+        )
         return DeploymentRecord(
             spec_hash=cr_hash,
             name=name,
@@ -152,6 +161,7 @@ class GatewayWatcher:
                     "seldon.io/engine-grpc-port", ENGINE_GRPC_PORT
                 )
             ),
+            endpoints=endpoints,
             annotations={_SOURCE_ANNOTATION: "watch"},
         )
 

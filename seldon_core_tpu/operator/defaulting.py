@@ -187,6 +187,24 @@ def validate(mldep: SeldonDeployment) -> None:
     if not mldep.spec.predictors:
         raise ValidationError("deployment has no predictors")
     for predictor in mldep.spec.predictors:
+        # a typo'd disagg role must fail at ADMISSION, not brick the engine
+        # pod at boot (resolve_role raises there too, but that surfaces as
+        # CrashLoopBackOff instead of a rejected apply)
+        from seldon_core_tpu.operator.resources import (
+            ENGINE_ROLE_ANNOTATION,
+            ENGINE_ROLES,
+        )
+
+        role = (
+            predictor.annotations.get(ENGINE_ROLE_ANNOTATION)
+            or mldep.metadata.annotations.get(ENGINE_ROLE_ANNOTATION)
+            or ""
+        ).strip().lower()
+        if role and role not in ENGINE_ROLES:
+            raise ValidationError(
+                f"predictor {predictor.name!r} engine role {role!r} is not "
+                f"one of {', '.join(ENGINE_ROLES)}"
+            )
         container_names = {
             c.get("name", "") for _, c in _containers(predictor)
         }

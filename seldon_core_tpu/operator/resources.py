@@ -39,6 +39,14 @@ from seldon_core_tpu import __version__ as _VERSION
 ENGINE_IMAGE_DEFAULT = f"seldon-core-tpu/engine:{_VERSION}"
 ENGINE_REST_PORT = 8000
 ENGINE_GRPC_PORT = 5001
+
+# Disaggregated prefill/decode (docs/DISAGGREGATION.md): a predictor (or
+# CR-wide) annotation sets the engine's pool role and — for prefill pools —
+# the decode peers its KV handoffs stream to; the operator turns them into
+# the engine's SCT_ENGINE_ROLE / SCT_DISAGG_DECODE env.
+ENGINE_ROLE_ANNOTATION = "seldon.io/engine-role"
+DISAGG_DECODE_ANNOTATION = "seldon.io/disagg-decode"
+ENGINE_ROLES = ("prefill", "decode", "unified")
 # health/drain/metrics are served on the REST port (the reference used a
 # second Tomcat "admin" connector on 8082; this engine has one listener)
 ENGINE_ADMIN_PORT = ENGINE_REST_PORT
@@ -117,6 +125,23 @@ def engine_container(mldep: SeldonDeployment, predictor: PredictorDef, image: st
         "resources": copy.deepcopy(predictor.engineResources)
         or {"requests": {"cpu": "0.1"}},
     }
+    # disagg role injection: predictor annotation wins, CR-wide annotation
+    # is the pool default; absent -> unified (the engine's own default, no
+    # env emitted so a scale-only change stays template-stable)
+    role = (
+        predictor.annotations.get(ENGINE_ROLE_ANNOTATION)
+        or mldep.metadata.annotations.get(ENGINE_ROLE_ANNOTATION)
+        or ""
+    ).strip().lower()
+    if role:
+        container["env"].append({"name": "SCT_ENGINE_ROLE", "value": role})
+    peers = (
+        predictor.annotations.get(DISAGG_DECODE_ANNOTATION)
+        or mldep.metadata.annotations.get(DISAGG_DECODE_ANNOTATION)
+        or ""
+    ).strip()
+    if peers:
+        container["env"].append({"name": "SCT_DISAGG_DECODE", "value": peers})
     if predictor.tpu is not None:
         # the engine pod hosts the LOCAL JAX units, so it is the TPU
         # consumer: device-plugin resource on the container (defaulting.py

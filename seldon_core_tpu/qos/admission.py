@@ -388,9 +388,15 @@ class AdmissionController:
     def snapshot(self) -> dict:
         """The ``GET /stats/qos`` payload."""
         est = self.estimate_s() if self.enabled else None
+        from seldon_core_tpu.obs import STAGE_QUEUE_WAIT
+
+        # queue-wait EWMA surfaced directly: the gateway's load-aware
+        # replica router (disagg/router.py) polls it as the p2c signal
+        qw = self.recorder.stage_ewma(STAGE_QUEUE_WAIT)
         return {
             "name": self.name,
             "enabled": self.enabled,
+            "queue_wait_ewma_ms": round(qw * 1e3, 3) if qw is not None else None,
             "inflight": self.inflight,
             "max_inflight": self.max_inflight,
             "max_queue": self.max_queue,

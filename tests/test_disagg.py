@@ -1,0 +1,1037 @@
+"""Disaggregated prefill/decode pool tests (docs/DISAGGREGATION.md).
+
+The acceptance bars this suite holds:
+
+* **Pinned-equal** — a generation served prefill-on-engine-A /
+  decode-on-engine-B is bit-identical to the same request on a unified
+  engine (greedy AND seeded top-k, prefix reuse on and off), and a killed
+  handoff falls back to unified-mode decode with ZERO leaked KV blocks.
+* **Routing** — with two decode replicas where only one holds a shared
+  160-token system-prompt prefix, >=90% of matching requests land on the
+  warm replica; with digests disabled the p2c fallback keeps per-replica
+  admitted-request skew <= 1.5x under a uniform flood.
+* **Fail-fast framing** — the shared step/handoff codec refuses frames
+  from a different build (magic/version) instead of mis-decoding KV bytes.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.disagg import (
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    ROLE_UNIFIED,
+    decode_upstreams,
+    resolve_role,
+)
+from seldon_core_tpu.disagg.handoff import (
+    HandoffError,
+    build_handoff_frame,
+    decode_handoff,
+    encode_handoff,
+)
+from seldon_core_tpu.disagg.router import (
+    ReplicaRouter,
+    RouterPoller,
+    extract_prompt_tokens,
+    prompt_chain_hashes,
+)
+from seldon_core_tpu.executor.generation import (
+    GenerationScheduler,
+    GenerativeModel,
+)
+from seldon_core_tpu.gateway.store import (
+    DeploymentRecord,
+    DeploymentStore,
+    Endpoint,
+)
+from seldon_core_tpu.models import llama
+
+run = asyncio.run
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    cfg = llama.Config.tiny(max_seq=64)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# Roles
+# ---------------------------------------------------------------------------
+
+class TestRoles:
+    def test_role_resolution_order(self):
+        assert resolve_role(None, environ={}) == ROLE_UNIFIED
+        assert resolve_role(None, environ={"SCT_ENGINE_ROLE": "prefill"}) == ROLE_PREFILL
+        # explicit wins over env
+        assert resolve_role("decode", environ={"SCT_ENGINE_ROLE": "prefill"}) == ROLE_DECODE
+        assert resolve_role(" Decode ", environ={}) == ROLE_DECODE
+
+    def test_unknown_role_fails_at_boot(self):
+        with pytest.raises(ValueError, match="prefil"):
+            resolve_role("prefil", environ={})
+
+    def test_decode_upstreams_parse(self):
+        assert decode_upstreams(None, environ={}) == []
+        assert decode_upstreams(
+            None, environ={"SCT_DISAGG_DECODE": "a:8000, b:8001 ,"}
+        ) == ["a:8000", "b:8001"]
+
+
+# ---------------------------------------------------------------------------
+# Frame versioning (shared multihost step / KV-handoff codec)
+# ---------------------------------------------------------------------------
+
+class TestFrameVersioning:
+    def test_frame_opens_with_magic_and_version(self):
+        from seldon_core_tpu.executor.multihost import (
+            FRAME_MAGIC,
+            FRAME_VERSION,
+            encode_step,
+        )
+
+        frame = encode_step("k", {"a": 1})
+        assert frame[:4] == FRAME_MAGIC
+        assert int.from_bytes(frame[4:6], "little") == FRAME_VERSION
+
+    def test_wrong_magic_fails_fast(self):
+        from seldon_core_tpu.executor.multihost import decode_step, encode_step
+
+        frame = bytearray(encode_step("k", {"a": np.arange(4)}))
+        frame[:4] = b"XXXX"
+        with pytest.raises(ValueError, match="magic"):
+            decode_step(bytes(frame))
+
+    def test_version_skew_fails_fast(self):
+        from seldon_core_tpu.executor.multihost import decode_step, encode_step
+
+        frame = bytearray(encode_step("k", {"a": np.arange(4)}))
+        frame[4:6] = (99).to_bytes(2, "little")
+        with pytest.raises(ValueError, match="version"):
+            decode_step(bytes(frame))
+
+    def test_round_trip_still_green(self):
+        from seldon_core_tpu.executor.multihost import decode_step, encode_step
+
+        payload = {"x": np.arange(12, dtype=np.int32).reshape(3, 4), "s": "ok"}
+        key, out = decode_step(encode_step("gen:m:step", payload))
+        assert key == "gen:m:step"
+        assert out["s"] == "ok"
+        np.testing.assert_array_equal(out["x"], payload["x"])
+
+
+class TestHandoffCodec:
+    def _frame_args(self, dtype):
+        k = np.arange(2 * 2 * 4 * 2 * 3, dtype=np.float32).reshape(2, 2, 4, 2, 3)
+        if dtype == "bfloat16":
+            import ml_dtypes
+
+            k = k.astype(ml_dtypes.bfloat16)
+        return np.array([5, 9, 2], np.int32), 7, k, (k + 1).astype(k.dtype)
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_round_trip_bit_exact(self, dtype):
+        prompt, tok, k, v = self._frame_args(dtype)
+        frame = encode_handoff(
+            prompt, tok, k, v, block_size=4, max_new_tokens=6,
+            temperature=0.5, eos_id=2,
+        )
+        out = decode_handoff(frame)
+        np.testing.assert_array_equal(out["prompt"], prompt)
+        assert out["first_token"] == 7
+        assert out["block_size"] == 4
+        assert out["max_new_tokens"] == 6
+        assert out["temperature"] == 0.5
+        assert out["eos_id"] == 2
+        assert str(out["k"].dtype) == dtype
+        # bit-exact: compare the raw bit patterns, not float values
+        assert out["k"].tobytes() == k.tobytes()
+        assert out["v"].tobytes() == v.tobytes()
+
+    def test_non_handoff_key_rejected(self):
+        from seldon_core_tpu.executor.multihost import encode_step
+
+        with pytest.raises(HandoffError, match="not a KV handoff"):
+            decode_handoff(encode_step("gen:m:step", {"a": 1}))
+
+    def test_torn_frame_is_value_error(self):
+        prompt, tok, k, v = self._frame_args("float32")
+        frame = encode_handoff(prompt, tok, k, v, block_size=4, max_new_tokens=2)
+        with pytest.raises(ValueError):
+            decode_handoff(frame[:-8])
+
+
+# ---------------------------------------------------------------------------
+# Pinned-equal: scheduler-level prefill-export / import-decode
+# ---------------------------------------------------------------------------
+
+class TestPinnedEqual:
+    PROMPT = [5, 9, 2, 17, 3]
+    MAX_NEW = 9
+
+    def _unified(self, cfg, params, *, temperature=0.0, top_k=0, reuse=False,
+                 seed=None, prompt=None, max_new=None):
+        model = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, top_k=top_k,
+            prefix_reuse=reuse,
+        )
+        sched = GenerationScheduler(model)
+        if seed is not None:
+            sched._seed = seed
+
+        async def go():
+            try:
+                return await sched.submit(
+                    np.asarray(prompt or self.PROMPT, np.int32),
+                    max_new_tokens=max_new or self.MAX_NEW,
+                    temperature=temperature,
+                )
+            finally:
+                await sched.close()
+
+        return run(go())
+
+    def _disagg(self, cfg, params, *, temperature=0.0, top_k=0, reuse=False,
+                seed=None, prompt=None, max_new=None):
+        """Prefill on model A, frame + decode the handoff, import on model
+        B.  Seeds: the decode scheduler starts one past the prefill base so
+        its block-seed stream continues exactly where a unified scheduler's
+        would after consuming one admission seed."""
+        model_a = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, top_k=top_k,
+            prefix_reuse=reuse,
+        )
+        model_b = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, top_k=top_k,
+            prefix_reuse=reuse,
+        )
+        sched_a = GenerationScheduler(model_a)
+        sched_b = GenerationScheduler(model_b)
+        if seed is not None:
+            sched_a._seed = seed
+            sched_b._seed = seed + 1
+        p = np.asarray(prompt or self.PROMPT, np.int32)
+        mn = max_new or self.MAX_NEW
+
+        async def go():
+            try:
+                slot, tok1 = await sched_a.submit_prefill(
+                    p, temperature=temperature
+                )
+                frame = build_handoff_frame(
+                    model_a, slot, p, tok1,
+                    max_new_tokens=mn, temperature=temperature,
+                )
+                sched_a.release_external(slot)
+                payload = decode_handoff(frame)
+                return await sched_b.submit_imported(
+                    payload["prompt"],
+                    first_token=payload["first_token"],
+                    k=payload["k"],
+                    v=payload["v"],
+                    max_new_tokens=payload["max_new_tokens"],
+                    temperature=payload["temperature"],
+                    eos_id=payload["eos_id"],
+                )
+            finally:
+                await sched_a.close()
+                await sched_b.close()
+
+        return run(go()), model_a, model_b
+
+    def test_greedy_bit_identical(self, tiny):
+        cfg, params = tiny
+        expect = self._unified(cfg, params)
+        got, _, model_b = self._disagg(cfg, params)
+        np.testing.assert_array_equal(got, expect)
+        assert model_b.imports == 1
+
+    @pytest.mark.parametrize("reuse", [False, True])
+    def test_greedy_bit_identical_prefix_reuse(self, tiny, reuse):
+        cfg, params = tiny
+        expect = self._unified(cfg, params, reuse=reuse)
+        got, _, _ = self._disagg(cfg, params, reuse=reuse)
+        np.testing.assert_array_equal(got, expect)
+
+    def test_seeded_top_k_bit_identical(self, tiny):
+        cfg, params = tiny
+        kw = dict(temperature=0.9, top_k=4, seed=4242)
+        expect = self._unified(cfg, params, **kw)
+        got, _, _ = self._disagg(cfg, params, **kw)
+        np.testing.assert_array_equal(got, expect)
+
+    def test_import_lands_on_warm_prefix_blocks(self, tiny):
+        """Decode-side import with prefix reuse ON: blocks this pool
+        already holds for the prompt's leading full blocks are referenced,
+        not rewritten — and the result still pins equal."""
+        cfg, params = tiny
+        prefix = list(range(7, 39))  # 2 full 16-token blocks
+        prompt = prefix + [40, 41]
+        expect = self._unified(cfg, params, prompt=prompt, max_new=6)
+
+        model_a = GenerativeModel(cfg, params, n_slots=2, decode_block=4)
+        model_b = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, prefix_reuse=True
+        )
+        sched_a = GenerationScheduler(model_a)
+        sched_b = GenerationScheduler(model_b)
+        p = np.asarray(prompt, np.int32)
+
+        async def go():
+            try:
+                # warm model_b's index with the shared prefix
+                await sched_b.submit(p, max_new_tokens=2)
+                slot, tok1 = await sched_a.submit_prefill(p)
+                frame = build_handoff_frame(
+                    model_a, slot, p, tok1, max_new_tokens=6
+                )
+                sched_a.release_external(slot)
+                payload = decode_handoff(frame)
+                return await sched_b.submit_imported(
+                    payload["prompt"],
+                    first_token=payload["first_token"],
+                    k=payload["k"],
+                    v=payload["v"],
+                    max_new_tokens=6,
+                )
+            finally:
+                await sched_a.close()
+                await sched_b.close()
+
+        got = run(go())
+        np.testing.assert_array_equal(got, expect)
+        assert model_b.prefix_index.hits >= 1
+
+
+class TestHandoffFailureIsLeakFree:
+    async def _wait_blocks(self, model, want):
+        for _ in range(200):
+            if model.free_block_count == want:
+                return
+            await asyncio.sleep(0.01)
+        raise AssertionError(
+            f"pool never returned to {want} free blocks "
+            f"(stuck at {model.free_block_count})"
+        )
+
+    def test_killed_handoff_releases_every_block(self, tiny):
+        """submit_prefill pins the slot's blocks; abandoning the handoff
+        (release_external, no import) must return the pool to baseline and
+        leave the slot admittable — the zero-leak guarantee."""
+        cfg, params = tiny
+        model = GenerativeModel(cfg, params, n_slots=2, decode_block=4)
+        sched = GenerationScheduler(model)
+        prompt = np.array([5, 9, 2, 17, 3], np.int32)
+
+        async def go():
+            try:
+                baseline = model.free_block_count
+                slot, _tok = await sched.submit_prefill(prompt)
+                assert model.free_block_count < baseline  # blocks pinned
+                assert slot in sched._external
+                # the handoff "dies" here: no import ever happens
+                sched.release_external(slot)
+                await self._wait_blocks(model, baseline)
+                assert not sched._external
+                # unified-mode fallback on the SAME engine still serves
+                out = await sched.submit(prompt, max_new_tokens=5)
+                assert len(out) == 5
+                await self._wait_blocks(model, baseline)
+            finally:
+                await sched.close()
+
+        run(go())
+
+    def test_pinned_slot_excluded_from_admission_until_release(self, tiny):
+        """With every slot pinned by an in-flight handoff, new work parks
+        (never steals pinned blocks) and admits after the release."""
+        cfg, params = tiny
+        model = GenerativeModel(cfg, params, n_slots=1, decode_block=4)
+        sched = GenerationScheduler(model)
+        prompt = np.array([5, 9, 2], np.int32)
+
+        async def go():
+            try:
+                slot, _ = await sched.submit_prefill(prompt)
+                waiter = asyncio.create_task(
+                    sched.submit(prompt, max_new_tokens=3)
+                )
+                await asyncio.sleep(0.05)
+                assert not waiter.done()  # parked behind the pinned slot
+                sched.release_external(slot)
+                out = await asyncio.wait_for(waiter, 30)
+                assert len(out) == 3
+            finally:
+                await sched.close()
+
+        run(go())
+
+    def test_vanished_client_releases_immediately(self, tiny):
+        """A prefill-only caller cancelled before its result lands must
+        not leave the slot pinned forever."""
+        cfg, params = tiny
+        model = GenerativeModel(cfg, params, n_slots=1, decode_block=4)
+        sched = GenerationScheduler(model)
+        prompt = np.array([5, 9, 2], np.int32)
+
+        async def go():
+            try:
+                baseline = model.free_block_count
+                task = asyncio.create_task(sched.submit_prefill(prompt))
+                await asyncio.sleep(0)  # enqueue, then vanish
+                task.cancel()
+                with pytest.raises(asyncio.CancelledError):
+                    await task
+                # whether cancellation landed before or after admission,
+                # nothing stays pinned
+                await self._wait_blocks(model, baseline)
+                out = await sched.submit(prompt, max_new_tokens=3)
+                assert len(out) == 3
+            finally:
+                await sched.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# Prefix digests
+# ---------------------------------------------------------------------------
+
+class TestPrefixDigest:
+    def test_digest_matches_prompt_chain_hashes(self):
+        from seldon_core_tpu.cache.prefix import PrefixIndex
+
+        idx = PrefixIndex(4)
+        tokens = np.arange(100, 112, dtype=np.int32)  # 3 full 4-token blocks
+        idx.insert(tokens, [10, 11, 12], start_level=0)
+        digest = idx.digest()
+        assert digest["block_size"] == 4
+        assert digest["entries"] == 3
+        assert not digest["truncated"]
+        want = prompt_chain_hashes(tokens, 4)
+        assert set(want) == set(digest["hashes"])
+        assert sorted(digest["depths"]) == [1, 2, 3]
+
+    def test_digest_bounds_payload_deepest_first(self):
+        from seldon_core_tpu.cache.prefix import PrefixIndex
+
+        idx = PrefixIndex(4)
+        tokens = np.arange(0, 40, dtype=np.int32)
+        idx.insert(tokens, list(range(1, 11)), start_level=0)
+        digest = idx.digest(max_entries=3)
+        assert digest["truncated"]
+        assert len(digest["hashes"]) == 3
+        assert digest["depths"] == [10, 9, 8]
+
+    def test_stats_cache_exposes_digest_over_rest(self):
+        """GET /stats/cache on a prefix-reuse engine carries the compact
+        routing digest the gateway poller consumes."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from seldon_core_tpu.engine.app import EngineApp
+        from seldon_core_tpu.engine.service import PredictionService
+        from seldon_core_tpu.graph.spec import PredictorSpec
+
+        predictor = {
+            "name": "llm",
+            "graph": {
+                "name": "gen",
+                "type": "MODEL",
+                "implementation": "JAX_GENERATIVE",
+                "parameters": [
+                    {"name": "family", "value": "llama", "type": "STRING"},
+                    {"name": "preset", "value": "tiny", "type": "STRING"},
+                    {"name": "n_slots", "value": "2", "type": "INT"},
+                    {"name": "kv_prefix_reuse", "value": "true", "type": "BOOL"},
+                ],
+            },
+        }
+
+        async def go():
+            service = PredictionService(PredictorSpec.model_validate(predictor))
+            app = EngineApp(service).build()
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                # wait out warmup: its final reset() flushes the index, so
+                # a request racing it could absorb-then-lose its blocks
+                for _ in range(600):
+                    if (await client.get("/ready")).status == 200:
+                        break
+                    await asyncio.sleep(0.05)
+                resp = await client.post(
+                    "/api/v0.1/predictions",
+                    json={"strData": json.dumps(
+                        {"tokens": list(range(5, 41)), "max_new_tokens": 2}
+                    )},
+                )
+                assert resp.status == 200, await resp.text()
+                # the prompt's full blocks absorb into the index when the
+                # run loop releases the slot, which can land just after
+                # the response — poll briefly
+                digest = {}
+                for _ in range(200):
+                    resp = await client.get("/stats/cache")
+                    assert resp.status == 200
+                    snap = (await resp.json())["cache"]
+                    (unit_snap,) = snap["prefix"].values()
+                    digest = unit_snap["digest"]
+                    if digest["entries"]:
+                        break
+                    await asyncio.sleep(0.01)
+                assert digest["block_size"] == 16
+                assert digest["entries"] == len(digest["hashes"]) >= 1
+                assert all(
+                    isinstance(h, str) and len(h) == 16 for h in digest["hashes"]
+                )
+            finally:
+                await client.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# Replica router (the routing acceptance bars)
+# ---------------------------------------------------------------------------
+
+class TestReplicaRouter:
+    ENDPOINTS = (Endpoint("warm", 8000), Endpoint("cold", 8000))
+
+    def test_prompt_extraction(self):
+        assert extract_prompt_tokens(b"not json") is None
+        assert extract_prompt_tokens(b'{"data": {"ndarray": [[1]]}}') is None
+        assert extract_prompt_tokens(b'{"tokens": [1, true]}') is None
+        np.testing.assert_array_equal(
+            extract_prompt_tokens(b'{"tokens": [1, 2, 3]}'), [1, 2, 3]
+        )
+        raw = json.dumps(
+            {"strData": json.dumps({"tokens": [4, 5], "max_new_tokens": 2})}
+        ).encode()
+        np.testing.assert_array_equal(extract_prompt_tokens(raw), [4, 5])
+
+    def test_prefix_match_routes_90pct_to_warm_replica(self):
+        """The acceptance bar verbatim: a 160-token shared system prompt
+        held by ONE of two replicas pulls >=90% of matching requests."""
+        import random
+
+        router = ReplicaRouter(rng=random.Random(7))
+        sys_prompt = np.arange(1000, 1160, dtype=np.int32)  # 160 tokens
+        router.update_replica(
+            "dep", "warm:8000",
+            hashes=prompt_chain_hashes(sys_prompt, 16), block_size=16,
+        )
+        router.update_replica("dep", "cold:8000", hashes=(), block_size=16)
+        rng = random.Random(3)
+        warm_hits = 0
+        n = 200
+        for i in range(n):
+            suffix = [rng.randrange(0, 500) for _ in range(rng.randrange(1, 30))]
+            prompt = np.concatenate([sys_prompt, np.asarray(suffix, np.int32)])
+            ep = router.pick("dep", self.ENDPOINTS, prompt)
+            if ep.host == "warm":
+                warm_hits += 1
+        assert warm_hits / n >= 0.90, f"only {warm_hits}/{n} hit the warm replica"
+        assert router.prefix_picks == warm_hits
+
+    def test_partial_deeper_chain_wins(self):
+        router = ReplicaRouter()
+        tokens = np.arange(0, 64, dtype=np.int32)
+        router.update_replica(
+            "dep", "warm:8000",
+            hashes=prompt_chain_hashes(tokens, 16), block_size=16,
+        )
+        router.update_replica(
+            "dep", "cold:8000",
+            hashes=prompt_chain_hashes(tokens, 16)[:1], block_size=16,
+        )
+        ep = router.pick("dep", self.ENDPOINTS, tokens)
+        assert ep.host == "warm"
+
+    def test_p2c_fallback_skew_bounded(self):
+        """Digests disabled: a uniform flood keeps max/min per-replica
+        admitted-request skew <= 1.5x (the acceptance bar)."""
+        import random
+
+        router = ReplicaRouter(rng=random.Random(11))
+        endpoints = (
+            Endpoint("a", 8000), Endpoint("b", 8000), Endpoint("c", 8000)
+        )
+        for _ in range(600):
+            ep = router.pick("dep", endpoints, None)  # no digests anywhere
+            router.note_start("dep", ep.key)
+            router.note_done("dep", ep.key)
+        snap = router.snapshot()["deployments"]["dep"]
+        picked = [st["picked"] for st in snap.values()]
+        assert sum(picked) == 600
+        assert max(picked) / min(picked) <= 1.5, picked
+        assert router.p2c_picks == 600
+
+    def test_queue_wait_steers_p2c(self):
+        import random
+
+        router = ReplicaRouter(rng=random.Random(5))
+        eps = (Endpoint("slow", 8000), Endpoint("fast", 8000))
+        router.update_replica("dep", "slow:8000", queue_wait_ms=50.0)
+        router.update_replica("dep", "fast:8000", queue_wait_ms=1.0)
+        picks = [router.pick("dep", eps, None).host for _ in range(50)]
+        # p2c with 2 endpoints compares both every time -> all go fast
+        # until its pick count alone cannot outweigh the queue-wait gap
+        assert picks.count("fast") > picks.count("slow")
+
+    def test_single_upstream_bypasses(self):
+        router = ReplicaRouter()
+        ep = router.pick("dep", (Endpoint("only", 8000),), None)
+        assert ep.host == "only"
+        assert router.single_picks == 1
+        assert router.p2c_picks == 0
+
+    def test_forget_clears_deployment(self):
+        router = ReplicaRouter()
+        router.update_replica("dep", "a:8000", hashes=["x"], block_size=16)
+        assert router.has_digests("dep")
+        router.forget("dep")
+        assert not router.has_digests("dep")
+
+
+class TestRouterPoller:
+    def _replica_app(self, hashes, queue_wait_ms):
+        from aiohttp import web
+
+        async def stats_cache(request):
+            return web.json_response({"cache": {"prefix": {"gen": {
+                "digest": {
+                    "block_size": 16, "hashes": list(hashes),
+                    "depths": list(range(1, len(hashes) + 1)),
+                    "entries": len(hashes), "truncated": False,
+                },
+            }}}})
+
+        async def stats_qos(request):
+            return web.json_response(
+                {"qos": {"queue_wait_ewma_ms": queue_wait_ms}}
+            )
+
+        app = web.Application()
+        app.router.add_get("/stats/cache", stats_cache)
+        app.router.add_get("/stats/qos", stats_qos)
+        return app
+
+    def test_poll_once_feeds_router_state(self):
+        from aiohttp.test_utils import TestServer
+
+        sys_prompt = np.arange(0, 160, dtype=np.int32)
+        hashes = prompt_chain_hashes(sys_prompt, 16)
+
+        async def go():
+            warm = TestServer(self._replica_app(hashes, 5.0))
+            cold = TestServer(self._replica_app([], 42.0))
+            await warm.start_server()
+            await cold.start_server()
+            store = DeploymentStore()
+            store.put(DeploymentRecord(
+                name="dep", oauth_key="dep", oauth_secret="s",
+                endpoints=(
+                    f"127.0.0.1:{warm.port}", f"127.0.0.1:{cold.port}"
+                ),
+            ))
+            router = ReplicaRouter()
+            poller = RouterPoller(store, router, interval_s=999)
+            try:
+                polled = await poller.poll_once()
+                assert polled == 2
+                rec = store.get("dep")
+                ep = router.pick("dep", rec.replica_endpoints, sys_prompt)
+                assert ep.key == f"127.0.0.1:{warm.port}"
+                snap = router.snapshot()["deployments"]["dep"]
+                assert snap[f"127.0.0.1:{cold.port}"]["queue_wait_ms"] == 42.0
+                assert snap[f"127.0.0.1:{warm.port}"]["digest_entries"] == len(hashes)
+            finally:
+                await poller.stop()
+                await warm.close()
+                await cold.close()
+
+        run(go())
+
+    def test_unreachable_replica_loses_its_digest(self):
+        from aiohttp.test_utils import TestServer
+
+        hashes = prompt_chain_hashes(np.arange(32, dtype=np.int32), 16)
+
+        async def go():
+            warm = TestServer(self._replica_app(hashes, 1.0))
+            await warm.start_server()
+            store = DeploymentStore()
+            store.put(DeploymentRecord(
+                name="dep", oauth_key="dep", oauth_secret="s",
+                endpoints=(f"127.0.0.1:{warm.port}", "127.0.0.1:1"),
+            ))
+            router = ReplicaRouter()
+            # pretend a previous sweep saw the now-dead replica warm
+            router.update_replica(
+                "dep", "127.0.0.1:1", hashes=hashes, block_size=16
+            )
+            poller = RouterPoller(store, router, timeout_s=0.5, interval_s=999)
+            try:
+                await poller.poll_once()
+                snap = router.snapshot()["deployments"]["dep"]
+                assert snap["127.0.0.1:1"]["digest_entries"] == 0
+                assert poller.errors >= 1
+            finally:
+                await poller.stop()
+                await warm.close()
+
+        run(go())
+
+    def test_single_upstream_records_skipped(self):
+        async def go():
+            store = DeploymentStore()
+            store.put(DeploymentRecord(
+                name="solo", oauth_key="solo", oauth_secret="s",
+                engine_host="127.0.0.1",
+            ))
+            poller = RouterPoller(store, ReplicaRouter(), interval_s=999)
+            try:
+                assert await poller.poll_once() == 0
+            finally:
+                await poller.stop()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# Multi-upstream deployment records (gateway store)
+# ---------------------------------------------------------------------------
+
+class TestMultiUpstreamStore:
+    def test_single_endpoint_form_unchanged(self):
+        rec = DeploymentRecord.from_dict(
+            {"name": "d", "engine_host": "h", "engine_rest_port": 9}
+        )
+        assert rec.endpoints == ()
+        (ep,) = rec.replica_endpoints
+        assert (ep.host, ep.rest_port) == ("h", 9)
+        assert rec.rest_base == "http://h:9"
+
+    def test_endpoints_list_with_back_compat_forms(self):
+        rec = DeploymentRecord.from_dict({
+            "name": "d",
+            "endpoints": [
+                "a:8000:5001",
+                {"host": "b", "rest_port": 8001},
+                "c",
+            ],
+        })
+        assert [e.host for e in rec.replica_endpoints] == ["a", "b", "c"]
+        assert rec.replica_endpoints[1].rest_port == 8001
+        assert rec.replica_endpoints[2].rest_port == 8000
+        # primary mirrors the first replica for legacy call sites
+        assert rec.rest_base == "http://a:8000"
+        assert rec.grpc_target == "a:5001"
+
+    def test_endpoint_change_changes_spec_hash(self):
+        a = DeploymentRecord.from_dict({"name": "d", "endpoints": ["a", "b"]})
+        b = DeploymentRecord.from_dict({"name": "d", "endpoints": ["a", "c"]})
+        assert a.spec_hash != b.spec_hash
+        assert a != b
+
+    def test_store_listener_flushes_whole_replica_set(self):
+        """Pools for EVERY replica of an updated deployment evict, and the
+        response-cache namespace flush covers the replica set (one
+        namespace per deployment)."""
+        from seldon_core_tpu.gateway.app import GatewayApp
+
+        async def go():
+            store = DeploymentStore()
+            rec = DeploymentRecord(
+                name="d", oauth_key="d", oauth_secret="s",
+                endpoints=("a:1", "b:2"),
+            )
+            store.put(rec)
+            gw = GatewayApp(store)
+            for ep in rec.replica_endpoints:
+                gw._pool(rec, ep)
+            assert len(gw._pools) == 2
+            store.put(DeploymentRecord(
+                name="d", oauth_key="d", oauth_secret="s",
+                endpoints=("a:1", "c:3"),
+            ))
+            await asyncio.sleep(0)  # let call_soon_threadsafe evictions run
+            assert gw._pools == {}
+            await gw.close()
+
+        run(go())
+
+    def test_watch_parses_endpoints_annotation(self):
+        from seldon_core_tpu.gateway.watch import GatewayWatcher
+
+        watcher = GatewayWatcher.__new__(GatewayWatcher)
+        rec = GatewayWatcher._record_unchecked(watcher, {
+            "metadata": {
+                "name": "d",
+                "annotations": {
+                    "seldon.io/engine-endpoints": "r1:8000, r2:8000:5002",
+                },
+            },
+            "spec": {"oauth_key": "d", "oauth_secret": "s"},
+        })
+        assert [e.host for e in rec.replica_endpoints] == ["r1", "r2"]
+        assert rec.replica_endpoints[1].grpc_port == 5002
+
+
+# ---------------------------------------------------------------------------
+# Operator role injection
+# ---------------------------------------------------------------------------
+
+class TestOperatorRoleInjection:
+    def _mldep(self, annotations=None, predictor_annotations=None):
+        from seldon_core_tpu.operator.crd import SeldonDeployment
+
+        return SeldonDeployment.from_dict({
+            "apiVersion": "machinelearning.seldon.io/v1",
+            "kind": "SeldonDeployment",
+            "metadata": {"name": "dep", "annotations": annotations or {}},
+            "spec": {
+                "name": "dep",
+                "predictors": [{
+                    "name": "p",
+                    "annotations": predictor_annotations or {},
+                    "graph": {
+                        "name": "m", "type": "MODEL",
+                        "implementation": "SIMPLE_MODEL",
+                    },
+                }],
+            },
+        })
+
+    @staticmethod
+    def _env(container):
+        return {e["name"]: e.get("value") for e in container["env"]}
+
+    def test_role_and_peers_injected_from_annotations(self):
+        from seldon_core_tpu.operator.resources import engine_container
+
+        mldep = self._mldep(annotations={
+            "seldon.io/engine-role": "prefill",
+            "seldon.io/disagg-decode": "dec-0:8000,dec-1:8000",
+        })
+        env = self._env(
+            engine_container(mldep, mldep.spec.predictors[0], "img")
+        )
+        assert env["SCT_ENGINE_ROLE"] == "prefill"
+        assert env["SCT_DISAGG_DECODE"] == "dec-0:8000,dec-1:8000"
+
+    def test_predictor_annotation_wins(self):
+        from seldon_core_tpu.operator.resources import engine_container
+
+        mldep = self._mldep(
+            annotations={"seldon.io/engine-role": "prefill"},
+            predictor_annotations={"seldon.io/engine-role": "decode"},
+        )
+        env = self._env(
+            engine_container(mldep, mldep.spec.predictors[0], "img")
+        )
+        assert env["SCT_ENGINE_ROLE"] == "decode"
+
+    def test_no_annotation_emits_no_env(self):
+        from seldon_core_tpu.operator.resources import engine_container
+
+        mldep = self._mldep()
+        env = self._env(
+            engine_container(mldep, mldep.spec.predictors[0], "img")
+        )
+        assert "SCT_ENGINE_ROLE" not in env
+        assert "SCT_DISAGG_DECODE" not in env
+
+    def test_validate_rejects_bad_role(self):
+        from seldon_core_tpu.operator.defaulting import (
+            ValidationError,
+            validate,
+        )
+
+        with pytest.raises(ValidationError, match="engine role"):
+            validate(self._mldep(annotations={
+                "seldon.io/engine-role": "prefiller"
+            }))
+        validate(self._mldep(annotations={
+            "seldon.io/engine-role": "decode"
+        }))
+
+
+# ---------------------------------------------------------------------------
+# Two-engine end-to-end over REST
+# ---------------------------------------------------------------------------
+
+class TestDisaggEngineE2E:
+    PREDICTOR = {
+        "name": "llm",
+        "graph": {
+            "name": "gen",
+            "type": "MODEL",
+            "implementation": "JAX_GENERATIVE",
+            "parameters": [
+                {"name": "family", "value": "llama", "type": "STRING"},
+                {"name": "preset", "value": "tiny", "type": "STRING"},
+                {"name": "n_slots", "value": "2", "type": "INT"},
+                {"name": "max_new_tokens", "value": "6", "type": "INT"},
+            ],
+        },
+    }
+
+    def _engine(self, **kw):
+        from seldon_core_tpu.engine.app import EngineApp
+        from seldon_core_tpu.engine.service import PredictionService
+        from seldon_core_tpu.graph.spec import PredictorSpec
+
+        service = PredictionService(PredictorSpec.model_validate(self.PREDICTOR))
+        return EngineApp(service, **kw)
+
+    async def _start(self, engine):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(engine.build()))
+        await client.start_server()
+        # wait for warmup like production ingress does (readiness 503s
+        # until warm): warmup's final reset() releases every slot, so a
+        # prefill racing it would lose its pinned reservation
+        for _ in range(600):
+            if (await client.get("/ready")).status == 200:
+                return client
+            await asyncio.sleep(0.05)
+        raise AssertionError("engine never became ready")
+
+    def test_prefill_a_decode_b_matches_unified(self, tiny):
+        """The e2e pinned-equal proof over the real REST surface: the
+        disagg answer equals the unified answer for the same request."""
+
+        async def go():
+            decode_engine = self._engine(role="decode")
+            decode_client = await self._start(decode_engine)
+            unified_engine = self._engine()  # role defaults unified
+            unified_client = await self._start(unified_engine)
+            prefill_engine = self._engine(
+                role="prefill",
+                decode_upstreams=[f"127.0.0.1:{decode_client.server.port}"],
+            )
+            prefill_client = await self._start(prefill_engine)
+            try:
+                body = {"tokens": [5, 9, 2, 17, 3], "max_new_tokens": 6}
+                resp = await prefill_client.post("/disagg/generate", json=body)
+                assert resp.status == 200, await resp.text()
+                disagg = await resp.json()
+                assert disagg["mode"] == "disagg"
+
+                resp = await unified_client.post("/disagg/generate", json=body)
+                assert resp.status == 200, await resp.text()
+                unified = await resp.json()
+                assert unified["mode"] == "unified"
+                assert disagg["tokens"] == unified["tokens"]
+                assert len(disagg["tokens"]) == 6
+
+                # ledger: one handoff out, one import in
+                resp = await prefill_client.get("/stats/disagg")
+                snap = (await resp.json())["disagg"]
+                assert snap["role"] == "prefill"
+                assert snap["handoffs_ok"] == 1
+                resp = await decode_client.get("/stats/disagg")
+                snap = (await resp.json())["disagg"]
+                assert snap["imports_ok"] == 1
+            finally:
+                await prefill_client.close()
+                await unified_client.close()
+                await decode_client.close()
+
+        run(go())
+
+    def test_dead_decode_pool_falls_back_unified_and_leak_free(self, tiny):
+        async def go():
+            unified_engine = self._engine()
+            unified_client = await self._start(unified_engine)
+            prefill_engine = self._engine(
+                role="prefill", decode_upstreams=["127.0.0.1:1"]
+            )
+            prefill_client = await self._start(prefill_engine)
+            try:
+                (unit,) = prefill_engine.service.generative_units()
+                baseline = unit.model.free_block_count
+                body = {"tokens": [5, 9, 2, 17, 3], "max_new_tokens": 6}
+                resp = await prefill_client.post("/disagg/generate", json=body)
+                assert resp.status == 200, await resp.text()
+                out = await resp.json()
+                assert out["mode"] == "unified-fallback"
+
+                resp = await unified_client.post("/disagg/generate", json=body)
+                unified = await resp.json()
+                assert out["tokens"] == unified["tokens"]
+
+                # zero leaked KV blocks: the pinned prefill slot released
+                for _ in range(200):
+                    if unit.model.free_block_count == baseline:
+                        break
+                    await asyncio.sleep(0.01)
+                assert unit.model.free_block_count == baseline
+                resp = await prefill_client.get("/stats/disagg")
+                snap = (await resp.json())["disagg"]
+                assert snap["handoffs_failed"] == 1
+                assert snap["local_fallbacks"] == 1
+            finally:
+                await prefill_client.close()
+                await unified_client.close()
+
+        run(go())
+
+    def test_prefill_role_rejects_imports(self, tiny):
+        async def go():
+            engine = self._engine(role="prefill", decode_upstreams=["x:1"])
+            client = await self._start(engine)
+            try:
+                resp = await client.post("/disagg/import", data=b"junk")
+                assert resp.status == 409
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_malformed_frame_is_client_error(self, tiny):
+        async def go():
+            engine = self._engine(role="decode")
+            client = await self._start(engine)
+            try:
+                resp = await client.post("/disagg/import", data=b"not a frame")
+                assert resp.status == 400
+                body = await resp.json()
+                assert "handoff" in body["status"]["info"]
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_block_size_skew_is_conflict(self, tiny):
+        cfg, params = tiny
+
+        async def go():
+            engine = self._engine(role="decode")
+            client = await self._start(engine)
+            try:
+                k = np.zeros(
+                    (cfg.n_layers, 1, 8, cfg.n_kv_heads, cfg.head_dim),
+                    np.float32,
+                )
+                frame = encode_handoff(
+                    np.array([5, 9, 2], np.int32), 7, k, k,
+                    block_size=8, max_new_tokens=4,  # pool uses 16
+                )
+                resp = await client.post("/disagg/import", data=frame)
+                assert resp.status == 409
+                body = await resp.json()
+                assert "block size" in body["status"]["info"]
+            finally:
+                await client.close()
+
+        run(go())

@@ -387,7 +387,7 @@ class TestGrpcHopPropagation:
             ))
             gw = GatewayApp(store, metrics=MetricsRegistry())
             handler = FastGatewayGrpc(gw)
-            handler._channels["k"] = FakeChannel()
+            handler._channels[("k", "127.0.0.1:1")] = FakeChannel()
             tok, _ = gw.tokens.issue("k")
             relay = handler.make_relay("Predict")
             conn = FakeConn()
@@ -618,7 +618,7 @@ class TestWireAccounting:
             ))
             gw = GatewayApp(store, metrics=MetricsRegistry())
             handler = FastGatewayGrpc(gw)
-            handler._channels["k"] = FakeChannel()
+            handler._channels[("k", "127.0.0.1:1")] = FakeChannel()
             tok, _ = gw.tokens.issue("k")
             relay = handler.make_relay("Predict")
             conn = FakeConn()

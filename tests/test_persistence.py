@@ -207,6 +207,61 @@ class TestCheckpoint:
         orig = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), model.params)
         jax.tree.map(np.testing.assert_array_equal, host_back, orig)
 
+    def test_bfloat16_bit_pattern_roundtrip(self, tmp_path):
+        """BIT-pattern exactness, not value closeness: NaNs (multiple
+        payloads), infinities, signed zeros, and denormals must survive the
+        uint16 transport form unchanged — the checkpoint is the drain-time
+        KV/param handoff fallback (docs/DISAGGREGATION.md), where a decode
+        pool resumes another engine's state and 'almost equal' would break
+        the pinned-equal guarantee."""
+        import ml_dtypes
+
+        from seldon_core_tpu.executor.checkpoint import load_params, save_params
+
+        patterns = np.array(
+            [
+                0x0000, 0x8000,  # +0.0, -0.0
+                0x7F80, 0xFF80,  # +inf, -inf
+                0x7FC0, 0x7FC1, 0xFFC5,  # NaNs with distinct payloads
+                0x0001, 0x8001, 0x007F,  # denormals
+                0x3F80, 0xC000, 0x7F7F,  # 1.0, -2.0, bf16 max
+            ],
+            np.uint16,
+        )
+        arr = patterns.view(ml_dtypes.bfloat16)
+        path = str(tmp_path / "bits.npz")
+        save_params(path, {"w": arr})
+        back = load_params(path)
+        assert back["w"].dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(back["w"].view(np.uint16), patterns)
+
+    def test_save_on_one_mesh_load_on_another(self, tmp_path):
+        """Save sharded on a tp=2 mesh, load re-sharded onto a tp=4 mesh:
+        values identical, leaves placed on the NEW mesh — the resharding
+        path a drain-time handoff to a differently-sized pool exercises."""
+        import jax
+
+        from seldon_core_tpu.executor.checkpoint import load_params, save_params
+        from seldon_core_tpu.models import registry
+        from seldon_core_tpu.parallel import best_mesh
+
+        mesh_a = best_mesh(8, tp=2)
+        mesh_b = best_mesh(8, tp=4)
+        model = registry.build_compiled("mlp", preset="tiny", mesh=mesh_a)
+        path = str(tmp_path / "remesh.npz")
+        model.save_checkpoint(path)
+
+        fam = registry.get_family("mlp")
+        host = load_params(path)
+        axes = fam.param_logical_axes(host)
+        dev = load_params(path, mesh=mesh_b, param_axes=axes)
+        for leaf in jax.tree_util.tree_leaves(dev):
+            assert isinstance(leaf, jax.Array)
+            assert leaf.sharding.mesh.shape == mesh_b.shape
+        back = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), dev)
+        orig = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), model.params)
+        jax.tree.map(np.testing.assert_array_equal, back, orig)
+
     def test_structural_none_leaves_roundtrip(self, tmp_path):
         from seldon_core_tpu.executor.checkpoint import load_params, save_params
 
