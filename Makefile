@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -62,6 +62,17 @@ perf-check:
 disagg-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_disagg.py -q
 	JAX_PLATFORMS=cpu BENCH_ONLY=disagg BENCH_SECONDS=2 BENCH_RUNS=1 \
+		$(PYTHON) bench.py
+
+# device-side decode frontier gate (docs/PERFORMANCE.md), CPU-safe:
+# pinned-equal greedy spec-on == spec-off (incl. overlap, prefix reuse,
+# tp=2 mesh, disagg handoff), host-sync audit still <= 1 sync per fused
+# block with speculation on, int8 handoff round-trip bit-exactness +
+# checkpoint round-trip, the repetitive-text acceptance-rate floor, and
+# the program cache-key audit; then a CPU smoke of the spec bench stage
+spec-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_spec.py -q
+	JAX_PLATFORMS=cpu BENCH_ONLY=SPEC BENCH_RUNS=1 BENCH_SPEC_TOKENS=16 \
 		$(PYTHON) bench.py
 
 clean:

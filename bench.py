@@ -25,6 +25,10 @@ Stages (each skippable via env; ``BENCH_ONLY=name`` runs one stage):
   disagg               BENCH_SKIP_DISAGG interactive TTFT p99 under batch-
                                          prefill flood: unified vs split
                                          prefill/decode pools
+  spec                 BENCH_SKIP_SPEC   device-side decode frontier:
+                                         speculative-decode acceptance on
+                                         repetitive text + int8 KV capacity
+                                         and greedy-divergence drift
 
 Credibility discipline (round-5 postmortem — the headline swung 4.5x with
 this file byte-identical and nothing could attribute it):
@@ -199,6 +203,18 @@ def _breakdown(port: int) -> dict:
             f"http://127.0.0.1:{port}/stats/breakdown", timeout=5
         ) as r:
             return json.loads(r.read()).get("stages", {})
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def _stats_generation(port: int) -> dict:
+    """Device-frontier ledger (GET /stats/breakdown `generation` section):
+    per-unit speculative-decode acceptance + paged-KV capacity."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats/breakdown", timeout=5
+        ) as r:
+            return json.loads(r.read()).get("generation", {})
     except Exception as e:
         return {"error": f"{type(e).__name__}: {e}"}
 
@@ -489,9 +505,18 @@ def stage_llm(detail: dict) -> None:
             concurrency=8, duration_s=SECONDS,
         )
     tok_s = r.rps * max_new
+    dev_tok = (dev or {}).get("tokens_per_s_device")
+    hbm_tok = (dev or {}).get("hbm_roofline_tok_s")
     detail["llm_generative_wire"] = {
         **r.summary(),
         "generated_tokens_per_s": round(tok_s, 1),
+        # decode is HBM-bandwidth-bound, so the honest utilization number
+        # for an LLM stage is the fraction of the module's own HBM roofline
+        # — compute MFU stays in the detail but off the headline (ISSUE 7:
+        # "llm_mfu 0.0" was a true-but-misleading 4e-4 compute ratio)
+        "device_frac_of_hbm_roofline": (
+            _sig(dev_tok / hbm_tok) if dev_tok and hbm_tok else None
+        ),
         "mfu": _wire_mfu(tok_s, dev, key="flops_per_token", digits=6),
         "device": dev,
         "note": "llama-tiny decode loop: continuous batching across 8 slots, "
@@ -579,7 +604,12 @@ def stage_llm_1b(detail: dict) -> None:
         )
         wire_snap = _stats_wire(18860)
         warmup_snap = _stats_warmup(18860)
+        gen_snap = _stats_generation(18860)
     tok_s = r.rps * max_new
+    # device-frontier numbers (ISSUE 7): paged-KV capacity for this layout
+    # and speculation acceptance (None with spec off — the spec stage
+    # measures the repetitive-text acceptance bar separately)
+    unit_snap = next(iter(gen_snap.values()), {}) if isinstance(gen_snap, dict) else {}
     # the acceptance ratios (ISSUE r6): device decode vs the module's OWN
     # HBM roofline, and wire delivery vs device — each names its limiter
     dev_tok = (dev or {}).get("tokens_per_s_device")
@@ -588,6 +618,9 @@ def stage_llm_1b(detail: dict) -> None:
         **r.summary(),
         "stats_wire": wire_snap,
         "warmup": warmup_snap,
+        "generation": gen_snap,
+        "kv_slots_per_chip": unit_snap.get("kv_slots_per_chip"),
+        "accepted_tokens_per_step": unit_snap.get("accepted_tokens_per_step"),
         "generated_tokens_per_s": round(tok_s, 1),
         "device_frac_of_hbm_roofline": (
             _sig(dev_tok / hbm_tok) if dev_tok and hbm_tok else None
@@ -598,6 +631,122 @@ def stage_llm_1b(detail: dict) -> None:
         "stream": stream,
         "model": "llama 1.1B bf16 (llama3-1b shape), overlapped decode "
                  f"pipeline, {max_new} new tokens per request",
+    }
+
+
+def stage_spec_frontier(detail: dict) -> None:
+    """Device-side decode frontier (ROADMAP 3): self-speculative decoding
+    acceptance on a repetitive-text stub prompt (where n-gram drafting must
+    win) and int8 paged-KV capacity + greedy quality drift vs the float
+    pool — in-process device measurements with the PR 3 median-of-N
+    discipline; no wire in the loop."""
+    import asyncio
+
+    import jax
+
+    from seldon_core_tpu.executor.generation import (
+        GenerationScheduler,
+        GenerativeModel,
+    )
+    from seldon_core_tpu.models import llama as llama_mod
+
+    cfg = llama_mod.Config.tiny(max_seq=256)
+    params = llama_mod.init_params(jax.random.PRNGKey(0), cfg)
+    max_new = int(os.environ.get("BENCH_SPEC_TOKENS", "48"))
+    n_req = 4
+    # repetitive text: the pattern self-speculation drafts correctly
+    rep = np.tile([3, 7, 11, 3, 7], 8).astype(np.int32)
+    rng = np.random.default_rng(7)
+    pinned = [rng.integers(1, cfg.vocab_size, 12).astype(np.int32)
+              for _ in range(n_req)]
+
+    def build(**kw):
+        return GenerativeModel(
+            cfg, params, n_slots=n_req, decode_block=8, **kw
+        )
+
+    def gen(model, prompts):
+        sched = GenerationScheduler(model)
+
+        async def go():
+            try:
+                return await asyncio.gather(
+                    *(
+                        sched.submit(
+                            np.asarray(p, np.int32), max_new_tokens=max_new
+                        )
+                        for p in prompts
+                    )
+                )
+            finally:
+                await sched.close()
+
+        t0 = time.perf_counter()
+        outs = asyncio.run(go())
+        return outs, time.perf_counter() - t0
+
+    # --- speculation: acceptance + pinned-equal + throughput delta ---
+    # one model per config (compiles amortize across the timed runs, like
+    # real serving after warmup); first run per config is the throwaway
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
+    base_model, spec_model = build(), build(spec_draft=4)
+    base_t, spec_t = [], []
+    pinned_equal = True
+    gen(base_model, [rep] * n_req)  # warmup: compile off the clock
+    gen(spec_model, [rep] * n_req)
+    for _ in range(runs):
+        base_outs, tb = gen(base_model, [rep] * n_req)
+        spec_outs, ts = gen(spec_model, [rep] * n_req)
+        base_t.append(tb)
+        spec_t.append(ts)
+        pinned_equal = pinned_equal and all(
+            np.array_equal(a, b) for a, b in zip(base_outs, spec_outs)
+        )
+    accepted = spec_model.spec_emitted_tokens / max(
+        1, spec_model.spec_verify_passes
+    )
+    tok = n_req * max_new
+    detail["llm_spec"] = {
+        "accepted_tokens_per_step": _sig(accepted),
+        "pinned_equal_greedy": pinned_equal,
+        "spec_draft": 4,
+        "spec_ngram": spec_model.spec_ngram,
+        "tok_s_spec_off_p50": _sig(tok / sorted(base_t)[runs // 2]),
+        "tok_s_spec_on_p50": _sig(tok / sorted(spec_t)[runs // 2]),
+        "runs": runs,
+        "model": "llama tiny, repetitive-text stub prompt, greedy, "
+                 f"{max_new} new tokens x {n_req} slots",
+    }
+
+    # --- int8 KV: capacity geometry + greedy divergence vs float pool ---
+    f_model, q_model = build(), build(kv_cache_dtype="int8")
+    f_outs, _ = gen(f_model, pinned)
+    q_outs, _ = gen(q_model, pinned)
+    divergence = []
+    for a, b in zip(f_outs, q_outs):
+        n = min(a.size, b.size)
+        diff = np.nonzero(a[:n] != b[:n])[0]
+        divergence.append(int(diff[0]) if diff.size else n)
+    cfg_1b = llama_mod.Config.llama3_1b()
+    bf16_slot = llama_mod.paged_kv_slot_bytes(cfg_1b, 16, dtype="bfloat16")
+    int8_slot = llama_mod.paged_kv_slot_bytes(
+        cfg_1b, 16, kv_dtype="int8", dtype="bfloat16"
+    )
+    detail["llm_int8_kv"] = {
+        # capacity at equal pool bytes, llama3-1b bf16 serving shape
+        "kv_slots_ratio": _sig(bf16_slot / int8_slot),
+        "kv_bytes_per_slot_bf16": bf16_slot,
+        "kv_bytes_per_slot_int8": int8_slot,
+        "kv_slots_per_chip_int8": q_model.kv_slots_per_chip(),
+        "kv_slots_per_chip_float": f_model.kv_slots_per_chip(),
+        # quality drift: first greedy step where int8 diverges from the
+        # float pool on the pinned prompt set (== max_new -> no divergence)
+        "greedy_divergence_step_min": min(divergence),
+        "greedy_divergence_steps": divergence,
+        "tokens_compared": max_new,
+        "prompts": n_req,
+        "model": "llama tiny pinned prompts; slots ratio from llama3-1b "
+                 "bf16 pool geometry",
     }
 
 
@@ -1230,6 +1379,7 @@ def main() -> None:
         ("BERT", "BENCH_SKIP_BERT", stage_bert),
         ("LLM", "BENCH_SKIP_LLM", stage_llm),
         ("LLM1B", "BENCH_SKIP_LLM1B", stage_llm_1b),
+        ("SPEC", "BENCH_SKIP_SPEC", stage_spec_frontier),
         ("RESNET", "BENCH_SKIP_RESNET", stage_resnet),
         ("LOOPBACK", "BENCH_SKIP_LOOPBACK", stage_loopback),
         ("AB", "BENCH_SKIP_AB", stage_ab),
@@ -1288,11 +1438,19 @@ _STAGE_HEADLINES = (
     ("bert_base_wire", "sequences_per_s", "bert_seq_s"),
     ("bert_base_wire", "mfu", "bert_mfu"),
     ("llm_generative_wire", "generated_tokens_per_s", "llm_tok_s"),
-    ("llm_generative_wire", "mfu", "llm_mfu"),
+    # decode-bound LLM stages headline their HBM-roofline fraction, not
+    # compute MFU: "llm_mfu 0.0" was a true-but-misleading 4e-4 compute
+    # ratio for a bandwidth-bound loop (full MFU stays in BENCH_DETAIL)
+    ("llm_generative_wire", "device_frac_of_hbm_roofline", "llm_hbm_frac"),
     ("llm_1b_wire", "generated_tokens_per_s", "llm1b_tok_s"),
-    ("llm_1b_wire", "mfu", "llm1b_mfu"),
     ("llm_1b_wire", "device_frac_of_hbm_roofline", "llm1b_device_hbm_frac"),
     ("llm_1b_wire", "wire_frac_of_device", "llm1b_wire_device_frac"),
+    ("llm_1b_wire", "kv_slots_per_chip", "llm1b_kv_slots_chip"),
+    ("llm_spec", "accepted_tokens_per_step", "spec_accepted_tok_step"),
+    ("llm_spec", "tok_s_spec_on_p50", "spec_tok_s_on"),
+    ("llm_spec", "tok_s_spec_off_p50", "spec_tok_s_off"),
+    ("llm_int8_kv", "kv_slots_ratio", "int8_kv_slots_ratio"),
+    ("llm_int8_kv", "greedy_divergence_step_min", "int8_divergence_step"),
     ("ab_graph", "p99_over_p95", "ab_p99_over_p95"),
     ("gateway_rest", "p50_ms", "gateway_rest_p50_ms"),
     ("gateway_rest", "vs_direct", "gateway_rest_vs_direct"),

@@ -27,6 +27,12 @@ from seldon_core_tpu.executor.multihost import decode_step, encode_step
 log = logging.getLogger(__name__)
 
 HANDOFF_KEY = "sct:kv-handoff"
+# Payload-level codec version (the step framing has its own magic+version
+# for transport skew).  v1: float/bf16 K/V blocks.  v2: adds the int8
+# quantized layout — ``kv_quant: "int8"`` plus per-(position, head)
+# ``k_scale``/``v_scale`` segments that travel verbatim, so an import is
+# bit-exact on the quantized representation with no re-quantization.
+HANDOFF_VERSION = 2
 
 
 class HandoffError(Exception):
@@ -62,12 +68,19 @@ def encode_handoff(
     max_new_tokens: int,
     temperature: float = 0.0,
     eos_id: int | None = None,
+    k_scale: np.ndarray | None = None,
+    v_scale: np.ndarray | None = None,
 ) -> bytes:
     """Frame one prefilled request for the engine→engine handoff.
 
     ``k``/``v`` are ``(layers, n_prompt_blocks, block_size, kv_heads,
     head_dim)`` — exactly what :meth:`GenerativeModel.export_slot_kv`
-    returns for the slot's prompt blocks."""
+    returns for the slot's prompt blocks.  From an int8 pool pass the
+    quantized blocks plus their ``k_scale``/``v_scale``
+    ``(layers, n_prompt_blocks, block_size, kv_heads)`` — codec v2 carries
+    the quantized representation verbatim (bit-exact import, no
+    re-quantization on either side)."""
+    quant = k_scale is not None
     k, kv_dtype = _pack_kv(np.ascontiguousarray(k))
     v, _ = _pack_kv(np.ascontiguousarray(v))
     payload: dict[str, Any] = {
@@ -78,9 +91,17 @@ def encode_handoff(
         "temperature": float(temperature),
         "eos_id": int(eos_id) if eos_id is not None else None,
         "kv_dtype": kv_dtype,
+        "hv": HANDOFF_VERSION,
         "k": k,
         "v": v,
     }
+    if quant:
+        ks, scale_dtype = _pack_kv(np.ascontiguousarray(k_scale))
+        vs, _ = _pack_kv(np.ascontiguousarray(v_scale))
+        payload["kv_quant"] = "int8"
+        payload["scale_dtype"] = scale_dtype
+        payload["k_scale"] = ks
+        payload["v_scale"] = vs
     return encode_step(HANDOFF_KEY, payload)
 
 
@@ -88,16 +109,35 @@ def decode_handoff(buf: bytes) -> dict[str, Any]:
     """Inverse of :func:`encode_handoff`.  Raises :class:`HandoffError` on
     a frame that is not a KV handoff (``ValueError`` from the shared codec
     — torn frame, wrong magic, version skew — propagates untouched: the
-    caller maps both to a client error)."""
+    caller maps both to a client error).  v1 frames (no ``hv`` field)
+    decode as the float layout; frames newer than :data:`HANDOFF_VERSION`
+    fail fast rather than guess at an unknown KV layout."""
     key, payload = decode_step(buf)
     if key != HANDOFF_KEY:
         raise HandoffError(f"frame key {key!r} is not a KV handoff")
+    hv = int(payload.get("hv", 1))
+    if hv > HANDOFF_VERSION:
+        raise HandoffError(
+            f"handoff codec version {hv} is newer than this engine's "
+            f"{HANDOFF_VERSION}; refusing to guess at the KV layout"
+        )
     for field in ("prompt", "first_token", "block_size", "k", "v", "kv_dtype"):
         if field not in payload:
             raise HandoffError(f"handoff frame missing field {field!r}")
     kv_dtype = str(payload["kv_dtype"])
     payload["k"] = _unpack_kv(payload["k"], kv_dtype)
     payload["v"] = _unpack_kv(payload["v"], kv_dtype)
+    if payload.get("kv_quant"):
+        if str(payload["kv_quant"]) != "int8":
+            raise HandoffError(
+                f"unknown kv_quant {payload['kv_quant']!r} in handoff frame"
+            )
+        for field in ("k_scale", "v_scale", "scale_dtype"):
+            if field not in payload:
+                raise HandoffError(f"handoff frame missing field {field!r}")
+        sdt = str(payload["scale_dtype"])
+        payload["k_scale"] = _unpack_kv(payload["k_scale"], sdt)
+        payload["v_scale"] = _unpack_kv(payload["v_scale"], sdt)
     return payload
 
 
@@ -112,8 +152,11 @@ def build_handoff_frame(
     eos_id: int | None = None,
 ) -> bytes:
     """Export ``slot``'s prompt KV from ``model`` and frame the handoff
-    (runs on a worker thread — the export is a device fetch)."""
-    k, v = model.export_slot_kv(slot, int(np.asarray(prompt).size))
+    (runs on a worker thread — the export is a device fetch).  An int8
+    pool exports its quantized blocks + scales (codec v2)."""
+    out = model.export_slot_kv(slot, int(np.asarray(prompt).size))
+    k, v = out[0], out[1]
+    k_scale, v_scale = (out[2], out[3]) if len(out) == 4 else (None, None)
     return encode_handoff(
         prompt,
         first_token,
@@ -123,6 +166,8 @@ def build_handoff_frame(
         max_new_tokens=max_new_tokens,
         temperature=temperature,
         eos_id=eos_id,
+        k_scale=k_scale,
+        v_scale=v_scale,
     )
 
 
@@ -137,6 +182,13 @@ async def apply_handoff(component: Any, payload: dict[str, Any]) -> np.ndarray:
             f"handoff block size {payload['block_size']} != pool block size "
             f"{model.kv_block_size}; pools must share kv_block_size"
         )
+    quant = bool(payload.get("kv_quant"))
+    if quant != bool(model.kv_dtype):
+        raise HandoffError(
+            f"handoff kv layout {'int8' if quant else 'float'} != pool "
+            f"layout {model.kv_dtype or 'float'}; pools must share "
+            "kv_cache_dtype"
+        )
     eos = payload.get("eos_id")
     return await component.scheduler.submit_imported(
         payload["prompt"],
@@ -146,4 +198,6 @@ async def apply_handoff(component: Any, payload: dict[str, Any]) -> np.ndarray:
         max_new_tokens=int(payload["max_new_tokens"]),
         temperature=float(payload.get("temperature", 0.0)),
         eos_id=int(eos) if eos is not None else None,
+        k_scale=payload.get("k_scale"),
+        v_scale=payload.get("v_scale"),
     )

@@ -575,8 +575,21 @@ class EngineApp:
         return web.json_response(RECORDER.stats(n=max(1, min(n, 200))))
 
     async def stats_breakdown(self, request: web.Request) -> web.Response:
-        """Aggregated per-stage p50/p90/p99 (the flight recorder)."""
-        return web.json_response({"stages": RECORDER.breakdown()})
+        """Aggregated per-stage p50/p90/p99 (the flight recorder), plus the
+        device-frontier ledger per generative unit: speculative-decode
+        acceptance (``accepted_tokens_per_step``) and paged-KV capacity
+        (``kv_slots_per_chip``, layout dtype)."""
+        payload: dict = {"stages": RECORDER.breakdown()}
+        try:
+            units = self.service.generative_units()
+        except AssertionError:
+            units = []
+        gen = {
+            unit.model.name: unit.model.spec_snapshot() for unit in units
+        }
+        if gen:
+            payload["generation"] = gen
+        return web.json_response(payload)
 
     async def stats_qos(self, request: web.Request) -> web.Response:
         """QoS plane state: admission caps, shed counters by reason,

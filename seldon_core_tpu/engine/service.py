@@ -127,6 +127,13 @@ class PredictionService:
                 if self.walker is not None
                 else None
             ),
+            # per-(bucket, program) labels incl. speculative-verify and
+            # int8 variants for units that attribute them
+            "variants": (
+                dict(self.walker.warmup_variants)
+                if self.walker is not None
+                else None
+            ),
         }
 
     async def close(self) -> None:

@@ -72,6 +72,11 @@ def _prefill_buckets(max_seq: int, smallest: int = 16) -> tuple[int, ...]:
     return tuple(sizes)
 
 
+# placeholder history-seed row for non-speculative prefills: the jitted
+# prefill takes the argument either way but never reads it with spec off
+_NO_HIST = np.zeros(1, np.int32)
+
+
 class OutOfKVBlocks(Exception):
     """The paged KV pool cannot reserve the blocks this request needs right
     now; the scheduler holds the request until completions free blocks."""
@@ -107,6 +112,10 @@ class GenerativeModel:
         kv_blocks: int | None = None,
         prefix_reuse: bool | None = None,
         top_k: int = 0,
+        spec_draft: int | None = None,
+        spec_ngram: int | None = None,
+        spec_hist: int = 64,
+        kv_cache_dtype: str | None = None,
     ):
         if family_mod is None:
             from seldon_core_tpu.models import llama as family_mod
@@ -149,6 +158,51 @@ class GenerativeModel:
         # decode steps per device dispatch (the scheduler's block size);
         # 1 disables the scan path entirely
         self.decode_block = max(1, int(decode_block))
+        # --- device-side decode frontier (docs/PERFORMANCE.md) ---
+        # self-speculative n-gram decoding: draft spec_draft tokens per
+        # verify pass from a per-slot on-device history ring; greedy output
+        # stays bit-identical to the plain path, accepted tokens cost ~one
+        # device step for k tokens.  Opt-in: graph param or SCT_SPEC_DRAFT.
+        if spec_draft is None:
+            spec_draft = int(os.environ.get("SCT_SPEC_DRAFT", "0") or 0)
+        if spec_ngram is None:
+            spec_ngram = int(os.environ.get("SCT_SPEC_NGRAM", "3") or 3)
+        self.spec_draft = max(0, int(spec_draft))
+        self.spec_ngram = max(1, int(spec_ngram))
+        self.spec_hist = max(8, int(spec_hist))
+        if self.spec_draft and self.decode_block <= 1:
+            # the draft/verify/accept loop lives inside the fused k-step
+            # program; the single-token step has no verify pass to fuse into
+            log.warning(
+                "generative model %r: spec_draft needs decode_block > 1; "
+                "speculative decoding disabled", name,
+            )
+            self.spec_draft = 0
+        if self.spec_draft:
+            if not hasattr(family_mod, "decode_slots_spec_paged"):
+                raise GraphUnitError(
+                    f"generative family {family_mod.__name__} has no "
+                    "decode_slots_spec_paged; speculative decoding needs the "
+                    "fused verify step"
+                )
+            if self.spec_hist <= self.spec_ngram + self.spec_draft:
+                raise GraphUnitError(
+                    f"spec_hist {self.spec_hist} must exceed spec_ngram "
+                    f"{self.spec_ngram} + spec_draft {self.spec_draft}"
+                )
+        # tokens a slot can emit per fused decode step (verify width)
+        self._tps = 1 + self.spec_draft
+        # int8 paged-KV quantization: ~2x sequences per HBM byte; opt-in
+        # via the kv_cache_dtype graph param or SCT_KV_DTYPE=int8
+        if kv_cache_dtype is None:
+            kv_cache_dtype = os.environ.get("SCT_KV_DTYPE") or None
+        if kv_cache_dtype in ("", "auto", "bf16", "bfloat16", "float32", "fp32"):
+            kv_cache_dtype = None  # pool float dtype — the default layout
+        if kv_cache_dtype not in (None, "int8"):
+            raise GraphUnitError(
+                f"kv_cache_dtype must be 'int8' or unset, got {kv_cache_dtype!r}"
+            )
+        self.kv_dtype: str | None = kv_cache_dtype
 
         if dtype is not None:
             import jax.numpy as jnp
@@ -215,9 +269,30 @@ class GenerativeModel:
         self._slot_row: dict[int, np.ndarray] = {}
 
         cache_dtype = dtype if dtype is not None else np.float32
-        cache = family_mod.init_paged_cache(
-            cfg, self.n_slots, self.kv_blocks, kv_block_size, dtype=cache_dtype
-        )
+        if self.kv_dtype:
+            try:
+                cache = family_mod.init_paged_cache(
+                    cfg, self.n_slots, self.kv_blocks, kv_block_size,
+                    dtype=cache_dtype, kv_dtype=self.kv_dtype,
+                )
+            except TypeError:
+                raise GraphUnitError(
+                    f"generative family {family_mod.__name__} does not "
+                    f"support kv_cache_dtype={self.kv_dtype!r}"
+                ) from None
+        else:
+            cache = family_mod.init_paged_cache(
+                cfg, self.n_slots, self.kv_blocks, kv_block_size,
+                dtype=cache_dtype,
+            )
+        if self.spec_draft:
+            # per-slot history ring for the on-device n-gram proposer:
+            # token at position p lives at hist[slot, p % H]
+            import jax.numpy as jnp
+
+            cache["hist"] = jnp.zeros(
+                (self.n_slots, self.spec_hist), jnp.int32
+            )
         if mesh is not None:
             # KV heads ride the tp axis like the attention weights; blocks
             # and rows stay local (decode is latency-, not FLOP-bound)
@@ -225,12 +300,19 @@ class GenerativeModel:
 
             kv_sh = NamedSharding(mesh, P(None, None, None, "tp", None))
             rep = NamedSharding(mesh, P())
-            cache = {
+            placed = {
                 "k": jax.device_put(cache["k"], kv_sh),
                 "v": jax.device_put(cache["v"], kv_sh),
                 "pos": jax.device_put(cache["pos"], rep),
                 "table": jax.device_put(cache["table"], rep),
             }
+            if "k_scale" in cache:
+                sc_sh = NamedSharding(mesh, P(None, None, None, "tp"))
+                placed["k_scale"] = jax.device_put(cache["k_scale"], sc_sh)
+                placed["v_scale"] = jax.device_put(cache["v_scale"], sc_sh)
+            if "hist" in cache:
+                placed["hist"] = jax.device_put(cache["hist"], rep)
+            cache = placed
         self._cache = cache
         self.prefill_buckets = tuple(
             b for b in _prefill_buckets(cfg.max_seq) if b >= kv_block_size
@@ -265,13 +347,23 @@ class GenerativeModel:
 
             return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
 
-        def _prefill(params, tokens, length, slot, blocks, temperature, seed, cache):
+        spec_d = self.spec_draft
+        spec_n = self.spec_ngram
+        spec_H = self.spec_hist
+
+        def _prefill(params, tokens, length, slot, blocks, temperature, seed,
+                     hist_seed, cache):
             logits, cache = fam.prefill_slot_paged(
                 params, tokens, length, slot, blocks, cache, cfg,
                 mesh=mesh, seq_impl=seq_impl,
             )
             key = jax.random.PRNGKey(seed)
             tok = _sample(logits[None], temperature[None], key)[0]
+            if spec_d:
+                # seed the proposer ring: prompt tail (host-computed) plus
+                # the first sampled token at its position's row
+                row = hist_seed.at[length % spec_H].set(tok)
+                cache["hist"] = cache["hist"].at[slot].set(row)
             return _replicate(tok), cache
 
         def _decode(window):
@@ -338,31 +430,136 @@ class GenerativeModel:
 
             return fn
 
+        def _decode_k_spec(k, window):
+            """k fused SPECULATIVE verify passes in one device dispatch
+            (docs/PERFORMANCE.md): each pass drafts ``spec_draft`` tokens
+            from the slot's on-device history ring, scores current +
+            drafts in one batched model call, accepts the longest agreeing
+            prefix, and emits 1..(1+draft) tokens — so accepted tokens
+            cost ~one device step apiece-divided-by-acceptance.  Same
+            contract as :func:`_decode_k` with ``k * (1 + draft)`` result
+            rows: the second output is the per-row EMITTED mask (exactly
+            the role the was-active mask plays in the plain block), and
+            the ``(tokens, active, remaining)`` carry stays device-
+            resident for the overlapped pipeline.  Zero acceptance
+            degrades to the plain single-token step: row 0 of a pass is
+            bit-identical to the non-speculative program's output."""
+            from jax import lax
+            import jax.numpy as jnp
+
+            from seldon_core_tpu.executor.speculative import propose_ngram
+
+            L = 1 + spec_d
+
+            def fn(params, tokens, active, temperature, seed, eos, remaining, cache):
+                base_key = jax.random.PRNGKey(seed)
+                S = tokens.shape[0]
+                offs = jnp.arange(L)[None, :]
+                slot_col = jnp.arange(S)[:, None]
+
+                def body(carry, i):
+                    tokens, active, remaining, cache = carry
+                    hist = cache["hist"]
+                    pos = cache["pos"]
+                    drafts = propose_ngram(
+                        hist, pos, tokens, n=spec_n, draft=spec_d
+                    )
+                    qtoks = jnp.concatenate([tokens[:, None], drafts], axis=1)
+                    # writes past the slot's reserved blocks (drafts beyond
+                    # the remaining budget) route to the sink block
+                    qvalid = active[:, None] & (offs < remaining[:, None])
+                    logits, cache = fam.decode_slots_spec_paged(
+                        params, qtoks, cache, active, qvalid, cfg,
+                        window=window,
+                    )
+                    key = jax.random.fold_in(base_key, i)
+                    V = logits.shape[-1]
+                    out = _sample(
+                        logits.reshape(S * L, V),
+                        jnp.repeat(temperature, L),
+                        key,
+                    ).reshape(S, L)
+                    # accept the longest prefix where the draft agrees with
+                    # what the model actually emits
+                    agree = (drafts == out[:, :-1]).astype(jnp.int32)
+                    n_acc = jnp.cumprod(agree, axis=1).sum(axis=1)
+                    base = qvalid & (offs <= n_acc[:, None])
+                    eos_here = base & (eos[:, None] >= 0) & (out == eos[:, None])
+                    eos_before = (
+                        jnp.cumsum(eos_here.astype(jnp.int32), axis=1)
+                        - eos_here.astype(jnp.int32)
+                    )
+                    emitted = base & (eos_before == 0)
+                    n_em = emitted.sum(axis=1)
+                    last = jnp.maximum(n_em - 1, 0)
+                    new_cur = jnp.take_along_axis(out, last[:, None], axis=1)[:, 0]
+                    tokens = jnp.where(active, new_cur, tokens)
+                    remaining = jnp.where(active, remaining - n_em, remaining)
+                    active2 = active & ~eos_here.any(axis=1) & (remaining > 0)
+                    # scatter emitted tokens into the history ring (their
+                    # positions pos+1 .. pos+n_em) and advance pos
+                    widx = (pos[:, None] + 1 + offs) % spec_H
+                    old = jnp.take_along_axis(hist, widx, axis=1)
+                    cache["hist"] = hist.at[slot_col, widx].set(
+                        jnp.where(emitted, out, old)
+                    )
+                    cache["pos"] = jnp.where(active, pos + n_em, pos)
+                    return (tokens, active2, remaining, cache), (out.T, emitted.T)
+
+                (tokens, active, remaining, cache), (toks_seq, emit_seq) = lax.scan(
+                    body, (tokens, active, remaining, cache), jnp.arange(k)
+                )
+                # (k, L, S) -> (k*L, S): chronological rows, same shape
+                # contract the host delivery loop already speaks
+                toks_seq = toks_seq.reshape(k * L, S)
+                emit_seq = emit_seq.reshape(k * L, S)
+                return (
+                    _replicate(toks_seq),
+                    _replicate(emit_seq),
+                    _replicate(tokens),
+                    _replicate(active),
+                    _replicate(remaining),
+                    cache,
+                )
+
+            return fn
+
         def _prefill_suffix(pw):
             """Suffix-only prefill against a reused KV prefix (one compiled
             program per (suffix bucket, prefix window))."""
 
             def fn(params, tokens, prefix_len, length, slot, blocks_row,
-                   suffix_blocks, temperature, seed, cache):
+                   suffix_blocks, temperature, seed, hist_seed, cache):
                 logits, cache = fam.prefill_suffix_paged(
                     params, tokens, prefix_len, length, slot, blocks_row,
                     suffix_blocks, cache, cfg, prefix_window=pw,
                 )
                 key = jax.random.PRNGKey(seed)
                 tok = _sample(logits[None], temperature[None], key)[0]
+                if spec_d:
+                    row = hist_seed.at[length % spec_H].set(tok)
+                    cache["hist"] = cache["hist"].at[slot].set(row)
                 return _replicate(tok), cache
 
             return fn
 
         # cache buffers are donated: each step reuses the previous buffers
         # in place instead of holding two live copies of a multi-GB cache
-        self._prefill = jax.jit(_prefill, donate_argnums=(7,))
+        self._prefill = jax.jit(_prefill, donate_argnums=(8,))
         self._prefill_suffix_factory = _prefill_suffix
-        self._prefill_suffix_jit: dict[tuple[int, int], Any] = {}
+        self._prefill_suffix_jit: dict[tuple, Any] = {}
         self._decode_factory = _decode
-        self._decode_jit: dict[int, Any] = {}  # window -> jitted step
-        self._decode_k_factory = _decode_k
-        self._decode_k_jit: dict[tuple[int, int], Any] = {}  # (k, window)
+        self._decode_jit: dict[tuple, Any] = {}  # (window, config) -> step
+        self._decode_k_factory = _decode_k_spec if self.spec_draft else _decode_k
+        self._decode_k_jit: dict[tuple, Any] = {}  # (k, window, config)
+        # static program configuration folded into every compiled-program
+        # cache key: two deployments differing only in sampling/speculation/
+        # quantization config must NEVER share a compiled step (the audit in
+        # tests/test_spec.py holds this)
+        self._program_config = (
+            self.top_k, self.spec_draft, self.spec_ngram, self.spec_hist,
+            self.kv_dtype,
+        )
         # overlapped-pipeline state: the last dispatched block's final
         # (tokens, active, remaining) as DEVICE arrays, plus the host-side
         # (temperature, eos) the block ran with — a continue-dispatch feeds
@@ -416,6 +613,14 @@ class GenerativeModel:
         self.prefills = 0
         self.prefills_reused = 0  # prefills that skipped a reused prefix
         self.imports = 0  # disagg KV handoffs imported into this pool
+        # speculative-decoding ledger: tokens emitted vs (slot, verify-pass)
+        # pairs — their ratio is accepted_tokens_per_step (> 1.0 means the
+        # drafts are paying for themselves)
+        self.spec_emitted_tokens = 0
+        self.spec_verify_passes = 0
+        # per-(bucket, program) compile attribution filled by warmup() and
+        # served on GET /stats/warmup
+        self.warmup_programs: list[str] = []
         # decode FLOPs ≈ 2·params per token (roofline's estimate) — feeds
         # the MFU gauge from measured step round trips
         self.flops_per_token = 2.0 * sum(
@@ -423,6 +628,9 @@ class GenerativeModel:
         )
         self._m_device_step = DEFAULT_METRICS.device_step.labels(name)
         self._m_mfu = DEFAULT_METRICS.mfu.labels(name)
+        DEFAULT_METRICS.kv_slots_per_chip.labels(name).set(
+            self.kv_slots_per_chip()
+        )
         # RLock: warmup calls admit/step under the same lock
         self._lock = threading.RLock()
 
@@ -464,6 +672,9 @@ class GenerativeModel:
                 np.asarray(payload["blocks"], np.int32),
                 np.float32(payload["temperature"]),
                 np.int32(payload["seed"]),
+                np.asarray(
+                    payload.get("hist_seed", _NO_HIST), np.int32
+                ),
                 self._cache,
             )
             self.prefills += 1
@@ -562,13 +773,16 @@ class GenerativeModel:
 
     # -------------------------------------------------- disagg KV handoff
 
-    def export_slot_kv(self, slot: int, prompt_len: int) -> tuple[np.ndarray, np.ndarray]:
+    def export_slot_kv(self, slot: int, prompt_len: int) -> tuple:
         """Fetch the K/V of ``slot``'s prompt blocks to host for a disagg
         handoff (docs/DISAGGREGATION.md): ``(layers, ceil(L/bs), bs,
-        kv_heads, head_dim)`` each.  The slot's reservation pins the blocks
-        — shared prefix blocks included — so nothing here can be reclaimed
-        or overwritten until the owner releases the slot, which it only
-        does after the handoff succeeds or is abandoned."""
+        kv_heads, head_dim)`` each.  An int8 pool returns a 4-tuple
+        ``(k, v, k_scale, v_scale)`` — the QUANTIZED representation plus
+        its scales travel verbatim so the import is bit-exact with no
+        re-quantization.  The slot's reservation pins the blocks — shared
+        prefix blocks included — so nothing here can be reclaimed or
+        overwritten until the owner releases the slot, which it only does
+        after the handoff succeeds or is abandoned."""
         if self._multihost:
             raise GraphUnitError(
                 "disagg KV export is not supported from a multi-host slice "
@@ -584,6 +798,10 @@ class GenerativeModel:
         with self._lock:
             k = np.asarray(jax.device_get(self._cache["k"][:, phys]))
             v = np.asarray(jax.device_get(self._cache["v"][:, phys]))
+            if self.kv_dtype:
+                ks = np.asarray(jax.device_get(self._cache["k_scale"][:, phys]))
+                vs = np.asarray(jax.device_get(self._cache["v_scale"][:, phys]))
+                return k, v, ks, vs
         return k, v
 
     def attach_imported(
@@ -594,6 +812,9 @@ class GenerativeModel:
         v: np.ndarray,
         *,
         reserve_tokens: int = 0,
+        k_scale: np.ndarray | None = None,
+        v_scale: np.ndarray | None = None,
+        first_token: int | None = None,
     ) -> None:
         """Install another engine's exported prompt KV into ``slot``:
         reserve blocks (longest-prefix reuse applies — blocks this pool
@@ -601,8 +822,11 @@ class GenerativeModel:
         of rewritten; identical prefixes have bit-identical K/V so skipping
         the write preserves exactness), scatter the novel blocks, and set
         the slot's position/table.  After this the slot decodes exactly as
-        if it had prefilled locally.  Raises :class:`OutOfKVBlocks` like a
-        local admission when the pool cannot cover it."""
+        if it had prefilled locally.  Int8 pools require the quantized
+        blocks plus their ``k_scale``/``v_scale`` (handoff codec v2) and
+        scatter both verbatim — bit-exact, no re-quantization.  Raises
+        :class:`OutOfKVBlocks` like a local admission when the pool cannot
+        cover it."""
         prompt = np.asarray(prompt, np.int32).ravel()
         L = int(prompt.size)
         if L < 1:
@@ -617,6 +841,21 @@ class GenerativeModel:
                 f"imported KV shape {tuple(k.shape)} does not match this "
                 f"pool's {expect} (config or block-size skew)"
             )
+        if bool(self.kv_dtype) != (k_scale is not None):
+            raise GraphUnitError(
+                f"imported KV dtype skew: pool is "
+                f"{self.kv_dtype or 'float'} but the handoff "
+                f"{'carries' if k_scale is not None else 'lacks'} int8 "
+                "scales; pools must share kv_cache_dtype"
+            )
+        if k_scale is not None:
+            k_scale = np.asarray(k_scale)
+            v_scale = np.asarray(v_scale)
+            if tuple(k_scale.shape) != expect[:4] or tuple(v_scale.shape) != expect[:4]:
+                raise GraphUnitError(
+                    f"imported KV scale shape {tuple(k_scale.shape)} does "
+                    f"not match this pool's {expect[:4]}"
+                )
         row, prefix_len = self.reserve_for_prompt(
             slot, prompt, L + max(0, int(reserve_tokens))
         )
@@ -633,6 +872,17 @@ class GenerativeModel:
             "k": np.ascontiguousarray(k[:, skip:]),
             "v": np.ascontiguousarray(v[:, skip:]),
         }
+        if k_scale is not None:
+            if str(k_scale.dtype) == "bfloat16":
+                k_scale = k_scale.view(np.uint16)
+                v_scale = v_scale.view(np.uint16)
+            payload["k_scale"] = np.ascontiguousarray(k_scale[:, skip:])
+            payload["v_scale"] = np.ascontiguousarray(v_scale[:, skip:])
+        if self.spec_draft:
+            row_h = self._hist_seed(prompt)
+            if first_token is not None:
+                row_h[L % self.spec_hist] = int(first_token)
+            payload["hist_seed"] = row_h
         if self.driver is not None:
             self.driver.lead(self._mh_import_key, payload)
         else:
@@ -651,9 +901,34 @@ class GenerativeModel:
         table = table.at[slot].set(row)
         return k, v, pos, table
 
+    @staticmethod
+    @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+    def _import_scatter_q(
+        k, v, ks, vs, pos, table, phys, impk, impv, impks, impvs, slot,
+        length, row,
+    ):
+        """Int8-pool variant: the quantized blocks AND their scales scatter
+        verbatim — the handoff's bytes become the pool's bytes."""
+        k = k.at[:, phys].set(impk)
+        v = v.at[:, phys].set(impv)
+        ks = ks.at[:, phys].set(impks.astype(ks.dtype))
+        vs = vs.at[:, phys].set(impvs.astype(vs.dtype))
+        pos = pos.at[slot].set(length)
+        table = table.at[slot].set(row)
+        return k, v, ks, vs, pos, table
+
+    @staticmethod
+    def _unpack_bf16(arr: np.ndarray, want_dtype) -> np.ndarray:
+        if str(want_dtype) == "bfloat16" and arr.dtype == np.uint16:
+            import ml_dtypes
+
+            return arr.view(ml_dtypes.bfloat16)
+        return arr
+
     def _exec_import(self, payload: dict) -> None:
         """Symmetric import body (runs on every slice process): scatter the
-        imported blocks and set the slot's pos/table."""
+        imported blocks (+ scales on an int8 pool) and set the slot's
+        pos/table (+ proposer history when speculation is on)."""
         import jax.numpy as jnp
 
         with self._lock:
@@ -661,24 +936,42 @@ class GenerativeModel:
             slot = int(payload["slot"])
             phys = np.asarray(payload["phys"], np.int32)
             newk, newv = c["k"], c["v"]
+            newks, newvs = c.get("k_scale"), c.get("v_scale")
             pos, table = c["pos"], c["table"]
-            k = np.asarray(payload["k"]) if phys.size else None
-            if k is not None and str(newk.dtype) == "bfloat16" and k.dtype == np.uint16:
-                import ml_dtypes
-
-                k = k.view(ml_dtypes.bfloat16)
-                v = np.asarray(payload["v"]).view(ml_dtypes.bfloat16)
-            elif k is not None:
-                v = np.asarray(payload["v"])
+            quant = self.kv_dtype is not None
+            k = v = ks = vs = None
+            if phys.size:
+                k = self._unpack_bf16(np.asarray(payload["k"]), newk.dtype)
+                v = self._unpack_bf16(np.asarray(payload["v"]), newv.dtype)
+                if quant:
+                    ks = self._unpack_bf16(
+                        np.asarray(payload["k_scale"]), newks.dtype
+                    )
+                    vs = self._unpack_bf16(
+                        np.asarray(payload["v_scale"]), newvs.dtype
+                    )
             if phys.size and self.mesh is None:
                 # single-device fast path: donated fused scatter (no pool
                 # copy; the pool buffers update in place)
-                newk, newv, pos, table = GenerativeModel._import_scatter(
-                    newk, newv, pos, table, jnp.asarray(phys),
-                    jnp.asarray(k), jnp.asarray(v),
+                args = (
+                    jnp.asarray(phys), jnp.asarray(k), jnp.asarray(v),
+                )
+                tail = (
                     np.int32(slot), np.int32(payload["length"]),
                     np.asarray(payload["row"], np.int32),
                 )
+                if quant:
+                    (newk, newv, newks, newvs, pos, table) = (
+                        GenerativeModel._import_scatter_q(
+                            newk, newv, newks, newvs, pos, table,
+                            args[0], args[1], args[2],
+                            jnp.asarray(ks), jnp.asarray(vs), *tail,
+                        )
+                    )
+                else:
+                    newk, newv, pos, table = GenerativeModel._import_scatter(
+                        newk, newv, pos, table, *args, *tail
+                    )
             else:
                 if phys.size:
                     newk = newk.at[:, phys].set(jnp.asarray(k).astype(newk.dtype))
@@ -688,12 +981,33 @@ class GenerativeModel:
                     # keep their compiled layouts
                     newk = jax.device_put(newk, c["k"].sharding)
                     newv = jax.device_put(newv, c["v"].sharding)
+                    if quant:
+                        newks = newks.at[:, phys].set(
+                            jnp.asarray(ks).astype(newks.dtype)
+                        )
+                        newvs = newvs.at[:, phys].set(
+                            jnp.asarray(vs).astype(newvs.dtype)
+                        )
+                        newks = jax.device_put(newks, c["k_scale"].sharding)
+                        newvs = jax.device_put(newvs, c["v_scale"].sharding)
                 pos = pos.at[slot].set(np.int32(payload["length"]))
                 table = table.at[slot].set(np.asarray(payload["row"], np.int32))
                 if self.mesh is not None:
                     pos = jax.device_put(pos, c["pos"].sharding)
                     table = jax.device_put(table, c["table"].sharding)
-            self._cache = {"k": newk, "v": newv, "pos": pos, "table": table}
+            out = dict(c)
+            out.update(k=newk, v=newv, pos=pos, table=table)
+            if quant:
+                out["k_scale"] = newks
+                out["v_scale"] = newvs
+            if self.spec_draft and "hist_seed" in payload:
+                hist = c["hist"].at[int(slot)].set(
+                    np.asarray(payload["hist_seed"], np.int32)
+                )
+                if self.mesh is not None:
+                    hist = jax.device_put(hist, c["hist"].sharding)
+                out["hist"] = hist
+            self._cache = out
 
     def admit_dispatch(
         self,
@@ -741,6 +1055,8 @@ class GenerativeModel:
                 "temperature": float(temperature),
                 "seed": int(seed),
             }
+            if self.spec_draft:
+                payload["hist_seed"] = self._hist_seed(prompt)
             if self.driver is not None:
                 return self.driver.lead(self._mh_prefill_suffix_key, payload)
             return self._exec_prefill_suffix(payload)
@@ -755,9 +1071,76 @@ class GenerativeModel:
             "temperature": float(temperature),
             "seed": int(seed),
         }
+        if self.spec_draft:
+            payload["hist_seed"] = self._hist_seed(prompt)
         if self.driver is not None:
             return self.driver.lead(self._mh_prefill_key, payload)
         return self._exec_prefill(payload)
+
+    def _hist_seed(self, prompt: np.ndarray) -> np.ndarray:
+        """Host-side proposer-ring row for an admission: the prompt tail at
+        its ``p % H`` rows (the first sampled token lands in-program)."""
+        from seldon_core_tpu.executor.speculative import seed_history
+
+        return seed_history(prompt, self.spec_hist)
+
+    # ---------------------------------------------- device-frontier stats
+
+    def kv_bytes_per_slot(self) -> int:
+        """HBM bytes one max_seq slot costs in this pool's layout."""
+        fam = self.family
+        if hasattr(fam, "paged_kv_slot_bytes"):
+            dt = str(self._cache["k_scale"].dtype) if self.kv_dtype else str(
+                self._cache["k"].dtype
+            )
+            return int(
+                fam.paged_kv_slot_bytes(
+                    self.cfg, self.kv_block_size, kv_dtype=self.kv_dtype,
+                    dtype=dt,
+                )
+            )
+        per_block = sum(
+            int(self._cache[key].nbytes) // self.kv_blocks
+            for key in ("k", "v", "k_scale", "v_scale")
+            if key in self._cache
+        )
+        return per_block * self.max_blocks_per_slot
+
+    def kv_slots_per_chip(self, hbm_bytes: int | None = None) -> int:
+        """Max-seq sequences this pool layout fits per chip after the
+        weights — the capacity number int8 quantization ~doubles.  The HBM
+        budget defaults to ``SCT_HBM_GB`` (16 GiB, a v5e chip)."""
+        if hbm_bytes is None:
+            hbm_bytes = int(
+                float(os.environ.get("SCT_HBM_GB", "16")) * (1 << 30)
+            )
+        param_bytes = sum(
+            int(np.prod(x.shape)) * x.dtype.itemsize
+            for x in jax.tree.leaves(self.params)
+        )
+        return max(0, int((hbm_bytes - param_bytes) // self.kv_bytes_per_slot()))
+
+    def spec_snapshot(self) -> dict:
+        """Device-frontier state for ``GET /stats/breakdown`` and bench:
+        speculation acceptance + quantized-pool capacity accounting."""
+        ratio = (
+            self.spec_emitted_tokens / self.spec_verify_passes
+            if self.spec_verify_passes
+            else None
+        )
+        return {
+            "spec_draft": self.spec_draft,
+            "spec_ngram": self.spec_ngram if self.spec_draft else None,
+            "spec_hist": self.spec_hist if self.spec_draft else None,
+            "spec_verify_passes": self.spec_verify_passes,
+            "spec_emitted_tokens": self.spec_emitted_tokens,
+            "accepted_tokens_per_step": (
+                round(ratio, 4) if ratio is not None else None
+            ),
+            "kv_dtype": self.kv_dtype or str(self._cache["k"].dtype),
+            "kv_bytes_per_slot": self.kv_bytes_per_slot(),
+            "kv_slots_per_chip": self.kv_slots_per_chip(),
+        }
 
     def _prefix_window(self, prefix_len: int) -> int:
         """Smallest power-of-two multiple of the block size covering
@@ -772,11 +1155,11 @@ class GenerativeModel:
         """Symmetric suffix-prefill body (runs on every slice process)."""
         bucket = int(payload["padded"].shape[1])
         window = int(payload["window"])
-        key = (bucket, window)
+        key = (bucket, window) + self._program_config
         fn = self._prefill_suffix_jit.get(key)
         if fn is None:
             fn = jax.jit(
-                self._prefill_suffix_factory(window), donate_argnums=(9,)
+                self._prefill_suffix_factory(window), donate_argnums=(10,)
             )
             self._prefill_suffix_jit[key] = fn
         with self._lock:
@@ -790,6 +1173,9 @@ class GenerativeModel:
                 np.asarray(payload["suffix_blocks"], np.int32),
                 np.float32(payload["temperature"]),
                 np.int32(payload["seed"]),
+                np.asarray(
+                    payload.get("hist_seed", _NO_HIST), np.int32
+                ),
                 self._cache,
             )
             self.prefills += 1
@@ -825,10 +1211,11 @@ class GenerativeModel:
 
     def _exec_decode(self, payload: dict):
         window = int(payload.get("window") or self.cfg.max_seq)
-        fn = self._decode_jit.get(window)
+        key = (window,) + self._program_config
+        fn = self._decode_jit.get(key)
         if fn is None:
             fn = jax.jit(self._decode_factory(window), donate_argnums=(5,))
-            self._decode_jit[window] = fn
+            self._decode_jit[key] = fn
         with self._lock:
             toks, self._cache = fn(
                 self.params,
@@ -917,15 +1304,18 @@ class GenerativeModel:
             "eos": np.asarray(eos, np.int32),
             "remaining": np.asarray(remaining, np.int32),
             "k": int(k),
-            "window": window or self._window_for(active, k),
+            # a speculative block can emit up to k * (1 + draft) tokens —
+            # the window must cover the ceiling either way
+            "window": window or self._window_for(active, k * self._tps),
         }
         t0 = time.perf_counter()
         if self.driver is not None:
             toks_seq, act_seq = self.driver.lead(self._mh_decode_k_key, payload)
         else:
             toks_seq, act_seq = self._exec_decode_k(payload)
-        self._pos_ceiling[np.asarray(active, bool)] += k
-        return (toks_seq, act_seq, t0)
+        act = np.asarray(active, bool)
+        self._pos_ceiling[act] += k * self._tps
+        return (toks_seq, act_seq, t0, act, int(k))
 
     def step_k_continue(
         self, active: np.ndarray, seed: int, k: int, window: int | None = None
@@ -940,29 +1330,59 @@ class GenerativeModel:
         payload = {
             "k": int(k),
             "seed": int(seed),
-            "window": window or self._window_for(active, k),
+            "window": window or self._window_for(active, k * self._tps),
         }
         t0 = time.perf_counter()
         if self.driver is not None:
             toks_seq, act_seq = self.driver.lead(self._mh_decode_cont_key, payload)
         else:
             toks_seq, act_seq = self._exec_decode_cont(payload)
-        self._pos_ceiling[np.asarray(active, bool)] += k
+        act = np.asarray(active, bool)
+        self._pos_ceiling[act] += k * self._tps
         self.overlapped += 1
-        return (toks_seq, act_seq, t0)
+        return (toks_seq, act_seq, t0, act, int(k))
 
     def step_k_fetch(self, handle: tuple) -> tuple[np.ndarray, np.ndarray]:
-        """Materialize a dispatched block's ``(k, S)`` tokens + active mask.
+        """Materialize a dispatched block's ``(rows, S)`` tokens + emitted
+        mask (``rows = k`` plain, ``k * (1 + spec_draft)`` speculative).
         ONE device_get for both arrays: two separate fetches would pay two
         host round trips per block on a tunnel-attached chip."""
-        toks_seq, act_seq, t0 = handle
+        toks_seq, act_seq, t0, disp_active, k = handle
         toks_np, act_np = jax.device_get((toks_seq, act_seq))
         act_np = np.asarray(act_np)
+        if self.spec_draft and disp_active is not None and disp_active.any():
+            # speculation accounting + ceiling tightening: dispatch assumed
+            # the worst case k*(1+d) per slot; the fetched emitted mask says
+            # what actually landed.  The ceiling stays an overestimate of
+            # the true device position throughout (never an underestimate).
+            emitted = act_np.sum(axis=0).astype(np.int64)
+            self._pos_ceiling[disp_active] -= (
+                k * self._tps - emitted[disp_active]
+            )
+            # acceptance counts PRODUCTIVE (pass, slot) pairs only — a slot
+            # that finished its budget mid-block rides the rest of the
+            # fused block inactive in the plain path too, so charging those
+            # idle passes would understate what drafting actually bought
+            productive = int(
+                act_np.reshape(k, self._tps, -1).any(axis=1).sum()
+            )
+            self.spec_emitted_tokens += int(emitted.sum())
+            self.spec_verify_passes += productive
+            ratio = self.spec_emitted_tokens / max(1, self.spec_verify_passes)
+            DEFAULT_METRICS.spec_emitted.labels(self.name).inc(
+                int(emitted.sum())
+            )
+            DEFAULT_METRICS.spec_verify_passes.labels(self.name).inc(
+                productive
+            )
+            DEFAULT_METRICS.spec_accepted_per_step.labels(self.name).set(ratio)
         self._record_step(time.perf_counter() - t0, int(act_np.sum()))
         return np.asarray(toks_np), act_np
 
     def _decode_k_fn(self, k: int, window: int):
-        key = (k, window)
+        # static sampling/speculation/quantization config rides the key so
+        # no two configurations can ever share a compiled block program
+        key = (k, window) + self._program_config
         fn = self._decode_k_jit.get(key)
         if fn is None:
             # donate the carry args (tokens/active/remaining) along with the
@@ -1038,8 +1458,20 @@ class GenerativeModel:
             if self.prefills or self.steps:
                 return 0
             n = 0
+            self.warmup_programs = []
+            # program-variant tag: the static config each compiled program
+            # bakes in — /stats/warmup shows it so readiness demonstrably
+            # covered the speculative-verify and int8 variants actually
+            # served (not just their plain-path namesakes)
+            tag = []
+            if self.spec_draft:
+                tag.append(f"spec{self.spec_draft}")
+            if self.kv_dtype:
+                tag.append(self.kv_dtype)
+            sfx = ("[" + ",".join(tag) + "]") if tag else ""
             for b in self.prefill_buckets:
                 self.admit(0, np.ones(b, np.int32), 0.0, 0)
+                self.warmup_programs.append(f"prefill:b{b}{sfx}")
                 n += 1
             # every attention-window bucket compiles up front: a window
             # first hit mid-serving would stall that decode block for the
@@ -1058,6 +1490,9 @@ class GenerativeModel:
                         self.decode_block,
                         window=w,
                     )
+                    self.warmup_programs.append(
+                        f"decode_k:k{self.decode_block}:w{w}{sfx}"
+                    )
                 else:
                     self.step(
                         np.zeros(self.n_slots, np.int32),
@@ -1066,6 +1501,7 @@ class GenerativeModel:
                         0,
                         window=w,
                     )
+                    self.warmup_programs.append(f"decode:w{w}{sfx}")
                 n += 1
             # KV prefix reuse on: the suffix-prefill program for each
             # prefix window would otherwise first-compile on the first
@@ -1095,10 +1531,17 @@ class GenerativeModel:
                         "temperature": 0.0,
                         "seed": 0,
                     }
+                    if self.spec_draft:
+                        payload["hist_seed"] = np.zeros(
+                            self.spec_hist, np.int32
+                        )
                     if self.driver is not None:
                         self.driver.lead(self._mh_prefill_suffix_key, payload)
                     else:
                         self._exec_prefill_suffix(payload)
+                    self.warmup_programs.append(
+                        f"suffix:b{bucket}:w{pw}{sfx}"
+                    )
                     n += 1
                 self.prefills, self.prefills_reused = pf, pfr
             # warmup wrote garbage into slot 0 and advanced nothing real
@@ -1410,6 +1853,8 @@ class GenerationScheduler:
         temperature: float = 0.0,
         eos_id: int | None = None,
         on_token: "Callable[[int], None] | None" = None,
+        k_scale: np.ndarray | None = None,
+        v_scale: np.ndarray | None = None,
     ) -> np.ndarray:
         """Disagg decode-side admission: continue a generation whose
         prompt KV (``k``/``v``) and first sampled token arrived from a
@@ -1432,7 +1877,10 @@ class GenerationScheduler:
             on_token=on_token, t0=time.perf_counter(), span=current_span(),
             priority=qos.get_priority(), deadline=qos.get_deadline(),
         )
-        req.imported = {"first_token": int(first_token), "k": k, "v": v}
+        req.imported = {
+            "first_token": int(first_token), "k": k, "v": v,
+            "k_scale": k_scale, "v_scale": v_scale,
+        }
         self._enqueue(req)
         return await self._await_withdrawing(req)
 
@@ -1820,6 +2268,9 @@ class GenerationScheduler:
                         self.model.attach_imported(
                             slot, req.prompt, imp["k"], imp["v"],
                             reserve_tokens=req.max_new_tokens,
+                            k_scale=imp.get("k_scale"),
+                            v_scale=imp.get("v_scale"),
+                            first_token=imp["first_token"],
                         )
                         placed.append((req, slot, imp["first_token"]))
                         continue
@@ -1909,6 +2360,12 @@ class GenerativeComponent(SeldonComponent):
     def warmup(self) -> int:
         return self.model.warmup()
 
+    def warmup_variants(self) -> list[str]:
+        """Per-(bucket, program) compile attribution for /stats/warmup —
+        names the speculative-verify and int8 variants explicitly so
+        readiness provably covered every program actually served."""
+        return list(self.model.warmup_programs)
+
     async def close(self) -> None:
         await self.scheduler.close()
 
@@ -1919,6 +2376,13 @@ class GenerativeComponent(SeldonComponent):
             {"key": f"{self.model.name}_overlapped_blocks", "type": "GAUGE", "value": self.model.overlapped},
             {"key": f"{self.model.name}_kv_imports", "type": "GAUGE", "value": self.model.imports},
         ]
+        if self.model.spec_draft and self.model.spec_verify_passes:
+            out.append({
+                "key": f"{self.model.name}_accepted_tokens_per_step",
+                "type": "GAUGE",
+                "value": self.model.spec_emitted_tokens
+                / self.model.spec_verify_passes,
+            })
         if self.model.prefix_index is not None:
             out.append({
                 "key": f"{self.model.name}_prefills_reused",

@@ -1,0 +1,92 @@
+"""On-device n-gram proposer for self-speculative decoding.
+
+Self-speculation needs no second model (Leviathan et al.'s drafter is the
+sequence's OWN recent history): natural-language and code generations
+repeat themselves — identifiers, boilerplate, quoted spans — so matching
+the last ``n`` generated tokens against earlier occurrences in a per-slot
+history ring and replaying what followed is a free draft distribution.
+The fused verify pass (``models/llama.py::decode_slots_spec_paged``)
+scores the current token plus all ``draft`` proposals in ONE batched
+model call; the longest agreeing prefix is accepted, so k accepted tokens
+cost ~one device step instead of k.
+
+Everything here is pure ``jnp`` with static shapes: the proposer runs
+INSIDE the fused k-step decode program (``_decode_k`` in
+executor/generation.py), so drafting never touches the host and the
+overlapped pipeline's zero-host-round-trip contract survives speculation.
+
+The history ring ``hist (S, H)`` stores the token at sequence position
+``p`` in row ``p % H`` — prefill seeds it with the prompt tail, the
+decode carry scatters each emitted token, and the invariant
+``hist[slot, pos % H] == current token`` holds at every block boundary.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def propose_ngram(
+    hist: jnp.ndarray,
+    pos: jnp.ndarray,
+    cur: jnp.ndarray,
+    *,
+    n: int,
+    draft: int,
+) -> jnp.ndarray:
+    """Draft ``draft`` tokens per slot from the history ring.
+
+    ``hist`` is ``(S, H)`` int32 (position ``p`` lives at ``p % H``);
+    ``pos`` ``(S,)`` the current position (``hist[pos % H]`` is the
+    current token ``cur``); ``n``/``draft`` are STATIC.  For each slot the
+    most recent earlier occurrence of the last-``n``-token suffix is
+    located and the ``draft`` tokens that followed it are proposed; slots
+    with no match fall back to repeating ``cur`` (harmless — the verify
+    pass still emits at least the one real token, and constant runs are
+    the one pattern the fallback drafts correctly).
+
+    Candidate starts are bounded so the whole match window
+    (``n + draft`` tokens) is inside the ring AND strictly before the
+    suffix's own occurrence — the proposer never "matches" the suffix
+    against itself.
+    """
+    S, H = hist.shape
+    win = n + draft
+    if H <= win:
+        raise ValueError(f"history {H} too small for n={n} + draft={draft}")
+    C = H - win  # candidate starts per slot, c=0 the most recent
+    # the last n tokens (positions pos-n+1 .. pos)
+    sfx_idx = (pos[:, None] + jnp.arange(-n + 1, 1)[None, :]) % H
+    suffix = jnp.take_along_axis(hist, sfx_idx, axis=1)  # (S, n)
+    # start s_c matches tokens s_c..s_c+n-1 and proposes the next `draft`;
+    # all win tokens must be known (<= pos) and still in the ring (> pos-H)
+    starts = pos[:, None] - win + 1 - jnp.arange(C)[None, :]  # (S, C)
+    ok = (starts >= 0) & (starts > pos[:, None] - H)
+    widx = (starts[:, :, None] + jnp.arange(win)[None, None, :]) % H
+    wins = jnp.take_along_axis(hist[:, None, :], widx, axis=2)  # (S, C, win)
+    match = ok & jnp.all(wins[:, :, :n] == suffix[:, None, :], axis=-1)
+    any_match = match.any(axis=1)
+    # smallest c (most recent occurrence) among matches
+    best = jnp.argmax(
+        match.astype(jnp.int32) * (C - jnp.arange(C))[None, :], axis=1
+    )
+    cand = jnp.take_along_axis(
+        wins[:, :, n:], best[:, None, None], axis=1
+    )[:, 0]  # (S, draft)
+    fallback = jnp.broadcast_to(cur[:, None], (S, draft))
+    return jnp.where(any_match[:, None], cand, fallback).astype(hist.dtype)
+
+
+def seed_history(prompt, hist_len: int):
+    """Host-side history-ring row for a freshly admitted prompt: the last
+    ``hist_len - 1`` prompt tokens at their ``p % H`` rows (one row is
+    left for the first sampled token, written in-program at
+    ``length % H``).  Returns ``(hist_len,)`` int32 numpy."""
+    import numpy as np
+
+    row = np.zeros(int(hist_len), np.int32)
+    prompt = np.asarray(prompt, np.int32).ravel()
+    lp = int(prompt.size)
+    for p in range(max(0, lp - int(hist_len) + 1), lp):
+        row[p % int(hist_len)] = prompt[p]
+    return row

@@ -353,7 +353,10 @@ def _jax_generative(parameters: dict[str, Any]) -> Any:
     ``max_new_tokens``, ``temperature``, ``top_k`` (fused on-device top-k
     sampling), ``eos_id``, ``dtype``, ``checkpoint``, ``seq_impl``,
     ``decode_block``, ``overlap`` (overlapped decode pipeline,
-    docs/PERFORMANCE.md), ``kv_prefix_reuse``, plus model-config overrides.
+    docs/PERFORMANCE.md), ``kv_prefix_reuse``, ``spec_draft`` /
+    ``spec_ngram`` / ``spec_hist`` (fused self-speculative decoding),
+    ``kv_cache_dtype`` (``int8`` paged-KV quantization), plus model-config
+    overrides.
     """
     from seldon_core_tpu.models import registry as model_registry
 

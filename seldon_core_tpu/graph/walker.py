@@ -316,6 +316,11 @@ class GraphWalker:
         # warmup() — GET /stats/warmup exposes it so a slow readiness tail
         # is attributable to the unit that compiled longest
         self.warmup_seconds: dict[str, float] = {}
+        # per-unit program-variant attribution (unit name -> labels like
+        # "decode_k:k16:w256[spec4,int8]") for units that report it —
+        # /stats/warmup proves readiness covered every (bucket, program)
+        # pair actually served, variants included
+        self.warmup_variants: dict[str, list[str]] = {}
         self.root = self._build(spec)
 
     def deterministic(self) -> bool:
@@ -370,15 +375,19 @@ class GraphWalker:
         lock).  Per-unit wall time lands in :attr:`warmup_seconds`."""
         report: dict[str, int] = {}
         self.warmup_seconds = {}
+        self.warmup_variants = {}
 
-        async def _one(name: str, fn) -> None:
+        async def _one(name: str, comp, fn) -> None:
             t0 = time.perf_counter()
             report[name] = await asyncio.to_thread(fn)
             self.warmup_seconds[name] = round(time.perf_counter() - t0, 3)
+            variants = getattr(comp, "warmup_variants", None)
+            if callable(variants):
+                self.warmup_variants[name] = variants()
 
         await asyncio.gather(
             *(
-                _one(name, fn)
+                _one(name, comp, fn)
                 for name, comp in self.iter_components()
                 if callable(fn := getattr(comp, "warmup", None))
             )

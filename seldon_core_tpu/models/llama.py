@@ -161,18 +161,26 @@ def _dense_causal_attention(q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _layer(x, lp, cfg: Config, positions, attn_fn):
+def _layer(x, lp, cfg: Config, positions, attn_fn, kv_hook=None):
+    """``kv_hook(k, v) -> (k_attn, v_attn, stored)`` lets a quantized KV
+    pool attend the DEQUANTIZED values it will actually cache (fake-quant
+    consistency: a reused prefix then reads byte-identical K/V to what the
+    cold prefill attended, keeping prefix reuse bit-exact under int8)."""
     h = _rmsnorm(x, lp["ln_att"], cfg.norm_eps)
     q = jnp.einsum("ble,ehd->blhd", h, lp["wq"])
     k = jnp.einsum("ble,ehd->blhd", h, lp["wk"])
     v = jnp.einsum("ble,ehd->blhd", h, lp["wv"])
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    o = attn_fn(q, _gqa_repeat(k, cfg.n_heads), _gqa_repeat(v, cfg.n_heads))
+    if kv_hook is None:
+        ka, va, stored = k, v, (k, v)
+    else:
+        ka, va, stored = kv_hook(k, v)
+    o = attn_fn(q, _gqa_repeat(ka, cfg.n_heads), _gqa_repeat(va, cfg.n_heads))
     x = x + jnp.einsum("blhd,hde->ble", o, lp["wo"])
     h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
     mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
-    return x + mlp, (k, v)
+    return x + mlp, stored
 
 
 # ---------------------------------------------------------------------------
@@ -248,7 +256,7 @@ def prefill(
     can route attention through ring/Ulysses sequence parallelism over the
     mesh's ``sp`` axis (``seq_impl`` in {"dense", "ring", "ulysses"}).
     """
-    x, ks, vs = _prefill_core(params, tokens, cfg, _select_attn(mesh, seq_impl))
+    x, (ks, vs) = _prefill_core(params, tokens, cfg, _select_attn(mesh, seq_impl))
     cache = {
         "k": jax.lax.dynamic_update_slice(cache["k"], ks.astype(cache["k"].dtype), (0, 0, 0, 0, 0)),
         "v": jax.lax.dynamic_update_slice(cache["v"], vs.astype(cache["v"].dtype), (0, 0, 0, 0, 0)),
@@ -258,18 +266,20 @@ def prefill(
     return x @ params["head"], cache
 
 
-def _prefill_core(params, tokens, cfg: Config, attn_fn):
+def _prefill_core(params, tokens, cfg: Config, attn_fn, kv_hook=None):
     """Embed + layer scan shared by :func:`prefill` and :func:`prefill_slot`.
-    Returns ``(hidden (B, L, E), ks, vs (layers, B, L, kv, hd))``."""
+    Returns ``(hidden (B, L, E), stored)`` where ``stored`` is
+    ``(ks, vs) (layers, B, L, kv, hd)`` for float pools, or the kv_hook's
+    per-layer pytree (quantized blocks + scales) when one is given."""
     x = params["tok_emb"][tokens]
     positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
 
     def body(x, lp):
-        x, (k, v) = _layer(x, lp, cfg, positions, attn_fn)
-        return x, (k, v)
+        x, stored = _layer(x, lp, cfg, positions, attn_fn, kv_hook)
+        return x, stored
 
-    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
-    return x, ks, vs
+    x, stored = jax.lax.scan(body, x, params["layers"])
+    return x, stored
 
 
 def decode_step(params: dict, token: jax.Array, cache: dict, cfg: Config) -> tuple[jax.Array, dict]:
@@ -331,7 +341,7 @@ def prefill_slot(
     taken at ``length - 1``, and decode's validity mask never reaches pad
     cache rows before they are overwritten.
     """
-    x, ks, vs = _prefill_core(params, tokens, cfg, _select_attn(mesh, seq_impl))
+    x, (ks, vs) = _prefill_core(params, tokens, cfg, _select_attn(mesh, seq_impl))
     # ks: (layers, 1, Lp, kv, hd) -> write rows [0, Lp) of this slot
     cache = {
         "k": jax.lax.dynamic_update_slice(
@@ -368,20 +378,90 @@ def prefill_slot(
 # scales with the POOL (HBM budget), not with n_slots x max_seq.
 
 def init_paged_cache(
-    cfg: Config, n_slots: int, n_blocks: int, block_size: int, dtype=jnp.float32
+    cfg: Config,
+    n_slots: int,
+    n_blocks: int,
+    block_size: int,
+    dtype=jnp.float32,
+    kv_dtype: str | None = None,
 ) -> dict:
+    """``kv_dtype="int8"`` stores K/V blocks as int8 with one ``dtype``
+    scale per (position, kv-head) — ``k_scale``/``v_scale`` of shape
+    ``(layers, n_blocks, block_size, kv_heads)`` — roughly doubling the
+    sequences a fixed HBM pool holds (docs/PERFORMANCE.md).  Attention
+    reads dequantize in place; writes quantize per row, so incremental
+    decode appends never rescale neighbouring rows."""
     if cfg.max_seq % block_size:
         raise ValueError(
             f"max_seq {cfg.max_seq} must be a multiple of block_size {block_size}"
         )
+    if kv_dtype not in (None, "int8"):
+        raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
     mb = cfg.max_seq // block_size
     shape = (cfg.n_layers, n_blocks, block_size, cfg.n_kv_heads, cfg.head_dim)
-    return {
-        "k": jnp.zeros(shape, dtype),
-        "v": jnp.zeros(shape, dtype),
+    cache = {
+        "k": jnp.zeros(shape, jnp.int8 if kv_dtype == "int8" else dtype),
+        "v": jnp.zeros(shape, jnp.int8 if kv_dtype == "int8" else dtype),
         "pos": jnp.zeros((n_slots,), jnp.int32),
         "table": jnp.zeros((n_slots, mb), jnp.int32),
     }
+    if kv_dtype == "int8":
+        cache["k_scale"] = jnp.zeros(shape[:4], dtype)
+        cache["v_scale"] = jnp.zeros(shape[:4], dtype)
+    return cache
+
+
+def paged_kv_slot_bytes(
+    cfg: Config, block_size: int, *, kv_dtype: str | None = None, dtype="float32"
+) -> int:
+    """HBM bytes one max_seq slot costs in the paged pool — the geometry
+    behind ``kv_slots_per_chip`` accounting.  ``dtype`` is the pool's
+    float dtype (scales use it too); int8 pools bill 1 byte per element
+    plus one scale per (position, kv-head)."""
+    import numpy as _np
+
+    itemsize = 2 if str(dtype) in ("bfloat16", "bf16") else _np.dtype(dtype).itemsize
+    if kv_dtype == "int8":
+        per_head = cfg.head_dim * 1 + itemsize  # int8 rows + one scale
+    else:
+        per_head = cfg.head_dim * itemsize
+    per_token = 2 * cfg.n_kv_heads * per_head * cfg.n_layers  # K and V
+    return cfg.max_seq * per_token
+
+
+def _quant_kv(x, scale_dtype):
+    """``x (..., head_dim)`` float -> ``(int8 (..., head_dim), scale (...))``.
+    Symmetric per-(position, head) absmax scaling: the max-magnitude
+    element maps to exactly ±127, so quantization is deterministic and a
+    stored block re-exports bit-identically."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = amax / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(xf / safe[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale.astype(scale_dtype)
+
+
+def _dequant_kv(q, scale, dtype):
+    return (q.astype(jnp.float32) * scale.astype(jnp.float32)[..., None]).astype(
+        dtype
+    )
+
+
+def _fake_quant_hook(scale_dtype):
+    """kv_hook for :func:`_layer` under an int8 pool: attention sees the
+    dequantized values, the scan collects ``(qk, sk, qv, sv)`` to store."""
+
+    def hook(k, v):
+        qk, sk = _quant_kv(k, scale_dtype)
+        qv, sv = _quant_kv(v, scale_dtype)
+        return (
+            _dequant_kv(qk, sk, k.dtype),
+            _dequant_kv(qv, sv, v.dtype),
+            (qk, sk, qv, sv),
+        )
+
+    return hook
 
 
 def prefill_slot_paged(
@@ -405,19 +485,39 @@ def prefill_slot_paged(
     the static-slot variant."""
     bs = cache["k"].shape[2]
     lp = tokens.shape[1]
-    x, ks, vs = _prefill_core(params, tokens, cfg, _select_attn(mesh, seq_impl))
+    quant = "k_scale" in cache
+    hook = _fake_quant_hook(cache["k_scale"].dtype) if quant else None
+    x, stored = _prefill_core(
+        params, tokens, cfg, _select_attn(mesh, seq_impl), kv_hook=hook
+    )
     # (layers, 1, Lp, kv, hd) -> (layers, Lb, bs, kv, hd) scattered to the
     # slot's first Lb physical blocks
     lb = lp // bs
-    ksb = ks[:, 0].reshape(cfg.n_layers, lb, bs, cfg.n_kv_heads, cfg.head_dim)
-    vsb = vs[:, 0].reshape(cfg.n_layers, lb, bs, cfg.n_kv_heads, cfg.head_dim)
+    kvh, hd = cfg.n_kv_heads, cfg.head_dim
     phys = blocks_row[:lb]
-    cache = {
-        "k": cache["k"].at[:, phys].set(ksb.astype(cache["k"].dtype)),
-        "v": cache["v"].at[:, phys].set(vsb.astype(cache["v"].dtype)),
-        "pos": cache["pos"].at[slot].set(length),
-        "table": cache["table"].at[slot].set(blocks_row),
-    }
+    cache = dict(cache)
+    if quant:
+        qk, sk, qv, sv = stored
+        cache["k"] = cache["k"].at[:, phys].set(
+            qk[:, 0].reshape(cfg.n_layers, lb, bs, kvh, hd)
+        )
+        cache["v"] = cache["v"].at[:, phys].set(
+            qv[:, 0].reshape(cfg.n_layers, lb, bs, kvh, hd)
+        )
+        cache["k_scale"] = cache["k_scale"].at[:, phys].set(
+            sk[:, 0].reshape(cfg.n_layers, lb, bs, kvh)
+        )
+        cache["v_scale"] = cache["v_scale"].at[:, phys].set(
+            sv[:, 0].reshape(cfg.n_layers, lb, bs, kvh)
+        )
+    else:
+        ks, vs = stored
+        ksb = ks[:, 0].reshape(cfg.n_layers, lb, bs, kvh, hd)
+        vsb = vs[:, 0].reshape(cfg.n_layers, lb, bs, kvh, hd)
+        cache["k"] = cache["k"].at[:, phys].set(ksb.astype(cache["k"].dtype))
+        cache["v"] = cache["v"].at[:, phys].set(vsb.astype(cache["v"].dtype))
+    cache["pos"] = cache["pos"].at[slot].set(length)
+    cache["table"] = cache["table"].at[slot].set(blocks_row)
     h = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
     h = _rmsnorm(h, params["ln_f"], cfg.norm_eps)
     return h @ params["head"], cache
@@ -462,6 +562,7 @@ def prefill_suffix_paged(
     pb = max(1, pw // bs)
     lb = ls // bs
     kvh, hd = cfg.n_kv_heads, cfg.head_dim
+    quant = "k_scale" in cache
     x = params["tok_emb"][tokens]  # (1, Ls, E)
     positions = prefix_len + jnp.arange(ls)[None, :]  # (1, Ls) global positions
     read_idx = blocks_row[:pb]  # (pb,) physical prefix blocks
@@ -472,9 +573,10 @@ def prefill_suffix_paged(
         [jnp.broadcast_to(prefix_valid, (ls, pb * bs)), causal], axis=1
     )  # (Ls, P + Ls)
     scale = 1.0 / math.sqrt(cfg.head_dim)
+    hook = _fake_quant_hook(cache["k_scale"].dtype) if quant else None
 
     def body(carry, inputs):
-        x, ck, cv = carry
+        x, ck, cv, cks, cvs = carry
         li, lp = inputs
         h = _rmsnorm(x, lp["ln_att"], cfg.norm_eps)
         q = jnp.einsum("ble,ehd->blhd", h, lp["wq"])
@@ -482,10 +584,22 @@ def prefill_suffix_paged(
         v = jnp.einsum("ble,ehd->blhd", h, lp["wv"])
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
+        if quant:
+            # attend the dequantized suffix K/V (fake-quant: exactly what
+            # the pool will hold) and collect the quantized form to store
+            k, v, (qk, sk, qv, sv) = hook(k, v)
         ckl = jax.lax.dynamic_index_in_dim(ck, li, 0, keepdims=False)
         cvl = jax.lax.dynamic_index_in_dim(cv, li, 0, keepdims=False)
-        kp = ckl[read_idx].reshape(1, pb * bs, kvh, hd).astype(k.dtype)
-        vp = cvl[read_idx].reshape(1, pb * bs, kvh, hd).astype(v.dtype)
+        if quant:
+            sk_l = jax.lax.dynamic_index_in_dim(cks, li, 0, keepdims=False)
+            sv_l = jax.lax.dynamic_index_in_dim(cvs, li, 0, keepdims=False)
+            kp = _dequant_kv(ckl[read_idx], sk_l[read_idx], k.dtype)
+            vp = _dequant_kv(cvl[read_idx], sv_l[read_idx], v.dtype)
+            kp = kp.reshape(1, pb * bs, kvh, hd)
+            vp = vp.reshape(1, pb * bs, kvh, hd)
+        else:
+            kp = ckl[read_idx].reshape(1, pb * bs, kvh, hd).astype(k.dtype)
+            vp = cvl[read_idx].reshape(1, pb * bs, kvh, hd).astype(v.dtype)
         k_all = jnp.concatenate([kp, k], axis=1)  # (1, P+Ls, kv, hd)
         v_all = jnp.concatenate([vp, v], axis=1)
         kf = _gqa_repeat(k_all, cfg.n_heads)
@@ -497,23 +611,40 @@ def prefill_suffix_paged(
         x = x + jnp.einsum("blhd,hde->ble", o, lp["wo"])
         h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
         mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
-        ksb = k[0].reshape(lb, bs, kvh, hd)
-        vsb = v[0].reshape(lb, bs, kvh, hd)
-        ck = ck.at[li, suffix_blocks].set(ksb.astype(ck.dtype))
-        cv = cv.at[li, suffix_blocks].set(vsb.astype(cv.dtype))
-        return (x + mlp, ck, cv), None
+        if quant:
+            ck = ck.at[li, suffix_blocks].set(qk[0].reshape(lb, bs, kvh, hd))
+            cv = cv.at[li, suffix_blocks].set(qv[0].reshape(lb, bs, kvh, hd))
+            cks = cks.at[li, suffix_blocks].set(sk[0].reshape(lb, bs, kvh))
+            cvs = cvs.at[li, suffix_blocks].set(sv[0].reshape(lb, bs, kvh))
+        else:
+            ksb = k[0].reshape(lb, bs, kvh, hd)
+            vsb = v[0].reshape(lb, bs, kvh, hd)
+            ck = ck.at[li, suffix_blocks].set(ksb.astype(ck.dtype))
+            cv = cv.at[li, suffix_blocks].set(vsb.astype(cv.dtype))
+        return (x + mlp, ck, cv, cks, cvs), None
 
-    (x, new_k, new_v), _ = jax.lax.scan(
+    zero = jnp.zeros((), jnp.int8)  # scan carries need SOME leaf when not quant
+    (x, new_k, new_v, new_ks, new_vs), _ = jax.lax.scan(
         body,
-        (x, cache["k"], cache["v"]),
+        (
+            x,
+            cache["k"],
+            cache["v"],
+            cache["k_scale"] if quant else zero,
+            cache["v_scale"] if quant else zero,
+        ),
         (jnp.arange(cfg.n_layers), params["layers"]),
     )
-    cache = {
-        "k": new_k,
-        "v": new_v,
-        "pos": cache["pos"].at[slot].set(length),
-        "table": cache["table"].at[slot].set(blocks_row),
-    }
+    cache = dict(cache)
+    cache.update(
+        k=new_k,
+        v=new_v,
+        pos=cache["pos"].at[slot].set(length),
+        table=cache["table"].at[slot].set(blocks_row),
+    )
+    if quant:
+        cache["k_scale"] = new_ks
+        cache["v_scale"] = new_vs
     h = jax.lax.dynamic_index_in_dim(
         x[0], length - prefix_len - 1, axis=0, keepdims=False
     )
@@ -536,34 +667,88 @@ def decode_slots_paged(
     first ``window // block_size`` table entries per slot (same byte volume
     as the static window read — the pool layout changes where rows LIVE,
     not how many are read)."""
+    logits, cache = _decode_paged_multi(
+        params, tokens[:, None], cache, active, active[:, None], cfg,
+        window=window,
+    )
+    cache["pos"] = jnp.where(active, cache["pos"] + 1, cache["pos"])
+    return logits[:, 0], cache
+
+
+def decode_slots_spec_paged(
+    params: dict,
+    qtokens: jax.Array,
+    cache: dict,
+    active: jax.Array,
+    qvalid: jax.Array,
+    cfg: Config,
+    *,
+    window: int | None = None,
+) -> tuple[jax.Array, dict]:
+    """Speculative verify pass: score ``L = 1 + draft`` query positions per
+    slot in ONE model call (docs/PERFORMANCE.md).
+
+    ``qtokens (S, L)`` is the current token followed by the drafted ones;
+    query ``j`` runs at position ``pos + j`` and its K/V is written there
+    (exactly the bytes the sequential path would write if the draft is
+    accepted).  ``qvalid (S, L)`` gates the cache writes — draft positions
+    beyond the slot's remaining-token budget (whose blocks may not be
+    reserved) are routed to the sink block.  ``cache["pos"]`` is NOT
+    advanced: the caller moves it by however many tokens were accepted —
+    rejected positions stay above ``pos``, invisible to every later read
+    and overwritten by the next pass before they can be accepted.
+
+    Returns ``(logits (S, L, V), cache)``.
+    """
+    return _decode_paged_multi(
+        params, qtokens, cache, active, qvalid, cfg, window=window
+    )
+
+
+def _decode_paged_multi(
+    params, qtokens, cache, active, qvalid, cfg: Config, *, window
+):
+    """Shared L-query decode body: ``L=1`` is the classic decode step,
+    ``L>1`` the fused speculative verify.  The per-row contraction shapes
+    are identical in both, so a verify pass's first position is bit-equal
+    to the single-token step it replaces."""
     pos = cache["pos"]  # (S,)
     table = cache["table"]  # (S, MB)
-    S = tokens.shape[0]
+    S, L = qtokens.shape
     bs = cache["k"].shape[2]
+    mb = table.shape[1]
+    quant = "k_scale" in cache
     W = cfg.max_seq if window is None else min(window, cfg.max_seq)
     wb = max(1, W // bs)
     W = wb * bs
     read_idx = table[:, :wb]  # (S, wb) physical blocks attention reads
-    x = params["tok_emb"][tokens][:, None]  # (S, 1, E)
-    positions = pos[:, None]
+    x = params["tok_emb"][qtokens]  # (S, L, E)
+    offs = jnp.arange(L)[None, :]
+    positions = pos[:, None] + offs  # (S, L)
     scale = 1.0 / math.sqrt(cfg.head_dim)
-    valid = jnp.arange(W)[None, :] <= pos[:, None]  # (S, W)
-    slot_idx = jnp.arange(S)
-    # This step's write target: physical block + in-block offset per slot.
-    # INACTIVE slots still flow through the scatter (fixed shapes), but
-    # their table rows may reference blocks already reclaimed and handed to
-    # another request — their writes are routed to physical block 0, which
-    # the allocator reserves as a garbage sink and never hands out.
+    # row r visible to query j iff r <= pos + j (draft positions see the
+    # draft K/V written before them — causal speculation)
+    valid = jnp.arange(W)[None, None, :] <= positions[:, :, None]  # (S, L, W)
+    # Per-query write target: physical block + in-block offset.  INACTIVE
+    # slots still flow through the scatter (fixed shapes), but their table
+    # rows may reference blocks already reclaimed and handed to another
+    # request — their writes are routed to physical block 0, which the
+    # allocator reserves as a garbage sink and never hands out; the same
+    # routing guards draft positions past the slot's block reservation.
     write_blk = jnp.where(
-        active,
-        jnp.take_along_axis(table, (pos // bs)[:, None], axis=1)[:, 0],
+        qvalid,
+        jnp.take_along_axis(
+            table, jnp.minimum(positions // bs, mb - 1), axis=1
+        ),
         0,
-    )
-    write_off = pos % bs
+    )  # (S, L)
+    write_off = positions % bs
     kv, hd = cfg.n_kv_heads, cfg.head_dim
+    sdt = cache["k_scale"].dtype if quant else None
+    zero = jnp.zeros((), jnp.int8)
 
     def body(carry, inputs):
-        x, ck, cv = carry
+        x, ck, cv, cks, cvs = carry
         li, lp = inputs
         h = _rmsnorm(x, lp["ln_att"], cfg.norm_eps)
         q = jnp.einsum("ble,ehd->blhd", h, lp["wq"])
@@ -571,38 +756,65 @@ def decode_slots_paged(
         v = jnp.einsum("ble,ehd->blhd", h, lp["wv"])
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        ck = ck.at[li, write_blk, write_off].set(k[:, 0].astype(ck.dtype))
-        cv = cv.at[li, write_blk, write_off].set(v[:, 0].astype(cv.dtype))
+        if quant:
+            qk, sk = _quant_kv(k, sdt)
+            qv, sv = _quant_kv(v, sdt)
+            ck = ck.at[li, write_blk, write_off].set(qk)
+            cv = cv.at[li, write_blk, write_off].set(qv)
+            cks = cks.at[li, write_blk, write_off].set(sk)
+            cvs = cvs.at[li, write_blk, write_off].set(sv)
+        else:
+            ck = ck.at[li, write_blk, write_off].set(k.astype(ck.dtype))
+            cv = cv.at[li, write_blk, write_off].set(v.astype(cv.dtype))
         ckl = jax.lax.dynamic_index_in_dim(ck, li, 0, keepdims=False)
         cvl = jax.lax.dynamic_index_in_dim(cv, li, 0, keepdims=False)
         # gather each slot's visible blocks: (S, wb, bs, kv, hd) -> (S, W, ..)
-        kw = ckl[read_idx].reshape(S, W, kv, hd)
-        vw = cvl[read_idx].reshape(S, W, kv, hd)
+        if quant:
+            sk_l = jax.lax.dynamic_index_in_dim(cks, li, 0, keepdims=False)
+            sv_l = jax.lax.dynamic_index_in_dim(cvs, li, 0, keepdims=False)
+            kw = _dequant_kv(ckl[read_idx], sk_l[read_idx], q.dtype)
+            vw = _dequant_kv(cvl[read_idx], sv_l[read_idx], q.dtype)
+            kw = kw.reshape(S, W, kv, hd)
+            vw = vw.reshape(S, W, kv, hd)
+        else:
+            kw = ckl[read_idx].reshape(S, W, kv, hd)
+            vw = cvl[read_idx].reshape(S, W, kv, hd)
+        # grouped-query attention against the *un-repeated* cache: repeating
+        # kv to n_heads here would multiply cache reads by the group size
+        # every decode step, defeating GQA's bandwidth savings
         groups = cfg.n_heads // cfg.n_kv_heads
-        qg = q.reshape(S, 1, cfg.n_kv_heads, groups, cfg.head_dim)
+        qg = q.reshape(S, L, cfg.n_kv_heads, groups, cfg.head_dim)
         s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kw) * scale
-        s = jnp.where(valid[:, None, None, None, :], s, jnp.finfo(s.dtype).min)
+        s = jnp.where(
+            valid[:, None, None, :, :], s, jnp.finfo(s.dtype).min
+        )
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bkgqs,bskd->bqkgd", p, vw)
-        o = o.reshape(S, 1, cfg.n_heads, cfg.head_dim)
+        o = o.reshape(S, L, cfg.n_heads, cfg.head_dim)
         x = x + jnp.einsum("blhd,hde->ble", o, lp["wo"])
         h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
         mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
-        return (x + mlp, ck, cv), None
+        return (x + mlp, ck, cv, cks, cvs), None
 
-    (x, new_k, new_v), _ = jax.lax.scan(
+    (x, new_k, new_v, new_ks, new_vs), _ = jax.lax.scan(
         body,
-        (x, cache["k"], cache["v"]),
+        (
+            x,
+            cache["k"],
+            cache["v"],
+            cache["k_scale"] if quant else zero,
+            cache["v_scale"] if quant else zero,
+        ),
         (jnp.arange(cfg.n_layers), params["layers"]),
     )
-    cache = {
-        "k": new_k,
-        "v": new_v,
-        "pos": jnp.where(active, pos + 1, pos),
-        "table": table,
-    }
-    x = _rmsnorm(x[:, 0], params["ln_f"], cfg.norm_eps)
-    return x @ params["head"], cache
+    out = dict(cache)
+    out["k"] = new_k
+    out["v"] = new_v
+    if quant:
+        out["k_scale"] = new_ks
+        out["v_scale"] = new_vs
+    x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
+    return x @ params["head"], out
 
 
 def decode_slots(

@@ -219,12 +219,20 @@ def build_generative_component(
     kv_prefix_reuse: bool | None = None,
     top_k: int = 0,
     overlap: bool | None = None,
+    spec_draft: int | None = None,
+    spec_ngram: int | None = None,
+    spec_hist: int = 64,
+    kv_cache_dtype: str | None = None,
     **overrides,
 ):
     """Build a continuous-batching generative graph unit (JAX_GENERATIVE).
 
     ``kv_block_size`` / ``kv_blocks`` size the paged KV pool (defaults:
-    16-token blocks, pool big enough for every slot at full max_seq)."""
+    16-token blocks, pool big enough for every slot at full max_seq).
+    ``spec_draft``/``spec_ngram``/``spec_hist`` turn on fused
+    self-speculative decoding; ``kv_cache_dtype="int8"`` stores the paged
+    pool quantized with per-(position, head) scales
+    (docs/PERFORMANCE.md)."""
     from seldon_core_tpu.executor.generation import (
         GenerativeComponent,
         GenerativeModel,
@@ -264,6 +272,10 @@ def build_generative_component(
         kv_blocks=kv_blocks,
         prefix_reuse=kv_prefix_reuse,
         top_k=top_k,
+        spec_draft=spec_draft,
+        spec_ngram=spec_ngram,
+        spec_hist=spec_hist,
+        kv_cache_dtype=kv_cache_dtype,
     )
     return GenerativeComponent(
         model,

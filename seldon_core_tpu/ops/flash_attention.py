@@ -136,12 +136,28 @@ def flash_attention(
     )(q, k, v)
 
 
+def _fit_block(s: int, preferred: int = 128) -> int:
+    """Largest divisor of ``s`` that is <= ``preferred`` — lengths that are
+    not a multiple of the preferred tile still run (a 192-token bucket
+    tiles at 96, a prime length degrades to 1 in interpret mode) instead
+    of rejecting the shape the model zoo handed us."""
+    b = min(preferred, s)
+    while s % b:
+        b -= 1
+    return b
+
+
 def flash_causal_attention_blhd(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     """Adapter for the model zoo's ``(B, L, H, D)`` attention contract
-    (``models/llama.py::_layer``): transpose, run the kernel, transpose back.
-    Falls back to nothing here — callers choose flash via ``seq_impl``."""
+    (``models/llama.py::_layer``): transpose, pick tile sizes that divide
+    the actual sequence lengths, run the kernel, transpose back.  Callers
+    choose flash via ``seq_impl``."""
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = flash_attention(qt, kt, vt, causal=True)
+    out = flash_attention(
+        qt, kt, vt, causal=True,
+        block_q=_fit_block(qt.shape[2]),
+        block_k=_fit_block(kt.shape[2]),
+    )
     return out.transpose(0, 2, 1, 3)

@@ -264,6 +264,36 @@ class MetricsRegistry:
             ["model_name"],
             registry=self.registry,
         )
+        # Speculative decoding (docs/PERFORMANCE.md): the acceptance ledger
+        # behind accepted_tokens_per_step — emitted tokens over (slot,
+        # verify-pass) pairs; > 1.0 means the n-gram drafts pay for
+        # themselves on the live traffic mix.
+        self.spec_emitted = Counter(
+            "seldon_spec_emitted_tokens",
+            "Tokens emitted by fused speculative verify passes",
+            ["model_name"],
+            registry=self.registry,
+        )
+        self.spec_verify_passes = Counter(
+            "seldon_spec_verify_passes",
+            "Per-slot speculative verify passes (active slot x fused step)",
+            ["model_name"],
+            registry=self.registry,
+        )
+        self.spec_accepted_per_step = Gauge(
+            "seldon_spec_accepted_tokens_per_step",
+            "Cumulative tokens emitted per verify pass (speculative decode "
+            "acceptance; 1.0 = no draft ever accepted)",
+            ["model_name"],
+            registry=self.registry,
+        )
+        self.kv_slots_per_chip = Gauge(
+            "seldon_kv_slots_per_chip",
+            "Max-seq sequences the paged-KV layout fits per chip after "
+            "weights (int8 KV quantization ~doubles this)",
+            ["model_name"],
+            registry=self.registry,
+        )
         self.obs_spans = Gauge(
             "seldon_obs_spans",
             "Span recorder counters (state: recorded / ring / sampled_out)",

@@ -55,6 +55,62 @@ class TestFlashAttention:
             flash_attention(q, q, q, block_q=64, block_k=64)
 
 
+class TestFlashBlhdAdapter:
+    """Direct unit coverage for ``flash_causal_attention_blhd`` — the
+    model-zoo entry (``seq_impl=flash``) — against the dense reference
+    (``models/llama.py::_dense_causal_attention``), across sequence
+    lengths that are NOT multiples of the preferred 128 tile and across
+    GQA head counts (the adapter receives kv already repeated to full
+    heads, exactly as ``_layer`` calls it)."""
+
+    def _ref(self, q, k, v):
+        from seldon_core_tpu.models.llama import _dense_causal_attention
+
+        return _dense_causal_attention(q, k, v)
+
+    @pytest.mark.parametrize("seq", [48, 96, 120, 192])
+    def test_matches_dense_at_non_multiple_of_block_lengths(self, seq):
+        from seldon_core_tpu.ops import flash_causal_attention_blhd
+
+        B, H, D = 2, 4, 32
+        rng = np.random.default_rng(seq)
+        q = jnp.asarray(rng.normal(size=(B, seq, H, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, seq, H, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, seq, H, D)), jnp.float32)
+        out = flash_causal_attention_blhd(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._ref(q, k, v)),
+            rtol=2e-5, atol=2e-5,
+        )
+
+    @pytest.mark.parametrize("n_heads,n_kv", [(8, 2), (4, 1), (6, 3)])
+    def test_matches_dense_across_gqa_head_counts(self, n_heads, n_kv):
+        from seldon_core_tpu.models.llama import _gqa_repeat
+        from seldon_core_tpu.ops import flash_causal_attention_blhd
+
+        B, S, D = 1, 80, 16
+        rng = np.random.default_rng(n_heads * 10 + n_kv)
+        q = jnp.asarray(rng.normal(size=(B, S, n_heads, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, S, n_kv, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, S, n_kv, D)), jnp.float32)
+        kf, vf = _gqa_repeat(k, n_heads), _gqa_repeat(v, n_heads)
+        out = flash_causal_attention_blhd(q, kf, vf)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._ref(q, kf, vf)),
+            rtol=2e-5, atol=2e-5,
+        )
+
+    def test_fit_block_picks_largest_divisor(self):
+        from seldon_core_tpu.ops.flash_attention import _fit_block
+
+        assert _fit_block(128) == 128
+        assert _fit_block(192) == 96
+        assert _fit_block(48) == 48
+        assert _fit_block(120) == 120
+        assert _fit_block(97) == 97  # <= preferred: one tile, never rejects
+        assert _fit_block(131) == 1  # prime past the tile: degrades
+
+
 class TestFlashInLlama:
     def test_forward_seq_impl_flash_matches_dense(self):
         from seldon_core_tpu.models import llama
