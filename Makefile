@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -74,6 +74,20 @@ spec-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_spec.py -q
 	JAX_PLATFORMS=cpu BENCH_ONLY=SPEC BENCH_RUNS=1 BENCH_SPEC_TOKENS=16 \
 		$(PYTHON) bench.py
+
+# chunked-prefill + paged decode-kernel gate (docs/PERFORMANCE.md §7),
+# CPU-safe: pinned-equal chunked-vs-monolithic matrix (greedy + seeded
+# top-k, prefix reuse, int8, tp=2 mesh, disagg handoff of a chunk-prefilled
+# slot), host-sync audit stays <= 1/block with chunking on, Pallas paged
+# decode-attention kernel vs dense reference in interpret mode, and the
+# program cache-key audit; then a CPU smoke of the chunked bench stage
+# (decode ITL p99 under a batch-prefill flood, chunked on vs off)
+chunk-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chunked.py -q
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_ops.py -q \
+		-k PagedDecodeAttention
+	JAX_PLATFORMS=cpu BENCH_ONLY=CHUNKED BENCH_RUNS=1 \
+		BENCH_CHUNK_TOKENS=96 $(PYTHON) bench.py
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

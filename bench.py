@@ -29,6 +29,9 @@ Stages (each skippable via env; ``BENCH_ONLY=name`` runs one stage):
                                          speculative-decode acceptance on
                                          repetitive text + int8 KV capacity
                                          and greedy-divergence drift
+  chunked              BENCH_SKIP_CHUNKED decode ITL p99 under a batch-
+                                         prefill flood, chunked prefill
+                                         on vs off + decode-kernel timing
 
 Credibility discipline (round-5 postmortem — the headline swung 4.5x with
 this file byte-identical and nothing could attribute it):
@@ -576,6 +579,16 @@ def stage_llm_1b(detail: dict) -> None:
     dev = _roofline(["--family", "llama", "--preset", "llama3-1b",
                      "--generative", "--n-slots", str(slots),
                      "--decode-block", "16"])
+    # same decode loop through the fused Pallas paged-attention step: the
+    # two runs' hbm_frac is the kernel-on-vs-off roofline fraction
+    # (ISSUE 8; skippable — interpret mode off-TPU is measurement noise)
+    dev_k = (
+        {"skipped": "BENCH_LLM1B_KERNEL=0"}
+        if os.environ.get("BENCH_LLM1B_KERNEL") == "0"
+        else _roofline(["--family", "llama", "--preset", "llama3-1b",
+                        "--generative", "--n-slots", str(slots),
+                        "--decode-block", "16", "--decode-kernel"])
+    )
     graph = {
         "name": "gen1b", "type": "MODEL", "implementation": "JAX_GENERATIVE",
         "parameters": [
@@ -625,9 +638,11 @@ def stage_llm_1b(detail: dict) -> None:
         "device_frac_of_hbm_roofline": (
             _sig(dev_tok / hbm_tok) if dev_tok and hbm_tok else None
         ),
+        "device_frac_of_hbm_roofline_kernel_on": dev_k.get("hbm_frac"),
         "wire_frac_of_device": _sig(tok_s / dev_tok) if dev_tok else None,
         "mfu": _wire_mfu(tok_s, dev, key="flops_per_token", digits=6),
         "device": dev,
+        "device_kernel": dev_k,
         "stream": stream,
         "model": "llama 1.1B bf16 (llama3-1b shape), overlapped decode "
                  f"pipeline, {max_new} new tokens per request",
@@ -748,6 +763,160 @@ def stage_spec_frontier(detail: dict) -> None:
         "model": "llama tiny pinned prompts; slots ratio from llama3-1b "
                  "bf16 pool geometry",
     }
+
+
+def stage_chunked(detail: dict) -> None:
+    """Chunked prefill (ROADMAP 3b, docs/PERFORMANCE.md §7): decode ITL
+    p99 for interactive streams under a concurrent batch-prefill flood,
+    chunked ON vs OFF — the Sarathi stall-free-admission property as a
+    number.  Client-visible ITL: per-token arrival gaps at the streaming
+    hook, so an admission's monolithic prefill stalling the pipeline lands
+    in the stream's own gap distribution.  In-process device measurement
+    with the PR 3 median-of-N discipline; plus a kernel-on/off fused
+    decode-step timing on the same tiny config (the llm_1b stage records
+    the real-scale kernel roofline fraction)."""
+    import asyncio
+
+    import jax
+
+    from seldon_core_tpu.executor.generation import (
+        GenerationScheduler,
+        GenerativeModel,
+    )
+    from seldon_core_tpu.models import llama as llama_mod
+
+    # a config where prefill COMPUTE dominates dispatch overhead (the
+    # real-scale regime): on llama-tiny a 192-token prefill costs ~3 ms —
+    # less than the per-chunk dispatch it would be split into, so the
+    # measurement would show overhead, not the stall it removes.  Here a
+    # 448-token monolithic prefill is ~50 ms against ~7 ms per 64-token
+    # chunk and ~6 ms per decode block.
+    cfg = llama_mod.Config(
+        vocab_size=256, hidden=128, n_layers=4, n_heads=8, n_kv_heads=4,
+        ffn=512, max_seq=512, rope_theta=10000.0,
+    )
+    params = llama_mod.init_params(jax.random.PRNGKey(0), cfg)
+    chunk = int(os.environ.get("BENCH_CHUNK", "64"))
+    # stream length tuning: long enough that the flood's prefills land
+    # mid-stream (no stall to measure otherwise), short enough that the
+    # stalled blocks aren't diluted by a long clean tail at p99
+    max_new = int(os.environ.get("BENCH_CHUNK_TOKENS", "96"))
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
+    flood_len = 448
+    n_floods = 3
+    flood_prompt = np.tile(np.arange(7, 103), 8)[:flood_len].astype(np.int32)
+
+    def build(chunked):
+        return GenerativeModel(
+            cfg, params, n_slots=4, decode_block=8,
+            prefill_chunk=chunk if chunked else 0,
+            name=f"bench-chunk-{'on' if chunked else 'off'}",
+        )
+
+    async def one_round(model):
+        """2 interactive streams in steady-state decode + a flood of
+        long-prompt admissions; returns the streams' token arrival gaps."""
+        sched = GenerationScheduler(model)
+        gaps: list[float] = []
+        last = [0.0, 0.0]
+
+        def hook(i):
+            def cb(_tok):
+                now = time.perf_counter()
+                if last[i]:
+                    gaps.append(now - last[i])
+                last[i] = now
+            return cb
+
+        interactive = [
+            asyncio.create_task(
+                sched.submit(
+                    np.asarray([5 + i, 9, 2], np.int32),
+                    max_new_tokens=max_new, on_token=hook(i),
+                )
+            )
+            for i in range(2)
+        ]
+        await asyncio.sleep(0.05)  # let the streams reach steady decode
+        floods = [
+            asyncio.create_task(
+                sched.submit(flood_prompt, max_new_tokens=2)
+            )
+            for _ in range(n_floods)
+        ]
+        await asyncio.gather(*interactive)
+        await asyncio.gather(*floods)
+        await sched.close()
+        return gaps
+
+    result = {}
+    for chunked in (False, True):
+        model = build(chunked)
+        asyncio.run(one_round(model))  # warmup: compiles off the clock
+        model._itl.clear()  # drop the warmup round's compile-stall samples
+        p99s, p50s = [], []
+        for _ in range(runs):
+            gaps = np.asarray(asyncio.run(one_round(model)))
+            p99s.append(float(np.percentile(gaps, 99)) * 1e3)
+            p50s.append(float(np.percentile(gaps, 50)) * 1e3)
+        key = "chunked" if chunked else "monolithic"
+        result[f"itl_p99_ms_{key}"] = _sig(sorted(p99s)[runs // 2])
+        result[f"itl_p50_ms_{key}"] = _sig(sorted(p50s)[runs // 2])
+        result[f"itl_p99_ms_{key}_runs"] = [_sig(x) for x in p99s]
+        snap = model.spec_snapshot()
+        result[f"server_itl_p99_ms_{key}"] = snap["itl_p99_ms"]
+        if chunked:
+            result["prefill_chunks"] = snap["prefill_chunks"]
+
+    result["itl_p99_chunked_vs_monolithic"] = _sig(
+        result["itl_p99_ms_chunked"] / result["itl_p99_ms_monolithic"]
+    )
+    result["chunked_improves_p99"] = (
+        result["itl_p99_ms_chunked"] < result["itl_p99_ms_monolithic"]
+    )
+
+    # kernel on/off fused-step timing on the same tiny config (interpret
+    # mode off-TPU: the honest CPU number; real-scale fraction in llm_1b)
+    from seldon_core_tpu.utils.roofline import measure_step_time
+
+    for kern in (False, True):
+        m = GenerativeModel(
+            cfg, params, n_slots=4, decode_block=8, decode_kernel=kern,
+            name=f"bench-kern-{int(kern)}",
+        )
+        last_toks = [int(m.admit(s, flood_prompt[:8], 0.0, s))
+                     for s in range(4)]
+        payload = {
+            "tokens": np.asarray(last_toks, np.int32),
+            "active": np.ones(4, bool),
+            "temperature": np.zeros(4, np.float32),
+            "seed": 0,
+            "eos": np.full(4, -1, np.int32),
+            "remaining": np.full(4, 1 << 30, np.int32),
+            "k": 8,
+            "window": 64,
+        }
+        sec = measure_step_time(
+            lambda _x: m._exec_decode_k(payload)[0], np.zeros(1), iters=4
+        )
+        result[f"tok_s_kernel_{'on' if kern else 'off'}"] = (
+            _sig(4 * 8 / sec) if np.isfinite(sec) and sec > 0 else None
+        )
+    if jax.default_backend() != "tpu":
+        # interpret-mode Pallas is an emulator: the on/off pair above is a
+        # smoke, not a comparison — the real one is llm_1b's roofline pair
+        result["kernel_timing_note"] = "off-TPU: kernel ran in interpret mode"
+
+    result.update(
+        runs=runs,
+        prefill_chunk=chunk,
+        flood_prompt_tokens=flood_len,
+        flood_requests=n_floods,
+        model="llama 128h/4L, 2 interactive streams x "
+              f"{max_new} tokens under a {n_floods}x{flood_len}-token "
+              "batch-prefill flood; gaps are client-visible token arrivals",
+    )
+    detail["llm_chunked"] = result
 
 
 def stage_resnet(detail: dict) -> None:
@@ -1380,6 +1549,7 @@ def main() -> None:
         ("LLM", "BENCH_SKIP_LLM", stage_llm),
         ("LLM1B", "BENCH_SKIP_LLM1B", stage_llm_1b),
         ("SPEC", "BENCH_SKIP_SPEC", stage_spec_frontier),
+        ("CHUNKED", "BENCH_SKIP_CHUNKED", stage_chunked),
         ("RESNET", "BENCH_SKIP_RESNET", stage_resnet),
         ("LOOPBACK", "BENCH_SKIP_LOOPBACK", stage_loopback),
         ("AB", "BENCH_SKIP_AB", stage_ab),
@@ -1451,6 +1621,11 @@ _STAGE_HEADLINES = (
     ("llm_spec", "tok_s_spec_off_p50", "spec_tok_s_off"),
     ("llm_int8_kv", "kv_slots_ratio", "int8_kv_slots_ratio"),
     ("llm_int8_kv", "greedy_divergence_step_min", "int8_divergence_step"),
+    ("llm_chunked", "itl_p99_ms_chunked", "chunk_itl_p99_ms_on"),
+    ("llm_chunked", "itl_p99_ms_monolithic", "chunk_itl_p99_ms_off"),
+    ("llm_chunked", "itl_p99_chunked_vs_monolithic", "chunk_itl_p99_ratio"),
+    ("llm_1b_wire", "device_frac_of_hbm_roofline_kernel_on",
+     "llm1b_kernel_hbm_frac"),
     ("ab_graph", "p99_over_p95", "ab_p99_over_p95"),
     ("gateway_rest", "p50_ms", "gateway_rest_p50_ms"),
     ("gateway_rest", "vs_direct", "gateway_rest_vs_direct"),

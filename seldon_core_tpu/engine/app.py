@@ -577,8 +577,10 @@ class EngineApp:
     async def stats_breakdown(self, request: web.Request) -> web.Response:
         """Aggregated per-stage p50/p90/p99 (the flight recorder), plus the
         device-frontier ledger per generative unit: speculative-decode
-        acceptance (``accepted_tokens_per_step``) and paged-KV capacity
-        (``kv_slots_per_chip``, layout dtype)."""
+        acceptance (``accepted_tokens_per_step``), paged-KV capacity
+        (``kv_slots_per_chip``, layout dtype), and per-slot inter-token
+        latency (``itl_p50_ms``/``itl_p99_ms`` — prefill-induced decode
+        stalls land here; docs/PERFORMANCE.md §7)."""
         payload: dict = {"stages": RECORDER.breakdown()}
         try:
             units = self.service.generative_units()

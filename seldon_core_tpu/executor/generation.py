@@ -116,6 +116,8 @@ class GenerativeModel:
         spec_ngram: int | None = None,
         spec_hist: int = 64,
         kv_cache_dtype: str | None = None,
+        prefill_chunk: int | None = None,
+        decode_kernel: bool | None = None,
     ):
         if family_mod is None:
             from seldon_core_tpu.models import llama as family_mod
@@ -203,6 +205,61 @@ class GenerativeModel:
                 f"kv_cache_dtype must be 'int8' or unset, got {kv_cache_dtype!r}"
             )
         self.kv_dtype: str | None = kv_cache_dtype
+        # chunked prefill (Sarathi-style, docs/PERFORMANCE.md §7): split an
+        # admission's prompt into fixed-size chunks so the scheduler can
+        # interleave one chunk per decode sync point — a long prompt then
+        # bounds in-flight streams' inter-token latency by ONE chunk's
+        # latency instead of the whole prefill.  Chunk boundaries land on
+        # KV-block boundaries (rounded up); each chunk past the first runs
+        # the suffix-prefill program over the slot's own already-written
+        # blocks, so the written K/V — and the first sampled token — are
+        # bit-identical to the monolithic prefill.  Opt-in per deployment
+        # via the ``prefill_chunk`` graph parameter or SCT_PREFILL_CHUNK.
+        if prefill_chunk is None:
+            prefill_chunk = int(os.environ.get("SCT_PREFILL_CHUNK", "0") or 0)
+        prefill_chunk = max(0, int(prefill_chunk))
+        if prefill_chunk:
+            prefill_chunk = min(
+                -(-prefill_chunk // kv_block_size) * kv_block_size,
+                cfg.max_seq,
+            )
+            if not hasattr(family_mod, "prefill_suffix_paged"):
+                log.warning(
+                    "generative model %r: family %s has no "
+                    "prefill_suffix_paged; chunked prefill disabled",
+                    name, family_mod,
+                )
+                prefill_chunk = 0
+        self.prefill_chunk = prefill_chunk
+        # Pallas paged decode-attention kernel (ops/paged_attention.py):
+        # fuses block-table gather + int8 dequant + attention over the
+        # paged pool inside the compiled decode step.  Single-device only
+        # for now — the kernel does not partition over a mesh axis — and
+        # interpret-mode on CPU so tier-1 covers it.  Opt-in via the
+        # ``decode_kernel`` graph parameter or SCT_DECODE_KERNEL=1.
+        if decode_kernel is None:
+            decode_kernel = os.environ.get("SCT_DECODE_KERNEL", "0") == "1"
+        decode_kernel = bool(decode_kernel)
+        if decode_kernel:
+            import inspect
+
+            _dsp = getattr(family_mod, "decode_slots_paged", None)
+            if _dsp is None or "kernel" not in inspect.signature(
+                _dsp
+            ).parameters:
+                log.warning(
+                    "generative model %r: family %s decode has no kernel "
+                    "path; Pallas decode kernel disabled", name, family_mod,
+                )
+                decode_kernel = False
+            elif mesh is not None:
+                log.warning(
+                    "generative model %r: the Pallas decode kernel is "
+                    "single-device (no mesh partitioning yet); disabled",
+                    name,
+                )
+                decode_kernel = False
+        self.decode_kernel = decode_kernel
 
         if dtype is not None:
             import jax.numpy as jnp
@@ -350,6 +407,10 @@ class GenerativeModel:
         spec_d = self.spec_draft
         spec_n = self.spec_ngram
         spec_H = self.spec_hist
+        # static decode-attention implementation choice: the Pallas kernel
+        # path when enabled, the XLA gather path otherwise (both ride the
+        # program cache keys via _program_config)
+        dec_kw = {"kernel": True} if self.decode_kernel else {}
 
         def _prefill(params, tokens, length, slot, blocks, temperature, seed,
                      hist_seed, cache):
@@ -369,7 +430,8 @@ class GenerativeModel:
         def _decode(window):
             def fn(params, tokens, active, temperature, seed, cache):
                 logits, cache = fam.decode_slots_paged(
-                    params, tokens, cache, active, cfg, window=window
+                    params, tokens, cache, active, cfg, window=window,
+                    **dec_kw,
                 )
                 key = jax.random.PRNGKey(seed)
                 toks = _sample(logits, temperature, key)
@@ -406,7 +468,8 @@ class GenerativeModel:
                     # the cond occasionally skips (decode is bandwidth-bound;
                     # inactive slots' math is already masked).
                     logits, cache = fam.decode_slots_paged(
-                        params, tokens, cache, active, cfg, window=window
+                        params, tokens, cache, active, cfg, window=window,
+                        **dec_kw,
                     )
                     key = jax.random.fold_in(base_key, i)
                     toks = _sample(logits, temperature, key)
@@ -470,7 +533,7 @@ class GenerativeModel:
                     qvalid = active[:, None] & (offs < remaining[:, None])
                     logits, cache = fam.decode_slots_spec_paged(
                         params, qtoks, cache, active, qvalid, cfg,
-                        window=window,
+                        window=window, **dec_kw,
                     )
                     key = jax.random.fold_in(base_key, i)
                     V = logits.shape[-1]
@@ -554,11 +617,12 @@ class GenerativeModel:
         self._decode_k_jit: dict[tuple, Any] = {}  # (k, window, config)
         # static program configuration folded into every compiled-program
         # cache key: two deployments differing only in sampling/speculation/
-        # quantization config must NEVER share a compiled step (the audit in
-        # tests/test_spec.py holds this)
+        # quantization/chunking/kernel config must NEVER share a compiled
+        # step (the audits in tests/test_spec.py + tests/test_chunked.py
+        # hold this)
         self._program_config = (
             self.top_k, self.spec_draft, self.spec_ngram, self.spec_hist,
-            self.kv_dtype,
+            self.kv_dtype, self.prefill_chunk, self.decode_kernel,
         )
         # overlapped-pipeline state: the last dispatched block's final
         # (tokens, active, remaining) as DEVICE arrays, plus the host-side
@@ -612,7 +676,19 @@ class GenerativeModel:
         self.steps = 0
         self.prefills = 0
         self.prefills_reused = 0  # prefills that skipped a reused prefix
+        self.prefill_chunks = 0  # chunked-prefill chunk dispatches
         self.imports = 0  # disagg KV handoffs imported into this pool
+        # per-slot inter-token latency ledger (fed by the scheduler's
+        # delivery loop): bounded ring for the /stats/breakdown percentiles
+        # plus the seldon_itl_seconds histogram.  Each sample is one
+        # (fetched block, slot) pair's delivery gap divided by the tokens it
+        # carried — a prefill-induced decode stall inflates every live
+        # slot's sample for that block, which is exactly what TTFT and
+        # device-step histograms could not see.
+        from collections import deque
+
+        self._itl = deque(maxlen=4096)
+        self._m_itl = DEFAULT_METRICS.itl.labels(name)
         # speculative-decoding ledger: tokens emitted vs (slot, verify-pass)
         # pairs — their ratio is accepted_tokens_per_step (> 1.0 means the
         # drafts are paying for themselves)
@@ -633,6 +709,16 @@ class GenerativeModel:
         )
         # RLock: warmup calls admit/step under the same lock
         self._lock = threading.RLock()
+
+    def note_itl(self, seconds: float) -> None:
+        """One inter-token-latency sample (scheduler delivery loop)."""
+        self._itl.append(float(seconds))
+        self._m_itl.observe(seconds)
+
+    def _itl_pct(self, q: float) -> float | None:
+        if not self._itl:
+            return None
+        return float(np.percentile(np.asarray(self._itl), q))
 
     def _record_step(self, step_s: float, tokens_emitted: int) -> None:
         """Flight-recorder + metrics for one decode dispatch (runs on the
@@ -661,6 +747,24 @@ class GenerativeModel:
             f"prompt length {n} exceeds max_seq {self.cfg.max_seq}"
         )
 
+    def _count_prefill(self, payload: dict, *, reused: bool = False) -> None:
+        """Prefill accounting that stays honest under chunking: a chunked
+        admission counts ONE logical prefill (on its final chunk) plus one
+        ``prefill_chunks`` tick per chunk dispatched; ``prefills_reused``
+        only counts admissions whose reservation matched a shared prefix —
+        never the suffix-program calls chunking itself issues."""
+        ch = payload.get("chunk")
+        if ch is None:
+            self.prefills += 1
+            if reused:
+                self.prefills_reused += 1
+            return
+        self.prefill_chunks += 1
+        if ch.get("last"):
+            self.prefills += 1
+            if ch.get("reused"):
+                self.prefills_reused += 1
+
     def _exec_prefill(self, payload: dict):
         """Symmetric prefill body (runs on every slice process)."""
         with self._lock:
@@ -677,7 +781,7 @@ class GenerativeModel:
                 ),
                 self._cache,
             )
-            self.prefills += 1
+            self._count_prefill(payload)
         return tok
 
     def reserve_blocks(self, slot: int, total_tokens: int) -> np.ndarray:
@@ -1027,6 +1131,17 @@ class GenerativeModel:
         L = prompt.shape[0]
         if L < 1:
             raise GraphUnitError("empty prompt")
+        if self.prefill_chunk and L > self.prefill_chunk:
+            # chunked admission, dispatched back-to-back (callers that can
+            # interleave — the scheduler — use admit_chunk_plan directly
+            # and pace one chunk per decode sync point instead)
+            plan = self.admit_chunk_plan(
+                slot, prompt, temperature, seed, reserve_tokens
+            )
+            tok = None
+            for i in range(len(plan["payloads"])):
+                tok = self.prefill_chunk_dispatch(plan, i)
+            return tok
         blocks_row, prefix_len = self.reserve_for_prompt(
             slot, prompt, L + max(0, int(reserve_tokens))
         )
@@ -1076,6 +1191,102 @@ class GenerativeModel:
         if self.driver is not None:
             return self.driver.lead(self._mh_prefill_key, payload)
         return self._exec_prefill(payload)
+
+    # ------------------------------------------------------ chunked prefill
+
+    def admit_chunk_plan(
+        self,
+        slot: int,
+        prompt: np.ndarray,
+        temperature: float,
+        seed: int,
+        reserve_tokens: int = 0,
+    ) -> dict:
+        """Reserve ``slot``'s blocks and lay out the admission as a list of
+        prefill-chunk payloads (docs/PERFORMANCE.md §7).  Nothing touches
+        the device here: the scheduler dispatches one chunk per decode sync
+        point via :meth:`prefill_chunk_dispatch`, so a long prompt can
+        never stall in-flight streams for more than one chunk's latency.
+        KV prefix reuse composes — a matched prefix skips its chunks
+        entirely and only the novel suffix is chunked.  The written K/V and
+        the first sampled token are bit-identical to the monolithic prefill
+        (every chunk past the first is the pinned-equal suffix program over
+        the slot's own blocks; the final chunk samples with the admission's
+        seed exactly like the monolithic program)."""
+        prompt = np.asarray(prompt, np.int32).ravel()
+        L = int(prompt.size)
+        if L < 1:
+            raise GraphUnitError("empty prompt")
+        blocks_row, prefix_len = self.reserve_for_prompt(
+            slot, prompt, L + max(0, int(reserve_tokens))
+        )
+        self._pos_ceiling[int(slot)] = L
+        C = self.prefill_chunk or L
+        spans = []
+        s = prefix_len
+        while s < L:
+            e = min(s + C, L)
+            spans.append((s, e))
+            s = e
+        bs = self.kv_block_size
+        payloads: list[tuple[str, dict]] = []
+        for idx, (s, e) in enumerate(spans):
+            seg = prompt[s:e]
+            bucket = self.fit_bucket(seg.size)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, : seg.size] = seg
+            meta = {
+                "i": idx,
+                "last": idx == len(spans) - 1,
+                "reused": prefix_len > 0,
+            }
+            if s == 0:
+                payloads.append(("prefill", {
+                    "padded": padded,
+                    "length": int(e),
+                    "slot": int(slot),
+                    "blocks": blocks_row,
+                    "temperature": float(temperature),
+                    "seed": int(seed),
+                    "chunk": meta,
+                }))
+            else:
+                pb = s // bs
+                lb = bucket // bs
+                suffix_blocks = np.zeros(lb, np.int32)
+                avail = blocks_row[pb : pb + lb]
+                suffix_blocks[: avail.size] = avail  # overflow pads -> sink
+                payloads.append(("suffix", {
+                    "padded": padded,
+                    "prefix_len": int(s),
+                    "length": int(e),
+                    "slot": int(slot),
+                    "blocks": blocks_row,
+                    "suffix_blocks": suffix_blocks,
+                    "window": self._prefix_window(s),
+                    "temperature": float(temperature),
+                    "seed": int(seed),
+                    "chunk": meta,
+                }))
+            if self.spec_draft:
+                payloads[-1][1]["hist_seed"] = self._hist_seed(prompt[:e])
+        return {"slot": int(slot), "payloads": payloads,
+                "prefix_len": prefix_len}
+
+    def prefill_chunk_dispatch(self, plan: dict, i: int):
+        """Dispatch chunk ``i`` of an :meth:`admit_chunk_plan` admission.
+        Returns the chunk's sampled token as a DEVICE array — only the
+        final chunk's is the request's real first token; intermediate
+        chunks' samples are discarded unfetched, so chunking adds zero host
+        syncs over the monolithic path."""
+        kind, payload = plan["payloads"][i]
+        if kind == "prefill":
+            if self.driver is not None:
+                return self.driver.lead(self._mh_prefill_key, payload)
+            return self._exec_prefill(payload)
+        if self.driver is not None:
+            return self.driver.lead(self._mh_prefill_suffix_key, payload)
+        return self._exec_prefill_suffix(payload)
 
     def _hist_seed(self, prompt: np.ndarray) -> np.ndarray:
         """Host-side proposer-ring row for an admission: the prompt tail at
@@ -1140,6 +1351,22 @@ class GenerativeModel:
             "kv_dtype": self.kv_dtype or str(self._cache["k"].dtype),
             "kv_bytes_per_slot": self.kv_bytes_per_slot(),
             "kv_slots_per_chip": self.kv_slots_per_chip(),
+            # chunked prefill + decode kernel state (docs/PERFORMANCE.md §7)
+            "prefill_chunk": self.prefill_chunk or None,
+            "prefill_chunks": self.prefill_chunks,
+            "decode_kernel": self.decode_kernel,
+            # per-slot inter-token latency (scheduler delivery gaps): the
+            # number TTFT/device-step histograms cannot see — a prefill
+            # stalling the decode pipeline lands here
+            "itl_p50_ms": (
+                round(self._itl_pct(50) * 1e3, 3)
+                if self._itl else None
+            ),
+            "itl_p99_ms": (
+                round(self._itl_pct(99) * 1e3, 3)
+                if self._itl else None
+            ),
+            "itl_samples": len(self._itl),
         }
 
     def _prefix_window(self, prefix_len: int) -> int:
@@ -1178,8 +1405,7 @@ class GenerativeModel:
                 ),
                 self._cache,
             )
-            self.prefills += 1
-            self.prefills_reused += 1
+            self._count_prefill(payload, reused=True)
         return tok
 
     def admit(
@@ -1468,11 +1694,31 @@ class GenerativeModel:
                 tag.append(f"spec{self.spec_draft}")
             if self.kv_dtype:
                 tag.append(self.kv_dtype)
+            if self.prefill_chunk:
+                tag.append(f"chunk{self.prefill_chunk}")
+            if self.decode_kernel:
+                tag.append("kernel")
             sfx = ("[" + ",".join(tag) + "]") if tag else ""
+            # with chunking on, an admission longer than one chunk compiles
+            # the chunk-0 bucket plus suffix programs per chunk boundary
+            # window — exactly the serving set; the variant list names them
+            # so readiness provably covered the chunk pipeline
+            suffix_before = set(self._prefill_suffix_jit)
             for b in self.prefill_buckets:
                 self.admit(0, np.ones(b, np.int32), 0.0, 0)
-                self.warmup_programs.append(f"prefill:b{b}{sfx}")
-                n += 1
+                if not self.prefill_chunk or b <= self.prefill_chunk:
+                    # monolithic program for this bucket really compiled
+                    # (longer admissions run the chunk pipeline instead)
+                    self.warmup_programs.append(f"prefill:b{b}{sfx}")
+                    n += 1
+            if self.prefill_chunk:
+                for key in sorted(
+                    set(self._prefill_suffix_jit) - suffix_before
+                ):
+                    self.warmup_programs.append(
+                        f"prefill:b{key[0]}:w{key[1]}{sfx}"
+                    )
+                    n += 1
             # every attention-window bucket compiles up front: a window
             # first hit mid-serving would stall that decode block for the
             # compile (seconds on a big model), wrecking its requests' p99.
@@ -1619,9 +1865,11 @@ class _Request:
     # streaming hook: called with each sampled token as it lands (in
     # event-loop context, decode_block tokens at a time per device fetch)
     on_token: "Callable[[int], None] | None" = None
-    # flight-recorder timestamps: submission and first sampled token
+    # flight-recorder timestamps: submission, first sampled token, and the
+    # last delivery (feeds the per-slot inter-token-latency ledger)
     t0: float = 0.0
     t_first_token: float = 0.0
+    t_last_tok: float = 0.0
     # the submitting request's live span (captured at submit, same loop):
     # first-token lands on it as an event even though the scheduler loop
     # runs outside the request's contextvar scope
@@ -1684,6 +1932,12 @@ class GenerationScheduler:
         # decode block
         self._external: set[int] = set()
         self._external_release: list[int] = []
+        # chunked prefill (docs/PERFORMANCE.md §7): admissions whose prompt
+        # is mid-prefill — one chunk advances per decode sync point so a
+        # long admission never stalls in-flight streams for more than one
+        # chunk's latency.  Their slots are reserved but not decode-active.
+        self._prefilling: list[dict] = []
+        self._prefill_slots: set[int] = set()
         self._task: asyncio.Task | None = None
         self._closed = False
         # Random base so temperature>0 sampling differs across restarts and
@@ -1927,8 +2181,10 @@ class GenerationScheduler:
     def _token_done(self, req: _Request, tok: int) -> bool:
         if not req.out and req.t0:
             # first sampled token: the serving TTFT (queue wait + prefill
-            # + the first decode fetch)
+            # + the first decode fetch); later deliveries measure against
+            # this for the inter-token-latency ledger
             req.t_first_token = time.perf_counter()
+            req.t_last_tok = req.t_first_token
             ttft = req.t_first_token - req.t0
             RECORDER.record_stage(STAGE_TTFT, ttft)
             DEFAULT_METRICS.ttft.labels(self.model.name).observe(ttft)
@@ -2013,6 +2269,9 @@ class GenerationScheduler:
         carry stays consistent and the overlap pipeline keeps running; the
         freed slot's blocks are only re-reserved at the next sync point."""
         S = len(slots)
+        now = time.perf_counter()
+        reqs = list(slots)  # completions below null the live entries
+        counts = [0] * S
         for step_i in range(toks_seq.shape[0]):
             for i in range(S):
                 if not act_seq[step_i, i] or slots[i] is None:
@@ -2020,14 +2279,35 @@ class GenerationScheduler:
                 req = slots[i]
                 tok = int(toks_seq[step_i, i])
                 cur[i] = tok
+                counts[i] += 1
                 if self._token_done(req, tok):
                     self._complete(req)
                     slots[i] = None
                     active[i] = False
                     self.model.release_slot(i)
+        # per-slot inter-token latency: one sample per (block, slot) — the
+        # delivery gap spread over the tokens it carried.  A prefill (or
+        # anything else) stalling the pipeline between blocks inflates
+        # every live slot's sample; TTFT and device-step never see it.
+        # getattr: duck-typed stand-in models (tests) predate the ledger.
+        note_itl = getattr(self.model, "note_itl", None)
+        for i in range(S):
+            req = reqs[i]
+            if req is None or not counts[i]:
+                continue
+            if req.t_last_tok and note_itl is not None:
+                note_itl((now - req.t_last_tok) / counts[i])
+            req.t_last_tok = now
 
     def _fail_inflight(self, slots, active, exc: BaseException) -> None:
-        """A failed device step poisons every in-flight request."""
+        """A failed device step poisons every in-flight request,
+        mid-prefill admissions included (their blocks release with the
+        blanket slot sweep below)."""
+        for ent in self._prefilling:
+            if not ent["req"].future.done():
+                ent["req"].future.set_exception(exc)
+        self._prefilling.clear()
+        self._prefill_slots.clear()
         for i in range(len(slots)):
             if slots[i] is not None and not slots[i].future.done():
                 slots[i].future.set_exception(exc)
@@ -2059,6 +2339,7 @@ class GenerationScheduler:
                     and not active.any()
                     and not self._overflow
                     and not self._waiting
+                    and not self._prefilling
                 ):
                     # fully idle: park until a submit wakes us (no await
                     # between the emptiness check and clear, so a submit
@@ -2078,7 +2359,11 @@ class GenerationScheduler:
                     # round trip
                     batch: list[_Request] = []
                     # capacity excludes slots pinned by in-flight handoffs
-                    cap_free = S - int(active.sum()) - len(self._external)
+                    # and slots mid-chunked-prefill
+                    cap_free = (
+                        S - int(active.sum()) - len(self._external)
+                        - len(self._prefill_slots)
+                    )
                     while self._overflow and len(batch) < cap_free:
                         batch.append(self._overflow.pop(0))
                     if self._waiting and len(batch) < cap_free:
@@ -2089,8 +2374,17 @@ class GenerationScheduler:
                             batch.append(self._waiting.pop(0))
                     if batch:
                         await self._admit_batch(batch, slots, cur, temps, active)
+                    if self._prefilling:
+                        # chunked prefill: ONE chunk per sync point — the
+                        # admission cost a decode stall can see is bounded
+                        # by a chunk, not a prompt (docs/PERFORMANCE.md §7)
+                        await self._advance_prefill(slots, cur, temps, active)
                     self._reap_slots(slots, active)
                     if not active.any():
+                        if self._prefilling:
+                            # chunks still advancing: loop straight back —
+                            # each iteration does real device work
+                            continue
                         if self._overflow and not self._external:
                             # nothing in flight can ever free blocks: these
                             # requests exceed the pool outright
@@ -2195,6 +2489,9 @@ class GenerationScheduler:
                     and not self._overflow
                     # a pending handoff release needs a sync point
                     and not self._external_release
+                    # a mid-prefill admission needs sync points to advance
+                    # its chunks — overlapping would starve it
+                    and not self._prefilling
                 ):
                     try:
                         nxt = await asyncio.to_thread(
@@ -2238,6 +2535,11 @@ class GenerationScheduler:
                     carry_dirty = True
         except asyncio.CancelledError:
             err = RuntimeError("GenerationScheduler closed")
+            for ent in self._prefilling:
+                if not ent["req"].future.done():
+                    ent["req"].future.set_exception(err)
+            self._prefilling.clear()
+            self._prefill_slots.clear()
             for i, req in enumerate(slots):
                 if req is not None and not req.future.done():
                     req.future.set_exception(err)
@@ -2252,13 +2554,24 @@ class GenerationScheduler:
         free = [
             i
             for i in range(len(slots))
-            if not active[i] and i not in self._external
+            if not active[i]
+            and i not in self._external
+            and i not in self._prefill_slots
         ]
+        # chunk-pace an admission only when live decode streams exist to
+        # protect: an idle scheduler admits monolithically — nothing can
+        # stall, the prefill costs fewer dispatches, and sampled streams
+        # keep the exact seed-per-block sequence of the unchunked path.
+        # getattr: duck-typed stand-in models (tests) predate chunking.
+        chunk_c = (
+            getattr(self.model, "prefill_chunk", 0) if active.any() else 0
+        )
 
         def dispatch_and_fetch():
             placed = []
             errors = []
             starved = []
+            chunked = []
             for req, slot in zip(batch, free):
                 try:
                     if req.imported is not None:
@@ -2274,6 +2587,20 @@ class GenerationScheduler:
                         )
                         placed.append((req, slot, imp["first_token"]))
                         continue
+                    if (
+                        chunk_c
+                        and not req.prefill_only
+                        and req.prompt.size > chunk_c
+                    ):
+                        # chunked prefill: reserve only (host-side) — the
+                        # run loop paces the chunks, one per sync point
+                        plan = self.model.admit_chunk_plan(
+                            slot, req.prompt, req.temperature,
+                            self._next_seed(),
+                            reserve_tokens=req.max_new_tokens,
+                        )
+                        chunked.append((req, slot, plan))
+                        continue
                     tok_dev = self.model.admit_dispatch(
                         slot, req.prompt, req.temperature, self._next_seed(),
                         reserve_tokens=req.max_new_tokens,
@@ -2288,9 +2615,19 @@ class GenerationScheduler:
             # one round trip fetches every admitted first token (imported
             # first tokens are host ints already; device_get passes them)
             toks = jax.device_get([t for _, _, t in placed]) if placed else []
-            return placed, toks, errors, starved
+            return placed, toks, errors, starved, chunked
 
-        placed, toks, errors, starved = await asyncio.to_thread(dispatch_and_fetch)
+        placed, toks, errors, starved, chunked = await asyncio.to_thread(
+            dispatch_and_fetch
+        )
+        for req, slot, plan in chunked:
+            if req.future.done():  # client vanished while we reserved
+                self.model.release_slot(slot)
+                continue
+            self._prefilling.append(
+                {"req": req, "slot": slot, "plan": plan, "i": 0}
+            )
+            self._prefill_slots.add(slot)
         self._overflow.extend(starved)
         for req, exc in errors:
             if not isinstance(exc, GraphUnitError):
@@ -2316,6 +2653,76 @@ class GenerationScheduler:
             cur[slot] = int(tok)
             temps[slot] = req.temperature
             active[slot] = True
+
+    async def _advance_prefill(self, slots, cur, temps, active) -> None:
+        """Advance chunked prefills by ONE chunk (Sarathi-style stall-free
+        admission, docs/PERFORMANCE.md §7).  Runs only at sync points, so a
+        chunk and a decode block are queued back-to-back on the device and
+        the in-flight streams pay at most one chunk of extra latency per
+        block.  Intermediate chunks are dispatched without a host fetch;
+        only the final chunk's sampled token is materialized — the same one
+        host sync an unchunked admission costs."""
+        now = time.monotonic()
+        keep = []
+        for ent in self._prefilling:
+            req = ent["req"]
+            if req.future.done():  # cancel-on-disconnect mid-prefill
+                self._prefill_slots.discard(ent["slot"])
+                self.model.release_slot(ent["slot"])
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                req.future.set_exception(qos.DeadlineExceeded(
+                    f"deadline expired after {ent['i']} prefill chunks"
+                ))
+                DEFAULT_METRICS.qos_deadline_miss.labels(
+                    self.model.name, "prefill"
+                ).inc()
+                qos.note_deadline_miss("prefill", req.priority)
+                if req.span is not None:
+                    req.span.event(
+                        "qos-drop", reason="deadline", stage="prefill"
+                    )
+                self._prefill_slots.discard(ent["slot"])
+                self.model.release_slot(ent["slot"])
+                continue
+            keep.append(ent)
+        self._prefilling[:] = keep
+        if not self._prefilling:
+            return
+        ent = self._prefilling[0]
+        req, slot, plan = ent["req"], ent["slot"], ent["plan"]
+        last = ent["i"] == len(plan["payloads"]) - 1
+
+        def one_chunk():
+            tok_dev = self.model.prefill_chunk_dispatch(plan, ent["i"])
+            return int(tok_dev) if last else None
+
+        try:
+            tok = await asyncio.to_thread(one_chunk)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            if not isinstance(exc, GraphUnitError):
+                log.exception("chunked prefill failed")
+            self._prefilling.pop(0)
+            self._prefill_slots.discard(slot)
+            self.model.release_slot(slot)
+            if not req.future.done():
+                req.future.set_exception(exc)
+            return
+        ent["i"] += 1
+        if not last:
+            return
+        self._prefilling.pop(0)
+        self._prefill_slots.discard(slot)
+        if self._token_done(req, tok):
+            self._complete(req)
+            self.model.release_slot(slot)
+            return
+        slots[slot] = req
+        cur[slot] = tok
+        temps[slot] = req.temperature
+        active[slot] = True
 
 
 PAD_ID = -1  # right-pad for ragged generated rows in dense responses
@@ -2388,6 +2795,12 @@ class GenerativeComponent(SeldonComponent):
                 "key": f"{self.model.name}_prefills_reused",
                 "type": "GAUGE",
                 "value": self.model.prefills_reused,
+            })
+        if self.model.prefill_chunk:
+            out.append({
+                "key": f"{self.model.name}_prefill_chunks",
+                "type": "GAUGE",
+                "value": self.model.prefill_chunks,
             })
         return out
 

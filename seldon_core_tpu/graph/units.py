@@ -355,8 +355,10 @@ def _jax_generative(parameters: dict[str, Any]) -> Any:
     ``decode_block``, ``overlap`` (overlapped decode pipeline,
     docs/PERFORMANCE.md), ``kv_prefix_reuse``, ``spec_draft`` /
     ``spec_ngram`` / ``spec_hist`` (fused self-speculative decoding),
-    ``kv_cache_dtype`` (``int8`` paged-KV quantization), plus model-config
-    overrides.
+    ``kv_cache_dtype`` (``int8`` paged-KV quantization), ``prefill_chunk``
+    (Sarathi-style chunked prefill interleaved with decode),
+    ``decode_kernel`` (fused Pallas paged decode-attention kernel), plus
+    model-config overrides.
     """
     from seldon_core_tpu.models import registry as model_registry
 

@@ -660,16 +660,19 @@ def decode_slots_paged(
     cfg: Config,
     *,
     window: int | None = None,
+    kernel: bool = False,
 ) -> tuple[jax.Array, dict]:
     """One decode step for every slot against the paged cache.
 
     Identical contract to :func:`decode_slots`; attention reads gather the
     first ``window // block_size`` table entries per slot (same byte volume
     as the static window read — the pool layout changes where rows LIVE,
-    not how many are read)."""
+    not how many are read).  ``kernel`` (static) routes the attention read
+    through the fused Pallas paged decode-attention kernel
+    (``ops/paged_attention.py``) instead of the XLA gather path."""
     logits, cache = _decode_paged_multi(
         params, tokens[:, None], cache, active, active[:, None], cfg,
-        window=window,
+        window=window, kernel=kernel,
     )
     cache["pos"] = jnp.where(active, cache["pos"] + 1, cache["pos"])
     return logits[:, 0], cache
@@ -684,6 +687,7 @@ def decode_slots_spec_paged(
     cfg: Config,
     *,
     window: int | None = None,
+    kernel: bool = False,
 ) -> tuple[jax.Array, dict]:
     """Speculative verify pass: score ``L = 1 + draft`` query positions per
     slot in ONE model call (docs/PERFORMANCE.md).
@@ -701,17 +705,26 @@ def decode_slots_spec_paged(
     Returns ``(logits (S, L, V), cache)``.
     """
     return _decode_paged_multi(
-        params, qtokens, cache, active, qvalid, cfg, window=window
+        params, qtokens, cache, active, qvalid, cfg, window=window,
+        kernel=kernel,
     )
 
 
 def _decode_paged_multi(
-    params, qtokens, cache, active, qvalid, cfg: Config, *, window
+    params, qtokens, cache, active, qvalid, cfg: Config, *, window,
+    kernel: bool = False,
 ):
     """Shared L-query decode body: ``L=1`` is the classic decode step,
     ``L>1`` the fused speculative verify.  The per-row contraction shapes
     are identical in both, so a verify pass's first position is bit-equal
-    to the single-token step it replaces."""
+    to the single-token step it replaces.
+
+    ``kernel`` (static — folded into the serving program cache keys) swaps
+    the attention read side for the Pallas paged decode-attention kernel:
+    block-table gather, int8 dequant, and the softmax/PV contraction fuse
+    into one VMEM-resident pass over the pool blocks instead of
+    materializing the gathered window in HBM (docs/PERFORMANCE.md §7).
+    The K/V *write* side (scatter of this step's rows) is unchanged."""
     pos = cache["pos"]  # (S,)
     table = cache["table"]  # (S, MB)
     S, L = qtokens.shape
@@ -768,29 +781,42 @@ def _decode_paged_multi(
             cv = cv.at[li, write_blk, write_off].set(v.astype(cv.dtype))
         ckl = jax.lax.dynamic_index_in_dim(ck, li, 0, keepdims=False)
         cvl = jax.lax.dynamic_index_in_dim(cv, li, 0, keepdims=False)
-        # gather each slot's visible blocks: (S, wb, bs, kv, hd) -> (S, W, ..)
         if quant:
             sk_l = jax.lax.dynamic_index_in_dim(cks, li, 0, keepdims=False)
             sv_l = jax.lax.dynamic_index_in_dim(cvs, li, 0, keepdims=False)
-            kw = _dequant_kv(ckl[read_idx], sk_l[read_idx], q.dtype)
-            vw = _dequant_kv(cvl[read_idx], sv_l[read_idx], q.dtype)
-            kw = kw.reshape(S, W, kv, hd)
-            vw = vw.reshape(S, W, kv, hd)
+        if kernel:
+            # fused Pallas read side: table gather + (dequant +) attention
+            # in one VMEM pass over the window's pool blocks
+            from seldon_core_tpu.ops import paged_decode_attention
+
+            o = paged_decode_attention(
+                q, ckl, cvl, read_idx, pos,
+                k_scale=sk_l if quant else None,
+                v_scale=sv_l if quant else None,
+            )
         else:
-            kw = ckl[read_idx].reshape(S, W, kv, hd)
-            vw = cvl[read_idx].reshape(S, W, kv, hd)
-        # grouped-query attention against the *un-repeated* cache: repeating
-        # kv to n_heads here would multiply cache reads by the group size
-        # every decode step, defeating GQA's bandwidth savings
-        groups = cfg.n_heads // cfg.n_kv_heads
-        qg = q.reshape(S, L, cfg.n_kv_heads, groups, cfg.head_dim)
-        s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kw) * scale
-        s = jnp.where(
-            valid[:, None, None, :, :], s, jnp.finfo(s.dtype).min
-        )
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bkgqs,bskd->bqkgd", p, vw)
-        o = o.reshape(S, L, cfg.n_heads, cfg.head_dim)
+            # gather each slot's visible blocks:
+            # (S, wb, bs, kv, hd) -> (S, W, ..)
+            if quant:
+                kw = _dequant_kv(ckl[read_idx], sk_l[read_idx], q.dtype)
+                vw = _dequant_kv(cvl[read_idx], sv_l[read_idx], q.dtype)
+                kw = kw.reshape(S, W, kv, hd)
+                vw = vw.reshape(S, W, kv, hd)
+            else:
+                kw = ckl[read_idx].reshape(S, W, kv, hd)
+                vw = cvl[read_idx].reshape(S, W, kv, hd)
+            # grouped-query attention against the *un-repeated* cache:
+            # repeating kv to n_heads here would multiply cache reads by the
+            # group size every decode step, defeating GQA's bandwidth savings
+            groups = cfg.n_heads // cfg.n_kv_heads
+            qg = q.reshape(S, L, cfg.n_kv_heads, groups, cfg.head_dim)
+            s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kw) * scale
+            s = jnp.where(
+                valid[:, None, None, :, :], s, jnp.finfo(s.dtype).min
+            )
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bkgqs,bskd->bqkgd", p, vw)
+            o = o.reshape(S, L, cfg.n_heads, cfg.head_dim)
         x = x + jnp.einsum("blhd,hde->ble", o, lp["wo"])
         h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
         mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]

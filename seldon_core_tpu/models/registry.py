@@ -223,6 +223,8 @@ def build_generative_component(
     spec_ngram: int | None = None,
     spec_hist: int = 64,
     kv_cache_dtype: str | None = None,
+    prefill_chunk: int | None = None,
+    decode_kernel: bool | None = None,
     **overrides,
 ):
     """Build a continuous-batching generative graph unit (JAX_GENERATIVE).
@@ -231,8 +233,10 @@ def build_generative_component(
     16-token blocks, pool big enough for every slot at full max_seq).
     ``spec_draft``/``spec_ngram``/``spec_hist`` turn on fused
     self-speculative decoding; ``kv_cache_dtype="int8"`` stores the paged
-    pool quantized with per-(position, head) scales
-    (docs/PERFORMANCE.md)."""
+    pool quantized with per-(position, head) scales;
+    ``prefill_chunk`` enables Sarathi-style chunked prefill interleaved
+    with decode and ``decode_kernel`` the fused Pallas paged
+    decode-attention kernel (docs/PERFORMANCE.md §7)."""
     from seldon_core_tpu.executor.generation import (
         GenerativeComponent,
         GenerativeModel,
@@ -276,6 +280,8 @@ def build_generative_component(
         spec_ngram=spec_ngram,
         spec_hist=spec_hist,
         kv_cache_dtype=kv_cache_dtype,
+        prefill_chunk=prefill_chunk,
+        decode_kernel=decode_kernel,
     )
     return GenerativeComponent(
         model,

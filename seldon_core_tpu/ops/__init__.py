@@ -1,13 +1,23 @@
 """Pallas TPU kernels for the serving hot path.
 
 The compute plane is mostly XLA-fused jit code; kernels live here only
-where explicit tiling beats the compiler — currently flash attention
-(O(S^2) HBM traffic -> O(S*D)).
+where explicit tiling beats the compiler — flash attention (O(S^2) HBM
+traffic -> O(S*D)) and paged decode-attention (block-table gather + int8
+dequant + attention fused over the paged KV pool, docs/PERFORMANCE.md §7).
 """
 
 from seldon_core_tpu.ops.flash_attention import (
     flash_attention,
     flash_causal_attention_blhd,
 )
+from seldon_core_tpu.ops.paged_attention import (
+    paged_decode_attention,
+    paged_decode_attention_reference,
+)
 
-__all__ = ["flash_attention", "flash_causal_attention_blhd"]
+__all__ = [
+    "flash_attention",
+    "flash_causal_attention_blhd",
+    "paged_decode_attention",
+    "paged_decode_attention_reference",
+]

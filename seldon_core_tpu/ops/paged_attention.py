@@ -1,0 +1,226 @@
+"""Paged decode-attention as a Pallas TPU kernel.
+
+The fused decode step (``models/llama.py::_decode_paged_multi``) spends its
+HBM budget reading each slot's attention window out of the paged KV pool.
+The XLA path does that as gather -> (dequant) -> einsum -> softmax -> einsum,
+which materializes the gathered ``(S, W, kv, hd)`` window (and, under int8,
+its dequantized copy) in HBM between ops.  This kernel fuses the whole read
+side: the block-table gather is the BlockSpec index map (scalar-prefetched
+table entries steer each grid step's DMA straight at the right pool block),
+int8 blocks dequantize in VMEM against their per-(position, head) scales,
+and attention runs the online-softmax recurrence over one KV block at a
+time — pool bytes are read once, nothing intermediate touches HBM
+(guide: /opt/skills/guides/pallas_guide.md; the gather idiom is the
+standard TPU paged-attention pattern, the recurrence is flash decoding).
+
+Query shapes are the decode step's: ``L = 1`` for the plain step,
+``L = 1 + spec_draft`` for the fused speculative verify pass.  Grouped
+queries attend the *un-repeated* KV heads (GQA), exactly like the XLA path.
+
+On non-TPU backends (the CPU test harness) the kernel runs in Pallas
+interpret mode, so equivalence tests pin it to the dense reference
+everywhere; :func:`paged_decode_attention_reference` is the XLA-path math
+factored out for those tests and for callers that want the fallback
+explicitly.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-but-finite: -inf * 0 = nan would poison the rescale
+
+
+def _paged_kernel(
+    table_ref,  # (S, WB) int32 scalar-prefetch: physical block per grid step
+    pos_ref,  # (S,) int32 scalar-prefetch: per-slot base position
+    q_ref,  # (1, 1, R, D) queries for this (slot, kv head)
+    k_ref,  # (1, BS, 1, D) one gathered KV block
+    v_ref,
+    *refs,  # [k_scale_ref, v_scale_ref,] o_ref, m_scr, l_scr, acc_scr
+    bs,
+    groups,
+    n_w,
+    scale,
+    quant,
+):
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
+    s_i = pl.program_id(0)
+    w = pl.program_id(2)
+
+    @pl.when(w == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    R = q_ref.shape[2]
+    base = pos_ref[s_i]
+    # key blocks entirely past every query position are dead weight: the
+    # furthest query sits at base + L - 1 (row R-1 is query L-1's last group)
+    live = w * bs <= base + (R - 1) // groups
+
+    @pl.when(live)
+    def _tile():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # (R, D)
+        k = k_ref[0, :, 0].astype(jnp.float32)  # (BS, D)
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        if quant:
+            # per-(position, head) symmetric scales: the dequant the XLA
+            # path pays as a separate HBM-resident op happens in VMEM here
+            k = k * ks_ref[0, :, 0].astype(jnp.float32)[:, None]
+            v = v * vs_ref[0, :, 0].astype(jnp.float32)[:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (R, BS)
+        # query row r belongs to query position j = r // groups and may see
+        # pool rows [0, base + j] — the causal-speculation window
+        rows_j = jax.lax.broadcasted_iota(jnp.int32, (R, bs), 0) // groups
+        cols = w * bs + jax.lax.broadcasted_iota(jnp.int32, (R, bs), 1)
+        s = jnp.where(cols <= base + rows_j, s, NEG_INF)
+        m_prev = m_scr[:, 0]
+        l_prev = l_scr[:, 0]
+        m_cur = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_cur[:, None])
+        alpha = jnp.exp(m_prev - m_cur)
+        l_cur = alpha * l_prev + p.sum(axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_cur[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_cur[:, None], l_scr.shape)
+
+    @pl.when(w == n_w - 1)
+    def _emit():
+        l = l_scr[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
+        o_ref[0, 0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    table: jax.Array,
+    pos: jax.Array,
+    *,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Attention for ``L`` decode queries per slot over the paged KV pool.
+
+    ``q (S, L, H, D)`` post-RoPE queries (``H = kv_heads * groups``);
+    ``k_pages``/``v_pages (NB, BS, KV, D)`` ONE layer's pool (float, or
+    int8 with ``k_scale``/``v_scale (NB, BS, KV)``); ``table (S, WB)`` the
+    physical blocks each slot's attention window reads; ``pos (S,)`` the
+    slot's base position — query ``j`` sees pool rows ``[0, pos + j]``.
+    Returns ``(S, L, H, D)`` in the query dtype.  Semantics are exactly
+    :func:`paged_decode_attention_reference` (the XLA gather path).
+    """
+    S, L, H, D = q.shape
+    NB, BS, KV, _ = k_pages.shape
+    WB = table.shape[1]
+    if H % KV:
+        raise ValueError(f"H {H} must be a multiple of kv heads {KV}")
+    groups = H // KV
+    R = L * groups
+    quant = k_scale is not None
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = 1.0 / math.sqrt(D)
+    # row r = j * groups + g: query-major so r // groups recovers j
+    qr = (
+        q.reshape(S, L, KV, groups, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(S, KV, R, D)
+    )
+    kernel = functools.partial(
+        _paged_kernel, bs=BS, groups=groups, n_w=WB, scale=scale, quant=quant
+    )
+    in_specs = [
+        pl.BlockSpec((1, 1, R, D), lambda s, h, w, t, p: (s, h, 0, 0)),
+        # the gather: scalar-prefetched table entries drive the DMA source
+        pl.BlockSpec((1, BS, 1, D), lambda s, h, w, t, p: (t[s, w], 0, h, 0)),
+        pl.BlockSpec((1, BS, 1, D), lambda s, h, w, t, p: (t[s, w], 0, h, 0)),
+    ]
+    args = [qr, k_pages, v_pages]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, BS, 1), lambda s, h, w, t, p: (t[s, w], 0, h)),
+            pl.BlockSpec((1, BS, 1), lambda s, h, w, t, p: (t[s, w], 0, h)),
+        ]
+        args += [k_scale, v_scale]
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(S, KV, WB),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, 1, R, D), lambda s, h, w, t, p: (s, h, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((R, 128), jnp.float32),  # running max (col 0)
+                pltpu.VMEM((R, 128), jnp.float32),  # running denom (col 0)
+                pltpu.VMEM((R, D), jnp.float32),  # output accumulator
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, KV, R, D), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(table, jnp.int32), jnp.asarray(pos, jnp.int32), *args)
+    return (
+        out.reshape(S, KV, L, groups, D)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(S, L, H, D)
+    )
+
+
+def paged_decode_attention_reference(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    table: jax.Array,
+    pos: jax.Array,
+    *,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+) -> jax.Array:
+    """The XLA gather path, factored out of ``_decode_paged_multi``: the
+    pure-JAX fallback and the pin the kernel equivalence tests hold to."""
+    S, L, H, D = q.shape
+    NB, BS, KV, _ = k_pages.shape
+    WB = table.shape[1]
+    W = WB * BS
+    groups = H // KV
+    kw = k_pages[table]  # (S, WB, BS, KV, D)
+    vw = v_pages[table]
+    if k_scale is not None:
+        kw = kw.astype(jnp.float32) * k_scale[table][..., None].astype(
+            jnp.float32
+        )
+        vw = vw.astype(jnp.float32) * v_scale[table][..., None].astype(
+            jnp.float32
+        )
+        kw = kw.astype(q.dtype)
+        vw = vw.astype(q.dtype)
+    kw = kw.reshape(S, W, KV, D)
+    vw = vw.reshape(S, W, KV, D)
+    positions = pos[:, None] + jnp.arange(L)[None, :]  # (S, L)
+    valid = jnp.arange(W)[None, None, :] <= positions[:, :, None]  # (S, L, W)
+    scale = 1.0 / math.sqrt(D)
+    qg = q.reshape(S, L, KV, groups, D)
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kw) * scale
+    s = jnp.where(valid[:, None, None, :, :], s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgqs,bskd->bqkgd", p, vw)
+    return o.reshape(S, L, H, D)

@@ -129,6 +129,15 @@ class MetricsRegistry:
             registry=self.registry,
             buckets=_BUCKETS,
         )
+        self.itl = Histogram(
+            "seldon_itl_seconds",
+            "Generative per-slot inter-token latency (delivery gap per "
+            "fetched block over the tokens it carried) — prefill-induced "
+            "decode stalls land here, invisible to TTFT/device-step",
+            ["model_name"],
+            registry=self.registry,
+            buckets=_BUCKETS,
+        )
         self.generated_tokens = Counter(
             "seldon_generative_tokens_total",
             "Generated tokens (rate() gives sustained tokens/s)",

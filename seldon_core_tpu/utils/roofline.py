@@ -256,10 +256,14 @@ def generative_roofline(
     dtype: str | None = "bfloat16",
     prompt_len: int = 8,
     iters: int = 8,
+    decode_kernel: bool | None = None,
     **overrides,
 ) -> dict:
     """Decode-loop roofline for a generative family: tokens/s at full slot
-    occupancy and MFU from XLA's cost model of the decode program."""
+    occupancy and MFU from XLA's cost model of the decode program.
+    ``decode_kernel`` times the fused Pallas paged decode-attention step
+    instead of the XLA gather path — comparing the two runs' ``hbm_frac``
+    is the kernel-on-vs-off roofline fraction the bench records."""
     import jax
 
     from seldon_core_tpu.models import registry
@@ -271,6 +275,7 @@ def generative_roofline(
         decode_block=decode_block,
         dtype=dtype,
         max_new_tokens=decode_block,
+        decode_kernel=decode_kernel,
         **overrides,
     )
     model = comp.model
@@ -372,6 +377,7 @@ def generative_roofline(
         "block_ms": round(sec * 1e3, 3) if ok else None,
         "kv_block_size": model.kv_block_size,
         "kv_blocks": model.kv_blocks,
+        "decode_kernel": model.decode_kernel,
         "device_kind": jax.devices()[0].device_kind,
     }
 
@@ -430,6 +436,11 @@ def main(argv: list[str] | None = None) -> None:
     ap.add_argument("--n-slots", type=int, default=8)
     ap.add_argument("--decode-block", type=int, default=32)
     ap.add_argument(
+        "--decode-kernel", action="store_true",
+        help="time the fused Pallas paged decode-attention step instead "
+        "of the XLA gather path (generative only)",
+    )
+    ap.add_argument(
         "--sweep",
         default=None,
         help="operating-point sweep: comma list of SLOTSxBLOCK "
@@ -461,6 +472,7 @@ def main(argv: list[str] | None = None) -> None:
             decode_block=args.decode_block,
             dtype=args.dtype,
             iters=args.iters,
+            decode_kernel=args.decode_kernel or None,
             **overrides,
         )
     else:

@@ -159,3 +159,120 @@ class TestFlashInLlama:
             if act_seq[i, 0]:
                 got.append(int(toks_seq[i, 0]))
         assert got == expected
+
+
+class TestPagedDecodeAttention:
+    """Paged decode-attention kernel (docs/PERFORMANCE.md §7) pinned to its
+    pure-JAX reference — the exact math ``_decode_paged_multi``'s XLA
+    gather path runs — across query counts (plain step and speculative
+    verify), positions that are NOT multiples of the KV block size, GQA
+    head counts, and the int8 dequant-fusion path."""
+
+    def _rand(self, rng, *shape):
+        return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+    def _compare(self, S, L, KV, G, D, NB, BS, WB, *, quant=False, seed=0):
+        from seldon_core_tpu.ops import (
+            paged_decode_attention,
+            paged_decode_attention_reference,
+        )
+
+        rng = np.random.default_rng(seed)
+        H = KV * G
+        q = self._rand(rng, S, L, H, D)
+        table = jnp.asarray(rng.integers(0, NB, (S, WB)), jnp.int32)
+        # positions deliberately off block boundaries
+        pos = jnp.asarray(rng.integers(0, WB * BS - L, S), jnp.int32)
+        kw = {}
+        if quant:
+            k = jnp.asarray(
+                rng.integers(-127, 128, (NB, BS, KV, D)), jnp.int8
+            )
+            v = jnp.asarray(
+                rng.integers(-127, 128, (NB, BS, KV, D)), jnp.int8
+            )
+            kw["k_scale"] = jnp.asarray(
+                rng.random((NB, BS, KV)) * 0.1, jnp.float32
+            )
+            kw["v_scale"] = jnp.asarray(
+                rng.random((NB, BS, KV)) * 0.1, jnp.float32
+            )
+        else:
+            k = self._rand(rng, NB, BS, KV, D)
+            v = self._rand(rng, NB, BS, KV, D)
+        out = paged_decode_attention(q, k, v, table, pos, **kw)
+        ref = paged_decode_attention_reference(q, k, v, table, pos, **kw)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    @pytest.mark.parametrize("L", [1, 3, 5])
+    def test_matches_reference_across_query_counts(self, L):
+        self._compare(3, L, 2, 2, 16, 9, 16, 3, seed=L)
+
+    @pytest.mark.parametrize("KV,G", [(1, 4), (2, 2), (3, 2), (4, 1)])
+    def test_matches_reference_across_gqa_head_counts(self, KV, G):
+        self._compare(2, 2, KV, G, 16, 7, 8, 3, seed=KV * 10 + G)
+
+    @pytest.mark.parametrize("BS,WB", [(4, 7), (16, 2), (8, 5)])
+    def test_matches_reference_at_non_multiple_positions(self, BS, WB):
+        # pos values land mid-block; the mask must cut inside a KV block
+        self._compare(4, 2, 2, 2, 8, 11, BS, WB, seed=BS)
+
+    def test_int8_dequant_fusion_matches_reference(self):
+        self._compare(3, 2, 2, 2, 16, 9, 16, 3, quant=True)
+        self._compare(2, 1, 2, 4, 8, 5, 4, 4, quant=True, seed=7)
+
+    def test_zero_position_first_token(self):
+        # pos = 0 everywhere: only row 0 of block table[ :, 0] is visible
+        from seldon_core_tpu.ops import (
+            paged_decode_attention,
+            paged_decode_attention_reference,
+        )
+
+        rng = np.random.default_rng(3)
+        q = self._rand(rng, 2, 1, 4, 8)
+        k = self._rand(rng, 5, 4, 2, 8)
+        v = self._rand(rng, 5, 4, 2, 8)
+        table = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+        pos = jnp.zeros(2, jnp.int32)
+        out = paged_decode_attention(q, k, v, table, pos)
+        ref = paged_decode_attention_reference(q, k, v, table, pos)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+        # with one visible row, attention must return exactly that row's v
+        np.testing.assert_allclose(
+            np.asarray(out[0, 0, 0]), np.asarray(v[1, 0, 0]),
+            rtol=2e-5, atol=2e-5,
+        )
+
+    def test_in_model_decode_matches_dense_path(self):
+        """The kernel call site inside ``decode_slots_paged``: one decode
+        step with kernel on equals the XLA gather path bit-for-bit-ish
+        (same fp32 accumulation; interpret mode on CPU)."""
+        from seldon_core_tpu.models import llama
+
+        cfg = llama.Config.tiny(max_seq=64)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        for kv_dtype in (None, "int8"):
+            cache = llama.init_paged_cache(cfg, 2, 9, 16, kv_dtype=kv_dtype)
+            row = np.zeros(4, np.int32)
+            row[:4] = np.arange(1, 5)
+            logits, cache = llama.prefill_slot_paged(
+                params,
+                jnp.asarray(np.arange(1, 17)[None, :], jnp.int32),
+                jnp.int32(16), jnp.int32(0), jnp.asarray(row), cache, cfg,
+            )
+            tok = jnp.asarray([int(jnp.argmax(logits)), 0], jnp.int32)
+            act = jnp.asarray([True, False])
+            dense_logits, _ = llama.decode_slots_paged(
+                params, tok, dict(cache), act, cfg, window=64, kernel=False
+            )
+            kern_logits, _ = llama.decode_slots_paged(
+                params, tok, dict(cache), act, cfg, window=64, kernel=True
+            )
+            np.testing.assert_allclose(
+                np.asarray(dense_logits[0]), np.asarray(kern_logits[0]),
+                rtol=2e-5, atol=2e-5,
+            )

@@ -593,7 +593,9 @@ class TestProgramKeyAudit:
             kv_cache_dtype="int8",
         )
         assert model._program_config == (3, 2, model.spec_ngram,
-                                         model.spec_hist, "int8")
+                                         model.spec_hist, "int8",
+                                         model.prefill_chunk,
+                                         model.decode_kernel)
 
 
 class TestWarmupVariants:
