@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -88,6 +88,18 @@ chunk-check:
 		-k PagedDecodeAttention
 	JAX_PLATFORMS=cpu BENCH_ONLY=CHUNKED BENCH_RUNS=1 \
 		BENCH_CHUNK_TOKENS=96 $(PYTHON) bench.py
+
+# generation-forensics gate (docs/OBSERVABILITY.md), CPU-safe: timeline
+# ledger unit + scheduler-integration tests, the stitched-trace two-engine
+# disagg e2e (one trace id -> gateway + prefill + export/import + decode
+# spans, /stats/timeline lifecycle for a chunked + speculative request),
+# handoff codec v2 back-compat bit-exactness, QoS-through-frame, host-sync
+# audit with the ledger on; then the obs_overhead bench smoke (decode ITL
+# ledger on vs off + spans/s)
+forensics-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_forensics.py -q
+	JAX_PLATFORMS=cpu BENCH_ONLY=OBS_OVERHEAD BENCH_RUNS=1 \
+		BENCH_OBS_TOKENS=24 $(PYTHON) bench.py
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -919,6 +919,106 @@ def stage_chunked(detail: dict) -> None:
     detail["llm_chunked"] = result
 
 
+def stage_obs_overhead(detail: dict) -> None:
+    """Generation-forensics overhead (docs/OBSERVABILITY.md): decode ITL
+    with the per-request timeline ledger ON vs OFF on the same tiny-llama
+    workload — the ledger must be free at the decode granularity — plus
+    span-recording and timeline-event micro-throughput (events/s the obs
+    plane can absorb before it, not the model, becomes the bottleneck)."""
+    import asyncio
+
+    import jax
+
+    from seldon_core_tpu.executor.generation import (
+        GenerationScheduler,
+        GenerativeModel,
+    )
+    from seldon_core_tpu.models import llama as llama_mod
+    from seldon_core_tpu.obs import RECORDER, TIMELINE
+
+    cfg = llama_mod.Config.tiny(max_seq=128)
+    params = llama_mod.init_params(jax.random.PRNGKey(0), cfg)
+    max_new = int(os.environ.get("BENCH_OBS_TOKENS", "48"))
+    n_req = 4
+    rng = np.random.default_rng(11)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, 12).astype(np.int32)
+        for _ in range(n_req)
+    ]
+
+    def run_workload(model):
+        sched = GenerationScheduler(model)
+
+        async def go():
+            try:
+                await asyncio.gather(
+                    *(
+                        sched.submit(p, max_new_tokens=max_new)
+                        for p in prompts
+                    )
+                )
+            finally:
+                await sched.close()
+
+        asyncio.run(go())
+
+    def itl_p50(ledger_on: bool) -> float | None:
+        model = GenerativeModel(
+            cfg, params, n_slots=n_req, decode_block=8, name="obs-bench"
+        )
+        was = TIMELINE.enabled
+        TIMELINE.enabled = ledger_on
+        try:
+            run_workload(model)  # warmup: compiles off the clock
+            run_workload(model)
+        finally:
+            TIMELINE.enabled = was
+        snap = model.spec_snapshot()
+        return snap.get("itl_p50_ms")
+
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
+    on_runs = [itl_p50(True) for _ in range(runs)]
+    off_runs = [itl_p50(False) for _ in range(runs)]
+    on_p50 = sorted(v for v in on_runs if v is not None)
+    off_p50 = sorted(v for v in off_runs if v is not None)
+    itl_on = on_p50[len(on_p50) // 2] if on_p50 else None
+    itl_off = off_p50[len(off_p50) // 2] if off_p50 else None
+
+    # micro-throughput: spans/s and timeline events/s the obs plane absorbs
+    t0 = time.perf_counter()
+    n_spans = 0
+    while time.perf_counter() - t0 < 0.2:
+        with RECORDER.span("bench.obs", service="bench"):
+            pass
+        n_spans += 1
+    spans_s = n_spans / (time.perf_counter() - t0)
+    tl = TIMELINE.begin("bench-obs-overhead", model="obs-bench")
+    n_ev = 0
+    t0 = time.perf_counter()
+    if tl is not None:
+        while time.perf_counter() - t0 < 0.2:
+            # distinct attrs so the consecutive-dedupe fast path is not
+            # the only thing measured
+            tl.event("block", tokens=n_ev & 7)
+            n_ev += 1
+    events_s = n_ev / (time.perf_counter() - t0) if n_ev else None
+
+    detail["obs_overhead"] = {
+        "itl_p50_ms_ledger_on": _sig(itl_on) if itl_on is not None else None,
+        "itl_p50_ms_ledger_off": _sig(itl_off) if itl_off is not None else None,
+        "itl_on_vs_off": (
+            _sig(itl_on / itl_off) if itl_on and itl_off else None
+        ),
+        "spans_per_s": _sig(spans_s),
+        "timeline_events_per_s": (
+            _sig(events_s) if events_s is not None else None
+        ),
+        "runs": runs,
+        "model": f"llama tiny, {n_req} slots x {max_new} tokens, greedy; "
+                 "ledger toggled via TIMELINE.enabled",
+    }
+
+
 def stage_resnet(detail: dict) -> None:
     """ResNet-50 wire-served over the BINARY path — BASELINE config #3's
     model and the north star's named workload (SURVEY §6).
@@ -1557,6 +1657,7 @@ def main() -> None:
         ("OVERLOAD", "BENCH_SKIP_OVERLOAD", stage_overload),
         ("CACHE", "BENCH_SKIP_CACHE", stage_cache),
         ("DISAGG", "BENCH_SKIP_DISAGG", stage_disagg),
+        ("OBS_OVERHEAD", "BENCH_SKIP_OBS_OVERHEAD", stage_obs_overhead),
     ]
     only = os.environ.get("BENCH_ONLY", "").upper()
     for name, skip_env, fn in stages:
@@ -1646,6 +1747,8 @@ _STAGE_HEADLINES = (
     ("disagg_unified", "ttft_p50_ms", "disagg_unified_ttft_p50_ms"),
     ("disagg_split", "ttft_p50_ms", "disagg_split_ttft_p50_ms"),
     ("disagg_split", "ttft_p99_vs_unified", "disagg_ttft_p99_gain"),
+    ("obs_overhead", "itl_on_vs_off", "obs_itl_ledger_on_vs_off"),
+    ("obs_overhead", "spans_per_s", "obs_spans_per_s"),
 )
 
 

@@ -32,7 +32,13 @@ HANDOFF_KEY = "sct:kv-handoff"
 # quantized layout — ``kv_quant: "int8"`` plus per-(position, head)
 # ``k_scale``/``v_scale`` segments that travel verbatim, so an import is
 # bit-exact on the quantized representation with no re-quantization.
-HANDOFF_VERSION = 2
+# v3: adds the forensics/QoS envelope — ``traceparent`` + ``origin_span``
+# (the prefill pool's export span, so the decode pool's import span
+# stitches under the SAME trace), and ``deadline_ms`` + ``priority`` (the
+# client's remaining budget at export, so decode-pool reaping honors the
+# original SLO even when an intermediary strips the QoS headers).  All v3
+# fields are optional: v1/v2 frames decode unchanged and import bit-exact.
+HANDOFF_VERSION = 3
 
 
 class HandoffError(Exception):
@@ -70,6 +76,10 @@ def encode_handoff(
     eos_id: int | None = None,
     k_scale: np.ndarray | None = None,
     v_scale: np.ndarray | None = None,
+    traceparent: str | None = None,
+    origin_span: str | None = None,
+    deadline_ms: float | None = None,
+    priority: str | None = None,
 ) -> bytes:
     """Frame one prefilled request for the engine→engine handoff.
 
@@ -79,7 +89,10 @@ def encode_handoff(
     quantized blocks plus their ``k_scale``/``v_scale``
     ``(layers, n_prompt_blocks, block_size, kv_heads)`` — codec v2 carries
     the quantized representation verbatim (bit-exact import, no
-    re-quantization on either side)."""
+    re-quantization on either side).  ``traceparent``/``origin_span`` and
+    ``deadline_ms``/``priority`` are the v3 forensics/QoS envelope — the
+    importer's span stitches under the exporter's trace and its reaper
+    honors the client's remaining budget."""
     quant = k_scale is not None
     k, kv_dtype = _pack_kv(np.ascontiguousarray(k))
     v, _ = _pack_kv(np.ascontiguousarray(v))
@@ -95,6 +108,14 @@ def encode_handoff(
         "k": k,
         "v": v,
     }
+    if traceparent:
+        payload["traceparent"] = str(traceparent)
+    if origin_span:
+        payload["origin_span"] = str(origin_span)
+    if deadline_ms is not None:
+        payload["deadline_ms"] = max(1.0, float(deadline_ms))
+    if priority:
+        payload["priority"] = str(priority)
     if quant:
         ks, scale_dtype = _pack_kv(np.ascontiguousarray(k_scale))
         vs, _ = _pack_kv(np.ascontiguousarray(v_scale))
@@ -152,11 +173,21 @@ def build_handoff_frame(
     eos_id: int | None = None,
 ) -> bytes:
     """Export ``slot``'s prompt KV from ``model`` and frame the handoff
-    (runs on a worker thread — the export is a device fetch).  An int8
-    pool exports its quantized blocks + scales (codec v2)."""
+    (runs on a worker thread — the export is a device fetch; contextvars
+    carry the caller's trace + QoS into the thread).  An int8 pool exports
+    its quantized blocks + scales (codec v2); the v3 envelope stamps the
+    CURRENT traceparent (the export span, when the caller opened one) and
+    the remaining deadline budget so the decode pool stitches and reaps
+    against the original request."""
+    from seldon_core_tpu import qos
+    from seldon_core_tpu.utils.tracectx import get_traceparent, parse_traceparent
+
     out = model.export_slot_kv(slot, int(np.asarray(prompt).size))
     k, v = out[0], out[1]
     k_scale, v_scale = (out[2], out[3]) if len(out) == 4 else (None, None)
+    tp = get_traceparent()
+    parsed = parse_traceparent(tp)
+    remaining = qos.remaining_s()
     return encode_handoff(
         prompt,
         first_token,
@@ -168,7 +199,37 @@ def build_handoff_frame(
         eos_id=eos_id,
         k_scale=k_scale,
         v_scale=v_scale,
+        traceparent=tp if parsed else None,
+        origin_span=parsed[1] if parsed else None,
+        deadline_ms=remaining * 1e3 if remaining is not None else None,
+        priority=qos.get_priority(),
     )
+
+
+def seed_qos_from_frame(payload: dict[str, Any]) -> None:
+    """Seed the request context's QoS from the frame's v3 envelope: the
+    TIGHTER of the frame's exported budget and whatever the transport
+    headers already seeded wins (the frame budget was stamped at export,
+    so it can only over-grant the transfer time — never under), and the
+    frame's priority class applies when the headers carried none.  A v1/v2
+    frame (no envelope) leaves the context untouched."""
+    import time as _time
+
+    from seldon_core_tpu import qos
+
+    dl_ms = payload.get("deadline_ms")
+    if dl_ms is not None:
+        try:
+            frame_deadline = _time.monotonic() + float(dl_ms) / 1e3
+        except (TypeError, ValueError):
+            frame_deadline = None
+        if frame_deadline is not None:
+            cur = qos.get_deadline()
+            if cur is None or frame_deadline < cur:
+                qos.set_deadline(frame_deadline)
+    prio = payload.get("priority")
+    if prio:
+        qos.set_priority(qos.parse_priority(prio))
 
 
 async def apply_handoff(component: Any, payload: dict[str, Any]) -> np.ndarray:
@@ -189,6 +250,7 @@ async def apply_handoff(component: Any, payload: dict[str, Any]) -> np.ndarray:
             f"layout {model.kv_dtype or 'float'}; pools must share "
             "kv_cache_dtype"
         )
+    seed_qos_from_frame(payload)
     eos = payload.get("eos_id")
     return await component.scheduler.submit_imported(
         payload["prompt"],

@@ -37,9 +37,12 @@ from seldon_core_tpu.obs import (
     LOOP_LAG,
     RECORDER,
     STAGE_STREAM_FLUSH,
+    TIMELINE,
     WIRE,
     WIRE_ENGINE_REST,
     configure_exporters_from_env,
+    set_engine_role,
+    set_process_role,
     wire_stats_payload,
 )
 from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
@@ -126,6 +129,11 @@ class EngineApp:
         async def _wire_mw(request: web.Request, handler):
             import time as _time
 
+            # every span this request records carries the pool role
+            # (engine.role resource attr — docs/OBSERVABILITY.md): the
+            # contextvar wins over the process default so test harnesses
+            # running several role-typed engines in one process stay honest
+            set_engine_role(self.role)
             t0 = _time.perf_counter()
             resp = await handler(request)
             body = getattr(resp, "body", None)
@@ -189,12 +197,18 @@ class EngineApp:
         r.add_post("/disagg/generate", self.disagg_generate)
         r.add_post("/disagg/import", self.disagg_import)
         r.add_get("/stats/disagg", self.stats_disagg)
+        # per-request generation lifecycle ledger (obs/timeline.py):
+        # ?trace=<id> reconstructs one request's whole story after the fact
+        r.add_get("/stats/timeline", self.stats_timeline)
         app.on_startup.append(self._startup)
         app.on_cleanup.append(self._cleanup)
         return app
 
     async def _startup(self, app: web.Application) -> None:
         configure_exporters_from_env()
+        # spans recorded outside a request context (scheduler loop,
+        # executor threads) still get the pool's engine.role attribute
+        set_process_role(self.role)
         LOOP_LAG.start("engine")
         await self.service.start()
         if self.service.response_cache is not None and self.service.graph_deterministic():
@@ -564,7 +578,39 @@ class EngineApp:
         return web.Response(text="unpaused")
 
     async def prometheus(self, request: web.Request) -> web.Response:
+        # scrape-time refresh of the seldon_kv_* pool gauges: occupancy is
+        # host bookkeeping, so reading it here costs no device sync and
+        # the decode hot path never pays for gauge updates
+        for unit in self._generative_units_or_empty():
+            snap = getattr(unit.model, "pool_snapshot", None)
+            if callable(snap):
+                snap()
         return web.Response(body=self.metrics.expose(), content_type="text/plain")
+
+    def _generative_units_or_empty(self) -> list:
+        try:
+            return self.service.generative_units()
+        except Exception:
+            return []
+
+    async def stats_timeline(self, request: web.Request) -> web.Response:
+        """Per-request generation lifecycle ledger (obs/timeline.py):
+        ``?trace=<id>`` returns every entry recorded for that trace
+        (a disagg request shows its prefill-pool and decode-pool legs),
+        otherwise the most recent ``n`` entries plus ledger counters."""
+        trace = request.query.get("trace")
+        if trace:
+            return web.json_response({"timeline": TIMELINE.by_trace(trace)})
+        try:
+            n = int(request.query.get("n", "20"))
+        except ValueError:
+            n = 20
+        return web.json_response(
+            {
+                "timeline": TIMELINE.recent(max(1, min(n, 200))),
+                **TIMELINE.snapshot(),
+            }
+        )
 
     async def stats_spans(self, request: web.Request) -> web.Response:
         """Recent traces + slowest-N root spans from the in-process ring."""
@@ -734,20 +780,27 @@ class EngineApp:
                     h["code"] = "400"
                     return web.json_response(_status_body(400, str(e)), status=400)
                 prompt = np.asarray(prompt, np.int32)
-                if (
-                    self.role == disagg_mod.ROLE_PREFILL
-                    and self.decode_upstreams
-                    and max_new > 1
-                ):
-                    tokens, mode = await self._prefill_and_handoff(
-                        unit, prompt, max_new, temperature, eos
-                    )
-                else:
-                    out = await unit.scheduler.submit(
-                        prompt, max_new_tokens=max_new,
-                        temperature=temperature, eos_id=eos,
-                    )
-                    tokens, mode = [int(t) for t in out], "unified"
+                # the request's generation span: child of the gateway/client
+                # trace, parent of the prefill + handoff spans — the frame
+                # carries the export span's id so the decode pool's import
+                # span stitches UNDER this trace (docs/OBSERVABILITY.md)
+                with RECORDER.span("disagg.generate", service=dep) as sp:
+                    if (
+                        self.role == disagg_mod.ROLE_PREFILL
+                        and self.decode_upstreams
+                        and max_new > 1
+                    ):
+                        tokens, mode = await self._prefill_and_handoff(
+                            unit, prompt, max_new, temperature, eos
+                        )
+                    else:
+                        out = await unit.scheduler.submit(
+                            prompt, max_new_tokens=max_new,
+                            temperature=temperature, eos_id=eos,
+                        )
+                        tokens, mode = [int(t) for t in out], "unified"
+                    if sp is not None:
+                        sp.set_attr("mode", mode)
                 return web.json_response({"tokens": tokens, "mode": mode})
             except qos.QosRejection as e:
                 h["code"] = str(e.status)
@@ -763,24 +816,49 @@ class EngineApp:
     ) -> tuple[list[int], str]:
         """Prefill into a pinned slot, export + POST the KV handoff, relay
         the decode peer's tokens.  The slot releases in every outcome —
-        the zero-leak guarantee — and failure degrades to local decode."""
-        slot, tok1 = await unit.scheduler.submit_prefill(
-            prompt, temperature=temperature
-        )
+        the zero-leak guarantee — and failure degrades to local decode.
+        Span shape (docs/OBSERVABILITY.md "cross-pool stitching"):
+        ``disagg.prefill`` times the pinned prefill, ``handoff.export``
+        covers the KV fetch + framing (the frame's traceparent IS this
+        span, so the decode pool's import span becomes its child), and
+        ``handoff.relay`` times the POST + token relay."""
+        from seldon_core_tpu.utils.tracectx import current_trace_id
+
+        dep = self.service.deployment_name
+        with RECORDER.span("disagg.prefill", service=dep) as psp:
+            slot, tok1 = await unit.scheduler.submit_prefill(
+                prompt, temperature=temperature
+            )
+            if psp is not None:
+                psp.set_attr("slot", slot)
         try:
             from seldon_core_tpu.disagg.handoff import build_handoff_frame
 
-            frame = await asyncio.to_thread(
-                build_handoff_frame, unit.model, slot, prompt, tok1,
-                max_new_tokens=max_new, temperature=temperature, eos_id=eos,
-            )
-            tokens = await self._send_handoff(frame)
+            with RECORDER.span("handoff.export", service=dep) as esp:
+                # build_handoff_frame runs IN this span's context (to_thread
+                # copies contextvars): the frame's traceparent names this
+                # span as the origin the importer stitches under
+                frame = await asyncio.to_thread(
+                    build_handoff_frame, unit.model, slot, prompt, tok1,
+                    max_new_tokens=max_new, temperature=temperature, eos_id=eos,
+                )
+                if esp is not None:
+                    esp.set_attr("bytes", len(frame))
+                TIMELINE.note(
+                    current_trace_id(), "handoff-export",
+                    bytes=len(frame), slot=slot,
+                )
+            with RECORDER.span("handoff.relay", service=dep):
+                tokens = await self._send_handoff(frame)
             self.disagg_stats["handoffs_ok"] += 1
             return tokens, "disagg"
         except asyncio.CancelledError:
             raise
         except Exception as e:
             self.disagg_stats["handoffs_failed"] += 1
+            TIMELINE.note(
+                current_trace_id(), "handoff-failed", error=str(e)[:200]
+            )
             log.warning(
                 "KV handoff failed (%s); falling back to unified local decode", e
             )
@@ -878,13 +956,32 @@ class EngineApp:
                     return web.json_response(
                         _status_body(400, f"bad handoff frame: {e}"), status=400
                     )
-                try:
-                    out = await apply_handoff(unit, payload)
-                except HandoffError as e:
-                    # decodable frame, incompatible pool (block size skew)
-                    self.disagg_stats["imports_failed"] += 1
-                    h["code"] = "409"
-                    return web.json_response(_status_body(409, str(e)), status=409)
+                # cross-pool stitching (docs/OBSERVABILITY.md): a v3 frame
+                # carries the prefill pool's traceparent — re-parent this
+                # request onto it so the import span (and every generation
+                # span under it) is a linked child of the EXPORT span, even
+                # when an intermediary stripped the trace headers
+                frame_tp = payload.get("traceparent")
+                if frame_tp:
+                    set_traceparent(str(frame_tp))
+                with RECORDER.span("disagg.import", service=dep) as isp:
+                    if isp is not None:
+                        isp.set_attr("handoff.version", int(payload.get("hv", 1)))
+                        if payload.get("origin_span"):
+                            isp.set_attr(
+                                "origin_span_id", str(payload["origin_span"])
+                            )
+                    try:
+                        out = await apply_handoff(unit, payload)
+                    except HandoffError as e:
+                        # decodable frame, incompatible pool (block size skew)
+                        self.disagg_stats["imports_failed"] += 1
+                        h["code"] = "409"
+                        if isp is not None:
+                            isp.set_status("ERROR")
+                        return web.json_response(
+                            _status_body(409, str(e)), status=409
+                        )
                 self.disagg_stats["imports_ok"] += 1
                 return web.json_response({"tokens": [int(t) for t in out]})
             except qos.QosRejection as e:

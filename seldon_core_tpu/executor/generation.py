@@ -51,7 +51,8 @@ import numpy as np
 
 from seldon_core_tpu import qos
 from seldon_core_tpu.graph.units import GraphUnitError, SeldonComponent
-from seldon_core_tpu.obs import RECORDER, STAGE_DEVICE_STEP, STAGE_TTFT
+from seldon_core_tpu.obs import RECORDER, STAGE_DEVICE_STEP, STAGE_TTFT, TIMELINE
+from seldon_core_tpu.utils.tracectx import current_trace_id
 from seldon_core_tpu.parallel.sharding import (
     DEFAULT_RULES,
     ShardingRules,
@@ -678,6 +679,41 @@ class GenerativeModel:
         self.prefills_reused = 0  # prefills that skipped a reused prefix
         self.prefill_chunks = 0  # chunked-prefill chunk dispatches
         self.imports = 0  # disagg KV handoffs imported into this pool
+        # KV/HBM pool ledger (docs/OBSERVABILITY.md "generation forensics"):
+        # high-water mark of blocks in use, and the byte classes the HBM
+        # budget splits into — served on /stats/breakdown and as the
+        # seldon_kv_* gauges so router/autoscaler pressure decisions are
+        # debuggable after the fact
+        self._blocks_high_water = 0
+        self.param_bytes = sum(
+            int(np.prod(x.shape)) * x.dtype.itemsize
+            for x in jax.tree.leaves(self.params)
+        )
+        # program-cache telemetry: hits vs compiles across the dict-cached
+        # program families (decode, decode_k, suffix-prefill), per-variant
+        # compile seconds (warmup-attributed or measured at the first
+        # serving call), and a bounded recent-compiles ring — a mid-traffic
+        # recompile becomes a program.compile span instead of a mystery
+        # latency spike
+        self.program_hits = 0
+        self.program_compiles = 0
+        from collections import deque as _deque
+
+        self._program_events: _deque = _deque(maxlen=64)
+        self.warmup_program_seconds: dict[str, float] = {}
+        self._in_warmup = False
+        # static program-variant tag shared by warmup labels, profiler
+        # TraceAnnotations, and compile telemetry (e.g. "[spec4,int8]")
+        tag = []
+        if self.spec_draft:
+            tag.append(f"spec{self.spec_draft}")
+        if self.kv_dtype:
+            tag.append(self.kv_dtype)
+        if self.prefill_chunk:
+            tag.append(f"chunk{self.prefill_chunk}")
+        if self.decode_kernel:
+            tag.append("kernel")
+        self.variant_sfx = ("[" + ",".join(tag) + "]") if tag else ""
         # per-slot inter-token latency ledger (fed by the scheduler's
         # delivery loop): bounded ring for the /stats/breakdown percentiles
         # plus the seldon_itl_seconds histogram.  Each sample is one
@@ -719,6 +755,41 @@ class GenerativeModel:
         if not self._itl:
             return None
         return float(np.percentile(np.asarray(self._itl), q))
+
+    def _note_compile(self, label: str, seconds: float) -> None:
+        """Program-cache telemetry for one fresh compile: the bounded
+        recent-compiles ring, per-variant seconds, the prometheus counter,
+        and — OUTSIDE warmup, where a compile means readiness lied about
+        coverage — a ``program.compile`` root span so the latency spike it
+        caused is attributable from /stats/spans."""
+        seconds = round(seconds, 3)
+        self._program_events.append(
+            {
+                "label": label,
+                "seconds": seconds,
+                "ts": time.time(),
+                "warmup": self._in_warmup,
+            }
+        )
+        self.warmup_program_seconds.setdefault(label, seconds)
+        DEFAULT_METRICS.program_compiles.labels(self.name).inc()
+        if not self._in_warmup:
+            from seldon_core_tpu.utils.tracectx import make_trace_id
+
+            RECORDER.record_span(
+                "program.compile",
+                trace_id=make_trace_id(),
+                parent_id=None,
+                start=time.time() - seconds,
+                duration_s=seconds,
+                service=self.name,
+                attrs={"variant": label, "model": self.name},
+            )
+            log.warning(
+                "generative model %r: mid-traffic program compile %s "
+                "(%.3fs) — warmup did not cover this variant",
+                self.name, label, seconds,
+            )
 
     def _record_step(self, step_s: float, tokens_emitted: int) -> None:
         """Flight-recorder + metrics for one decode dispatch (runs on the
@@ -766,21 +837,26 @@ class GenerativeModel:
                 self.prefills_reused += 1
 
     def _exec_prefill(self, payload: dict):
-        """Symmetric prefill body (runs on every slice process)."""
+        """Symmetric prefill body (runs on every slice process).  The
+        TraceAnnotation names the dispatch after its program-cache variant
+        label so a /profile/start capture lines up with span names and
+        /stats/warmup entries."""
+        label = f"prefill:b{int(payload['padded'].shape[1])}{self.variant_sfx}"
         with self._lock:
-            tok, self._cache = self._prefill(
-                self.params,
-                payload["padded"],
-                np.int32(payload["length"]),
-                np.int32(payload["slot"]),
-                np.asarray(payload["blocks"], np.int32),
-                np.float32(payload["temperature"]),
-                np.int32(payload["seed"]),
-                np.asarray(
-                    payload.get("hist_seed", _NO_HIST), np.int32
-                ),
-                self._cache,
-            )
+            with jax.profiler.TraceAnnotation(label):
+                tok, self._cache = self._prefill(
+                    self.params,
+                    payload["padded"],
+                    np.int32(payload["length"]),
+                    np.int32(payload["slot"]),
+                    np.asarray(payload["blocks"], np.int32),
+                    np.float32(payload["temperature"]),
+                    np.int32(payload["seed"]),
+                    np.asarray(
+                        payload.get("hist_seed", _NO_HIST), np.int32
+                    ),
+                    self._cache,
+                )
             self._count_prefill(payload)
         return tok
 
@@ -825,6 +901,9 @@ class GenerativeModel:
         got = self._free_blocks[-own_need:] if own_need else []
         if own_need:
             del self._free_blocks[-own_need:]
+        used = (self.kv_blocks - 1) - len(self._free_blocks)
+        if used > self._blocks_high_water:
+            self._blocks_high_water = used
         self._slot_blocks[slot] = got
         if self.prefix_index is not None and prompt is not None:
             self._slot_prompt[slot] = np.asarray(prompt, np.int32).copy()
@@ -1325,11 +1404,99 @@ class GenerativeModel:
             hbm_bytes = int(
                 float(os.environ.get("SCT_HBM_GB", "16")) * (1 << 30)
             )
-        param_bytes = sum(
-            int(np.prod(x.shape)) * x.dtype.itemsize
-            for x in jax.tree.leaves(self.params)
+        return max(
+            0, int((hbm_bytes - self.param_bytes) // self.kv_bytes_per_slot())
         )
-        return max(0, int((hbm_bytes - param_bytes) // self.kv_bytes_per_slot()))
+
+    def reservation_snapshot(self, slot: int) -> dict | None:
+        """Host-side reservation bookkeeping for ``slot`` (None when it
+        holds none) — feeds the timeline ledger's admit event with the
+        prefix-reuse depth and block split, from values the host already
+        holds (no device touch)."""
+        slot = int(slot)
+        if self._slot_row.get(slot) is None:
+            return None
+        matched = self._slot_matched.get(slot, 0)
+        return {
+            "blocks_reused": matched,
+            "blocks_allocated": len(self._slot_blocks.get(slot, ())),
+            "prefix_tokens": matched * self.kv_block_size,
+        }
+
+    def pool_snapshot(self) -> dict:
+        """The KV/HBM pool ledger (docs/OBSERVABILITY.md): block occupancy
+        by holder (free / prefix index / slot reservations), high-water
+        mark, byte classes (weights / KV pool / int8 scales), and the
+        prefix-index churn counters.  Also refreshes the ``seldon_kv_*``
+        gauges — called at /stats/breakdown and /prometheus scrape time,
+        never on the decode hot path."""
+        total = self.kv_blocks - 1
+        free = len(self._free_blocks)
+        prefix_held = len(self.prefix_index) if self.prefix_index is not None else 0
+        slot_held = sum(len(b) for b in self._slot_blocks.values())
+        kv_bytes = int(self._cache["k"].nbytes) + int(self._cache["v"].nbytes)
+        scale_bytes = (
+            int(self._cache["k_scale"].nbytes) + int(self._cache["v_scale"].nbytes)
+            if "k_scale" in self._cache
+            else 0
+        )
+        snap = {
+            "blocks": {
+                "total": total,
+                "free": free,
+                "prefix_index": prefix_held,
+                "slots": slot_held,
+                "high_water": self._blocks_high_water,
+                "block_size": self.kv_block_size,
+            },
+            "bytes": {
+                "weights": self.param_bytes,
+                "kv_pool": kv_bytes,
+                "kv_scales": scale_bytes,
+                "per_slot": self.kv_bytes_per_slot(),
+            },
+            "prefix_evictions": (
+                self.prefix_index.evicted if self.prefix_index is not None else 0
+            ),
+            "prefix_insertions": (
+                self.prefix_index.inserted if self.prefix_index is not None else 0
+            ),
+        }
+        m = DEFAULT_METRICS
+        for state, val in (
+            ("free", free),
+            ("prefix_index", prefix_held),
+            ("slots", slot_held),
+        ):
+            m.kv_blocks.labels(self.name, state).set(val)
+        m.kv_blocks_high_water.labels(self.name).set(self._blocks_high_water)
+        for cls, val in (
+            ("weights", self.param_bytes),
+            ("kv_pool", kv_bytes),
+            ("kv_scales", scale_bytes),
+        ):
+            m.kv_bytes.labels(self.name, cls).set(val)
+        m.kv_prefix_evictions.labels(self.name).set(snap["prefix_evictions"])
+        return snap
+
+    def program_snapshot(self) -> dict:
+        """Program-cache telemetry: hits vs fresh compiles across the
+        dict-cached program families, per-variant compile seconds (warmup
+        or first serving call), and the bounded recent-compiles ring —
+        ``warmup: false`` entries are the mid-traffic recompiles that also
+        produced a ``program.compile`` span."""
+        return {
+            "compiles": self.program_compiles,
+            "hits": self.program_hits,
+            "cached": (
+                1  # the monolithic prefill program
+                + len(self._decode_jit)
+                + len(self._decode_k_jit)
+                + len(self._prefill_suffix_jit)
+            ),
+            "variant_seconds": dict(self.warmup_program_seconds),
+            "recent_compiles": list(self._program_events),
+        }
 
     def spec_snapshot(self) -> dict:
         """Device-frontier state for ``GET /stats/breakdown`` and bench:
@@ -1367,6 +1534,10 @@ class GenerativeModel:
                 if self._itl else None
             ),
             "itl_samples": len(self._itl),
+            # generation-forensics ledgers (docs/OBSERVABILITY.md): KV/HBM
+            # pool occupancy + byte classes, and program-cache churn
+            "pool": self.pool_snapshot(),
+            "programs": self.program_snapshot(),
         }
 
     def _prefix_window(self, prefix_len: int) -> int:
@@ -1382,29 +1553,38 @@ class GenerativeModel:
         """Symmetric suffix-prefill body (runs on every slice process)."""
         bucket = int(payload["padded"].shape[1])
         window = int(payload["window"])
+        label = f"suffix:b{bucket}:w{window}{self.variant_sfx}"
         key = (bucket, window) + self._program_config
         fn = self._prefill_suffix_jit.get(key)
-        if fn is None:
+        fresh = fn is None
+        if fresh:
             fn = jax.jit(
                 self._prefill_suffix_factory(window), donate_argnums=(10,)
             )
             self._prefill_suffix_jit[key] = fn
+            self.program_compiles += 1
+        else:
+            self.program_hits += 1
         with self._lock:
-            tok, self._cache = fn(
-                self.params,
-                payload["padded"],
-                np.int32(payload["prefix_len"]),
-                np.int32(payload["length"]),
-                np.int32(payload["slot"]),
-                np.asarray(payload["blocks"], np.int32),
-                np.asarray(payload["suffix_blocks"], np.int32),
-                np.float32(payload["temperature"]),
-                np.int32(payload["seed"]),
-                np.asarray(
-                    payload.get("hist_seed", _NO_HIST), np.int32
-                ),
-                self._cache,
-            )
+            t0 = time.perf_counter()
+            with jax.profiler.TraceAnnotation(label):
+                tok, self._cache = fn(
+                    self.params,
+                    payload["padded"],
+                    np.int32(payload["prefix_len"]),
+                    np.int32(payload["length"]),
+                    np.int32(payload["slot"]),
+                    np.asarray(payload["blocks"], np.int32),
+                    np.asarray(payload["suffix_blocks"], np.int32),
+                    np.float32(payload["temperature"]),
+                    np.int32(payload["seed"]),
+                    np.asarray(
+                        payload.get("hist_seed", _NO_HIST), np.int32
+                    ),
+                    self._cache,
+                )
+            if fresh:
+                self._note_compile(label, time.perf_counter() - t0)
             self._count_prefill(payload, reused=True)
         return tok
 
@@ -1437,20 +1617,29 @@ class GenerativeModel:
 
     def _exec_decode(self, payload: dict):
         window = int(payload.get("window") or self.cfg.max_seq)
+        label = f"decode:w{window}{self.variant_sfx}"
         key = (window,) + self._program_config
         fn = self._decode_jit.get(key)
-        if fn is None:
+        fresh = fn is None
+        if fresh:
             fn = jax.jit(self._decode_factory(window), donate_argnums=(5,))
             self._decode_jit[key] = fn
+            self.program_compiles += 1
+        else:
+            self.program_hits += 1
         with self._lock:
-            toks, self._cache = fn(
-                self.params,
-                np.asarray(payload["tokens"], np.int32),
-                np.asarray(payload["active"], bool),
-                np.asarray(payload["temperature"], np.float32),
-                np.int32(payload["seed"]),
-                self._cache,
-            )
+            t0 = time.perf_counter()
+            with jax.profiler.TraceAnnotation(label):
+                toks, self._cache = fn(
+                    self.params,
+                    np.asarray(payload["tokens"], np.int32),
+                    np.asarray(payload["active"], bool),
+                    np.asarray(payload["temperature"], np.float32),
+                    np.int32(payload["seed"]),
+                    self._cache,
+                )
+            if fresh:
+                self._note_compile(label, time.perf_counter() - t0)
             self.steps += 1
         return toks
 
@@ -1605,7 +1794,7 @@ class GenerativeModel:
         self._record_step(time.perf_counter() - t0, int(act_np.sum()))
         return np.asarray(toks_np), act_np
 
-    def _decode_k_fn(self, k: int, window: int):
+    def _decode_k_fn(self, k: int, window: int) -> tuple[Any, bool]:
         # static sampling/speculation/quantization config rides the key so
         # no two configurations can ever share a compiled block program
         key = (k, window) + self._program_config
@@ -1618,25 +1807,33 @@ class GenerativeModel:
                 self._decode_k_factory(k, window), donate_argnums=(1, 2, 6, 7)
             )
             self._decode_k_jit[key] = fn
-        return fn
+            self.program_compiles += 1
+            return fn, True
+        self.program_hits += 1
+        return fn, False
 
     def _exec_decode_k(self, payload: dict):
         k = int(payload["k"])
         window = int(payload.get("window") or self.cfg.max_seq)
-        fn = self._decode_k_fn(k, window)
+        fn, fresh = self._decode_k_fn(k, window)
+        label = f"decode_k:k{k}:w{window}{self.variant_sfx}"
         with self._lock:
             temps = np.asarray(payload["temperature"], np.float32)
             eos = np.asarray(payload["eos"], np.int32)
-            (toks_seq, act_seq, tok_c, act_c, rem_c, self._cache) = fn(
-                self.params,
-                np.asarray(payload["tokens"], np.int32),
-                np.asarray(payload["active"], bool),
-                temps,
-                np.int32(payload["seed"]),
-                eos,
-                np.asarray(payload["remaining"], np.int32),
-                self._cache,
-            )
+            t0 = time.perf_counter()
+            with jax.profiler.TraceAnnotation(label):
+                (toks_seq, act_seq, tok_c, act_c, rem_c, self._cache) = fn(
+                    self.params,
+                    np.asarray(payload["tokens"], np.int32),
+                    np.asarray(payload["active"], bool),
+                    temps,
+                    np.int32(payload["seed"]),
+                    eos,
+                    np.asarray(payload["remaining"], np.int32),
+                    self._cache,
+                )
+            if fresh:
+                self._note_compile(label, time.perf_counter() - t0)
             self._carry = (tok_c, act_c, rem_c)
             self._carry_aux = (temps, eos)
             self.steps += k
@@ -1647,7 +1844,8 @@ class GenerativeModel:
         block's inputs are THIS process's stored device carry."""
         k = int(payload["k"])
         window = int(payload.get("window") or self.cfg.max_seq)
-        fn = self._decode_k_fn(k, window)
+        fn, fresh = self._decode_k_fn(k, window)
+        label = f"decode_k:k{k}:w{window}{self.variant_sfx}"
         with self._lock:
             if self._carry is None or self._carry_aux is None:
                 raise RuntimeError(
@@ -1656,16 +1854,20 @@ class GenerativeModel:
                 )
             tok_c, act_c, rem_c = self._carry
             temps, eos = self._carry_aux
-            (toks_seq, act_seq, tok_c, act_c, rem_c, self._cache) = fn(
-                self.params,
-                tok_c,
-                act_c,
-                temps,
-                np.int32(payload["seed"]),
-                eos,
-                rem_c,
-                self._cache,
-            )
+            t0 = time.perf_counter()
+            with jax.profiler.TraceAnnotation(label):
+                (toks_seq, act_seq, tok_c, act_c, rem_c, self._cache) = fn(
+                    self.params,
+                    tok_c,
+                    act_c,
+                    temps,
+                    np.int32(payload["seed"]),
+                    eos,
+                    rem_c,
+                    self._cache,
+                )
+            if fresh:
+                self._note_compile(label, time.perf_counter() - t0)
             self._carry = (tok_c, act_c, rem_c)
             self.steps += k
         return toks_seq, act_seq
@@ -1688,28 +1890,28 @@ class GenerativeModel:
             # program-variant tag: the static config each compiled program
             # bakes in — /stats/warmup shows it so readiness demonstrably
             # covered the speculative-verify and int8 variants actually
-            # served (not just their plain-path namesakes)
-            tag = []
-            if self.spec_draft:
-                tag.append(f"spec{self.spec_draft}")
-            if self.kv_dtype:
-                tag.append(self.kv_dtype)
-            if self.prefill_chunk:
-                tag.append(f"chunk{self.prefill_chunk}")
-            if self.decode_kernel:
-                tag.append("kernel")
-            sfx = ("[" + ",".join(tag) + "]") if tag else ""
+            # served (not just their plain-path namesakes).  Compiles in
+            # here are warmup-attributed (no program.compile span); their
+            # per-variant seconds land in warmup_program_seconds for the
+            # program-cache telemetry to join.
+            self._in_warmup = True
+            sfx = self.variant_sfx
             # with chunking on, an admission longer than one chunk compiles
             # the chunk-0 bucket plus suffix programs per chunk boundary
             # window — exactly the serving set; the variant list names them
             # so readiness provably covered the chunk pipeline
             suffix_before = set(self._prefill_suffix_jit)
             for b in self.prefill_buckets:
+                t0 = time.perf_counter()
                 self.admit(0, np.ones(b, np.int32), 0.0, 0)
                 if not self.prefill_chunk or b <= self.prefill_chunk:
                     # monolithic program for this bucket really compiled
                     # (longer admissions run the chunk pipeline instead)
                     self.warmup_programs.append(f"prefill:b{b}{sfx}")
+                    self.warmup_program_seconds.setdefault(
+                        f"prefill:b{b}{sfx}",
+                        round(time.perf_counter() - t0, 3),
+                    )
                     n += 1
             if self.prefill_chunk:
                 for key in sorted(
@@ -1792,6 +1994,7 @@ class GenerativeModel:
                 self.prefills, self.prefills_reused = pf, pfr
             # warmup wrote garbage into slot 0 and advanced nothing real
             self.reset()
+            self._in_warmup = False
             return n
 
     def _prefix_windows(self) -> list[int]:
@@ -1884,6 +2087,11 @@ class _Request:
     # and first token arrived from another engine's handoff
     prefill_only: bool = False
     imported: dict | None = None
+    # generation-forensics ledger entry (obs/timeline.py; None when the
+    # ledger is off) and the terminal reason _token_done computed — every
+    # event is stamped from host-held values only
+    timeline: Any = None
+    done_reason: str | None = None
 
 
 class GenerationScheduler:
@@ -1948,6 +2156,47 @@ class GenerationScheduler:
         self._seed = (self._seed + 1) % (2**31 - 1)
         return self._seed
 
+    # ------------------------------------------- lifecycle timeline feeds
+    # (obs/timeline.py; docs/OBSERVABILITY.md "generation forensics").
+    # Every event is stamped from values the host ALREADY holds — fetched
+    # token counts, reservation bookkeeping, queue state — never a device
+    # array: the <=1-sync-per-fused-block audit runs with the ledger on.
+
+    def _begin_tl(self, req: _Request, kind: str = "generate") -> None:
+        req.timeline = TIMELINE.begin(
+            current_trace_id(),
+            model=self.model.name,
+            kind=kind,
+            prompt_tokens=int(req.prompt.size),
+            max_new_tokens=int(req.max_new_tokens),
+            priority=req.priority,
+        )
+
+    def _tl(self, req: _Request, name: str, span: bool = True, **attrs) -> None:
+        """One lifecycle event: the timeline entry plus (bounded) the same
+        event folded onto the request's generation span."""
+        if req.timeline is not None:
+            req.timeline.event(name, **attrs)
+        if span and req.span is not None and len(req.span.span.events) < 256:
+            req.span.event(name, **attrs)
+
+    def _end_tl(self, req: _Request, reason: str, **attrs) -> None:
+        if req.done_reason is None:
+            req.done_reason = reason
+        if req.timeline is not None:
+            req.timeline.end(reason, **attrs)
+        if req.span is not None and len(req.span.span.events) < 256:
+            req.span.event("terminal", reason=reason, **attrs)
+
+    def _note_shed(self, priority: str, depth: int, cap: int) -> None:
+        """A QueueFull shed leaves a terminal-only timeline entry so the
+        trace's forensics say WHY the request never ran."""
+        tl = TIMELINE.begin(
+            current_trace_id(), model=self.model.name, priority=priority
+        )
+        if tl is not None:
+            tl.end("shed", depth=depth, cap=cap)
+
     async def submit(
         self,
         prompt: np.ndarray,
@@ -1997,6 +2246,7 @@ class GenerationScheduler:
             else self._batch_cap
         )
         if self._maxsize and depth >= cap:
+            self._note_shed(priority, depth, cap)
             raise qos.QueueFull(
                 f"generation queue is full ({depth} waiting, cap {cap} "
                 f"for {priority})"
@@ -2012,6 +2262,8 @@ class GenerationScheduler:
             span=current_span(),
             priority=priority, deadline=qos.get_deadline(),
         )
+        self._begin_tl(req)
+        self._tl(req, "queued", span=False, depth=len(self._waiting))
         self._waiting.append(req)
         self._wake.set()
         try:
@@ -2024,6 +2276,7 @@ class GenerationScheduler:
                 self._waiting.remove(req)
             if req in self._overflow:
                 self._overflow.remove(req)
+            self._end_tl(req, "disconnect", stage="queue")
             raise
 
     # ------------------------------------------------------ disagg entries
@@ -2053,12 +2306,14 @@ class GenerationScheduler:
             else self._batch_cap
         )
         if self._maxsize and depth >= cap:
+            self._note_shed(req.priority, depth, cap)
             raise qos.QueueFull(
                 f"generation queue is full ({depth} waiting, cap {cap} "
                 f"for {req.priority})"
             )
         if self._task is None or self._task.done():
             self._task = asyncio.get_running_loop().create_task(self._run())
+        self._tl(req, "queued", span=False, depth=len(self._waiting))
         self._waiting.append(req)
         self._wake.set()
 
@@ -2070,6 +2325,7 @@ class GenerationScheduler:
                 self._waiting.remove(req)
             if req in self._overflow:
                 self._overflow.remove(req)
+            self._end_tl(req, "disconnect", stage="queue")
             raise
 
     async def submit_prefill(
@@ -2093,6 +2349,7 @@ class GenerationScheduler:
             priority=qos.get_priority(), deadline=qos.get_deadline(),
         )
         req.prefill_only = True
+        self._begin_tl(req, kind="prefill")
         self._enqueue(req)
         return await self._await_withdrawing(req)
 
@@ -2135,6 +2392,7 @@ class GenerationScheduler:
             "first_token": int(first_token), "k": k, "v": v,
             "k_scale": k_scale, "v_scale": v_scale,
         }
+        self._begin_tl(req, kind="imported")
         self._enqueue(req)
         return await self._await_withdrawing(req)
 
@@ -2168,6 +2426,12 @@ class GenerationScheduler:
 
     # ---------------------------------------------------------------- loop
 
+    def _finish_tl(self, req: _Request) -> None:
+        """Terminal timeline event for a completed request — called AFTER
+        the block event that delivered its last token, so the event order
+        reads admit -> blocks -> terminal."""
+        self._end_tl(req, req.done_reason or "budget", tokens=len(req.out))
+
     def _complete(self, req: _Request) -> None:
         if not req.future.done():
             req.future.set_result(np.asarray(req.out, np.int32))
@@ -2198,8 +2462,12 @@ class GenerationScheduler:
                 log.exception("on_token hook failed; detaching it")
                 req.on_token = None
         if req.eos_id is not None and tok == req.eos_id:
+            req.done_reason = "eos"
             return True
-        return len(req.out) >= req.max_new_tokens
+        if len(req.out) >= req.max_new_tokens:
+            req.done_reason = "budget"
+            return True
+        return False
 
     def _reap_queues(self) -> None:
         """Pre-admission QoS sweep: drop abandoned requests (client gone →
@@ -2226,6 +2494,7 @@ class GenerationScheduler:
                             "qos-drop", reason="deadline",
                             stage="generation-queue",
                         )
+                    self._end_tl(req, "deadline-reap", stage="queue")
                     continue
                 keep.append(req)
             q[:] = keep
@@ -2256,6 +2525,13 @@ class GenerationScheduler:
                 qos.note_deadline_miss("decode", req.priority)
                 if req.span is not None:
                     req.span.event("qos-drop", reason="deadline", stage="decode")
+                self._end_tl(
+                    req, "deadline-reap", stage="decode", tokens=len(req.out)
+                )
+            else:
+                self._end_tl(
+                    req, "disconnect", stage="decode", tokens=len(req.out)
+                )
             slots[i] = None
             active[i] = False
             self.model.release_slot(i)
@@ -2291,6 +2567,12 @@ class GenerationScheduler:
         # every live slot's sample; TTFT and device-step never see it.
         # getattr: duck-typed stand-in models (tests) predate the ledger.
         note_itl = getattr(self.model, "note_itl", None)
+        # timeline: one "block" event per (fetched block, slot) from the
+        # ALREADY-fetched emitted mask — with speculation on it carries the
+        # per-block draft/accept split (passes that ran vs tokens emitted),
+        # host-side arithmetic only
+        spec_d = getattr(self.model, "spec_draft", 0)
+        tps = getattr(self.model, "_tps", 1)
         for i in range(S):
             req = reqs[i]
             if req is None or not counts[i]:
@@ -2298,6 +2580,24 @@ class GenerationScheduler:
             if req.t_last_tok and note_itl is not None:
                 note_itl((now - req.t_last_tok) / counts[i])
             req.t_last_tok = now
+            if req.timeline is not None or req.span is not None:
+                attrs = {"tokens": counts[i]}
+                if spec_d and toks_seq.shape[0] % tps == 0:
+                    passes = int(
+                        np.asarray(act_seq[:, i])
+                        .reshape(-1, tps)
+                        .any(axis=1)
+                        .sum()
+                    )
+                    attrs.update(
+                        passes=passes,
+                        drafted=passes * spec_d,
+                        accepted=max(0, counts[i] - passes),
+                    )
+                self._tl(req, "block", **attrs)
+            if slots[i] is None and req.done_reason is not None:
+                # completed in this block: terminal AFTER its block event
+                self._finish_tl(req)
 
     def _fail_inflight(self, slots, active, exc: BaseException) -> None:
         """A failed device step poisons every in-flight request,
@@ -2306,11 +2606,14 @@ class GenerationScheduler:
         for ent in self._prefilling:
             if not ent["req"].future.done():
                 ent["req"].future.set_exception(exc)
+            self._end_tl(ent["req"], "error", stage="prefill")
         self._prefilling.clear()
         self._prefill_slots.clear()
         for i in range(len(slots)):
-            if slots[i] is not None and not slots[i].future.done():
-                slots[i].future.set_exception(exc)
+            if slots[i] is not None:
+                if not slots[i].future.done():
+                    slots[i].future.set_exception(exc)
+                self._end_tl(slots[i], "error", stage="decode")
             slots[i] = None
             self.model.release_slot(i)
         active[:] = False
@@ -2409,6 +2712,15 @@ class GenerationScheduler:
                             # the very release callback we wait for.  The
                             # timeout keeps deadline reaping of parked
                             # queue entries at ~50ms granularity.
+                            for q in (self._waiting, self._overflow):
+                                for r in q:
+                                    # deduped repeat on the timeline; never
+                                    # folded onto the span (a long park
+                                    # would flood it)
+                                    self._tl(
+                                        r, "paused", span=False,
+                                        cause="externals-pinned",
+                                    )
                             self._wake.clear()
                             try:
                                 await asyncio.wait_for(
@@ -2481,18 +2793,23 @@ class GenerationScheduler:
                 # work needs a sync point (admission), and a dirty carry
                 # (host-side reap) must be rebuilt from host arrays.
                 nxt: tuple | None = None
-                if (
-                    self.overlap
-                    and not carry_dirty
-                    and active.any()
-                    and not self._waiting
-                    and not self._overflow
-                    # a pending handoff release needs a sync point
-                    and not self._external_release
-                    # a mid-prefill admission needs sync points to advance
-                    # its chunks — overlapping would starve it
-                    and not self._prefilling
-                ):
+                break_cause: str | None = None
+                if self.overlap and active.any():
+                    # the overlap pipeline only continues from the device
+                    # carry in steady state; name WHY it breaks (the cause
+                    # lands on every live stream's timeline — the forensics
+                    # for "this request's ITL spiked right here")
+                    if carry_dirty:
+                        break_cause = "carry-dirty"
+                    elif self._waiting:
+                        break_cause = "admission"
+                    elif self._overflow:
+                        break_cause = "kv-starved"
+                    elif self._external_release:
+                        break_cause = "handoff-release"
+                    elif self._prefilling:
+                        break_cause = "chunked-prefill"
+                if self.overlap and active.any() and break_cause is None:
                     try:
                         nxt = await asyncio.to_thread(
                             self.model.step_k_continue, active, self._next_seed(), k
@@ -2505,6 +2822,13 @@ class GenerationScheduler:
                         )
                         nxt = None
                         carry_dirty = True
+                        break_cause = "dispatch-error"
+                if break_cause is not None:
+                    for i in range(S):
+                        if slots[i] is not None and active[i]:
+                            self._tl(
+                                slots[i], "overlap-break", cause=break_cause
+                            )
                 try:
                     toks_seq, act_seq = await asyncio.to_thread(
                         self.model.step_k_fetch, pending
@@ -2538,15 +2862,19 @@ class GenerationScheduler:
             for ent in self._prefilling:
                 if not ent["req"].future.done():
                     ent["req"].future.set_exception(err)
+                self._end_tl(ent["req"], "error", cause="closed")
             self._prefilling.clear()
             self._prefill_slots.clear()
             for i, req in enumerate(slots):
-                if req is not None and not req.future.done():
-                    req.future.set_exception(err)
+                if req is not None:
+                    if not req.future.done():
+                        req.future.set_exception(err)
+                    self._end_tl(req, "error", cause="closed")
                 self.model.release_slot(i)
             for req in self._overflow:
                 if not req.future.done():
                     req.future.set_exception(err)
+                self._end_tl(req, "error", cause="closed")
             self._overflow.clear()
             raise
 
@@ -2620,20 +2948,31 @@ class GenerationScheduler:
         placed, toks, errors, starved, chunked = await asyncio.to_thread(
             dispatch_and_fetch
         )
+        # timeline admit events come from host-side reservation bookkeeping
+        # (reuse depth, block split) — getattr: stand-in models predate it
+        resnap = getattr(self.model, "reservation_snapshot", lambda s: None)
         for req, slot, plan in chunked:
             if req.future.done():  # client vanished while we reserved
                 self.model.release_slot(slot)
+                self._end_tl(req, "disconnect", stage="prefill")
                 continue
             self._prefilling.append(
                 {"req": req, "slot": slot, "plan": plan, "i": 0}
             )
             self._prefill_slots.add(slot)
+            self._tl(
+                req, "admit", slot=slot, chunked=True,
+                chunks=len(plan["payloads"]), **(resnap(slot) or {}),
+            )
+        for req in starved:
+            self._tl(req, "kv-starved", span=False)
         self._overflow.extend(starved)
         for req, exc in errors:
             if not isinstance(exc, GraphUnitError):
                 log.exception("prefill admission failed", exc_info=exc)
             if not req.future.done():
                 req.future.set_exception(exc)
+            self._end_tl(req, "error", stage="admit")
         for (req, slot, _), tok in zip(placed, toks):
             if req.prefill_only:
                 # disagg handoff: pin the slot (blocks stay reserved for
@@ -2641,12 +2980,23 @@ class GenerationScheduler:
                 # client that vanished mid-prefill releases immediately
                 if req.future.done():
                     self.model.release_slot(slot)
+                    self._end_tl(req, "disconnect", stage="prefill")
                 else:
                     self._external.add(slot)
+                    self._tl(
+                        req, "admit", slot=slot, prefill_only=True,
+                        **(resnap(slot) or {}),
+                    )
                     req.future.set_result((slot, int(tok)))
+                    self._end_tl(req, "exported", slot=slot)
                 continue
+            attrs = resnap(slot) or {}
+            if req.imported is not None:
+                attrs["imported"] = True
+            self._tl(req, "admit", slot=slot, **attrs)
             if self._token_done(req, int(tok)):
                 self._complete(req)
+                self._finish_tl(req)
                 self.model.release_slot(slot)
                 continue
             slots[slot] = req
@@ -2669,6 +3019,7 @@ class GenerationScheduler:
             if req.future.done():  # cancel-on-disconnect mid-prefill
                 self._prefill_slots.discard(ent["slot"])
                 self.model.release_slot(ent["slot"])
+                self._end_tl(req, "disconnect", stage="prefill", chunks=ent["i"])
                 continue
             if req.deadline is not None and now >= req.deadline:
                 req.future.set_exception(qos.DeadlineExceeded(
@@ -2684,6 +3035,9 @@ class GenerationScheduler:
                     )
                 self._prefill_slots.discard(ent["slot"])
                 self.model.release_slot(ent["slot"])
+                self._end_tl(
+                    req, "deadline-reap", stage="prefill", chunks=ent["i"]
+                )
                 continue
             keep.append(ent)
         self._prefilling[:] = keep
@@ -2709,7 +3063,11 @@ class GenerationScheduler:
             self.model.release_slot(slot)
             if not req.future.done():
                 req.future.set_exception(exc)
+            self._end_tl(req, "error", stage="prefill", chunks=ent["i"])
             return
+        self._tl(
+            req, "chunk", i=ent["i"], of=len(plan["payloads"]), last=last
+        )
         ent["i"] += 1
         if not last:
             return
@@ -2717,6 +3075,7 @@ class GenerationScheduler:
         self._prefill_slots.discard(slot)
         if self._token_done(req, tok):
             self._complete(req)
+            self._finish_tl(req)
             self.model.release_slot(slot)
             return
         slots[slot] = req

@@ -46,6 +46,7 @@ from seldon_core_tpu.obs import (
     WIRE,
     WIRE_GATEWAY_REST,
     configure_exporters_from_env,
+    set_engine_role,
     wire_stats_payload,
 )
 from seldon_core_tpu.utils.tracectx import (
@@ -228,6 +229,11 @@ class GatewayApp:
         r.add_post("/oauth/token", self.oauth_token)
         r.add_post("/api/v0.1/predictions", self.predictions)
         r.add_post("/api/v0.1/feedback", self.feedback)
+        # disagg passthrough (docs/DISAGGREGATION.md): generate via a
+        # prefill-pool upstream, with the gateway's auth/QoS/trace seeding
+        # — the gateway span is the root the stitched cross-pool tree
+        # hangs under
+        r.add_post("/api/v0.1/disagg/generate", self.disagg_generate)
         r.add_get("/ping", self.ping)
         r.add_get("/ready", self.ready)
         r.add_post("/pause", self.pause)
@@ -437,6 +443,9 @@ class GatewayApp:
         # seed the hop's trace context; a trace-naive client gets a minted
         # root here so the engine's spans still stitch into one trace
         set_traceparent(traceparent)
+        # gateway spans carry engine.role=gateway so a stitched disagg
+        # trace attributes every hop to its pool (docs/OBSERVABILITY.md)
+        set_engine_role("gateway")
         # seed the QoS context: the client's deadline budget, or the
         # per-deployment default the gateway stamps for SLO-naive clients
         budget_ms, priority = qos.seed_from_headers(
@@ -546,7 +555,7 @@ class GatewayApp:
             if service == "predictions":
                 if self.tap.enabled:
                     await self._tap_pair(rec, body, reply)
-            else:
+            elif service == "feedback":
                 self._record_reward(rec, body)
             return code, reply
         except AuthError as e:
@@ -565,6 +574,13 @@ class GatewayApp:
 
     async def predictions(self, request: web.Request) -> web.Response:
         return await self._ingress(request, "/api/v0.1/predictions", "predictions")
+
+    async def disagg_generate(self, request: web.Request) -> web.Response:
+        """Forward a disagg generation to the deployment's (prefill-pool)
+        engine.  Rides the standard ingress: auth, QoS admission + deadline
+        stamping, trace seeding/minting — but never the response cache
+        (generations are not exact-repeat cacheable at this tier)."""
+        return await self._ingress(request, "/disagg/generate", "disagg_generate")
 
     async def feedback(self, request: web.Request) -> web.Response:
         return await self._ingress(request, "/api/v0.1/feedback", "feedback")

@@ -40,7 +40,13 @@ from seldon_core_tpu.proto.grpc_defs import (
     failure_message,
     use_grpcio,
 )
-from seldon_core_tpu.obs import RECORDER, STAGE_GATEWAY_RELAY, WIRE, WIRE_GATEWAY_GRPC
+from seldon_core_tpu.obs import (
+    RECORDER,
+    STAGE_GATEWAY_RELAY,
+    WIRE,
+    WIRE_GATEWAY_GRPC,
+    set_engine_role,
+)
 from seldon_core_tpu.utils.tracectx import (
     ensure_traceparent,
     new_traceparent,
@@ -159,6 +165,7 @@ class GatewayGrpc(_ChannelCacheBase):
         # silently break the chain; trace-naive clients get a minted root
         set_traceparent(md.get("traceparent"))
         ensure_traceparent()
+        set_engine_role("gateway")
         return _resolve_record(self.gateway, md.get(OAUTH_METADATA_KEY, ""))
 
     async def Predict(self, request: pb.SeldonMessage, context) -> pb.SeldonMessage:
@@ -221,6 +228,7 @@ class FastGatewayGrpc(_ChannelCacheBase):
         _request_token.set(token)
         set_traceparent(traceparent)
         ensure_traceparent()
+        set_engine_role("gateway")
 
     # -- inline unary relay -------------------------------------------------
 
@@ -280,7 +288,8 @@ class FastGatewayGrpc(_ChannelCacheBase):
                         duration_s=dt,
                         service=rec.name,
                         status="OK",
-                        attrs={"grpc_status": 0, "cache": "hit"},
+                        attrs={"grpc_status": 0, "cache": "hit",
+                               "engine.role": "gateway"},
                         sampled=bool(flags & 0x01),
                     )
                     conn.write_unary_response(stream_id, entry.value)
@@ -305,7 +314,7 @@ class FastGatewayGrpc(_ChannelCacheBase):
                     duration_s=dt,
                     service=rec.name,
                     status="OK" if status == 0 else "ERROR",
-                    attrs={"grpc_status": status},
+                    attrs={"grpc_status": status, "engine.role": "gateway"},
                     sampled=bool(flags & 0x01),
                 )
                 if status == 0:

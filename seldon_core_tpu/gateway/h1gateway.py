@@ -1091,7 +1091,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
             duration_s=dt,
             service=self.service,
             status="OK" if status < 400 else "ERROR",
-            attrs={"code": status},
+            attrs={"code": status, "engine.role": "gateway"},
             sampled=sampled,
         )
 

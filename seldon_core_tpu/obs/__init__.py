@@ -22,7 +22,15 @@ from seldon_core_tpu.obs.spans import (  # noqa: F401
     STAGES,
     Span,
     SpanRecorder,
+    current_engine_role,
     current_span,
+    set_engine_role,
+    set_process_role,
+)
+from seldon_core_tpu.obs.timeline import (  # noqa: F401
+    TIMELINE,
+    Timeline,
+    TimelineLedger,
 )
 from seldon_core_tpu.obs.wire import (  # noqa: F401
     WIRE,

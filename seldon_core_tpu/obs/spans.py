@@ -128,6 +128,35 @@ def current_span() -> "_LiveSpan | None":
     return _live_span.get()
 
 
+# ``engine.role`` resource attribute (docs/OBSERVABILITY.md "cross-pool
+# stitching"): every recorded span names the pool role that recorded it
+# (prefill / decode / unified / gateway), so a stitched disagg trace read
+# from either engine's /stats/spans attributes each hop to its pool.  A
+# request-scoped contextvar (seeded at every ingress) wins over the
+# process-level default (seeded at boot) — test harnesses run several
+# role-typed engines in one process.
+_engine_role: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "sct_engine_role", default=None
+)
+_process_role: str | None = None
+
+
+def set_engine_role(role: str | None) -> None:
+    """Seed this request context's ``engine.role`` span attribute."""
+    _engine_role.set(role or None)
+
+
+def set_process_role(role: str | None) -> None:
+    """Process-level fallback role (engine boot) for spans recorded
+    outside any request context."""
+    global _process_role
+    _process_role = role or None
+
+
+def current_engine_role() -> str | None:
+    return _engine_role.get() or _process_role
+
+
 def _percentile(sorted_vals: list[float], q: float) -> float:
     if not sorted_vals:
         return 0.0
@@ -215,6 +244,10 @@ class SpanRecorder:
         if recording:
             span_id = make_span_id()
             token = _traceparent.set(f"00-{trace_id}-{span_id}-{flags:02x}")
+            span_attrs = dict(attrs) if attrs else {}
+            role = current_engine_role()
+            if role is not None:
+                span_attrs.setdefault("engine.role", role)
             live = _LiveSpan(
                 Span(
                     trace_id=trace_id,
@@ -224,7 +257,7 @@ class SpanRecorder:
                     service=service,
                     start=start,
                     duration_s=0.0,
-                    attrs=dict(attrs) if attrs else {},
+                    attrs=span_attrs,
                 ),
                 t0,
             )
@@ -281,6 +314,10 @@ class SpanRecorder:
         if not sampled or self.sample <= 0.0:
             self.sampled_out += 1
             return
+        span_attrs = dict(attrs) if attrs else {}
+        role = current_engine_role()
+        if role is not None:
+            span_attrs.setdefault("engine.role", role)
         self.record(
             Span(
                 trace_id=trace_id,
@@ -291,7 +328,7 @@ class SpanRecorder:
                 start=start,
                 duration_s=duration_s,
                 status=status,
-                attrs=attrs or {},
+                attrs=span_attrs,
             )
         )
 

@@ -303,6 +303,47 @@ class MetricsRegistry:
             ["model_name"],
             registry=self.registry,
         )
+        # KV/HBM pool ledger (docs/OBSERVABILITY.md "generation
+        # forensics"; refreshed from GenerativeModel.pool_snapshot at
+        # /prometheus and /stats/breakdown time — the pressure signals the
+        # router and autoscaler arbitrate on)
+        self.kv_blocks = Gauge(
+            "seldon_kv_blocks",
+            "Paged-KV pool blocks by holder (state: free / prefix_index / "
+            "slots)",
+            ["model_name", "state"],
+            registry=self.registry,
+        )
+        self.kv_blocks_high_water = Gauge(
+            "seldon_kv_blocks_high_water",
+            "High-water mark of paged-KV pool blocks in use since boot",
+            ["model_name"],
+            registry=self.registry,
+        )
+        self.kv_bytes = Gauge(
+            "seldon_kv_bytes",
+            "HBM bytes by class (weights / kv_pool / kv_scales) for one "
+            "generative unit",
+            ["model_name", "class"],
+            registry=self.registry,
+        )
+        self.kv_prefix_evictions = Gauge(
+            "seldon_kv_prefix_evictions",
+            "Cumulative prefix-index entries evicted under pool pressure "
+            "or flush",
+            ["model_name"],
+            registry=self.registry,
+        )
+        # program-cache telemetry: a mid-traffic compile (warmup gap) is a
+        # counted, span-recorded event instead of a mystery latency spike
+        self.program_compiles = Counter(
+            "seldon_program_compiles",
+            "Fresh XLA program compiles in the generative program caches "
+            "(warmup + serving; serving-time ones also record a "
+            "program.compile span)",
+            ["model_name"],
+            registry=self.registry,
+        )
         self.obs_spans = Gauge(
             "seldon_obs_spans",
             "Span recorder counters (state: recorded / ring / sampled_out)",

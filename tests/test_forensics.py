@@ -1,0 +1,1053 @@
+"""Generation-forensics plane tests (docs/OBSERVABILITY.md).
+
+The acceptance bars this suite holds:
+
+* **Stitched cross-pool traces** — a two-engine disagg request behind the
+  gateway yields ONE trace id whose span tree covers gateway ingress ->
+  prefill-pool prefill -> handoff export/import -> decode-pool decode,
+  queryable over ``GET /stats/spans``, every span carrying its pool's
+  ``engine.role`` resource attribute.
+* **Per-request lifecycle timelines** — ``GET /stats/timeline?trace=<id>``
+  reconstructs a chunked + speculative request's whole story (admit with
+  reuse depth, chunk pacing, spec draft/accept counts, overlap breaks,
+  terminal reason), fed from host-held values only: the steady-state
+  decode host-sync audit stays <= 1 sync per fused block with the ledger
+  ON.
+* **Codec compatibility** — handoff v3 carries traceparent + QoS; v2
+  frames (no envelope) still import bit-exact; decode-pool reaping honors
+  the frame's exported deadline budget even with QoS headers stripped.
+* **KV/HBM + program telemetry** — /stats/breakdown's pool ledger adds up,
+  and a mid-traffic program-cache miss is a counted, span-recorded event.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu.disagg.handoff import (
+    HANDOFF_VERSION,
+    HandoffError,
+    build_handoff_frame,
+    decode_handoff,
+    encode_handoff,
+    seed_qos_from_frame,
+)
+from seldon_core_tpu.executor.generation import (
+    GenerationScheduler,
+    GenerativeModel,
+)
+from seldon_core_tpu.executor.multihost import encode_step
+from seldon_core_tpu.models import llama
+from seldon_core_tpu.obs import RECORDER, TIMELINE, TimelineLedger
+from seldon_core_tpu.utils.tracectx import (
+    new_traceparent,
+    parse_traceparent,
+    set_traceparent,
+)
+from seldon_core_tpu import qos
+
+run = asyncio.run
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    cfg = llama.Config.tiny(max_seq=64)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _fresh_context():
+    """Each test starts trace/QoS-naive (contextvars leak across run()
+    calls inside one test process otherwise)."""
+    set_traceparent(None)
+    qos.set_deadline(None)
+    qos.set_priority(qos.PRIO_INTERACTIVE)
+    yield
+
+
+# ---------------------------------------------------------------------------
+# Timeline ledger (obs/timeline.py) unit behavior
+# ---------------------------------------------------------------------------
+
+class TestTimelineLedger:
+    def test_bounded_entries_evict_oldest(self):
+        led = TimelineLedger(max_requests=3, max_events=8, enabled=True)
+        for i in range(5):
+            led.begin(f"trace-{i}", model="m")
+        assert led.snapshot()["held"] == 3
+        assert led.by_trace("trace-0") == []  # evicted
+        assert len(led.by_trace("trace-4")) == 1
+
+    def test_bounded_events_count_drops(self):
+        led = TimelineLedger(max_requests=4, max_events=8, enabled=True)
+        tl = led.begin("t", model="m")
+        for i in range(20):
+            tl.event("e", i=i)  # distinct attrs: no dedupe
+        d = tl.to_dict()
+        assert len(d["events"]) == 8
+        assert d["dropped"] == 12
+
+    def test_consecutive_duplicates_collapse(self):
+        led = TimelineLedger(max_requests=4, max_events=8, enabled=True)
+        tl = led.begin("t", model="m")
+        for _ in range(50):
+            tl.event("paused", cause="externals-pinned")
+        d = tl.to_dict()
+        assert len(d["events"]) == 1
+        assert d["events"][0]["n"] == 50
+        assert d["dropped"] == 0
+
+    def test_terminal_is_idempotent_and_last(self):
+        led = TimelineLedger(max_requests=4, max_events=8, enabled=True)
+        tl = led.begin("t", model="m")
+        tl.event("admit", slot=0)
+        tl.end("deadline-reap")
+        tl.end("budget")  # must not overwrite the real terminal
+        d = tl.to_dict()
+        assert d["done"] == "deadline-reap"
+        assert d["events"][-1]["name"] == "terminal"
+        assert d["events"][-1]["attrs"]["reason"] == "deadline-reap"
+
+    def test_disabled_ledger_records_nothing(self):
+        led = TimelineLedger(max_requests=4, max_events=8, enabled=False)
+        assert led.begin("t", model="m") is None
+        assert led.note("t", "e") is False
+        assert led.snapshot()["begun"] == 0
+
+    def test_note_attaches_to_newest_entry_of_trace(self):
+        led = TimelineLedger(max_requests=8, max_events=8, enabled=True)
+        led.begin("t", model="m", leg="first")
+        led.begin("t", model="m", leg="second")
+        assert led.note("t", "handoff-export", bytes=10) is True
+        legs = led.by_trace("t")
+        assert len(legs) == 2
+        assert [e["name"] for e in legs[1]["events"]] == ["handoff-export"]
+        assert legs[0]["events"] == []
+
+
+# ---------------------------------------------------------------------------
+# Scheduler-fed lifecycle (tiny llama, scheduler level)
+# ---------------------------------------------------------------------------
+
+def _events(entry: dict) -> list:
+    return [e["name"] for e in entry["events"]]
+
+
+class TestSchedulerTimeline:
+    def test_full_lifecycle_budget_terminal(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="tl-basic"
+        )
+        sched = GenerationScheduler(model)
+        tp = new_traceparent()
+        tid = parse_traceparent(tp)[0]
+
+        async def go():
+            set_traceparent(tp)
+            try:
+                return await sched.submit(
+                    np.asarray([5, 9, 2, 17, 3], np.int32), max_new_tokens=6
+                )
+            finally:
+                await sched.close()
+
+        out = run(go())
+        assert out.size == 6
+        (entry,) = TIMELINE.by_trace(tid)
+        names = _events(entry)
+        assert names[0] == "queued"
+        assert "admit" in names
+        assert "block" in names
+        assert names[-1] == "terminal"
+        assert entry["done"] == "budget"
+        admit = next(e for e in entry["events"] if e["name"] == "admit")
+        # reuse depth rides the admit event (0 here: no prefix index)
+        assert admit["attrs"]["blocks_reused"] == 0
+        assert admit["attrs"]["blocks_allocated"] >= 1
+
+    def test_eos_terminal_reason(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="tl-eos"
+        )
+        sched = GenerationScheduler(model)
+        prompt = np.asarray([5, 9, 2], np.int32)
+
+        async def probe():
+            try:
+                return await sched.submit(prompt, max_new_tokens=8)
+            finally:
+                pass
+
+        first = run(probe())
+        eos = int(first[1])  # make the 2nd sampled token the eos
+        tp = new_traceparent()
+        tid = parse_traceparent(tp)[0]
+
+        async def go():
+            set_traceparent(tp)
+            try:
+                return await sched.submit(
+                    prompt, max_new_tokens=8, eos_id=eos
+                )
+            finally:
+                await sched.close()
+
+        out = run(go())
+        assert int(out[-1]) == eos and out.size == 2
+        (entry,) = TIMELINE.by_trace(tid)
+        assert entry["done"] == "eos"
+        term = entry["events"][-1]
+        assert term["attrs"] == {"reason": "eos", "tokens": 2}
+
+    def test_prefix_reuse_depth_on_admit(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="tl-reuse",
+            prefix_reuse=True,
+        )
+        sched = GenerationScheduler(model)
+        shared = np.arange(1, 33, dtype=np.int32)  # 2 full 16-token blocks
+        prompt_a = np.concatenate([shared, [40, 41]]).astype(np.int32)
+        prompt_b = np.concatenate([shared, [50, 51]]).astype(np.int32)
+        tp = new_traceparent()
+        tid = parse_traceparent(tp)[0]
+
+        async def go():
+            await sched.submit(prompt_a, max_new_tokens=4)
+            set_traceparent(tp)
+            out = await sched.submit(prompt_b, max_new_tokens=4)
+            await sched.close()
+            return out
+
+        run(go())
+        (entry,) = TIMELINE.by_trace(tid)
+        admit = next(e for e in entry["events"] if e["name"] == "admit")
+        assert admit["attrs"]["blocks_reused"] == 2
+        assert admit["attrs"]["prefix_tokens"] == 32
+
+    def test_chunked_and_spec_events(self, tiny):
+        """A chunk-paced speculative request's timeline shows chunk events
+        (one per sync point) and block events carrying the draft/accept
+        split — the scheduler-level half of the acceptance e2e."""
+        cfg, params = tiny
+        model = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="tl-chunkspec",
+            prefill_chunk=16, spec_draft=2,
+        )
+        sched = GenerationScheduler(model)
+        tp = new_traceparent()
+        tid = parse_traceparent(tp)[0]
+
+        async def go():
+            # a live stream keeps decode active so the long admission is
+            # chunk-paced (idle admissions stay monolithic by design)
+            stream = asyncio.create_task(
+                sched.submit(np.asarray([3, 1, 4], np.int32), max_new_tokens=40)
+            )
+            while not model.steps:  # stream is decoding
+                await asyncio.sleep(0.01)
+            set_traceparent(tp)
+            out = await sched.submit(
+                np.arange(1, 41, dtype=np.int32), max_new_tokens=6
+            )
+            await stream
+            await sched.close()
+            return out
+
+        out = run(go())
+        assert out.size == 6
+        (entry,) = TIMELINE.by_trace(tid)
+        admit = next(e for e in entry["events"] if e["name"] == "admit")
+        assert admit["attrs"]["chunked"] is True
+        chunks = [e for e in entry["events"] if e["name"] == "chunk"]
+        assert len(chunks) == admit["attrs"]["chunks"] >= 2
+        assert chunks[-1]["attrs"]["last"] is True
+        blocks = [e for e in entry["events"] if e["name"] == "block"]
+        assert blocks, "no block events"
+        for b in blocks:
+            assert b["attrs"]["passes"] >= 1
+            assert b["attrs"]["drafted"] == b["attrs"]["passes"] * 2
+            assert b["attrs"]["accepted"] >= 0
+        assert entry["done"] == "budget"
+
+    def test_shed_leaves_terminal_only_entry(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(
+            cfg, params, n_slots=1, decode_block=4, name="tl-shed"
+        )
+        sched = GenerationScheduler(model, maxsize=1)
+        tp = new_traceparent()
+        tid = parse_traceparent(tp)[0]
+
+        async def go():
+            stream = asyncio.create_task(
+                sched.submit(np.asarray([3, 1], np.int32), max_new_tokens=30)
+            )
+            while not model.steps:
+                await asyncio.sleep(0.01)
+            # fill the wait list to its bound, then one more is shed
+            filler = asyncio.create_task(
+                sched.submit(np.asarray([7, 7], np.int32), max_new_tokens=2)
+            )
+            await asyncio.sleep(0)
+            set_traceparent(tp)
+            with pytest.raises(qos.QueueFull):
+                await sched.submit(
+                    np.asarray([8, 8], np.int32), max_new_tokens=2
+                )
+            await stream
+            await filler
+            await sched.close()
+
+        run(go())
+        (entry,) = TIMELINE.by_trace(tid)
+        assert entry["done"] == "shed"
+
+    def test_deadline_reap_terminal(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="tl-reap"
+        )
+        sched = GenerationScheduler(model)
+        tp = new_traceparent()
+        tid = parse_traceparent(tp)[0]
+
+        async def go():
+            set_traceparent(tp)
+            qos.set_budget_ms(30.0)  # expires mid-generation
+            try:
+                with pytest.raises(qos.DeadlineExceeded):
+                    await sched.submit(
+                        np.asarray([5, 9, 2], np.int32), max_new_tokens=512
+                    )
+            finally:
+                qos.set_deadline(None)
+                await sched.close()
+
+        run(go())
+        (entry,) = TIMELINE.by_trace(tid)
+        assert entry["done"] == "deadline-reap"
+        term = entry["events"][-1]["attrs"]
+        assert term["stage"] in ("queue", "decode", "prefill")
+
+    def test_host_sync_audit_stays_green_with_ledger_on(self, tiny):
+        """The no-host-sync rule: the ledger stamps events from host-held
+        values only, so steady-state decode still pays ~1 sync per fused
+        block (the PR-5 invariant) with timelines recording."""
+        from seldon_core_tpu.obs import host_sync_snapshot
+
+        assert TIMELINE.enabled
+        cfg, params = tiny
+        block, max_new, n_req = 8, 24, 3
+        model = GenerativeModel(
+            cfg, params, n_slots=4, decode_block=block, name="tl-sync-audit"
+        )
+        sched = GenerationScheduler(model, overlap=True)
+        before = host_sync_snapshot().get("tl-sync-audit", 0)
+
+        async def go():
+            set_traceparent(new_traceparent())
+            try:
+                return await asyncio.gather(
+                    *(
+                        sched.submit(
+                            np.asarray([5 + i, 9, 2], np.int32),
+                            max_new_tokens=max_new,
+                        )
+                        for i in range(n_req)
+                    )
+                )
+            finally:
+                await sched.close()
+
+        outs = run(go())
+        assert all(o.size == max_new for o in outs)
+        syncs = host_sync_snapshot().get("tl-sync-audit", 0) - before
+        tokens = n_req * max_new
+        budget = tokens // block + 4
+        assert syncs <= budget, f"{syncs} host syncs for {tokens} tokens"
+        assert model.overlapped >= 1
+
+
+# ---------------------------------------------------------------------------
+# Handoff codec v3: envelope, v2 back-compat, QoS-through-frame
+# ---------------------------------------------------------------------------
+
+class TestHandoffV3:
+    def _frame_payload(self, tiny, **ctx):
+        cfg, params = tiny
+        model = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="v3-src"
+        )
+        prompt = np.asarray(
+            [5, 9, 2, 17, 3, 8, 1, 4, 6, 11, 13, 2, 7, 9, 12, 15, 3], np.int32
+        )
+        tok = model.admit(0, prompt, 0.0, 0, reserve_tokens=6)
+        frame = build_handoff_frame(
+            model, 0, prompt, tok, max_new_tokens=6,
+        )
+        return model, prompt, tok, frame
+
+    def test_frame_carries_trace_and_qos_envelope(self, tiny):
+        tp = new_traceparent()
+        set_traceparent(tp)
+        qos.set_budget_ms(5000.0)
+        qos.set_priority(qos.PRIO_BATCH)
+        _, _, _, frame = self._frame_payload(tiny)
+        payload = decode_handoff(frame)
+        assert payload["hv"] == HANDOFF_VERSION == 3
+        assert payload["traceparent"] == tp
+        assert payload["origin_span"] == parse_traceparent(tp)[1]
+        assert 0 < payload["deadline_ms"] <= 5000.0
+        assert payload["priority"] == qos.PRIO_BATCH
+
+    def test_trace_naive_frame_omits_envelope(self, tiny):
+        _, _, _, frame = self._frame_payload(tiny)
+        payload = decode_handoff(frame)
+        assert "traceparent" not in payload
+        assert "origin_span" not in payload
+        assert "deadline_ms" not in payload
+
+    def test_v2_frame_imports_bit_exact(self, tiny):
+        """An old sender's v2 frame (no envelope) must decode and import
+        bit-exactly — the decoded tokens equal the unified generation."""
+        cfg, params = tiny
+        model_a = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="v2-a"
+        )
+        model_b = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="v2-b"
+        )
+        prompt = np.asarray([5, 9, 2, 17, 3], np.int32)
+        tok = model_a.admit(0, prompt, 0.0, 0, reserve_tokens=6)
+        out = model_a.export_slot_kv(0, prompt.size)
+        frame = encode_handoff(
+            prompt, tok, out[0], out[1],
+            block_size=model_a.kv_block_size, max_new_tokens=6,
+        )
+        # rebuild the frame exactly as a v2-era engine would have sent it
+        from seldon_core_tpu.executor.multihost import decode_step
+
+        key, payload = decode_step(frame)
+        payload["hv"] = 2
+        for field in ("traceparent", "origin_span", "deadline_ms", "priority"):
+            payload.pop(field, None)
+        v2_frame = encode_step(key, payload)
+        decoded = decode_handoff(v2_frame)
+        assert decoded["hv"] == 2
+        np.testing.assert_array_equal(decoded["k"], np.asarray(out[0]))
+        np.testing.assert_array_equal(decoded["v"], np.asarray(out[1]))
+
+        async def go():
+            sched_b = GenerationScheduler(model_b)
+            sched_u = GenerationScheduler(model_a)
+            try:
+                imported = await sched_b.submit_imported(
+                    decoded["prompt"],
+                    first_token=int(decoded["first_token"]),
+                    k=decoded["k"], v=decoded["v"], max_new_tokens=6,
+                )
+                model_a.release_slot(0)
+                unified = await sched_u.submit(prompt, max_new_tokens=6)
+                return imported, unified
+            finally:
+                await sched_b.close()
+                await sched_u.close()
+
+        imported, unified = run(go())
+        np.testing.assert_array_equal(imported, unified)
+
+    def test_future_version_still_fails_fast(self):
+        frame = encode_step(
+            "sct:kv-handoff",
+            {"hv": HANDOFF_VERSION + 1, "prompt": np.zeros(1, np.int32)},
+        )
+        with pytest.raises(HandoffError, match="newer"):
+            decode_handoff(frame)
+
+    def test_seed_qos_from_frame_tightens_deadline(self):
+        import time
+
+        qos.set_deadline(None)
+        seed_qos_from_frame({"deadline_ms": 1000.0, "priority": "batch"})
+        r = qos.remaining_s()
+        assert r is not None and 0.5 < r <= 1.0
+        assert qos.get_priority() == qos.PRIO_BATCH
+        # an already-tighter context deadline wins over the frame's
+        tight = time.monotonic() + 0.1
+        qos.set_deadline(tight)
+        seed_qos_from_frame({"deadline_ms": 60000.0})
+        assert qos.get_deadline() == tight
+        # a v2 frame (no envelope) leaves the context untouched
+        qos.set_deadline(None)
+        seed_qos_from_frame({})
+        assert qos.get_deadline() is None
+
+    def test_decode_pool_reaps_on_frame_budget_without_headers(self, tiny):
+        """Satellite: the exported deadline rides the FRAME, so the decode
+        pool 504s an expired import even when the transport carried no QoS
+        headers at all."""
+        cfg, params = tiny
+        model_a = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="qf-a"
+        )
+        model_b = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="qf-b"
+        )
+        prompt = np.asarray([5, 9, 2, 17, 3], np.int32)
+        tok = model_a.admit(0, prompt, 0.0, 0, reserve_tokens=400)
+        out = model_a.export_slot_kv(0, prompt.size)
+        frame = encode_handoff(
+            prompt, tok, out[0], out[1],
+            block_size=model_a.kv_block_size, max_new_tokens=6,
+            deadline_ms=1.0,  # already as good as expired
+        )
+        payload = decode_handoff(frame)
+
+        class _Comp:
+            model = model_b
+            scheduler = GenerationScheduler(model_b)
+
+        async def go():
+            from seldon_core_tpu.disagg.handoff import apply_handoff
+
+            try:
+                with pytest.raises(qos.DeadlineExceeded):
+                    await apply_handoff(_Comp(), payload)
+            finally:
+                qos.set_deadline(None)
+                await _Comp.scheduler.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# KV/HBM pool ledger + program-cache telemetry
+# ---------------------------------------------------------------------------
+
+class TestPoolAndProgramTelemetry:
+    def test_pool_ledger_adds_up(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="pool-ledger",
+            prefix_reuse=True,
+        )
+        prompt = np.arange(1, 35, dtype=np.int32)  # 2 full blocks + tail
+        model.admit(0, prompt, 0.0, 0, reserve_tokens=4)
+        snap = model.pool_snapshot()
+        b = snap["blocks"]
+        assert b["total"] == model.kv_blocks - 1
+        assert b["free"] + b["prefix_index"] + b["slots"] == b["total"]
+        assert b["slots"] >= 3
+        assert b["high_water"] >= b["slots"]
+        assert snap["bytes"]["weights"] == model.param_bytes > 0
+        assert snap["bytes"]["kv_pool"] > 0
+        assert snap["bytes"]["kv_scales"] == 0  # float pool
+        # release absorbs the full prompt blocks into the prefix index
+        model.release_slot(0)
+        snap2 = model.pool_snapshot()
+        assert snap2["blocks"]["prefix_index"] == 2
+        assert (
+            snap2["blocks"]["free"]
+            + snap2["blocks"]["prefix_index"]
+            + snap2["blocks"]["slots"]
+            == b["total"]
+        )
+
+    def test_int8_pool_reports_scale_bytes(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="pool-int8",
+            kv_cache_dtype="int8",
+        )
+        snap = model.pool_snapshot()
+        assert snap["bytes"]["kv_scales"] > 0
+
+    def test_mid_traffic_compile_is_an_observable_event(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="prog-telemetry"
+        )
+        before = RECORDER.recorded
+        model.step_k(
+            np.zeros(2, np.int32), np.zeros(2, bool), np.zeros(2, np.float32),
+            0, np.full(2, -1, np.int32), np.zeros(2, np.int32), 4, window=64,
+        )
+        prog = model.program_snapshot()
+        assert prog["compiles"] == 1
+        recent = prog["recent_compiles"]
+        assert recent and recent[-1]["warmup"] is False
+        assert recent[-1]["label"].startswith("decode_k:k4:w64")
+        assert recent[-1]["seconds"] > 0
+        # the compile produced a program.compile span
+        spans = [
+            s for s in list(RECORDER._spans)[-(RECORDER.recorded - before):]
+            if s.name == "program.compile"
+        ] if RECORDER.recorded > before else []
+        assert any(
+            s.attrs.get("variant", "").startswith("decode_k:k4:w64")
+            for s in spans
+        )
+        # a repeat is a cache hit, not a compile
+        model.step_k(
+            np.zeros(2, np.int32), np.zeros(2, bool), np.zeros(2, np.float32),
+            0, np.full(2, -1, np.int32), np.zeros(2, np.int32), 4, window=64,
+        )
+        prog2 = model.program_snapshot()
+        assert prog2["compiles"] == 1
+        assert prog2["hits"] >= 1
+
+    def test_warmup_attributes_per_variant_seconds(self, tiny):
+        cfg, params = tiny
+        model = GenerativeModel(
+            cfg, params, n_slots=2, decode_block=4, name="prog-warm"
+        )
+        model.warmup()
+        prog = model.program_snapshot()
+        assert model._in_warmup is False
+        # every warmed program label has joined compile seconds
+        for label in model.warmup_programs:
+            assert label in prog["variant_seconds"], label
+        # warmup-time compiles are attributed, not alarmed
+        assert all(e["warmup"] for e in prog["recent_compiles"])
+
+
+# ---------------------------------------------------------------------------
+# Two-engine stitched-trace e2e (gateway -> prefill pool -> decode pool)
+# ---------------------------------------------------------------------------
+
+class TestStitchedTraceE2E:
+    PREDICTOR = {
+        "name": "llm",
+        "graph": {
+            "name": "gen",
+            "type": "MODEL",
+            "implementation": "JAX_GENERATIVE",
+            "parameters": [
+                {"name": "family", "value": "llama", "type": "STRING"},
+                {"name": "preset", "value": "tiny", "type": "STRING"},
+                {"name": "n_slots", "value": "2", "type": "INT"},
+                {"name": "max_new_tokens", "value": "6", "type": "INT"},
+            ],
+        },
+    }
+
+    def _engine(self, **kw):
+        from seldon_core_tpu.engine.app import EngineApp
+        from seldon_core_tpu.engine.service import PredictionService
+        from seldon_core_tpu.graph.spec import PredictorSpec
+
+        service = PredictionService(PredictorSpec.model_validate(self.PREDICTOR))
+        return EngineApp(service, **kw)
+
+    async def _start(self, engine):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        client = TestClient(TestServer(engine.build()))
+        await client.start_server()
+        for _ in range(600):
+            if (await client.get("/ready")).status == 200:
+                return client
+            await asyncio.sleep(0.05)
+        raise AssertionError("engine never became ready")
+
+    async def _gateway(self, engine_port: int):
+        from aiohttp.test_utils import TestClient, TestServer
+        from seldon_core_tpu.gateway.app import GatewayApp
+        from seldon_core_tpu.gateway.store import (
+            DeploymentRecord,
+            DeploymentStore,
+        )
+
+        store = DeploymentStore()
+        store.put(
+            DeploymentRecord(
+                name="dep",
+                oauth_key="key1",
+                oauth_secret="sec1",
+                engine_host="127.0.0.1",
+                engine_rest_port=engine_port,
+            )
+        )
+        gw = GatewayApp(store)
+        client = TestClient(TestServer(gw.build()))
+        await client.start_server()
+        resp = await client.post(
+            "/oauth/token",
+            data={
+                "grant_type": "client_credentials",
+                "client_id": "key1",
+                "client_secret": "sec1",
+            },
+        )
+        assert resp.status == 200
+        token = (await resp.json())["access_token"]
+        return client, {"Authorization": f"Bearer {token}"}
+
+    def test_one_trace_id_stitches_gateway_prefill_import_decode(self, tiny):
+        """THE acceptance e2e: a client trace through gateway ->
+        /disagg/generate on the prefill pool -> KV handoff -> decode pool
+        yields one connected span tree with per-pool engine.role attrs,
+        readable over /stats/spans; /stats/timeline?trace= shows both
+        pool legs' lifecycles including the handoff events."""
+
+        async def go():
+            decode_engine = self._engine(role="decode")
+            decode_client = await self._start(decode_engine)
+            prefill_engine = self._engine(
+                role="prefill",
+                decode_upstreams=[f"127.0.0.1:{decode_client.server.port}"],
+            )
+            prefill_client = await self._start(prefill_engine)
+            gw_client, auth = await self._gateway(prefill_client.server.port)
+            try:
+                tp = new_traceparent()
+                tid = parse_traceparent(tp)[0]
+                resp = await gw_client.post(
+                    "/api/v0.1/disagg/generate",
+                    json={"tokens": [5, 9, 2, 17, 3], "max_new_tokens": 6},
+                    headers={**auth, "traceparent": tp},
+                )
+                assert resp.status == 200, await resp.text()
+                body = await resp.json()
+                assert body["mode"] == "disagg"
+                assert resp.headers.get("x-sct-trace-id") == tid
+
+                # the stitched tree, queryable over the engine's REST stats
+                sresp = await prefill_client.get("/stats/spans?n=200")
+                stats = await sresp.json()
+                spans = [
+                    s
+                    for t in stats["traces"]
+                    if t["trace_id"] == tid
+                    for s in t["spans"]
+                ]
+                tresp = await decode_client.get(f"/stats/timeline?trace={tid}")
+                timeline = (await tresp.json())["timeline"]
+                return tid, spans, timeline
+            finally:
+                await gw_client.close()
+                await prefill_client.close()
+                await decode_client.close()
+
+        tid, spans, timeline = run(go())
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], s)
+        for needed in (
+            "gateway.ingress", "disagg.generate", "disagg.prefill",
+            "handoff.export", "handoff.relay", "disagg.import",
+        ):
+            assert needed in by_name, f"missing span {needed}: {sorted(by_name)}"
+        # one trace id across both pools and the gateway
+        assert all(s["trace_id"] == tid for s in spans)
+        # role resource attrs name each hop's pool
+        assert by_name["gateway.ingress"]["attrs"]["engine.role"] == "gateway"
+        assert by_name["disagg.generate"]["attrs"]["engine.role"] == "prefill"
+        assert by_name["disagg.prefill"]["attrs"]["engine.role"] == "prefill"
+        assert by_name["disagg.import"]["attrs"]["engine.role"] == "decode"
+        # stitching: the decode pool's import span is a child of the
+        # prefill pool's export span; everything hangs off the client trace
+        assert (
+            by_name["disagg.import"]["parent_id"]
+            == by_name["handoff.export"]["span_id"]
+        )
+        assert by_name["disagg.import"]["attrs"]["origin_span_id"] == (
+            by_name["handoff.export"]["span_id"]
+        )
+        assert (
+            by_name["disagg.generate"]["parent_id"]
+            == by_name["gateway.ingress"]["span_id"]
+        )
+        ids = {s["span_id"] for s in spans}
+        for name in (
+            "disagg.prefill", "handoff.export", "handoff.relay",
+        ):
+            assert by_name[name]["parent_id"] in ids
+        # whole-tree connectivity: every span reaches the gateway root
+        parent_of = {s["span_id"]: s["parent_id"] for s in spans}
+        root = by_name["gateway.ingress"]["span_id"]
+        for s in spans:
+            cur, hops = s["span_id"], 0
+            while parent_of.get(cur) in ids and hops < 20:
+                cur = parent_of[cur]
+                hops += 1
+            assert cur == root or s["parent_id"] is None or (
+                parent_of.get(s["span_id"]) not in ids
+            )
+
+        # both pool legs appear on the timeline, handoff events included
+        kinds = {e["attrs"].get("kind") for e in timeline}
+        assert {"prefill", "imported"} <= kinds
+        prefill_leg = next(
+            e for e in timeline if e["attrs"].get("kind") == "prefill"
+        )
+        assert "handoff-export" in _events(prefill_leg)
+        decode_leg = next(
+            e for e in timeline if e["attrs"].get("kind") == "imported"
+        )
+        names = _events(decode_leg)
+        assert "admit" in names and names[-1] == "terminal"
+        admit = next(
+            e for e in decode_leg["events"] if e["name"] == "admit"
+        )
+        assert admit["attrs"]["imported"] is True
+        assert prefill_leg["role"] == "prefill"
+        assert decode_leg["role"] == "decode"
+
+    def test_chunked_spec_timeline_over_rest(self, tiny):
+        """Acceptance: /stats/timeline?trace= returns the ordered
+        lifecycle (admit with reuse depth, chunk pacing, spec accepts,
+        terminal reason) for a chunked + speculative request served over
+        the engine's REST streaming front."""
+        predictor = json.loads(json.dumps(self.PREDICTOR))
+        predictor["graph"]["parameters"] += [
+            {"name": "prefill_chunk", "value": "16", "type": "INT"},
+            {"name": "spec_draft", "value": "2", "type": "INT"},
+            {"name": "decode_block", "value": "4", "type": "INT"},
+        ]
+
+        async def go():
+            from seldon_core_tpu.engine.app import EngineApp
+            from seldon_core_tpu.engine.service import PredictionService
+            from seldon_core_tpu.graph.spec import PredictorSpec
+
+            service = PredictionService(
+                PredictorSpec.model_validate(predictor)
+            )
+            engine = EngineApp(service)
+            client = await self._start(engine)
+            try:
+                # a live stream keeps decode busy so the long admission is
+                # chunk-paced; read its first SSE token before admitting
+                stream_resp = await client.post(
+                    "/api/v0.1/predictions/stream",
+                    json={"tokens": [3, 1, 4], "max_new_tokens": 40},
+                )
+                assert stream_resp.status == 200
+                await stream_resp.content.readline()  # first token arrived
+                tp = new_traceparent()
+                tid = parse_traceparent(tp)[0]
+                resp = await client.post(
+                    "/api/v0.1/predictions/stream",
+                    json={
+                        "tokens": list(range(1, 41)),
+                        "max_new_tokens": 6,
+                    },
+                    headers={"traceparent": tp},
+                )
+                assert resp.status == 200
+                await resp.read()  # drain to completion
+                await stream_resp.read()
+                tresp = await client.get(f"/stats/timeline?trace={tid}")
+                return tid, (await tresp.json())["timeline"]
+            finally:
+                await client.close()
+
+        tid, timeline = run(go())
+        assert timeline, "no timeline entry for the trace"
+        entry = timeline[-1]
+        names = _events(entry)
+        assert names[0] == "queued" and names[-1] == "terminal"
+        admit = next(e for e in entry["events"] if e["name"] == "admit")
+        assert "blocks_reused" in admit["attrs"]  # reuse depth recorded
+        assert admit["attrs"].get("chunked") is True
+        assert any(n == "chunk" for n in names)
+        blocks = [e for e in entry["events"] if e["name"] == "block"]
+        assert blocks and all("passes" in b["attrs"] for b in blocks)
+        assert entry["done"] in ("budget", "eos")
+        assert entry["role"] == "unified"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: trace propagation through the relays with role-typed upstreams
+# ---------------------------------------------------------------------------
+
+class TestRelayTracePropagationRoleTyped:
+    """The h1 splice and the gRPC relay in front of a ROLE-TYPED engine:
+    client traceparent forwarded + re-parented, minted roots for
+    trace-naive clients, engine spans tagged with the pool role."""
+
+    PREDICTOR = TestStitchedTraceE2E.PREDICTOR
+
+    async def _role_engine(self, role):
+        from aiohttp.test_utils import TestClient, TestServer
+        from seldon_core_tpu.engine.app import EngineApp
+        from seldon_core_tpu.engine.service import PredictionService
+        from seldon_core_tpu.graph.spec import PredictorSpec
+
+        service = PredictionService(PredictorSpec.model_validate(self.PREDICTOR))
+        engine = EngineApp(service, role=role)
+        client = TestClient(TestServer(engine.build()))
+        await client.start_server()
+        for _ in range(600):
+            if (await client.get("/ready")).status == 200:
+                return engine, client
+            await asyncio.sleep(0.05)
+        raise AssertionError("engine never became ready")
+
+    def test_h1_splice_propagates_and_mints_to_prefill_engine(self, tiny):
+        import aiohttp
+        from seldon_core_tpu.gateway.app import GatewayApp
+        from seldon_core_tpu.gateway.h1gateway import H1SpliceFrontend
+        from seldon_core_tpu.gateway.store import (
+            DeploymentRecord,
+            DeploymentStore,
+        )
+
+        async def go():
+            engine, engine_client = await self._role_engine("prefill")
+            store = DeploymentStore()
+            store.put(
+                DeploymentRecord(
+                    name="dep", oauth_key="key1", oauth_secret="sec1",
+                    engine_host="127.0.0.1",
+                    engine_rest_port=engine_client.server.port,
+                )
+            )
+            gw = GatewayApp(store)
+            frontend = H1SpliceFrontend(gw)
+            port = await frontend.start(0, host="127.0.0.1")
+            try:
+                async with aiohttp.ClientSession() as s:
+                    resp = await s.post(
+                        f"http://127.0.0.1:{port}/oauth/token",
+                        data={
+                            "grant_type": "client_credentials",
+                            "client_id": "key1", "client_secret": "sec1",
+                        },
+                    )
+                    tok = (await resp.json())["access_token"]
+                    hdrs = {"Authorization": f"Bearer {tok}"}
+                    body = {
+                        "strData": json.dumps(
+                            {"tokens": [5, 9, 2], "max_new_tokens": 3}
+                        )
+                    }
+                    tp = new_traceparent()
+                    r1 = await s.post(
+                        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                        json=body, headers={**hdrs, "traceparent": tp},
+                    )
+                    assert r1.status == 200, await r1.text()
+                    echo1 = r1.headers.get("x-sct-trace-id")
+                    # trace-naive client: the splice MINTS a root
+                    r2 = await s.post(
+                        f"http://127.0.0.1:{port}/api/v0.1/predictions",
+                        json=body, headers=hdrs,
+                    )
+                    assert r2.status == 200
+                    echo2 = r2.headers.get("x-sct-trace-id")
+                return parse_traceparent(tp)[0], echo1, echo2
+            finally:
+                await frontend.stop()
+                await engine_client.close()
+
+        tid, echo1, echo2 = run(go())
+        assert echo1 == tid  # client trace id flows end to end
+        assert echo2 and echo2 != tid  # minted root, no leakage
+        for tid_i, want_minted in ((tid, False), (echo2, True)):
+            spans = [s for s in RECORDER._spans if s.trace_id == tid_i]
+            assert spans, f"no spans recorded for {tid_i}"
+            roles = {s.attrs.get("engine.role") for s in spans}
+            # gateway relay span + the prefill engine's route spans share
+            # the one trace, each tagged with its own role
+            assert "gateway" in roles
+            assert "prefill" in roles
+            relay = [s for s in spans if s.name == "gateway.relay"]
+            assert relay and (relay[0].parent_id is None) == want_minted
+
+    def test_grpc_relay_propagates_to_decode_engine(self, tiny):
+        """gRPC relay -> decode-role engine: metadata traceparent flows
+        through the relay, the relay span and the engine's spans share the
+        trace with per-role attribution, and a trace-naive call gets a
+        minted root instead of a leaked trace."""
+        import grpc
+
+        from seldon_core_tpu.contract import Payload, payload_to_proto
+        from seldon_core_tpu.engine.grpc_app import start_engine_grpc
+        from seldon_core_tpu.gateway.app import GatewayApp
+        from seldon_core_tpu.gateway.grpc_gateway import start_gateway_grpc
+        from seldon_core_tpu.gateway.store import (
+            DeploymentRecord,
+            DeploymentStore,
+        )
+        from seldon_core_tpu.proto.grpc_defs import Stub
+
+        async def go():
+            # role-typed engine: REST app boots too so the process-role
+            # fallback tags engine-side spans with the pool role
+            engine, engine_client = await self._role_engine("decode")
+            engine_grpc = await start_engine_grpc(engine.service, 0)
+            store = DeploymentStore()
+            store.put(
+                DeploymentRecord(
+                    name="dep", oauth_key="key1", oauth_secret="sec1",
+                    engine_host="127.0.0.1",
+                    engine_rest_port=engine_client.server.port,
+                    engine_grpc_port=engine_grpc.bound_port,
+                )
+            )
+            gwapp = GatewayApp(store)
+            token, _ = gwapp.tokens.issue("key1")
+            gw_grpc = await start_gateway_grpc(gwapp, 0)
+            try:
+                tp = new_traceparent()
+                from seldon_core_tpu.contract.payload import DataKind
+
+                req = payload_to_proto(
+                    Payload(
+                        json.dumps({"tokens": [5, 9, 2], "max_new_tokens": 3}),
+                        [],
+                        DataKind.STRING,
+                    )
+                )
+                async with grpc.aio.insecure_channel(
+                    f"127.0.0.1:{gw_grpc.bound_port}"
+                ) as ch:
+                    stub = Stub(ch, "Seldon")
+                    good = await stub.Predict(
+                        req,
+                        metadata=(
+                            ("oauth_token", token), ("traceparent", tp),
+                        ),
+                    )
+                    naive = await stub.Predict(
+                        req, metadata=(("oauth_token", token),)
+                    )
+                return parse_traceparent(tp), good, naive
+            finally:
+                await gw_grpc.gateway_handler.close()
+                await gw_grpc.stop(None)
+                await engine_grpc.stop(None)
+                await gwapp.close()
+                await engine_client.close()
+
+        (tid, client_span, _), good, naive = run(go())
+        from seldon_core_tpu.proto import prediction_pb2 as pb
+
+        assert good.status.status == pb.Status.SUCCESS
+        assert naive.status.status == pb.Status.SUCCESS
+        spans = [s for s in RECORDER._spans if s.trace_id == tid]
+        assert spans, "no spans recorded for the client trace"
+        relay = [s for s in spans if s.name.startswith("gateway.grpc")]
+        assert relay
+        assert relay[0].attrs.get("engine.role") == "gateway"
+        # relay joined the CLIENT trace, parented on the client's span
+        assert relay[0].parent_id == client_span
+        roles = {s.attrs.get("engine.role") for s in spans}
+        assert "decode" in roles, f"engine spans untagged: {roles}"
+        # the naive call minted a DIFFERENT trace with a root relay span
+        minted_roots = [
+            s for s in RECORDER._spans
+            if s.name.startswith("gateway.grpc")
+            and s.trace_id != tid and s.parent_id is None
+        ]
+        assert minted_roots

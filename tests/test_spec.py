@@ -428,7 +428,9 @@ class TestInt8KV:
             max_new_tokens=4, k_scale=ks, v_scale=vs,
         )
         payload = decode_handoff(frame)
-        assert payload["hv"] == 2
+        from seldon_core_tpu.disagg.handoff import HANDOFF_VERSION
+
+        assert payload["hv"] == HANDOFF_VERSION  # int8 rides >= v2
         assert payload["kv_quant"] == "int8"
         np.testing.assert_array_equal(payload["k"], k)
         np.testing.assert_array_equal(payload["v"], v)
