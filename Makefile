@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -100,6 +100,18 @@ forensics-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_forensics.py -q
 	JAX_PLATFORMS=cpu BENCH_ONLY=OBS_OVERHEAD BENCH_RUNS=1 \
 		BENCH_OBS_TOKENS=24 $(PYTHON) bench.py
+
+# batched multi-LoRA gate (docs/MULTITENANT.md), CPU-safe: the
+# null-adapter pinned-equal matrix (plain/top-k/spec/chunked/prefix
+# reuse/int8/tp=2/disagg handoff), per-slot gather vs solo runs,
+# adapter-salted prefix isolation, adapter-pool LRU + refcount pinning,
+# HBM memory-manager ledger + enforcement, handoff codec v4 adapter
+# rejection, program-key audit, host-sync audit, RandomABTest adapter
+# traffic split; then the mixed-adapter-vs-swap bench smoke
+lora-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_lora.py -q
+	JAX_PLATFORMS=cpu BENCH_ONLY=LORA BENCH_RUNS=1 \
+		BENCH_LORA_TOKENS=16 $(PYTHON) bench.py
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

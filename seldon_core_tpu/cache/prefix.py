@@ -35,11 +35,24 @@ import numpy as np
 
 def chain_hash(key: bytes) -> str:
     """Stable 64-bit-hex digest of one prefix-chain key (the raw int32
-    bytes of ``tokens[:k*block_size]``).  Shared with the gateway's
-    prefix-aware router (disagg/router.py): the gateway hashes a request's
-    leading blocks the same way and matches them against the digests each
-    replica publishes, without ever shipping raw token ids off-engine."""
+    bytes of ``tokens[:k*block_size]``, optionally prefixed by an adapter
+    salt).  Shared with the gateway's prefix-aware router
+    (disagg/router.py): the gateway hashes a request's leading blocks the
+    same way and matches them against the digests each replica publishes,
+    without ever shipping raw token ids off-engine."""
     return hashlib.sha256(key).hexdigest()[:16]
+
+
+def adapter_salt(adapter: "str | None") -> bytes:
+    """Chain-key salt for one LoRA adapter (docs/MULTITENANT.md).
+
+    LoRA on the attention projections changes K/V, so a prefix block
+    produced under adapter A must never serve adapter B — or the base
+    model.  Folding the adapter NAME (stable across replicas, unlike the
+    pool-local row index) into every chain key partitions the index per
+    adapter; no adapter (the base model) keeps the unsalted keys, so
+    lora-off digests and gateway hashes are unchanged."""
+    return (str(adapter).encode("utf-8") + b"\x00") if adapter else b""
 
 
 class _PrefixEntry:
@@ -79,26 +92,38 @@ class PrefixIndex:
         """Blocks owned by the index (evictable when refs drop to 0)."""
         return len(self._entries)
 
-    def _key(self, tokens: np.ndarray, k: int) -> bytes:
-        return np.ascontiguousarray(tokens[: k * self.block_size], np.int32).tobytes()
+    def _key(self, tokens: np.ndarray, k: int, salt: bytes = b"") -> tuple:
+        # (salt, token bytes) as a TUPLE: concatenating could make one
+        # adapter's key a byte-prefix of another's (or of an unsalted
+        # chain), which would confuse the eviction extension scan
+        return (
+            salt,
+            np.ascontiguousarray(
+                tokens[: k * self.block_size], np.int32
+            ).tobytes(),
+        )
 
     # -- lookup --------------------------------------------------------------
 
-    def match(self, tokens: np.ndarray, max_blocks: int) -> list[int]:
+    def match(
+        self, tokens: np.ndarray, max_blocks: int, salt: bytes = b""
+    ) -> list[int]:
         """Longest chain of full prefix blocks for ``tokens`` (capped at
         ``max_blocks``); ref-counts every matched entry.  Pair each call
-        with exactly one :meth:`release` for the same tokens/length."""
+        with exactly one :meth:`release` for the same tokens/length.
+        ``salt`` partitions chains per LoRA adapter (:func:`adapter_salt`):
+        adapter-tagged chains never match across adapters."""
         tokens = np.asarray(tokens, np.int32).ravel()
         blocks: list[int] = []
         with self._lock:
             self._tick += 1
             for k in range(1, max_blocks + 1):
-                e = self._entries.get(self._key(tokens, k))
+                e = self._entries.get(self._key(tokens, k, salt))
                 if e is None:
                     break
                 blocks.append(e.block)
             for k in range(1, len(blocks) + 1):
-                e = self._entries[self._key(tokens, k)]
+                e = self._entries[self._key(tokens, k, salt)]
                 e.refs += 1
                 e.tick = self._tick
             if blocks:
@@ -108,19 +133,25 @@ class PrefixIndex:
                 self.misses += 1
         return blocks
 
-    def release(self, tokens: np.ndarray, n_blocks: int) -> None:
+    def release(
+        self, tokens: np.ndarray, n_blocks: int, salt: bytes = b""
+    ) -> None:
         """Drop the refs :meth:`match` took (entries stay, evictable)."""
         tokens = np.asarray(tokens, np.int32).ravel()
         with self._lock:
             for k in range(1, n_blocks + 1):
-                e = self._entries.get(self._key(tokens, k))
+                e = self._entries.get(self._key(tokens, k, salt))
                 if e is not None and e.refs > 0:
                     e.refs -= 1
 
     # -- insertion -----------------------------------------------------------
 
     def insert(
-        self, tokens: np.ndarray, blocks: list[int], start_level: int
+        self,
+        tokens: np.ndarray,
+        blocks: list[int],
+        start_level: int,
+        salt: bytes = b"",
     ) -> list[int]:
         """Register chain levels ``start_level+1 .. start_level+len(blocks)``
         (0-based ``start_level`` = blocks already in the index) with the
@@ -134,13 +165,15 @@ class PrefixIndex:
             level = start_level
             for block in blocks:
                 level += 1
-                key = self._key(tokens, level)
+                key = self._key(tokens, level, salt)
                 if key in self._entries:
                     rejected.append(int(block))
                     continue
                 # a gap below this level (concurrent eviction) would orphan
                 # the entry — only chain onto a present parent
-                if level > 1 and self._key(tokens, level - 1) not in self._entries:
+                if level > 1 and self._key(
+                    tokens, level - 1, salt
+                ) not in self._entries:
                     rejected.append(int(block))
                     continue
                 self._entries[key] = _PrefixEntry(block, self._tick, level)
@@ -166,14 +199,15 @@ class PrefixIndex:
                     if e.refs == 0
                 ),
             )
-            doomed: set[bytes] = set()
+            doomed: set = set()
             for _tick, _negdepth, key in candidates:
                 if len(freed) >= need:
                     break
                 if key in doomed:
                     continue
                 exts = [
-                    k for k in self._entries if k != key and k.startswith(key)
+                    k for k in self._entries
+                    if k != key and k[0] == key[0] and k[1].startswith(key[1])
                 ]
                 for k in (*exts, key):
                     if k in doomed:
@@ -210,7 +244,9 @@ class PrefixIndex:
                 "block_size": self.block_size,
                 "entries": len(self._entries),
                 "truncated": len(self._entries) > len(items),
-                "hashes": [chain_hash(k) for k, _ in items],
+                # hash of salt + raw bytes: exactly what the gateway's
+                # prompt_chain_hashes computes for (adapter, tokens)
+                "hashes": [chain_hash(k[0] + k[1]) for k, _ in items],
                 "depths": [e.depth for _, e in items],
             }
 

@@ -38,7 +38,12 @@ HANDOFF_KEY = "sct:kv-handoff"
 # client's remaining budget at export, so decode-pool reaping honors the
 # original SLO even when an intermediary strips the QoS headers).  All v3
 # fields are optional: v1/v2 frames decode unchanged and import bit-exact.
-HANDOFF_VERSION = 3
+# v4: adds the optional ``adapter`` field (batched multi-LoRA,
+# docs/MULTITENANT.md) — the prompt KV was produced THROUGH that adapter's
+# attention deltas, so the decode pool must resolve the same named adapter
+# or reject the frame (the sender then falls back to unified local
+# decode).  v1-v3 frames decode unchanged.
+HANDOFF_VERSION = 4
 
 
 class HandoffError(Exception):
@@ -80,6 +85,7 @@ def encode_handoff(
     origin_span: str | None = None,
     deadline_ms: float | None = None,
     priority: str | None = None,
+    adapter: str | None = None,
 ) -> bytes:
     """Frame one prefilled request for the engine→engine handoff.
 
@@ -116,6 +122,8 @@ def encode_handoff(
         payload["deadline_ms"] = max(1.0, float(deadline_ms))
     if priority:
         payload["priority"] = str(priority)
+    if adapter:
+        payload["adapter"] = str(adapter)
     if quant:
         ks, scale_dtype = _pack_kv(np.ascontiguousarray(k_scale))
         vs, _ = _pack_kv(np.ascontiguousarray(v_scale))
@@ -171,6 +179,7 @@ def build_handoff_frame(
     max_new_tokens: int,
     temperature: float = 0.0,
     eos_id: int | None = None,
+    adapter: str | None = None,
 ) -> bytes:
     """Export ``slot``'s prompt KV from ``model`` and frame the handoff
     (runs on a worker thread — the export is a device fetch; contextvars
@@ -203,6 +212,7 @@ def build_handoff_frame(
         origin_span=parsed[1] if parsed else None,
         deadline_ms=remaining * 1e3 if remaining is not None else None,
         priority=qos.get_priority(),
+        adapter=adapter,
     )
 
 
@@ -250,6 +260,17 @@ async def apply_handoff(component: Any, payload: dict[str, Any]) -> np.ndarray:
             f"layout {model.kv_dtype or 'float'}; pools must share "
             "kv_cache_dtype"
         )
+    adapter = payload.get("adapter")
+    if adapter:
+        # the KV was produced through this adapter's attention deltas:
+        # decoding it through a different (or missing) adapter would be
+        # silently wrong — reject so the sender falls back to unified
+        pool = getattr(model, "lora_pool", None)
+        if pool is None or adapter not in pool:
+            raise HandoffError(
+                f"handoff names adapter {adapter!r} but it is not resident "
+                "on this decode pool; register it (or route elsewhere)"
+            )
     seed_qos_from_frame(payload)
     eos = payload.get("eos_id")
     return await component.scheduler.submit_imported(
@@ -262,4 +283,5 @@ async def apply_handoff(component: Any, payload: dict[str, Any]) -> np.ndarray:
         eos_id=int(eos) if eos is not None else None,
         k_scale=payload.get("k_scale"),
         v_scale=payload.get("v_scale"),
+        adapter=str(adapter) if adapter else None,
     )

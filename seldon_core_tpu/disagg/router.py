@@ -44,39 +44,63 @@ def extract_prompt_tokens(raw: bytes) -> "np.ndarray | None":
     replica has published digests (ReplicaRouter.has_digests)."""
     import json
 
+    return extract_prompt_request(raw)[0]
+
+
+def extract_prompt_request(
+    raw: bytes,
+) -> "tuple[np.ndarray | None, str | None]":
+    """Like :func:`extract_prompt_tokens` but also surfaces the request's
+    LoRA ``adapter`` name (docs/MULTITENANT.md) — adapter-tagged prefix
+    chains hash differently, so the router must fold it in to find the
+    replica that actually holds those blocks."""
+    import json
+
     try:
         body = json.loads(raw)
         if not isinstance(body, dict):
-            return None
+            return None, None
         if "strData" in body:
             body = json.loads(body["strData"])
             if not isinstance(body, dict):
-                return None
+                return None, None
+        adapter = body.get("adapter")
+        adapter = str(adapter) if isinstance(adapter, str) and adapter else None
         toks = body.get("tokens")
         if (
             isinstance(toks, (list, tuple))
             and toks
             and all(isinstance(t, int) and not isinstance(t, bool) for t in toks)
         ):
-            return np.asarray(toks, np.int32)
+            return np.asarray(toks, np.int32), adapter
+        return None, adapter
     except (ValueError, TypeError, KeyError):
-        return None
-    return None
+        return None, None
 
 
 def prompt_chain_hashes(
-    tokens: np.ndarray, block_size: int, max_blocks: int = 64
+    tokens: np.ndarray,
+    block_size: int,
+    max_blocks: int = 64,
+    adapter: "str | None" = None,
 ) -> list[str]:
     """Chain hashes of the request's leading FULL token blocks — the same
     key bytes + hash the engine-side ``PrefixIndex.digest`` publishes, so
-    membership at depth k means the replica holds KV for tokens[:k*bs]."""
+    membership at depth k means the replica holds KV for tokens[:k*bs].
+    ``adapter`` folds the request's LoRA adapter into the key exactly like
+    the engine's salted index (cache/prefix.py ``adapter_salt``)."""
+    from seldon_core_tpu.cache.prefix import adapter_salt
+
     tokens = np.asarray(tokens, np.int32).ravel()
     bs = int(block_size)
     if bs < 1:
         return []
+    salt = adapter_salt(adapter)
     n = min(tokens.size // bs, max_blocks)
     return [
-        chain_hash(np.ascontiguousarray(tokens[: k * bs], np.int32).tobytes())
+        chain_hash(
+            salt + np.ascontiguousarray(tokens[: k * bs], np.int32).tobytes()
+        )
         for k in range(1, n + 1)
     ]
 
@@ -174,6 +198,7 @@ class ReplicaRouter:
         dep: str,
         endpoints: Sequence[Any],
         prompt_tokens: np.ndarray | None = None,
+        adapter: "str | None" = None,
     ) -> Any:
         """Choose a replica for one request.  Counts the pick so the p2c
         tiebreak stays balanced even before any state is polled."""
@@ -196,7 +221,7 @@ class ReplicaRouter:
                     hs = by_bs.get(st.block_size)
                     if hs is None:
                         hs = by_bs[st.block_size] = prompt_chain_hashes(
-                            prompt_tokens, st.block_size
+                            prompt_tokens, st.block_size, adapter=adapter
                         )
                     depth = 0
                     for h in hs:

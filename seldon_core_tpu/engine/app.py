@@ -748,7 +748,9 @@ class EngineApp:
             eos = int(eos) if eos is not None else None
         except (TypeError, ValueError) as e:
             raise CodecError(f"bad generate option: {e}") from e
-        return prompt, max_new, temperature, eos
+        adapter = body.get("adapter", getattr(unit, "adapter", None))
+        adapter = str(adapter) if adapter else None
+        return prompt, max_new, temperature, eos, adapter
 
     async def disagg_generate(self, request: web.Request) -> web.Response:
         """Generate via the disagg topology: prefill HERE, stream the KV
@@ -773,8 +775,10 @@ class EngineApp:
                     h["code"] = "400"
                     return web.json_response(_status_body(400, reason), status=400)
                 try:
-                    prompt, max_new, temperature, eos = self._parse_generate_body(
-                        await self._json(request), unit
+                    (prompt, max_new, temperature, eos, adapter) = (
+                        self._parse_generate_body(
+                            await self._json(request), unit
+                        )
                     )
                 except (CodecError, ValueError, TypeError, KeyError) as e:
                     h["code"] = "400"
@@ -791,12 +795,13 @@ class EngineApp:
                         and max_new > 1
                     ):
                         tokens, mode = await self._prefill_and_handoff(
-                            unit, prompt, max_new, temperature, eos
+                            unit, prompt, max_new, temperature, eos, adapter
                         )
                     else:
                         out = await unit.scheduler.submit(
                             prompt, max_new_tokens=max_new,
                             temperature=temperature, eos_id=eos,
+                            adapter=adapter,
                         )
                         tokens, mode = [int(t) for t in out], "unified"
                     if sp is not None:
@@ -812,7 +817,8 @@ class EngineApp:
                 ticket.release()
 
     async def _prefill_and_handoff(
-        self, unit, prompt, max_new: int, temperature: float, eos: int | None
+        self, unit, prompt, max_new: int, temperature: float,
+        eos: int | None, adapter: str | None = None,
     ) -> tuple[list[int], str]:
         """Prefill into a pinned slot, export + POST the KV handoff, relay
         the decode peer's tokens.  The slot releases in every outcome —
@@ -827,7 +833,7 @@ class EngineApp:
         dep = self.service.deployment_name
         with RECORDER.span("disagg.prefill", service=dep) as psp:
             slot, tok1 = await unit.scheduler.submit_prefill(
-                prompt, temperature=temperature
+                prompt, temperature=temperature, adapter=adapter
             )
             if psp is not None:
                 psp.set_attr("slot", slot)
@@ -840,7 +846,8 @@ class EngineApp:
                 # span as the origin the importer stitches under
                 frame = await asyncio.to_thread(
                     build_handoff_frame, unit.model, slot, prompt, tok1,
-                    max_new_tokens=max_new, temperature=temperature, eos_id=eos,
+                    max_new_tokens=max_new, temperature=temperature,
+                    eos_id=eos, adapter=adapter,
                 )
                 if esp is not None:
                     esp.set_attr("bytes", len(frame))
@@ -866,7 +873,8 @@ class EngineApp:
             unit.scheduler.release_external(slot)
         self.disagg_stats["local_fallbacks"] += 1
         out = await unit.scheduler.submit(
-            prompt, max_new_tokens=max_new, temperature=temperature, eos_id=eos
+            prompt, max_new_tokens=max_new, temperature=temperature,
+            eos_id=eos, adapter=adapter,
         )
         return [int(t) for t in out], "unified-fallback"
 

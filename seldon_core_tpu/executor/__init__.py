@@ -16,6 +16,12 @@ from seldon_core_tpu.executor.compiled import BucketSpec, CompiledModel
 from seldon_core_tpu.executor.batcher import BatchQueue
 from seldon_core_tpu.executor.checkpoint import load_params, save_params
 from seldon_core_tpu.executor.component import JaxModelComponent
+from seldon_core_tpu.executor.lora import AdapterPool, AdapterPoolFull
+from seldon_core_tpu.executor.memory import (
+    MEMORY,
+    HBMOverCommit,
+    MemoryManager,
+)
 
 __all__ = [
     "BucketSpec",
@@ -24,4 +30,9 @@ __all__ = [
     "JaxModelComponent",
     "load_params",
     "save_params",
+    "AdapterPool",
+    "AdapterPoolFull",
+    "MemoryManager",
+    "MEMORY",
+    "HBMOverCommit",
 ]

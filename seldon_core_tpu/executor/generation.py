@@ -119,6 +119,11 @@ class GenerativeModel:
         kv_cache_dtype: str | None = None,
         prefill_chunk: int | None = None,
         decode_kernel: bool | None = None,
+        lora_rank: int | None = None,
+        lora_slots: int | None = None,
+        lora_targets: str | None = None,
+        lora_adapters: Any = None,
+        memory: Any = None,
     ):
         if family_mod is None:
             from seldon_core_tpu.models import llama as family_mod
@@ -261,6 +266,42 @@ class GenerativeModel:
                 )
                 decode_kernel = False
         self.decode_kernel = decode_kernel
+        # batched multi-LoRA serving (docs/MULTITENANT.md): a stacked
+        # (n_layers, lora_slots, ...) adapter pool in HBM, gathered per
+        # generation slot INSIDE the fused prefill/decode programs —
+        # serving N fine-tune variants of one base from one compiled step.
+        # Row 0 is the reserved null adapter (all zeros): adapter-less
+        # requests are bit-identical to a lora-off build.  (lora_rank,
+        # lora_slots) are STATIC (program cache keys); which named adapter
+        # occupies which row is host bookkeeping (executor/lora.py) so
+        # registration/eviction never recompiles mid-traffic.
+        if lora_rank is None:
+            lora_rank = int(os.environ.get("SCT_LORA_RANK", "0") or 0)
+        self.lora_rank = max(0, int(lora_rank))
+        if lora_slots is None:
+            lora_slots = int(os.environ.get("SCT_LORA_SLOTS", "8") or 8)
+        if lora_targets is None:
+            lora_targets = os.environ.get("SCT_LORA_TARGETS", "qkvo")
+        if self.lora_rank and not hasattr(family_mod, "init_lora_params"):
+            log.warning(
+                "generative model %r: family %s has no init_lora_params; "
+                "multi-LoRA serving disabled", name, family_mod,
+            )
+            self.lora_rank = 0
+        self.lora_slots = max(2, int(lora_slots)) if self.lora_rank else 0
+        if self.lora_rank:
+            targets = tuple(family_mod.LORA_ATTN_TARGETS)
+            lt = str(lora_targets or "qkvo").lower()
+            if lt in ("qkvo+mlp", "all", "mlp"):
+                targets = targets + tuple(family_mod.LORA_MLP_TARGETS)
+            elif lt not in ("qkvo", ""):
+                raise GraphUnitError(
+                    f"lora_targets must be 'qkvo' or 'qkvo+mlp', got "
+                    f"{lora_targets!r}"
+                )
+            self.lora_targets = targets
+        else:
+            self.lora_targets = ()
 
         if dtype is not None:
             import jax.numpy as jnp
@@ -280,6 +321,38 @@ class GenerativeModel:
         else:
             params = jax.device_put(params)
         self.params = params
+
+        # stacked LoRA adapter pool: device tensors + host registry.  The
+        # pool rides every prefill/decode dispatch as a plain (non-donated)
+        # argument like the base params; factors are small (rank r), so it
+        # replicates across a mesh rather than sharding.
+        self.lora_pool = None
+        self._lora = None
+        self.lora_bytes = 0
+        self._slot_aidx = np.zeros(self.n_slots, np.int32)
+        self._slot_salt: dict[int, bytes] = {}
+        if self.lora_rank:
+            lt = family_mod.init_lora_params(
+                cfg, self.lora_slots, self.lora_rank,
+                targets=self.lora_targets,
+                dtype=dtype if dtype is not None else np.float32,
+            )
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                lt = jax.device_put(lt, NamedSharding(mesh, P()))
+            else:
+                lt = jax.device_put(lt)
+            self._lora = lt
+            self.lora_bytes = sum(
+                int(x.nbytes) for x in jax.tree.leaves(lt)
+            )
+            from seldon_core_tpu.executor.lora import AdapterPool
+
+            self.lora_pool = AdapterPool(
+                self.lora_slots, self.lora_rank,
+                writer=self._lora_write, name=name,
+            )
 
         # paged KV pool: block 0 is the reserved garbage sink for inactive
         # slots' fixed-shape writes (models/llama.py decode_slots_paged);
@@ -414,10 +487,10 @@ class GenerativeModel:
         dec_kw = {"kernel": True} if self.decode_kernel else {}
 
         def _prefill(params, tokens, length, slot, blocks, temperature, seed,
-                     hist_seed, cache):
+                     hist_seed, aid, lora, cache):
             logits, cache = fam.prefill_slot_paged(
                 params, tokens, length, slot, blocks, cache, cfg,
-                mesh=mesh, seq_impl=seq_impl,
+                mesh=mesh, seq_impl=seq_impl, lora=lora, adapter_id=aid,
             )
             key = jax.random.PRNGKey(seed)
             tok = _sample(logits[None], temperature[None], key)[0]
@@ -429,10 +502,10 @@ class GenerativeModel:
             return _replicate(tok), cache
 
         def _decode(window):
-            def fn(params, tokens, active, temperature, seed, cache):
+            def fn(params, tokens, active, temperature, seed, aid, lora, cache):
                 logits, cache = fam.decode_slots_paged(
                     params, tokens, cache, active, cfg, window=window,
-                    **dec_kw,
+                    lora=lora, adapter_ids=aid, **dec_kw,
                 )
                 key = jax.random.PRNGKey(seed)
                 toks = _sample(logits, temperature, key)
@@ -456,7 +529,8 @@ class GenerativeModel:
             from jax import lax
             import jax.numpy as jnp
 
-            def fn(params, tokens, active, temperature, seed, eos, remaining, cache):
+            def fn(params, tokens, active, temperature, seed, eos, remaining,
+                   aid, lora, cache):
                 base_key = jax.random.PRNGKey(seed)
 
                 def body(carry, i):
@@ -470,7 +544,7 @@ class GenerativeModel:
                     # inactive slots' math is already masked).
                     logits, cache = fam.decode_slots_paged(
                         params, tokens, cache, active, cfg, window=window,
-                        **dec_kw,
+                        lora=lora, adapter_ids=aid, **dec_kw,
                     )
                     key = jax.random.fold_in(base_key, i)
                     toks = _sample(logits, temperature, key)
@@ -515,7 +589,8 @@ class GenerativeModel:
 
             L = 1 + spec_d
 
-            def fn(params, tokens, active, temperature, seed, eos, remaining, cache):
+            def fn(params, tokens, active, temperature, seed, eos, remaining,
+                   aid, lora, cache):
                 base_key = jax.random.PRNGKey(seed)
                 S = tokens.shape[0]
                 offs = jnp.arange(L)[None, :]
@@ -534,7 +609,7 @@ class GenerativeModel:
                     qvalid = active[:, None] & (offs < remaining[:, None])
                     logits, cache = fam.decode_slots_spec_paged(
                         params, qtoks, cache, active, qvalid, cfg,
-                        window=window, **dec_kw,
+                        window=window, lora=lora, adapter_ids=aid, **dec_kw,
                     )
                     key = jax.random.fold_in(base_key, i)
                     V = logits.shape[-1]
@@ -593,10 +668,12 @@ class GenerativeModel:
             program per (suffix bucket, prefix window))."""
 
             def fn(params, tokens, prefix_len, length, slot, blocks_row,
-                   suffix_blocks, temperature, seed, hist_seed, cache):
+                   suffix_blocks, temperature, seed, hist_seed, aid, lora,
+                   cache):
                 logits, cache = fam.prefill_suffix_paged(
                     params, tokens, prefix_len, length, slot, blocks_row,
                     suffix_blocks, cache, cfg, prefix_window=pw,
+                    lora=lora, adapter_id=aid,
                 )
                 key = jax.random.PRNGKey(seed)
                 tok = _sample(logits[None], temperature[None], key)[0]
@@ -609,7 +686,9 @@ class GenerativeModel:
 
         # cache buffers are donated: each step reuses the previous buffers
         # in place instead of holding two live copies of a multi-GB cache
-        self._prefill = jax.jit(_prefill, donate_argnums=(8,))
+        # (the lora pool arg is NOT donated — it persists across steps
+        # like the base params)
+        self._prefill = jax.jit(_prefill, donate_argnums=(10,))
         self._prefill_suffix_factory = _prefill_suffix
         self._prefill_suffix_jit: dict[tuple, Any] = {}
         self._decode_factory = _decode
@@ -624,6 +703,7 @@ class GenerativeModel:
         self._program_config = (
             self.top_k, self.spec_draft, self.spec_ngram, self.spec_hist,
             self.kv_dtype, self.prefill_chunk, self.decode_kernel,
+            self.lora_rank, self.lora_slots,
         )
         # overlapped-pipeline state: the last dispatched block's final
         # (tokens, active, remaining) as DEVICE arrays, plus the host-side
@@ -672,6 +752,12 @@ class GenerativeModel:
             self._mh_import_key = self.driver.register_unique(
                 f"gen:{name}:import", self._exec_import
             )
+            # adapter-row installs write device state on every process of
+            # the slice (payload carries the factor ndarrays), so they are
+            # driven steps like prefill/decode
+            self._mh_lora_key = self.driver.register_unique(
+                f"gen:{name}:lora", self._exec_lora_load
+            )
 
         # observability
         self.steps = 0
@@ -713,6 +799,8 @@ class GenerativeModel:
             tag.append(f"chunk{self.prefill_chunk}")
         if self.decode_kernel:
             tag.append("kernel")
+        if self.lora_rank:
+            tag.append(f"lora{self.lora_rank}")
         self.variant_sfx = ("[" + ",".join(tag) + "]") if tag else ""
         # per-slot inter-token latency ledger (fed by the scheduler's
         # delivery loop): bounded ring for the /stats/breakdown percentiles
@@ -745,6 +833,52 @@ class GenerativeModel:
         )
         # RLock: warmup calls admit/step under the same lock
         self._lock = threading.RLock()
+        # HBM memory manager (executor/memory.py): admission-time byte
+        # reservation for this model's classes — with SCT_HBM_ENFORCE=1 an
+        # over-committing SECOND deployment fails at build instead of
+        # OOMing the chip mid-traffic (docs/MULTITENANT.md)
+        if memory is None:
+            from seldon_core_tpu.executor.memory import MEMORY as memory
+        self.memory = memory
+        self._mem_key = f"{name}:{id(self):x}"
+        kv_bytes = int(self._cache["k"].nbytes) + int(self._cache["v"].nbytes)
+        scale_bytes = (
+            int(self._cache["k_scale"].nbytes)
+            + int(self._cache["v_scale"].nbytes)
+            if "k_scale" in self._cache
+            else 0
+        )
+        self.memory.reserve(
+            self._mem_key,
+            {
+                "weights": self.param_bytes,
+                "kv_pool": kv_bytes,
+                "kv_scales": scale_bytes,
+                "adapter_pool": self.lora_bytes,
+            },
+        )
+        # graph-declared adapters ("name", "name:seed", comma-separated or
+        # a list): registered at build so the deployment is ready to serve
+        # them the moment readiness flips
+        if self.lora_pool is not None and lora_adapters is None:
+            lora_adapters = os.environ.get("SCT_LORA_ADAPTERS") or None
+        if self.lora_pool is not None and lora_adapters:
+            names = (
+                [s for s in str(lora_adapters).split(",")]
+                if isinstance(lora_adapters, str)
+                else list(lora_adapters)
+            )
+            for ent in names:
+                ent = str(ent).strip()
+                if not ent:
+                    continue
+                nm, _, sd = ent.partition(":")
+                self.register_adapter(
+                    nm.strip(), seed=int(sd) if sd.strip() else None
+                )
+        # from here on, adapter registrations are dynamic: on a multi-host
+        # slice they broadcast as driven steps instead of local writes
+        self._built = True
 
     def note_itl(self, seconds: float) -> None:
         """One inter-token-latency sample (scheduler delivery loop)."""
@@ -808,6 +942,124 @@ class GenerativeModel:
                     tokens_emitted * self.flops_per_token / step_s / peak
                 )
 
+    # ------------------------------------------------- multi-LoRA adapters
+
+    def register_adapter(
+        self,
+        name: str,
+        *,
+        seed: int | None = None,
+        factors: Any = None,
+        scale: float = 0.05,
+    ) -> int:
+        """Install adapter ``name`` into the stacked pool and return its
+        row (docs/MULTITENANT.md).  ``factors`` is the family's per-adapter
+        pytree (``lora_adapter_factors`` layout); without one, synthetic
+        factors are generated from ``seed`` (default: a stable hash of the
+        name, so every replica builds the SAME stand-in deltas).  LRU
+        eviction under pressure and :class:`AdapterPoolFull` when every
+        row is pinned by in-flight slots."""
+        if self.lora_pool is None:
+            raise GraphUnitError(
+                f"generative model {self.name!r} was built without "
+                "multi-LoRA serving (set lora_rank / SCT_LORA_RANK)"
+            )
+        if factors is None:
+            if seed is None:
+                import zlib
+
+                seed = zlib.crc32(str(name).encode())
+            factors = self.family.lora_adapter_factors(
+                jax.random.PRNGKey(int(seed) & 0x7FFFFFFF), self.cfg,
+                self.lora_rank, targets=self.lora_targets, scale=scale,
+                dtype=self._lora[self.lora_targets[0]]["a"].dtype,
+            )
+        return self.lora_pool.register(name, factors)
+
+    def _lora_write(self, idx: int, factors: Any) -> None:
+        """AdapterPool's device writer: install one adapter's factors into
+        pool row ``idx`` on every process of the slice.  Build-time
+        registration (graph-declared adapters) runs symmetrically on every
+        process from the same spec, so it writes locally; only DYNAMIC
+        registrations after build are coordinator-led driven steps."""
+        payload = {"idx": int(idx)}
+        for t in self.lora_targets:
+            payload[f"a:{t}"] = np.asarray(factors[t]["a"])
+            payload[f"b:{t}"] = np.asarray(factors[t]["b"])
+        if self.driver is not None and getattr(self, "_built", False):
+            self.driver.lead(self._mh_lora_key, payload)
+        else:
+            self._exec_lora_load(payload)
+
+    def _exec_lora_load(self, payload: dict) -> None:
+        """Symmetric adapter-row install (runs on every slice process).
+        The pool tensors are NOT donated by the step programs, so the
+        functional ``.at[].set`` here never races a dispatched block — the
+        in-flight block keeps reading the old buffers, the next dispatch
+        picks up the new ones."""
+        idx = int(payload["idx"])
+        with self._lock:
+            lt = {}
+            for t, fac in self._lora.items():
+                a = fac["a"].at[:, idx].set(
+                    np.asarray(payload[f"a:{t}"]).astype(fac["a"].dtype)
+                )
+                b = fac["b"].at[:, idx].set(
+                    np.asarray(payload[f"b:{t}"]).astype(fac["b"].dtype)
+                )
+                if self.mesh is not None:
+                    a = jax.device_put(a, fac["a"].sharding)
+                    b = jax.device_put(b, fac["b"].sharding)
+                lt[t] = {"a": a, "b": b}
+            self._lora = lt
+
+    def _aid_vec(self, payload: dict):
+        """Per-slot adapter-id vector for a decode dispatch (None with
+        LoRA off — the compiled programs then take an empty pytree)."""
+        if self._lora is None:
+            return None
+        aid = payload.get("aid")
+        if aid is None:
+            return np.zeros(self.n_slots, np.int32)
+        return np.asarray(aid, np.int32)
+
+    def _aid_scalar(self, payload: dict):
+        if self._lora is None:
+            return None
+        return np.int32(payload.get("aid", 0))
+
+    def note_adapter_tokens(self, adapter: str, n: int) -> None:
+        """Per-adapter served-token ledger (scheduler delivery loop).
+        Keyed by NAME, not slot: a request that completed inside the
+        delivered block has already released its slot binding."""
+        if self.lora_pool is None or not adapter:
+            return
+        if self.lora_pool.note_tokens_name(adapter, n):
+            DEFAULT_METRICS.lora_tokens.labels(self.name, adapter).inc(int(n))
+
+    def slot_adapter(self, slot: int) -> str | None:
+        """Resident adapter name bound to ``slot`` (None = base model)."""
+        if self.lora_pool is None:
+            return None
+        return self.lora_pool.name_of(int(self._slot_aidx[int(slot)]))
+
+    def adapters_snapshot(self) -> dict | None:
+        """Adapter-pool ledger for ``GET /stats/breakdown`` — also
+        refreshes the ``seldon_lora_*`` gauges."""
+        if self.lora_pool is None:
+            return None
+        snap = self.lora_pool.snapshot()
+        snap["bytes"] = self.lora_bytes
+        m = DEFAULT_METRICS
+        m.lora_resident.labels(self.name).set(snap["resident"])
+        m.lora_evictions.labels(self.name).set(snap["evictions"])
+        m.lora_bytes.labels(self.name).set(self.lora_bytes)
+        return snap
+
+    def release_memory(self) -> None:
+        """Drop this model's HBM ledger reservation (component close)."""
+        self.memory.release(self._mem_key)
+
     # ------------------------------------------------------------------ ops
 
     def fit_bucket(self, n: int) -> int:
@@ -855,6 +1107,8 @@ class GenerativeModel:
                     np.asarray(
                         payload.get("hist_seed", _NO_HIST), np.int32
                     ),
+                    self._aid_scalar(payload),
+                    self._lora,
                     self._cache,
                 )
             self._count_prefill(payload)
@@ -869,13 +1123,39 @@ class GenerativeModel:
         return row
 
     def reserve_for_prompt(
-        self, slot: int, prompt: "np.ndarray | None", total_tokens: int
+        self,
+        slot: int,
+        prompt: "np.ndarray | None",
+        total_tokens: int,
+        adapter: str | None = None,
     ) -> tuple[np.ndarray, int]:
         """Prompt-aware reservation: with prefix reuse enabled, the longest
         chain of full prompt blocks already in the index is REFERENCED
         (shared, immutable) instead of allocated, and only the remainder
         comes from the free pool.  Returns ``(table row, prefix_len)`` —
-        ``prefix_len`` tokens of prefill are skipped by the caller."""
+        ``prefix_len`` tokens of prefill are skipped by the caller.
+
+        ``adapter`` binds the slot to a resident LoRA adapter for the
+        request's lifetime (refcounted; released with the slot) AND salts
+        the prefix-index keys: LoRA on the attention projections changes
+        K/V, so adapter-A blocks must never serve adapter-B — or the base
+        model (docs/MULTITENANT.md)."""
+        from seldon_core_tpu.cache.prefix import adapter_salt
+
+        aidx = 0
+        if adapter:
+            if self.lora_pool is None:
+                raise GraphUnitError(
+                    f"request names adapter {adapter!r} but model "
+                    f"{self.name!r} was built without multi-LoRA serving"
+                )
+            from seldon_core_tpu.executor.lora import UnknownAdapter
+
+            try:
+                aidx = self.lora_pool.acquire(adapter)
+            except UnknownAdapter as e:
+                raise GraphUnitError(str(e)) from None
+        salt = adapter_salt(adapter)
         total = min(int(total_tokens), self.cfg.max_seq)
         need = -(-total // self.kv_block_size)
         self.release_slot(slot)  # a stale reservation on this slot is dead
@@ -885,7 +1165,9 @@ class GenerativeModel:
             # least one real token to produce the first sampled logits
             max_reuse = (int(prompt.size) - 1) // self.kv_block_size
             if max_reuse > 0:
-                matched = self.prefix_index.match(prompt, min(max_reuse, need))
+                matched = self.prefix_index.match(
+                    prompt, min(max_reuse, need), salt=salt
+                )
         own_need = need - len(matched)
         if len(self._free_blocks) < own_need and self.prefix_index is not None:
             # reclaim unreferenced index blocks before failing admission
@@ -894,7 +1176,9 @@ class GenerativeModel:
             )
         if len(self._free_blocks) < own_need:
             if matched:
-                self.prefix_index.release(prompt, len(matched))
+                self.prefix_index.release(prompt, len(matched), salt=salt)
+            if aidx:
+                self.lora_pool.release_ref(aidx)
             raise OutOfKVBlocks(
                 f"need {own_need} KV blocks, {len(self._free_blocks)} free"
             )
@@ -905,6 +1189,9 @@ class GenerativeModel:
         if used > self._blocks_high_water:
             self._blocks_high_water = used
         self._slot_blocks[slot] = got
+        self._slot_aidx[int(slot)] = aidx
+        if salt:
+            self._slot_salt[int(slot)] = salt
         if self.prefix_index is not None and prompt is not None:
             self._slot_prompt[slot] = np.asarray(prompt, np.int32).copy()
             self._slot_matched[slot] = len(matched)
@@ -928,19 +1215,26 @@ class GenerativeModel:
         matched = self._slot_matched.pop(slot, 0)
         prompt = self._slot_prompt.pop(slot, None)
         blocks = self._slot_blocks.pop(slot, None)
+        salt = self._slot_salt.pop(slot, b"")
+        aidx = int(self._slot_aidx[slot])
+        if aidx:
+            self._slot_aidx[slot] = 0
+            if self.lora_pool is not None:
+                self.lora_pool.release_ref(aidx)
         self._slot_row.pop(slot, None)
         if matched and prompt is not None and self.prefix_index is not None:
-            self.prefix_index.release(prompt, matched)
+            self.prefix_index.release(prompt, matched, salt=salt)
         if blocks:
             if self.prefix_index is not None and prompt is not None:
                 # owned blocks are table positions [matched, need); the
                 # first (full_prompt_blocks - matched) of them hold ONLY
-                # complete prompt K/V -> shareable
+                # complete prompt K/V -> shareable (under the slot's
+                # adapter salt — adapter-tagged chains never cross)
                 full = int(prompt.size) // self.kv_block_size
                 insertable = blocks[: max(0, full - matched)]
                 if insertable:
                     rejected = self.prefix_index.insert(
-                        prompt, insertable, matched
+                        prompt, insertable, matched, salt=salt
                     )
                     absorbed = set(insertable) - set(rejected)
                     blocks = [b for b in blocks if b not in absorbed]
@@ -998,6 +1292,7 @@ class GenerativeModel:
         k_scale: np.ndarray | None = None,
         v_scale: np.ndarray | None = None,
         first_token: int | None = None,
+        adapter: str | None = None,
     ) -> None:
         """Install another engine's exported prompt KV into ``slot``:
         reserve blocks (longest-prefix reuse applies — blocks this pool
@@ -1040,7 +1335,7 @@ class GenerativeModel:
                     f"not match this pool's {expect[:4]}"
                 )
         row, prefix_len = self.reserve_for_prompt(
-            slot, prompt, L + max(0, int(reserve_tokens))
+            slot, prompt, L + max(0, int(reserve_tokens)), adapter=adapter
         )
         skip = prefix_len // bs
         if str(k.dtype) == "bfloat16":
@@ -1199,13 +1494,15 @@ class GenerativeModel:
         temperature: float,
         seed: int,
         reserve_tokens: int = 0,
+        adapter: str | None = None,
     ):
         """Enqueue one prefill WITHOUT fetching its sampled token (a device
         array is returned).  Several admissions dispatched back-to-back cost
         ONE host round trip when their tokens are fetched together —
         serializing fetch-per-admit costs one RTT each on a tunnel-attached
         chip.  ``reserve_tokens`` sizes the block reservation beyond the
-        prompt (the request's max_new_tokens)."""
+        prompt (the request's max_new_tokens); ``adapter`` binds the slot
+        to a resident LoRA adapter for the request's lifetime."""
         prompt = np.asarray(prompt, np.int32).ravel()
         L = prompt.shape[0]
         if L < 1:
@@ -1215,14 +1512,15 @@ class GenerativeModel:
             # interleave — the scheduler — use admit_chunk_plan directly
             # and pace one chunk per decode sync point instead)
             plan = self.admit_chunk_plan(
-                slot, prompt, temperature, seed, reserve_tokens
+                slot, prompt, temperature, seed, reserve_tokens,
+                adapter=adapter,
             )
             tok = None
             for i in range(len(plan["payloads"])):
                 tok = self.prefill_chunk_dispatch(plan, i)
             return tok
         blocks_row, prefix_len = self.reserve_for_prompt(
-            slot, prompt, L + max(0, int(reserve_tokens))
+            slot, prompt, L + max(0, int(reserve_tokens)), adapter=adapter
         )
         self._pos_ceiling[int(slot)] = L  # prefill wrote rows [0, L)
         if prefix_len > 0:
@@ -1249,6 +1547,8 @@ class GenerativeModel:
                 "temperature": float(temperature),
                 "seed": int(seed),
             }
+            if self._lora is not None:
+                payload["aid"] = int(self._slot_aidx[int(slot)])
             if self.spec_draft:
                 payload["hist_seed"] = self._hist_seed(prompt)
             if self.driver is not None:
@@ -1265,6 +1565,8 @@ class GenerativeModel:
             "temperature": float(temperature),
             "seed": int(seed),
         }
+        if self._lora is not None:
+            payload["aid"] = int(self._slot_aidx[int(slot)])
         if self.spec_draft:
             payload["hist_seed"] = self._hist_seed(prompt)
         if self.driver is not None:
@@ -1280,6 +1582,7 @@ class GenerativeModel:
         temperature: float,
         seed: int,
         reserve_tokens: int = 0,
+        adapter: str | None = None,
     ) -> dict:
         """Reserve ``slot``'s blocks and lay out the admission as a list of
         prefill-chunk payloads (docs/PERFORMANCE.md §7).  Nothing touches
@@ -1297,7 +1600,7 @@ class GenerativeModel:
         if L < 1:
             raise GraphUnitError("empty prompt")
         blocks_row, prefix_len = self.reserve_for_prompt(
-            slot, prompt, L + max(0, int(reserve_tokens))
+            slot, prompt, L + max(0, int(reserve_tokens)), adapter=adapter
         )
         self._pos_ceiling[int(slot)] = L
         C = self.prefill_chunk or L
@@ -1347,6 +1650,8 @@ class GenerativeModel:
                     "seed": int(seed),
                     "chunk": meta,
                 }))
+            if self._lora is not None:
+                payloads[-1][1]["aid"] = int(self._slot_aidx[int(slot)])
             if self.spec_draft:
                 payloads[-1][1]["hist_seed"] = self._hist_seed(prompt[:e])
         return {"slot": int(slot), "payloads": payloads,
@@ -1453,8 +1758,12 @@ class GenerativeModel:
                 "weights": self.param_bytes,
                 "kv_pool": kv_bytes,
                 "kv_scales": scale_bytes,
+                "adapter_pool": self.lora_bytes,
                 "per_slot": self.kv_bytes_per_slot(),
             },
+            # chip-level arbitration (executor/memory.py): every resident
+            # deployment's classes against the shared HBM budget
+            "hbm": self.memory.snapshot(),
             "prefix_evictions": (
                 self.prefix_index.evicted if self.prefix_index is not None else 0
             ),
@@ -1474,6 +1783,7 @@ class GenerativeModel:
             ("weights", self.param_bytes),
             ("kv_pool", kv_bytes),
             ("kv_scales", scale_bytes),
+            ("adapter_pool", self.lora_bytes),
         ):
             m.kv_bytes.labels(self.name, cls).set(val)
         m.kv_prefix_evictions.labels(self.name).set(snap["prefix_evictions"])
@@ -1522,6 +1832,11 @@ class GenerativeModel:
             "prefill_chunk": self.prefill_chunk or None,
             "prefill_chunks": self.prefill_chunks,
             "decode_kernel": self.decode_kernel,
+            # batched multi-LoRA (docs/MULTITENANT.md): the adapter-pool
+            # ledger — resident/evicted counts, bytes, per-adapter slot
+            # occupancy and tokens served
+            "lora_rank": self.lora_rank or None,
+            "adapters": self.adapters_snapshot(),
             # per-slot inter-token latency (scheduler delivery gaps): the
             # number TTFT/device-step histograms cannot see — a prefill
             # stalling the decode pipeline lands here
@@ -1559,7 +1874,7 @@ class GenerativeModel:
         fresh = fn is None
         if fresh:
             fn = jax.jit(
-                self._prefill_suffix_factory(window), donate_argnums=(10,)
+                self._prefill_suffix_factory(window), donate_argnums=(12,)
             )
             self._prefill_suffix_jit[key] = fn
             self.program_compiles += 1
@@ -1581,6 +1896,8 @@ class GenerativeModel:
                     np.asarray(
                         payload.get("hist_seed", _NO_HIST), np.int32
                     ),
+                    self._aid_scalar(payload),
+                    self._lora,
                     self._cache,
                 )
             if fresh:
@@ -1622,7 +1939,7 @@ class GenerativeModel:
         fn = self._decode_jit.get(key)
         fresh = fn is None
         if fresh:
-            fn = jax.jit(self._decode_factory(window), donate_argnums=(5,))
+            fn = jax.jit(self._decode_factory(window), donate_argnums=(7,))
             self._decode_jit[key] = fn
             self.program_compiles += 1
         else:
@@ -1636,6 +1953,8 @@ class GenerativeModel:
                     np.asarray(payload["active"], bool),
                     np.asarray(payload["temperature"], np.float32),
                     np.int32(payload["seed"]),
+                    self._aid_vec(payload),
+                    self._lora,
                     self._cache,
                 )
             if fresh:
@@ -1659,6 +1978,8 @@ class GenerativeModel:
             "seed": int(seed),
             "window": window or self._window_for(active, 1),
         }
+        if self._lora is not None:
+            payload["aid"] = self._slot_aidx.copy()
         t0 = time.perf_counter()
         if self.driver is not None:
             toks = self.driver.lead(self._mh_decode_key, payload)
@@ -1723,6 +2044,8 @@ class GenerativeModel:
             # the window must cover the ceiling either way
             "window": window or self._window_for(active, k * self._tps),
         }
+        if self._lora is not None:
+            payload["aid"] = self._slot_aidx.copy()
         t0 = time.perf_counter()
         if self.driver is not None:
             toks_seq, act_seq = self.driver.lead(self._mh_decode_k_key, payload)
@@ -1804,7 +2127,7 @@ class GenerativeModel:
             # cache: each block consumes its predecessor's buffers in place,
             # so the overlapped pipeline holds one live carry, not two
             fn = jax.jit(
-                self._decode_k_factory(k, window), donate_argnums=(1, 2, 6, 7)
+                self._decode_k_factory(k, window), donate_argnums=(1, 2, 6, 9)
             )
             self._decode_k_jit[key] = fn
             self.program_compiles += 1
@@ -1820,6 +2143,7 @@ class GenerativeModel:
         with self._lock:
             temps = np.asarray(payload["temperature"], np.float32)
             eos = np.asarray(payload["eos"], np.int32)
+            aid = self._aid_vec(payload)
             t0 = time.perf_counter()
             with jax.profiler.TraceAnnotation(label):
                 (toks_seq, act_seq, tok_c, act_c, rem_c, self._cache) = fn(
@@ -1830,12 +2154,16 @@ class GenerativeModel:
                     np.int32(payload["seed"]),
                     eos,
                     np.asarray(payload["remaining"], np.int32),
+                    aid,
+                    self._lora,
                     self._cache,
                 )
             if fresh:
                 self._note_compile(label, time.perf_counter() - t0)
             self._carry = (tok_c, act_c, rem_c)
-            self._carry_aux = (temps, eos)
+            # adapter bindings only change at sync points (admission /
+            # release), so the continue path reuses the dispatched ids
+            self._carry_aux = (temps, eos, aid)
             self.steps += k
         return toks_seq, act_seq
 
@@ -1853,7 +2181,7 @@ class GenerativeModel:
                     "without a carried block"
                 )
             tok_c, act_c, rem_c = self._carry
-            temps, eos = self._carry_aux
+            temps, eos, aid = self._carry_aux
             t0 = time.perf_counter()
             with jax.profiler.TraceAnnotation(label):
                 (toks_seq, act_seq, tok_c, act_c, rem_c, self._cache) = fn(
@@ -1864,6 +2192,8 @@ class GenerativeModel:
                     np.int32(payload["seed"]),
                     eos,
                     rem_c,
+                    aid,
+                    self._lora,
                     self._cache,
                 )
             if fresh:
@@ -2087,6 +2417,9 @@ class _Request:
     # and first token arrived from another engine's handoff
     prefill_only: bool = False
     imported: dict | None = None
+    # batched multi-LoRA (docs/MULTITENANT.md): the named adapter this
+    # request decodes through (None = base model / null adapter row)
+    adapter: str | None = None
     # generation-forensics ledger entry (obs/timeline.py; None when the
     # ledger is off) and the terminal reason _token_done computed — every
     # event is stamped from host-held values only
@@ -2205,12 +2538,14 @@ class GenerationScheduler:
         temperature: float = 0.0,
         eos_id: int | None = None,
         on_token: "Callable[[int], None] | None" = None,
+        adapter: str | None = None,
     ) -> np.ndarray:
         """Generate up to ``max_new_tokens`` ids for a 1-D prompt.
 
         ``on_token`` (optional) fires per sampled token in event-loop
         context — the streaming hook; tokens arrive ``decode_block`` at a
-        time per device fetch."""
+        time per device fetch.  ``adapter`` names a resident LoRA adapter
+        to decode through (docs/MULTITENANT.md)."""
         if self._closed:
             raise RuntimeError("GenerationScheduler is closed")
         prompt = np.asarray(prompt, np.int32).ravel()
@@ -2261,6 +2596,7 @@ class GenerationScheduler:
             on_token=on_token, t0=time.perf_counter(),
             span=current_span(),
             priority=priority, deadline=qos.get_deadline(),
+            adapter=adapter or None,
         )
         self._begin_tl(req)
         self._tl(req, "queued", span=False, depth=len(self._waiting))
@@ -2329,7 +2665,8 @@ class GenerationScheduler:
             raise
 
     async def submit_prefill(
-        self, prompt: np.ndarray, *, temperature: float = 0.0
+        self, prompt: np.ndarray, *, temperature: float = 0.0,
+        adapter: str | None = None,
     ) -> tuple[int, int]:
         """Disagg prefill-only admission (docs/DISAGGREGATION.md): prefill
         ``prompt`` into a free slot and return ``(slot, first_token)``
@@ -2347,6 +2684,7 @@ class GenerationScheduler:
             prompt, 1, float(temperature), None, fut,
             t0=time.perf_counter(), span=current_span(),
             priority=qos.get_priority(), deadline=qos.get_deadline(),
+            adapter=adapter or None,
         )
         req.prefill_only = True
         self._begin_tl(req, kind="prefill")
@@ -2366,6 +2704,7 @@ class GenerationScheduler:
         on_token: "Callable[[int], None] | None" = None,
         k_scale: np.ndarray | None = None,
         v_scale: np.ndarray | None = None,
+        adapter: str | None = None,
     ) -> np.ndarray:
         """Disagg decode-side admission: continue a generation whose
         prompt KV (``k``/``v``) and first sampled token arrived from a
@@ -2387,6 +2726,7 @@ class GenerationScheduler:
             prompt, max_new_tokens, float(temperature), eos_id, fut,
             on_token=on_token, t0=time.perf_counter(), span=current_span(),
             priority=qos.get_priority(), deadline=qos.get_deadline(),
+            adapter=adapter or None,
         )
         req.imported = {
             "first_token": int(first_token), "k": k, "v": v,
@@ -2573,10 +2913,15 @@ class GenerationScheduler:
         # host-side arithmetic only
         spec_d = getattr(self.model, "spec_draft", 0)
         tps = getattr(self.model, "_tps", 1)
+        # per-adapter served-token ledger (docs/MULTITENANT.md); getattr:
+        # duck-typed stand-in models predate multi-LoRA
+        note_adapter = getattr(self.model, "note_adapter_tokens", None)
         for i in range(S):
             req = reqs[i]
             if req is None or not counts[i]:
                 continue
+            if req.adapter and note_adapter is not None:
+                note_adapter(req.adapter, counts[i])
             if req.t_last_tok and note_itl is not None:
                 note_itl((now - req.t_last_tok) / counts[i])
             req.t_last_tok = now
@@ -2901,6 +3246,9 @@ class GenerationScheduler:
             starved = []
             chunked = []
             for req, slot in zip(batch, free):
+                # duck-typed stand-in models (tests) predate multi-LoRA:
+                # only pass the kwarg when the request actually names one
+                akw = {"adapter": req.adapter} if req.adapter else {}
                 try:
                     if req.imported is not None:
                         # disagg import: the prompt KV arrived from a
@@ -2912,6 +3260,7 @@ class GenerationScheduler:
                             k_scale=imp.get("k_scale"),
                             v_scale=imp.get("v_scale"),
                             first_token=imp["first_token"],
+                            **akw,
                         )
                         placed.append((req, slot, imp["first_token"]))
                         continue
@@ -2926,12 +3275,14 @@ class GenerationScheduler:
                             slot, req.prompt, req.temperature,
                             self._next_seed(),
                             reserve_tokens=req.max_new_tokens,
+                            **akw,
                         )
                         chunked.append((req, slot, plan))
                         continue
                     tok_dev = self.model.admit_dispatch(
                         slot, req.prompt, req.temperature, self._next_seed(),
                         reserve_tokens=req.max_new_tokens,
+                        **akw,
                     )
                     placed.append((req, slot, tok_dev))
                 except OutOfKVBlocks:
@@ -2960,9 +3311,10 @@ class GenerationScheduler:
                 {"req": req, "slot": slot, "plan": plan, "i": 0}
             )
             self._prefill_slots.add(slot)
+            akw = {"adapter": req.adapter} if req.adapter else {}
             self._tl(
                 req, "admit", slot=slot, chunked=True,
-                chunks=len(plan["payloads"]), **(resnap(slot) or {}),
+                chunks=len(plan["payloads"]), **akw, **(resnap(slot) or {}),
             )
         for req in starved:
             self._tl(req, "kv-starved", span=False)
@@ -2983,9 +3335,10 @@ class GenerationScheduler:
                     self._end_tl(req, "disconnect", stage="prefill")
                 else:
                     self._external.add(slot)
+                    akw = {"adapter": req.adapter} if req.adapter else {}
                     self._tl(
                         req, "admit", slot=slot, prefill_only=True,
-                        **(resnap(slot) or {}),
+                        **akw, **(resnap(slot) or {}),
                     )
                     req.future.set_result((slot, int(tok)))
                     self._end_tl(req, "exported", slot=slot)
@@ -2993,6 +3346,8 @@ class GenerationScheduler:
             attrs = resnap(slot) or {}
             if req.imported is not None:
                 attrs["imported"] = True
+            if req.adapter:
+                attrs["adapter"] = req.adapter
             self._tl(req, "admit", slot=slot, **attrs)
             if self._token_done(req, int(tok)):
                 self._complete(req)
@@ -3114,6 +3469,7 @@ class GenerativeComponent(SeldonComponent):
         eos_id: int | None = None,
         queue_max: int | None = None,
         overlap: bool | None = None,
+        adapter: str | None = None,
     ):
         self.model = model
         self.scheduler = GenerationScheduler(
@@ -3122,6 +3478,11 @@ class GenerativeComponent(SeldonComponent):
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.eos_id = eos_id
+        # deployment-default LoRA adapter (docs/MULTITENANT.md): requests
+        # may override per call with the strData "adapter" field; the A/B
+        # and canary machinery splits traffic between two adapter ids of
+        # one base deployment by giving each predictor a different default
+        self.adapter = adapter or None
 
     def warmup(self) -> int:
         return self.model.warmup()
@@ -3134,6 +3495,7 @@ class GenerativeComponent(SeldonComponent):
 
     async def close(self) -> None:
         await self.scheduler.close()
+        self.model.release_memory()
 
     def metrics(self) -> list[dict[str, Any]]:
         out = [
@@ -3169,6 +3531,7 @@ class GenerativeComponent(SeldonComponent):
         max_new_tokens: int,
         temperature: float,
         eos_id: int | None,
+        adapter: str | None = None,
     ) -> list[np.ndarray]:
         return list(
             await asyncio.gather(
@@ -3178,6 +3541,7 @@ class GenerativeComponent(SeldonComponent):
                         max_new_tokens=max_new_tokens,
                         temperature=temperature,
                         eos_id=eos_id,
+                        adapter=adapter,
                     )
                     for row in rows
                 )
@@ -3206,7 +3570,8 @@ class GenerativeComponent(SeldonComponent):
             keep = row != PAD_ID
             rows.append(row[: int(keep.cumsum().argmax()) + 1] if keep.any() else row)
         outs = await self._generate_rows(
-            rows, self.max_new_tokens, self.temperature, self.eos_id
+            rows, self.max_new_tokens, self.temperature, self.eos_id,
+            self.adapter,
         )
         return self._pad_rows(outs)
 
@@ -3217,6 +3582,7 @@ class GenerativeComponent(SeldonComponent):
         max_new_tokens: int | None = None,
         temperature: float | None = None,
         eos_id: int | None = None,
+        adapter: str | None = None,
     ) -> AsyncIterator[int]:
         """Yield generated token ids as they decode (the streaming serving
         path — neither the reference nor its successor streams at all).
@@ -3236,6 +3602,7 @@ class GenerativeComponent(SeldonComponent):
                     self.temperature if temperature is None else temperature
                 ),
                 eos_id=self.eos_id if eos_id is None else eos_id,
+                adapter=self.adapter if adapter is None else (adapter or None),
                 on_token=q.put_nowait,
             )
         )
@@ -3276,11 +3643,13 @@ class GenerativeComponent(SeldonComponent):
         except (json.JSONDecodeError, TypeError, KeyError, ValueError) as e:
             raise GraphUnitError(f"bad generative request: {e}") from e
         eos = body.get("eos_id", self.eos_id)
+        adapter = body.get("adapter", self.adapter)
         outs = await self._generate_rows(
             rows,
             int(body.get("max_new_tokens", self.max_new_tokens)),
             float(body.get("temperature", self.temperature)),
             int(eos) if eos is not None else None,
+            str(adapter) if adapter else None,
         )
         result = [o.tolist() for o in outs]
         return Payload(

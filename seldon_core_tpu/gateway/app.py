@@ -330,14 +330,14 @@ class GatewayApp:
         endpoints = rec.replica_endpoints
         ep = None
         if len(endpoints) > 1:
-            from seldon_core_tpu.disagg.router import extract_prompt_tokens
+            from seldon_core_tpu.disagg.router import extract_prompt_request
 
-            tokens = (
-                extract_prompt_tokens(raw)
+            tokens, adapter = (
+                extract_prompt_request(raw)
                 if self.router.has_digests(rec.oauth_key)
-                else None
+                else (None, None)
             )
-            ep = self.router.pick(rec.oauth_key, endpoints, tokens)
+            ep = self.router.pick(rec.oauth_key, endpoints, tokens, adapter)
             self.router.note_start(rec.oauth_key, ep.key)
         pool = self._pool(rec, ep)
         wire = WIRE.counter(WIRE_GATEWAY_REST, rec.name)

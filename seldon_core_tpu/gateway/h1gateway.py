@@ -1292,16 +1292,20 @@ class H1SpliceFrontend:
         ep = endpoints[0]
         if len(endpoints) > 1:
             router = self.gateway.router
-            tokens = None
+            tokens = adapter = None
             if (
                 raw is not None
                 and content_length
                 and router.has_digests(rec.oauth_key)
             ):
-                from seldon_core_tpu.disagg.router import extract_prompt_tokens
+                from seldon_core_tpu.disagg.router import (
+                    extract_prompt_request,
+                )
 
-                tokens = extract_prompt_tokens(raw[len(raw) - content_length:])
-            ep = router.pick(rec.oauth_key, endpoints, tokens)
+                tokens, adapter = extract_prompt_request(
+                    raw[len(raw) - content_length:]
+                )
+            ep = router.pick(rec.oauth_key, endpoints, tokens, adapter)
         key = (rec.oauth_key, ep.key)
         pool = self._pools.get(key)
         if pool is None:

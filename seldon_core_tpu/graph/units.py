@@ -357,7 +357,9 @@ def _jax_generative(parameters: dict[str, Any]) -> Any:
     ``spec_ngram`` / ``spec_hist`` (fused self-speculative decoding),
     ``kv_cache_dtype`` (``int8`` paged-KV quantization), ``prefill_chunk``
     (Sarathi-style chunked prefill interleaved with decode),
-    ``decode_kernel`` (fused Pallas paged decode-attention kernel), plus
+    ``decode_kernel`` (fused Pallas paged decode-attention kernel),
+    ``lora_rank`` / ``lora_slots`` / ``lora_targets`` / ``lora_adapters``
+    / ``adapter`` (batched multi-LoRA serving, docs/MULTITENANT.md), plus
     model-config overrides.
     """
     from seldon_core_tpu.models import registry as model_registry

@@ -124,6 +124,138 @@ def param_logical_axes(params):
 
 
 # ---------------------------------------------------------------------------
+# batched multi-LoRA adapters (docs/MULTITENANT.md)
+# ---------------------------------------------------------------------------
+#
+# S-LoRA/Punica-style serving: ONE stacked adapter pool in HBM,
+# ``(n_layers, n_adapters, ...)`` per low-rank factor, and a per-batch-row
+# ``adapter_id`` gather inside the SAME fused prefill/decode programs that
+# serve the base model — N fine-tune variants of one base ride one compiled
+# step with no per-adapter programs and no weight swapping.  Adapter row 0
+# is the reserved NULL adapter (all-zero factors): a null-adapter slot's
+# delta is exactly 0.0, so its outputs are bit-identical to a lora-off
+# build (the pinned-equal matrix in tests/test_lora.py holds this).
+
+LORA_ATTN_TARGETS = ("wq", "wk", "wv", "wo")
+LORA_MLP_TARGETS = ("w_gate", "w_up", "w_down")
+
+
+def _lora_shapes(cfg: Config, rank: int) -> dict:
+    """Per-target (a, b) factor shapes WITHOUT the leading
+    ``(n_layers, n_adapters)`` stack axes: ``delta = (x @ a) @ b`` matches
+    the base projection's contraction exactly."""
+    e, h, kv, d, f = (
+        cfg.hidden, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.ffn,
+    )
+    return {
+        "wq": ((e, rank), (rank, h, d)),
+        "wk": ((e, rank), (rank, kv, d)),
+        "wv": ((e, rank), (rank, kv, d)),
+        "wo": ((h, d, rank), (rank, e)),
+        "w_gate": ((e, rank), (rank, f)),
+        "w_up": ((e, rank), (rank, f)),
+        "w_down": ((f, rank), (rank, e)),
+    }
+
+
+def init_lora_params(
+    cfg: Config,
+    n_adapters: int,
+    rank: int,
+    targets: tuple = LORA_ATTN_TARGETS,
+    dtype=jnp.float32,
+) -> dict:
+    """Zero-initialized stacked adapter pool: ``{target: {"a": (L, A, in..,
+    r), "b": (L, A, r, out..)}}``.  Layers lead so the pool rides the layer
+    ``lax.scan`` as xs alongside ``params["layers"]``; adapter row 0 stays
+    all-zero forever (the null adapter)."""
+    shapes = _lora_shapes(cfg, int(rank))
+    nl, na = cfg.n_layers, int(n_adapters)
+    out = {}
+    for t in targets:
+        sa, sb = shapes[t]
+        out[t] = {
+            "a": jnp.zeros((nl, na) + sa, dtype),
+            "b": jnp.zeros((nl, na) + sb, dtype),
+        }
+    return out
+
+
+def lora_adapter_factors(
+    rng: jax.Array,
+    cfg: Config,
+    rank: int,
+    targets: tuple = LORA_ATTN_TARGETS,
+    scale: float = 0.05,
+    dtype=jnp.float32,
+) -> dict:
+    """ONE adapter's random factors ``{target: {"a": (L, in.., r), "b":
+    (L, r, out..)}}`` — the synthetic stand-in for a trained LoRA delta
+    (tests, bench, and the graph-declared adapter registry).  ``b`` is
+    non-zero (unlike training init) so distinct adapters provably produce
+    distinct generations."""
+    shapes = _lora_shapes(cfg, int(rank))
+    keys = jax.random.split(rng, 2 * len(targets))
+    out = {}
+    for i, t in enumerate(targets):
+        sa, sb = shapes[t]
+        fan_in = 1
+        for s in sa[:-1]:
+            fan_in *= s
+        out[t] = {
+            "a": (
+                jax.random.normal(keys[2 * i], (cfg.n_layers,) + sa)
+                / math.sqrt(fan_in)
+            ).astype(dtype),
+            "b": (
+                jax.random.normal(keys[2 * i + 1], (cfg.n_layers,) + sb)
+                * scale
+            ).astype(dtype),
+        }
+    return out
+
+
+def lora_pool_bytes(cfg: Config, n_adapters: int, rank: int,
+                    targets: tuple = LORA_ATTN_TARGETS,
+                    dtype="float32") -> int:
+    """HBM bytes the stacked adapter pool costs — the ``adapter_pool``
+    class in the memory manager's ledger (executor/memory.py)."""
+    import numpy as _np
+
+    itemsize = 2 if str(dtype) in ("bfloat16", "bf16") else _np.dtype(
+        dtype
+    ).itemsize
+    total = 0
+    for t in targets:
+        sa, sb = _lora_shapes(cfg, int(rank))[t]
+        n = 1
+        for s in sa:
+            n *= s
+        m = 1
+        for s in sb:
+            m *= s
+        total += (n + m) * cfg.n_layers * int(n_adapters) * itemsize
+    return total
+
+
+def _lora_delta(h, la, aid):
+    """Per-row low-rank delta: ``h (B, L, in..)`` through adapter
+    ``aid[b]``'s factors gathered from ONE layer's pool slice ``la =
+    {"a": (A, in.., r), "b": (A, r, out..)}``.  The gather is per batch
+    row — a mixed-adapter batch pays two small einsums, never a
+    per-adapter program."""
+    a = la["a"][aid]  # (B, in.., r)
+    b = la["b"][aid]  # (B, r, out..)
+    if a.ndim == 4:  # o-proj input (B, H, D, r)
+        xa = jnp.einsum("blhd,bhdr->blr", h, a)
+    else:
+        xa = jnp.einsum("ble,ber->blr", h, a)
+    if b.ndim == 4:  # attention out head-shaped (B, r, H|KV, D)
+        return jnp.einsum("blr,brhd->blhd", xa, b)
+    return jnp.einsum("blr,brf->blf", xa, b)
+
+
+# ---------------------------------------------------------------------------
 # building blocks
 # ---------------------------------------------------------------------------
 
@@ -161,15 +293,28 @@ def _dense_causal_attention(q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _layer(x, lp, cfg: Config, positions, attn_fn, kv_hook=None):
+def _layer(x, lp, cfg: Config, positions, attn_fn, kv_hook=None, lora=None,
+           aid=None):
     """``kv_hook(k, v) -> (k_attn, v_attn, stored)`` lets a quantized KV
     pool attend the DEQUANTIZED values it will actually cache (fake-quant
     consistency: a reused prefix then reads byte-identical K/V to what the
-    cold prefill attended, keeping prefix reuse bit-exact under int8)."""
+    cold prefill attended, keeping prefix reuse bit-exact under int8).
+
+    ``lora`` is ONE layer's adapter-pool slice (``{target: {"a": (A, ..),
+    "b": (A, ..)}}``) and ``aid (B,)`` the per-row adapter ids — the
+    batched multi-LoRA gather (docs/MULTITENANT.md); ``None`` compiles the
+    plain base-model layer."""
     h = _rmsnorm(x, lp["ln_att"], cfg.norm_eps)
     q = jnp.einsum("ble,ehd->blhd", h, lp["wq"])
     k = jnp.einsum("ble,ehd->blhd", h, lp["wk"])
     v = jnp.einsum("ble,ehd->blhd", h, lp["wv"])
+    if lora is not None:
+        if "wq" in lora:
+            q = q + _lora_delta(h, lora["wq"], aid)
+        if "wk" in lora:
+            k = k + _lora_delta(h, lora["wk"], aid)
+        if "wv" in lora:
+            v = v + _lora_delta(h, lora["wv"], aid)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if kv_hook is None:
@@ -177,10 +322,29 @@ def _layer(x, lp, cfg: Config, positions, attn_fn, kv_hook=None):
     else:
         ka, va, stored = kv_hook(k, v)
     o = attn_fn(q, _gqa_repeat(ka, cfg.n_heads), _gqa_repeat(va, cfg.n_heads))
-    x = x + jnp.einsum("blhd,hde->ble", o, lp["wo"])
+    proj = jnp.einsum("blhd,hde->ble", o, lp["wo"])
+    if lora is not None and "wo" in lora:
+        proj = proj + _lora_delta(o, lora["wo"], aid)
+    x = x + proj
     h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
-    mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
-    return x + mlp, stored
+    x = x + _mlp_block(h, lp, lora, aid)
+    return x, stored
+
+
+def _mlp_block(h, lp, lora=None, aid=None):
+    """SwiGLU MLP with optional per-row LoRA deltas on gate/up/down."""
+    gate = h @ lp["w_gate"]
+    up = h @ lp["w_up"]
+    if lora is not None:
+        if "w_gate" in lora:
+            gate = gate + _lora_delta(h, lora["w_gate"], aid)
+        if "w_up" in lora:
+            up = up + _lora_delta(h, lora["w_up"], aid)
+    act = jax.nn.silu(gate) * up
+    down = act @ lp["w_down"]
+    if lora is not None and "w_down" in lora:
+        down = down + _lora_delta(act, lora["w_down"], aid)
+    return down
 
 
 # ---------------------------------------------------------------------------
@@ -266,19 +430,32 @@ def prefill(
     return x @ params["head"], cache
 
 
-def _prefill_core(params, tokens, cfg: Config, attn_fn, kv_hook=None):
+def _prefill_core(params, tokens, cfg: Config, attn_fn, kv_hook=None,
+                  lora=None, aid=None):
     """Embed + layer scan shared by :func:`prefill` and :func:`prefill_slot`.
     Returns ``(hidden (B, L, E), stored)`` where ``stored`` is
     ``(ks, vs) (layers, B, L, kv, hd)`` for float pools, or the kv_hook's
-    per-layer pytree (quantized blocks + scales) when one is given."""
+    per-layer pytree (quantized blocks + scales) when one is given.
+    ``lora``/``aid``: the stacked adapter pool (layers-first) + per-row
+    adapter ids — the pool rides the scan xs next to the layer weights."""
     x = params["tok_emb"][tokens]
     positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
 
-    def body(x, lp):
-        x, stored = _layer(x, lp, cfg, positions, attn_fn, kv_hook)
-        return x, stored
+    if lora is None:
+        def body(x, lp):
+            x, stored = _layer(x, lp, cfg, positions, attn_fn, kv_hook)
+            return x, stored
 
-    x, stored = jax.lax.scan(body, x, params["layers"])
+        x, stored = jax.lax.scan(body, x, params["layers"])
+    else:
+        def body(x, inputs):
+            lp, ll = inputs
+            x, stored = _layer(
+                x, lp, cfg, positions, attn_fn, kv_hook, lora=ll, aid=aid
+            )
+            return x, stored
+
+        x, stored = jax.lax.scan(body, x, (params["layers"], lora))
     return x, stored
 
 
@@ -475,6 +652,8 @@ def prefill_slot_paged(
     *,
     mesh: Mesh | None = None,
     seq_impl: str = "dense",
+    lora: dict | None = None,
+    adapter_id: jax.Array | None = None,
 ) -> tuple[jax.Array, dict]:
     """Prefill ONE request's prompt into the blocks reserved for ``slot``.
 
@@ -482,13 +661,20 @@ def prefill_slot_paged(
     of the block size; ``blocks_row`` is the slot's full ``(max_blocks,)``
     table row (reserved physical ids, zero-padded).  Pad rows land in
     reserved blocks and are masked by decode's validity test, exactly like
-    the static-slot variant."""
+    the static-slot variant.  ``lora``/``adapter_id`` select the request's
+    adapter from the stacked pool (docs/MULTITENANT.md); adapter 0 (or no
+    pool) is the base model."""
     bs = cache["k"].shape[2]
     lp = tokens.shape[1]
     quant = "k_scale" in cache
     hook = _fake_quant_hook(cache["k_scale"].dtype) if quant else None
+    aid = (
+        None if lora is None
+        else jnp.asarray(adapter_id, jnp.int32).reshape(1)
+    )
     x, stored = _prefill_core(
-        params, tokens, cfg, _select_attn(mesh, seq_impl), kv_hook=hook
+        params, tokens, cfg, _select_attn(mesh, seq_impl), kv_hook=hook,
+        lora=lora, aid=aid,
     )
     # (layers, 1, Lp, kv, hd) -> (layers, Lb, bs, kv, hd) scattered to the
     # slot's first Lb physical blocks
@@ -535,6 +721,8 @@ def prefill_suffix_paged(
     cfg: Config,
     *,
     prefix_window: int,
+    lora: dict | None = None,
+    adapter_id: jax.Array | None = None,
 ) -> tuple[jax.Array, dict]:
     """Prefill only the SUFFIX of a prompt whose first ``prefix_len``
     tokens already have K/V in the slot's table blocks (KV prefix reuse,
@@ -574,14 +762,26 @@ def prefill_suffix_paged(
     )  # (Ls, P + Ls)
     scale = 1.0 / math.sqrt(cfg.head_dim)
     hook = _fake_quant_hook(cache["k_scale"].dtype) if quant else None
+    aid = (
+        None if lora is None
+        else jnp.asarray(adapter_id, jnp.int32).reshape(1)
+    )
 
     def body(carry, inputs):
         x, ck, cv, cks, cvs = carry
-        li, lp = inputs
+        li, lp = inputs[0], inputs[1]
+        ll = inputs[2] if lora is not None else None
         h = _rmsnorm(x, lp["ln_att"], cfg.norm_eps)
         q = jnp.einsum("ble,ehd->blhd", h, lp["wq"])
         k = jnp.einsum("ble,ehd->blhd", h, lp["wk"])
         v = jnp.einsum("ble,ehd->blhd", h, lp["wv"])
+        if ll is not None:
+            if "wq" in ll:
+                q = q + _lora_delta(h, ll["wq"], aid)
+            if "wk" in ll:
+                k = k + _lora_delta(h, ll["wk"], aid)
+            if "wv" in ll:
+                v = v + _lora_delta(h, ll["wv"], aid)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         if quant:
@@ -608,9 +808,12 @@ def prefill_suffix_paged(
         s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bkhd->bqhd", p, vf)
-        x = x + jnp.einsum("blhd,hde->ble", o, lp["wo"])
+        proj = jnp.einsum("blhd,hde->ble", o, lp["wo"])
+        if ll is not None and "wo" in ll:
+            proj = proj + _lora_delta(o, ll["wo"], aid)
+        x = x + proj
         h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
-        mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+        mlp = _mlp_block(h, lp, ll, aid)
         if quant:
             ck = ck.at[li, suffix_blocks].set(qk[0].reshape(lb, bs, kvh, hd))
             cv = cv.at[li, suffix_blocks].set(qv[0].reshape(lb, bs, kvh, hd))
@@ -624,6 +827,9 @@ def prefill_suffix_paged(
         return (x + mlp, ck, cv, cks, cvs), None
 
     zero = jnp.zeros((), jnp.int8)  # scan carries need SOME leaf when not quant
+    xs = (jnp.arange(cfg.n_layers), params["layers"])
+    if lora is not None:
+        xs = xs + (lora,)
     (x, new_k, new_v, new_ks, new_vs), _ = jax.lax.scan(
         body,
         (
@@ -633,7 +839,7 @@ def prefill_suffix_paged(
             cache["k_scale"] if quant else zero,
             cache["v_scale"] if quant else zero,
         ),
-        (jnp.arange(cfg.n_layers), params["layers"]),
+        xs,
     )
     cache = dict(cache)
     cache.update(
@@ -661,6 +867,8 @@ def decode_slots_paged(
     *,
     window: int | None = None,
     kernel: bool = False,
+    lora: dict | None = None,
+    adapter_ids: jax.Array | None = None,
 ) -> tuple[jax.Array, dict]:
     """One decode step for every slot against the paged cache.
 
@@ -669,10 +877,13 @@ def decode_slots_paged(
     as the static window read — the pool layout changes where rows LIVE,
     not how many are read).  ``kernel`` (static) routes the attention read
     through the fused Pallas paged decode-attention kernel
-    (``ops/paged_attention.py``) instead of the XLA gather path."""
+    (``ops/paged_attention.py``) instead of the XLA gather path.
+    ``lora``/``adapter_ids (S,)`` gather each slot's adapter delta inside
+    the same fused step — mixed-adapter batches ride ONE program
+    (docs/MULTITENANT.md)."""
     logits, cache = _decode_paged_multi(
         params, tokens[:, None], cache, active, active[:, None], cfg,
-        window=window, kernel=kernel,
+        window=window, kernel=kernel, lora=lora, adapter_ids=adapter_ids,
     )
     cache["pos"] = jnp.where(active, cache["pos"] + 1, cache["pos"])
     return logits[:, 0], cache
@@ -688,6 +899,8 @@ def decode_slots_spec_paged(
     *,
     window: int | None = None,
     kernel: bool = False,
+    lora: dict | None = None,
+    adapter_ids: jax.Array | None = None,
 ) -> tuple[jax.Array, dict]:
     """Speculative verify pass: score ``L = 1 + draft`` query positions per
     slot in ONE model call (docs/PERFORMANCE.md).
@@ -706,13 +919,14 @@ def decode_slots_spec_paged(
     """
     return _decode_paged_multi(
         params, qtokens, cache, active, qvalid, cfg, window=window,
-        kernel=kernel,
+        kernel=kernel, lora=lora, adapter_ids=adapter_ids,
     )
 
 
 def _decode_paged_multi(
     params, qtokens, cache, active, qvalid, cfg: Config, *, window,
-    kernel: bool = False,
+    kernel: bool = False, lora: dict | None = None,
+    adapter_ids: jax.Array | None = None,
 ):
     """Shared L-query decode body: ``L=1`` is the classic decode step,
     ``L>1`` the fused speculative verify.  The per-row contraction shapes
@@ -759,14 +973,26 @@ def _decode_paged_multi(
     kv, hd = cfg.n_kv_heads, cfg.head_dim
     sdt = cache["k_scale"].dtype if quant else None
     zero = jnp.zeros((), jnp.int8)
+    aid = (
+        None if lora is None
+        else jnp.asarray(adapter_ids, jnp.int32).reshape(S)
+    )
 
     def body(carry, inputs):
         x, ck, cv, cks, cvs = carry
-        li, lp = inputs
+        li, lp = inputs[0], inputs[1]
+        ll = inputs[2] if lora is not None else None
         h = _rmsnorm(x, lp["ln_att"], cfg.norm_eps)
         q = jnp.einsum("ble,ehd->blhd", h, lp["wq"])
         k = jnp.einsum("ble,ehd->blhd", h, lp["wk"])
         v = jnp.einsum("ble,ehd->blhd", h, lp["wv"])
+        if ll is not None:
+            if "wq" in ll:
+                q = q + _lora_delta(h, ll["wq"], aid)
+            if "wk" in ll:
+                k = k + _lora_delta(h, ll["wk"], aid)
+            if "wv" in ll:
+                v = v + _lora_delta(h, ll["wv"], aid)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         if quant:
@@ -817,11 +1043,17 @@ def _decode_paged_multi(
             p = jax.nn.softmax(s, axis=-1)
             o = jnp.einsum("bkgqs,bskd->bqkgd", p, vw)
             o = o.reshape(S, L, cfg.n_heads, cfg.head_dim)
-        x = x + jnp.einsum("blhd,hde->ble", o, lp["wo"])
+        proj = jnp.einsum("blhd,hde->ble", o, lp["wo"])
+        if ll is not None and "wo" in ll:
+            proj = proj + _lora_delta(o, ll["wo"], aid)
+        x = x + proj
         h = _rmsnorm(x, lp["ln_mlp"], cfg.norm_eps)
-        mlp = (jax.nn.silu(h @ lp["w_gate"]) * (h @ lp["w_up"])) @ lp["w_down"]
+        mlp = _mlp_block(h, lp, ll, aid)
         return (x + mlp, ck, cv, cks, cvs), None
 
+    xs_in = (jnp.arange(cfg.n_layers), params["layers"])
+    if lora is not None:
+        xs_in = xs_in + (lora,)
     (x, new_k, new_v, new_ks, new_vs), _ = jax.lax.scan(
         body,
         (
@@ -831,7 +1063,7 @@ def _decode_paged_multi(
             cache["k_scale"] if quant else zero,
             cache["v_scale"] if quant else zero,
         ),
-        (jnp.arange(cfg.n_layers), params["layers"]),
+        xs_in,
     )
     out = dict(cache)
     out["k"] = new_k

@@ -225,6 +225,11 @@ def build_generative_component(
     kv_cache_dtype: str | None = None,
     prefill_chunk: int | None = None,
     decode_kernel: bool | None = None,
+    lora_rank: int | None = None,
+    lora_slots: int | None = None,
+    lora_targets: str | None = None,
+    lora_adapters: Any = None,
+    adapter: str | None = None,
     **overrides,
 ):
     """Build a continuous-batching generative graph unit (JAX_GENERATIVE).
@@ -236,7 +241,11 @@ def build_generative_component(
     pool quantized with per-(position, head) scales;
     ``prefill_chunk`` enables Sarathi-style chunked prefill interleaved
     with decode and ``decode_kernel`` the fused Pallas paged
-    decode-attention kernel (docs/PERFORMANCE.md §7)."""
+    decode-attention kernel (docs/PERFORMANCE.md §7).
+    ``lora_rank``/``lora_slots``/``lora_targets``/``lora_adapters`` turn
+    on batched multi-LoRA serving (stacked adapter pool, per-slot gather
+    fused into decode — docs/MULTITENANT.md); ``adapter`` sets the
+    deployment-default adapter a request may override per call."""
     from seldon_core_tpu.executor.generation import (
         GenerativeComponent,
         GenerativeModel,
@@ -282,6 +291,10 @@ def build_generative_component(
         kv_cache_dtype=kv_cache_dtype,
         prefill_chunk=prefill_chunk,
         decode_kernel=decode_kernel,
+        lora_rank=lora_rank,
+        lora_slots=lora_slots,
+        lora_targets=lora_targets,
+        lora_adapters=lora_adapters,
     )
     return GenerativeComponent(
         model,
@@ -290,4 +303,5 @@ def build_generative_component(
         eos_id=eos_id,
         queue_max=queue_max,
         overlap=overlap,
+        adapter=adapter,
     )

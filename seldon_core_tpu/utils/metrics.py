@@ -344,6 +344,34 @@ class MetricsRegistry:
             ["model_name"],
             registry=self.registry,
         )
+        # batched multi-LoRA serving (docs/MULTITENANT.md): adapter-pool
+        # residency/eviction/bytes gauges refreshed at snapshot time, plus
+        # a per-adapter served-token counter fed by the delivery loop
+        self.lora_resident = Gauge(
+            "seldon_lora_resident_adapters",
+            "Named LoRA adapters resident in the stacked device pool",
+            ["model_name"],
+            registry=self.registry,
+        )
+        self.lora_evictions = Gauge(
+            "seldon_lora_evictions",
+            "Cumulative LRU evictions from the adapter pool",
+            ["model_name"],
+            registry=self.registry,
+        )
+        self.lora_bytes = Gauge(
+            "seldon_lora_pool_bytes",
+            "HBM bytes held by the stacked LoRA adapter pool (also the "
+            "adapter_pool class of seldon_kv_bytes)",
+            ["model_name"],
+            registry=self.registry,
+        )
+        self.lora_tokens = Counter(
+            "seldon_lora_tokens",
+            "Generated tokens served per named adapter",
+            ["model_name", "adapter"],
+            registry=self.registry,
+        )
         self.obs_spans = Gauge(
             "seldon_obs_spans",
             "Span recorder counters (state: recorded / ring / sampled_out)",

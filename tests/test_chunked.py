@@ -460,7 +460,7 @@ class TestProgramKeyAudit:
             prefill_chunk=32, decode_kernel=True,
         )
         assert model._program_config == (
-            3, 0, model.spec_ngram, model.spec_hist, None, 32, True,
+            3, 0, model.spec_ngram, model.spec_hist, None, 32, True, 0, 0,
         )
 
 

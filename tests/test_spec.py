@@ -597,7 +597,8 @@ class TestProgramKeyAudit:
         assert model._program_config == (3, 2, model.spec_ngram,
                                          model.spec_hist, "int8",
                                          model.prefill_chunk,
-                                         model.decode_kernel)
+                                         model.decode_kernel,
+                                         model.lora_rank, model.lora_slots)
 
 
 class TestWarmupVariants:
