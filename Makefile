@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -112,6 +112,11 @@ lora-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_lora.py -q
 	JAX_PLATFORMS=cpu BENCH_ONLY=LORA BENCH_RUNS=1 \
 		BENCH_LORA_TOKENS=16 $(PYTHON) bench.py
+
+tiers-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_tiers.py -q
+	JAX_PLATFORMS=cpu BENCH_ONLY=tiered BENCH_SECONDS=2 BENCH_RUNS=1 \
+		$(PYTHON) bench.py
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -36,6 +36,10 @@ Stages (each skippable via env; ``BENCH_ONLY=name`` runs one stage):
                                          the sequential adapter-swap
                                          baseline + adapter-pool HBM
                                          ledger + resident-per-chip
+  tiered               BENCH_SKIP_TIERED warm TTFT per prefix tier (HBM /
+                                         DRAM-promoted / peer-pulled /
+                                         cold) on a working set 4x the
+                                         HBM pool + prefill tokens saved
 
 Credibility discipline (round-5 postmortem — the headline swung 4.5x with
 this file byte-identical and nothing could attribute it):
@@ -1390,6 +1394,189 @@ def stage_cache(detail: dict) -> None:
     }
 
 
+def stage_tiered(detail: dict) -> None:
+    """Tiered prefix store (docs/CACHING.md "Tiered prefix store"): a
+    prefix working set ~4x the HBM KV pool cycles through the pool so the
+    early chains demote to host DRAM, then warm TTFT is measured per
+    serving tier — HBM-resident, DRAM-promoted, peer-pulled
+    (export+install+generate, the engine pull path without the wire) and
+    cold full prefill — plus the prefill tokens the tiers saved vs
+    tiers-off.  In-process device measurements; no wire in the loop."""
+    import asyncio
+
+    import jax
+
+    from seldon_core_tpu.executor.generation import (
+        GenerationScheduler,
+        GenerativeModel,
+    )
+    from seldon_core_tpu.models import llama as llama_mod
+
+    cfg = llama_mod.Config.tiny(max_seq=128)
+    params = llama_mod.init_params(jax.random.PRNGKey(0), cfg)
+    bs = 16
+    kv_blocks = 13  # 12 usable -> two 6-block chains resident at once
+    n_chains = int(os.environ.get("BENCH_TIER_CHAINS", "8"))
+    prefix_len = 6 * bs  # 6 full blocks per chain
+    working_set = n_chains * 7  # 6 prefix blocks + 1 absorbed suffix block
+
+    def build(reuse: bool = True, blocks: int = kv_blocks):
+        return GenerativeModel(
+            cfg, params, n_slots=2, kv_block_size=bs, kv_blocks=blocks,
+            decode_block=4, prefix_reuse=reuse,
+            prefix_dram_gb=0.01 if reuse else None, name="bench-tiers",
+        )
+
+    def chain(i: int) -> list:
+        return [(i * 97 + j * 13) % 251 + 1 for j in range(prefix_len)]
+
+    # every request carries a 28-token NOVEL suffix so each tier does a
+    # realistic short prefill on top of its prefix match (prompt 124 =
+    # max_seq - max_new); a 2-token suffix would measure pure scheduler
+    # overhead for the HBM tier and inflate the ratios
+    suffix_len = 28
+
+    def suf(seed: int) -> list:
+        return [(seed * 11 + j * 5) % 250 + 1 for j in range(suffix_len)]
+
+    async def gen(sched, prompt):
+        """(tokens, ttft_ms) for one greedy request."""
+        t0 = time.perf_counter()
+        first = [None]
+
+        def on_tok(_t):
+            if first[0] is None:
+                first[0] = time.perf_counter()
+
+        out = await sched.submit(
+            np.asarray(prompt, np.int32), max_new_tokens=4,
+            temperature=0.0, on_token=on_tok,
+        )
+        return out, ((first[0] or time.perf_counter()) - t0) * 1e3
+
+    model = build()
+    store = model.host_store
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
+    ttft = {"hbm": [], "dram": [], "cold": []}
+    stats = {"dram_verified": 0, "rerequest_tokens": 0, "saved_tokens": 0}
+
+    async def drive():
+        sched = GenerationScheduler(model)
+        try:
+            # warm pass: cycle the oversubscribed working set through the
+            # pool so early chains demote (compiles land here, off the clock)
+            for i in range(n_chains):
+                await gen(sched, chain(i) + suf(i))
+            # the promote-import and short-suffix prefill program variants
+            # compile on first use — exercise each once off the clock
+            await gen(sched, chain(0) + suf(501))
+            await gen(sched, chain(300) + suf(502))
+            idx_t0 = model.prefix_index.tokens_reused
+            promoted_t0 = store.promotions
+            for r in range(1, runs + 1):
+                # chain r was squeezed out of the pool -> DRAM promote
+                hits0 = model.dram_hits
+                _out, t = await gen(sched, chain(r) + suf(600 + r))
+                ttft["dram"].append(t)
+                stats["dram_verified"] += int(model.dram_hits > hits0)
+                # immediately re-request it -> fully HBM-resident
+                _out, t = await gen(sched, chain(r) + suf(700 + r))
+                ttft["hbm"].append(t)
+                # a never-seen chain -> cold full prefill
+                _out, t = await gen(sched, chain(100 + r) + suf(800 + r))
+                ttft["cold"].append(t)
+                stats["rerequest_tokens"] += 3 * (prefix_len + suffix_len)
+            stats["saved_tokens"] = (
+                model.prefix_index.tokens_reused - idx_t0
+                + (store.promotions - promoted_t0) * bs
+            )
+        finally:
+            await sched.close()
+
+    asyncio.run(drive())
+
+    # peer tier: export on the warm plane, install + generate on a cold
+    # one — the engine pull path minus the HTTP hop.  The peer gets a
+    # roomy pool so the timed install measures the import scatter, not
+    # an incidental demotion in a deliberately tiny pool
+    peer_model = build(blocks=26)
+    peer = {"ttft": None}
+
+    async def drive_peer():
+        sched = GenerationScheduler(peer_model)
+        try:
+            # compile warmup: full prefill, a re-request (short-suffix
+            # prefill + decode variants), and one sacrificial install so
+            # the fused-scatter import program is compiled off the clock
+            await gen(sched, chain(200) + suf(900))
+            await gen(sched, chain(200) + suf(901))
+            warm = model.export_prefix_kv(np.asarray(chain(1), np.int32))
+            if warm is not None:
+                _d, wk, wv, wks, wvs = warm
+                peer_model.install_prefix_chain(
+                    np.asarray(chain(1), np.int32), wk, wv,
+                    k_scale=wks, v_scale=wvs,
+                )
+            exported = model.export_prefix_kv(np.asarray(chain(0), np.int32))
+            if exported is not None:
+                _depth, k, v, ks, vs = exported
+                t0 = time.perf_counter()
+                peer_model.install_prefix_chain(
+                    np.asarray(chain(0), np.int32), k, v,
+                    k_scale=ks, v_scale=vs,
+                )
+                install_ms = (time.perf_counter() - t0) * 1e3
+                _out, t = await gen(sched, chain(0) + suf(902))
+                peer["ttft"] = install_ms + t
+        finally:
+            await sched.close()
+
+    asyncio.run(drive_peer())
+    peer_ttft = peer["ttft"]
+    dram_verified = stats["dram_verified"]
+    rerequest_tokens = stats["rerequest_tokens"]
+    saved_tokens = stats["saved_tokens"]
+
+    med = {k: _sig(sorted(v)[len(v) // 2]) for k, v in ttft.items() if v}
+    hbm_p50 = med.get("hbm") or 0
+    detail["llm_tiered"] = {
+        "pool_blocks": kv_blocks - 1,
+        "working_set_blocks": working_set,
+        "working_set_x_hbm": _sig(working_set / (kv_blocks - 1)),
+        "ttft_ms_p50": med,
+        "ttft_ms_peer": _sig(peer_ttft) if peer_ttft is not None else None,
+        "dram_ttft_over_hbm": (
+            _sig(med["dram"] / hbm_p50) if hbm_p50 and "dram" in med else None
+        ),
+        "peer_ttft_over_hbm": (
+            _sig(peer_ttft / hbm_p50) if hbm_p50 and peer_ttft else None
+        ),
+        "cold_ttft_over_hbm": (
+            _sig(med["cold"] / hbm_p50) if hbm_p50 and "cold" in med else None
+        ),
+        # promote vs re-prefill is the operative comparison for the DRAM
+        # tier: both run in the same pressured pool, so both pay the
+        # displaced-chain demotion an oversubscribed admission implies
+        "dram_ttft_over_cold": (
+            _sig(med["dram"] / med["cold"])
+            if med.get("cold") and "dram" in med else None
+        ),
+        "dram_promotions_verified": dram_verified,
+        "runs": runs,
+        "store": store.snapshot(),
+        # prefill device work the tiers saved on the warm re-requests:
+        # tiers-off prefills every prompt token, tiers-on only the novel
+        # suffixes (HBM-matched + DRAM-promoted blocks skip prefill)
+        "prefill_tokens_total": rerequest_tokens,
+        "prefill_tokens_saved": int(saved_tokens),
+        "prefill_saved_frac": _sig(saved_tokens / max(1, rerequest_tokens)),
+        "model": f"llama tiny, {n_chains}x {prefix_len}-token prefixes over "
+                 f"a {kv_blocks - 1}-block pool "
+                 f"({_sig(working_set / (kv_blocks - 1))}x oversubscribed), "
+                 f"{suffix_len}-token novel suffix, 4 new tokens, greedy",
+    }
+
+
 def _stats_disagg(port: int) -> dict:
     """Disagg-plane snapshot (GET /stats/disagg): role, decode peers,
     handoff/import ledger."""
@@ -1772,6 +1959,7 @@ def main() -> None:
         ("GATEWAY", "BENCH_SKIP_GATEWAY", stage_gateway),
         ("OVERLOAD", "BENCH_SKIP_OVERLOAD", stage_overload),
         ("CACHE", "BENCH_SKIP_CACHE", stage_cache),
+        ("TIERED", "BENCH_SKIP_TIERED", stage_tiered),
         ("DISAGG", "BENCH_SKIP_DISAGG", stage_disagg),
         ("OBS_OVERHEAD", "BENCH_SKIP_OBS_OVERHEAD", stage_obs_overhead),
     ]
@@ -1841,6 +2029,9 @@ _STAGE_HEADLINES = (
     ("llm_chunked", "itl_p99_ms_chunked", "chunk_itl_p99_ms_on"),
     ("llm_chunked", "itl_p99_ms_monolithic", "chunk_itl_p99_ms_off"),
     ("llm_chunked", "itl_p99_chunked_vs_monolithic", "chunk_itl_p99_ratio"),
+    ("llm_tiered", "dram_ttft_over_hbm", "tiered_dram_ttft_x"),
+    ("llm_tiered", "peer_ttft_over_hbm", "tiered_peer_ttft_x"),
+    ("llm_tiered", "prefill_saved_frac", "tiered_prefill_saved_frac"),
     ("llm_1b_wire", "device_frac_of_hbm_roofline_kernel_on",
      "llm1b_kernel_hbm_frac"),
     ("ab_graph", "p99_over_p95", "ab_p99_over_p95"),

@@ -32,3 +32,4 @@ from seldon_core_tpu.cache.content import (  # noqa: F401
 )
 from seldon_core_tpu.cache.prefix import PrefixIndex  # noqa: F401
 from seldon_core_tpu.cache.singleflight import SingleFlight  # noqa: F401
+from seldon_core_tpu.cache.tiers import HostPrefixStore  # noqa: F401

@@ -16,8 +16,10 @@ Design constraints that keep it exact:
   positions >= the full prompt length, which always land in the slot's
   own (non-shared) blocks, so "copy-on-write on divergence" degenerates
   to "diverging requests simply never share the diverging block";
-* entries are ref-counted while a slot uses them and LRU-evicted only at
-  zero refs, deepest-extension-first so a chain never orphans its tail.
+* entries are ref-counted while a slot uses them and evicted only at
+  zero refs, cheapest-to-rebuild chain first (rebuild cost = chain depth
+  x block count, LRU tick breaking ties) and always with every extension
+  of the victim so a chain never orphans its tail.
 
 The index is host-side state on the scheduler (coordinator) process; the
 physical block ids it hands out ride the same driven-step payloads the
@@ -133,6 +135,44 @@ class PrefixIndex:
                 self.misses += 1
         return blocks
 
+    def acquire(
+        self, tokens: np.ndarray, max_blocks: int, salt: bytes = b""
+    ) -> list[tuple]:
+        """Like :meth:`match` but for an EXPORT pin, not an admission:
+        refs the longest chain without touching the hit/miss counters or
+        the LRU ticks, and returns ``[(key, depth, block), ...]`` so the
+        caller can fetch the pinned blocks.  Pair with one
+        :meth:`release` for ``len(result)`` levels — while the pin is
+        held, eviction cannot free (or demote) the chain out from under
+        a concurrent peer-pull export."""
+        tokens = np.asarray(tokens, np.int32).ravel()
+        out: list[tuple] = []
+        with self._lock:
+            for k in range(1, max_blocks + 1):
+                key = self._key(tokens, k, salt)
+                e = self._entries.get(key)
+                if e is None:
+                    break
+                out.append((key, k, e.block))
+            for key, _k, _b in out:
+                self._entries[key].refs += 1
+        return out
+
+    def peek_depth(
+        self, tokens: np.ndarray, max_blocks: int, salt: bytes = b""
+    ) -> int:
+        """Longest-match depth WITHOUT taking refs or counting a hit —
+        the peer-pull client uses this to decide whether a pull would
+        beat what is already resident."""
+        tokens = np.asarray(tokens, np.int32).ravel()
+        with self._lock:
+            depth = 0
+            for k in range(1, max_blocks + 1):
+                if self._key(tokens, k, salt) not in self._entries:
+                    break
+                depth = k
+        return depth
+
     def release(
         self, tokens: np.ndarray, n_blocks: int, salt: bytes = b""
     ) -> None:
@@ -143,6 +183,24 @@ class PrefixIndex:
                 e = self._entries.get(self._key(tokens, k, salt))
                 if e is not None and e.refs > 0:
                     e.refs -= 1
+
+    def ref_range(
+        self,
+        tokens: np.ndarray,
+        start_level: int,
+        end_level: int,
+        salt: bytes = b"",
+    ) -> None:
+        """Take refs on levels ``start_level+1 .. end_level`` — the
+        promotion path refs freshly-inserted levels so one
+        ``release(tokens, end_level)`` at slot teardown covers matched
+        and promoted levels alike."""
+        tokens = np.asarray(tokens, np.int32).ravel()
+        with self._lock:
+            for k in range(int(start_level) + 1, int(end_level) + 1):
+                e = self._entries.get(self._key(tokens, k, salt))
+                if e is not None:
+                    e.refs += 1
 
     # -- insertion -----------------------------------------------------------
 
@@ -183,25 +241,43 @@ class PrefixIndex:
     # -- eviction ------------------------------------------------------------
 
     def evict(self, need: int) -> list[int]:
-        """Free up to ``need`` blocks from zero-ref entries, oldest chain
-        first.  Evicting an entry also evicts every entry that EXTENDS it
-        (extensions of a zero-ref entry are provably zero-ref themselves:
-        a slot holding level k holds refs on 1..k), so a chain never
+        """Free up to ``need`` blocks from zero-ref entries; see
+        :meth:`evict_entries` for the ordering."""
+        return [block for _key, _depth, block in self.evict_entries(need)]
+
+    def evict_entries(self, need: int) -> list[tuple]:
+        """Free up to ``need`` blocks from zero-ref entries and return
+        ``[(key, depth, block), ...]`` for every victim, so a host-DRAM
+        tier (cache/tiers.py) can absorb the evicted chain levels before
+        their physical blocks are recycled.
+
+        Victims are picked cheapest-to-rebuild chain first: each
+        candidate is scored by chain depth x block count (its own depth
+        times the levels that would go down with it — extensions of a
+        zero-ref entry are provably zero-ref themselves: a slot holding
+        level k holds refs on 1..k), LRU tick breaking ties.  A one-block
+        throwaway prompt is evicted long before the 40-block system
+        prompt that costs a 640-token prefill to rebuild.  Evicting an
+        entry also evicts every entry that EXTENDS it, so a chain never
         orphans its tail."""
-        freed: list[int] = []
+        victims: list[tuple] = []
         with self._lock:
             if need <= 0 or not self._entries:
-                return freed
-            candidates = sorted(
-                (
-                    (e.tick, -e.depth, key)
-                    for key, e in self._entries.items()
-                    if e.refs == 0
-                ),
-            )
+                return victims
+            zero_ref = [
+                (key, e) for key, e in self._entries.items() if e.refs == 0
+            ]
+            scored = []
+            for key, e in zero_ref:
+                n_ext = sum(
+                    1 for k in self._entries
+                    if k != key and k[0] == key[0] and k[1].startswith(key[1])
+                )
+                # rebuild cost of the chain rooted here: depth x blocks
+                scored.append((e.depth * (1 + n_ext), e.tick, key))
             doomed: set = set()
-            for _tick, _negdepth, key in candidates:
-                if len(freed) >= need:
+            for _cost, _tick, key in sorted(scored):
+                if len(victims) >= need:
                     break
                 if key in doomed:
                     continue
@@ -213,11 +289,12 @@ class PrefixIndex:
                     if k in doomed:
                         continue
                     doomed.add(k)
-                    freed.append(self._entries[k].block)
+                    e = self._entries[k]
+                    victims.append((k, e.depth, e.block))
             for k in doomed:
                 del self._entries[k]
             self.evicted += len(doomed)
-        return freed
+        return victims
 
     def flush(self) -> list[int]:
         """Drop every ZERO-REF entry (model reset / manual flush); returns

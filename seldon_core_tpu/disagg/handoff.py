@@ -45,6 +45,16 @@ HANDOFF_KEY = "sct:kv-handoff"
 # decode).  v1-v3 frames decode unchanged.
 HANDOFF_VERSION = 4
 
+# Prefix-chain frames (the peer-replica tier of the tiered prefix store,
+# docs/CACHING.md) ride the same step framing under their own key: a
+# chain frame carries ONLY the chain's tokens + its full-block KV in the
+# pool's storage representation — no generation options, no first token —
+# because the puller is warming its prefix cache, not continuing a
+# generation.
+PREFIX_KEY = "sct:kv-prefix"
+# v1: float/bf16 or int8+scales chain blocks, optional adapter salt.
+PREFIX_VERSION = 1
+
 
 class HandoffError(Exception):
     """A handoff frame that cannot be applied here: wrong key, mismatched
@@ -164,6 +174,94 @@ def decode_handoff(buf: bytes) -> dict[str, Any]:
         for field in ("k_scale", "v_scale", "scale_dtype"):
             if field not in payload:
                 raise HandoffError(f"handoff frame missing field {field!r}")
+        sdt = str(payload["scale_dtype"])
+        payload["k_scale"] = _unpack_kv(payload["k_scale"], sdt)
+        payload["v_scale"] = _unpack_kv(payload["v_scale"], sdt)
+    return payload
+
+
+def encode_prefix_chain(
+    tokens: np.ndarray,
+    k: np.ndarray,
+    v: np.ndarray,
+    *,
+    block_size: int,
+    k_scale: np.ndarray | None = None,
+    v_scale: np.ndarray | None = None,
+    adapter: str | None = None,
+) -> bytes:
+    """Frame one prefix chain for a peer pull (``POST
+    /disagg/prefix/pull``).  ``k``/``v`` are ``(layers, depth, block_size,
+    kv_heads, head_dim)`` — the chain's full blocks, shallowest level
+    first, in the pool's storage representation (int8 blocks + scales
+    travel verbatim, so the puller installs the exact bytes the exporter
+    holds and promoted generations stay bit-identical).  ``tokens`` are
+    the chain's covered tokens (``depth * block_size`` of them)."""
+    quant = k_scale is not None
+    tokens = np.asarray(tokens, np.int32).ravel()
+    depth = int(k.shape[1])
+    k, kv_dtype = _pack_kv(np.ascontiguousarray(k))
+    v, _ = _pack_kv(np.ascontiguousarray(v))
+    payload: dict[str, Any] = {
+        "tokens": tokens[: depth * int(block_size)],
+        "depth": depth,
+        "block_size": int(block_size),
+        "kv_dtype": kv_dtype,
+        "pv": PREFIX_VERSION,
+        "k": k,
+        "v": v,
+    }
+    if adapter:
+        payload["adapter"] = str(adapter)
+    if quant:
+        ks, scale_dtype = _pack_kv(np.ascontiguousarray(k_scale))
+        vs, _ = _pack_kv(np.ascontiguousarray(v_scale))
+        payload["kv_quant"] = "int8"
+        payload["scale_dtype"] = scale_dtype
+        payload["k_scale"] = ks
+        payload["v_scale"] = vs
+    return encode_step(PREFIX_KEY, payload)
+
+
+def decode_prefix_chain(buf: bytes) -> dict[str, Any]:
+    """Inverse of :func:`encode_prefix_chain`.  Same failure contract as
+    :func:`decode_handoff`: wrong key / missing fields / version-newer →
+    :class:`HandoffError`; a torn frame raises ``ValueError`` from the
+    shared codec.  Either way the puller falls back to plain suffix
+    prefill — a bad frame never costs correctness, only the pull."""
+    key, payload = decode_step(buf)
+    if key != PREFIX_KEY:
+        raise HandoffError(f"frame key {key!r} is not a prefix chain")
+    pv = int(payload.get("pv", 1))
+    if pv > PREFIX_VERSION:
+        raise HandoffError(
+            f"prefix codec version {pv} is newer than this engine's "
+            f"{PREFIX_VERSION}; refusing to guess at the KV layout"
+        )
+    for field in ("tokens", "depth", "block_size", "k", "v", "kv_dtype"):
+        if field not in payload:
+            raise HandoffError(f"prefix frame missing field {field!r}")
+    kv_dtype = str(payload["kv_dtype"])
+    payload["k"] = _unpack_kv(payload["k"], kv_dtype)
+    payload["v"] = _unpack_kv(payload["v"], kv_dtype)
+    depth = int(payload["depth"])
+    if payload["k"].ndim != 5 or payload["k"].shape[1] != depth:
+        raise HandoffError(
+            f"prefix frame depth {depth} does not match KV shape "
+            f"{payload['k'].shape}"
+        )
+    if int(np.asarray(payload["tokens"]).size) != depth * int(
+        payload["block_size"]
+    ):
+        raise HandoffError("prefix frame tokens do not cover its blocks")
+    if payload.get("kv_quant"):
+        if str(payload["kv_quant"]) != "int8":
+            raise HandoffError(
+                f"unknown kv_quant {payload['kv_quant']!r} in prefix frame"
+            )
+        for field in ("k_scale", "v_scale", "scale_dtype"):
+            if field not in payload:
+                raise HandoffError(f"prefix frame missing field {field!r}")
         sdt = str(payload["scale_dtype"])
         payload["k_scale"] = _unpack_kv(payload["k_scale"], sdt)
         payload["v_scale"] = _unpack_kv(payload["v_scale"], sdt)

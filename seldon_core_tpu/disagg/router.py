@@ -135,6 +135,17 @@ class ReplicaRouter:
         self.prefix_picks = 0
         self.p2c_picks = 0
         self.single_picks = 0
+        # peer tier of the tiered prefix store (docs/CACHING.md): when
+        # SCT_PREFIX_PEER_PULL=1, prefix affinity may YIELD to load — the
+        # router sends the request to a lighter replica and stamps the
+        # advertising replica as a pull hint, because the chain can move
+        # (one /disagg/prefix/pull) while queue depth cannot.  The yield
+        # threshold is the inflight gap that justifies a pull's network
+        # cost (SCT_GW_PEER_YIELD, in requests).
+        self.peer_pull = os.environ.get("SCT_PREFIX_PEER_PULL", "0") == "1"
+        self.peer_yield = int(os.environ.get("SCT_GW_PEER_YIELD", "4") or 4)
+        self.peer_hints = 0
+        self.peer_yield_picks = 0
 
     # -- state feeds ---------------------------------------------------------
 
@@ -202,18 +213,38 @@ class ReplicaRouter:
     ) -> Any:
         """Choose a replica for one request.  Counts the pick so the p2c
         tiebreak stays balanced even before any state is polled."""
+        return self.pick_with_peer(dep, endpoints, prompt_tokens, adapter)[0]
+
+    def pick_with_peer(
+        self,
+        dep: str,
+        endpoints: Sequence[Any],
+        prompt_tokens: np.ndarray | None = None,
+        adapter: "str | None" = None,
+    ) -> "tuple[Any, tuple[str, int] | None]":
+        """Like :meth:`pick`, plus a peer-pull hint ``(replica_key, depth)``
+        when the chosen replica should fetch the prompt's chain from an
+        advertising peer (``POST /disagg/prefix/pull``) instead of
+        re-prefilling it.  The hint fires when peer pull is enabled and
+        EITHER the prefix-holding replica is ``peer_yield`` inflight
+        requests hotter than the lightest alternative (the pick yields to
+        load and ships the chain after it), or the chosen replica simply
+        advertises a shallower chain than another.  With peer pull off the
+        hint is always ``None`` and the pick is byte-identical to the
+        legacy policy."""
         if len(endpoints) == 1:
             self.single_picks += 1
-            return endpoints[0]
+            return endpoints[0], None
         with self._lock:
             reps = self._deployments.get(dep, {})
             chosen = None
+            hint: "tuple[str, int] | None" = None
+            best_depth = 0
+            best: list[Any] = []
             if prompt_tokens is not None and reps:
                 # longest-prefix match, hashes computed once per distinct
                 # block size across the replica set
                 by_bs: dict[int, list[str]] = {}
-                best_depth = 0
-                best: list[Any] = []
                 for ep in endpoints:
                     st = reps.get(endpoint_key(ep))
                     if st is None or not st.hashes or st.block_size < 1:
@@ -232,10 +263,28 @@ class ReplicaRouter:
                         best_depth, best = depth, [ep]
                     elif depth and depth == best_depth:
                         best.append(ep)
-                if best:
-                    chosen = min(
-                        best, key=lambda ep: self._score(reps.get(endpoint_key(ep)))
-                    )
+            if best:
+                affine = min(
+                    best, key=lambda ep: self._score(reps.get(endpoint_key(ep)))
+                )
+                if self.peer_pull:
+                    others = [ep for ep in endpoints if ep not in best]
+                    if others:
+                        light = min(
+                            others,
+                            key=lambda ep: self._score(reps.get(endpoint_key(ep))),
+                        )
+                        gap = (
+                            self._score(reps.get(endpoint_key(affine)))[0]
+                            - self._score(reps.get(endpoint_key(light)))[0]
+                        )
+                        if gap >= self.peer_yield:
+                            chosen = light
+                            hint = (endpoint_key(affine), best_depth)
+                            self.peer_hints += 1
+                            self.peer_yield_picks += 1
+                if chosen is None:
+                    chosen = affine
                     self.prefix_picks += 1
             if chosen is None:
                 # power-of-two-choices on (inflight, queue-wait EWMA, picks)
@@ -245,8 +294,28 @@ class ReplicaRouter:
                 sb = self._score(reps.get(endpoint_key(eb)))
                 chosen = ea if sa <= sb else eb
                 self.p2c_picks += 1
+            if (
+                hint is None
+                and self.peer_pull
+                and best_depth
+                and chosen not in best
+            ):
+                # stale-digest / p2c case: someone else holds a chain the
+                # chosen replica lacks — still worth pulling
+                hint = (
+                    endpoint_key(
+                        min(
+                            best,
+                            key=lambda ep: self._score(
+                                reps.get(endpoint_key(ep))
+                            ),
+                        )
+                    ),
+                    best_depth,
+                )
+                self.peer_hints += 1
             self._state(dep, endpoint_key(chosen)).picked += 1
-            return chosen
+            return chosen, hint
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -254,6 +323,9 @@ class ReplicaRouter:
                 "prefix_picks": self.prefix_picks,
                 "p2c_picks": self.p2c_picks,
                 "single_picks": self.single_picks,
+                "peer_pull": self.peer_pull,
+                "peer_hints": self.peer_hints,
+                "peer_yield_picks": self.peer_yield_picks,
                 "deployments": {
                     dep: {
                         ep: {
@@ -360,6 +432,14 @@ class RouterPoller:
             digest = (snap or {}).get("digest") or {}
             hashes.update(digest.get("hashes") or ())
             block_size = block_size or int(digest.get("block_size") or 0)
+            # tiered prefix store (docs/CACHING.md): a chain demoted to the
+            # replica's host-DRAM tier is still ONE promotion scatter from
+            # warm — merge the DRAM digest so prefix routing (and the peer
+            # pull hint) treats both tiers as "this replica holds it"
+            dram = ((snap or {}).get("tiers") or {}).get("dram") or {}
+            dram_digest = dram.get("digest") or {}
+            hashes.update(dram_digest.get("hashes") or ())
+            block_size = block_size or int(dram_digest.get("block_size") or 0)
         self.router.update_replica(
             rec.oauth_key,
             key,

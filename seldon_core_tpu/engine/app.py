@@ -88,6 +88,19 @@ class EngineApp:
             "imports_ok": 0,
             "imports_failed": 0,
         }
+        # tiered prefix store, peer tier (docs/CACHING.md "Tiered prefix
+        # store"): pull-client + pull-server ledgers.  Pull failures of ANY
+        # kind degrade to plain suffix prefill — they are counted, never
+        # surfaced to the request.
+        self.prefix_pull_stats = {
+            "pulls_ok": 0,
+            "pulls_failed": 0,
+            "pull_misses": 0,
+            "pull_bytes": 0,
+            "pull_blocks": 0,
+            "serves_ok": 0,
+            "serve_misses": 0,
+        }
         # QoS plane (docs/QOS.md): per-deployment admission control +
         # deadline propagation; env-configured (SCT_QOS_*), on by default.
         # Registered process-wide so the generation scheduler's brownout
@@ -196,6 +209,10 @@ class EngineApp:
         # local fallback); import = receive a peer's KV handoff and decode
         r.add_post("/disagg/generate", self.disagg_generate)
         r.add_post("/disagg/import", self.disagg_import)
+        # peer tier of the tiered prefix store (docs/CACHING.md): a replica
+        # missing a prefix chain pulls the serialized KV from the replica
+        # whose digest advertises it, instead of re-prefilling
+        r.add_post("/disagg/prefix/pull", self.disagg_prefix_pull)
         r.add_get("/stats/disagg", self.stats_disagg)
         # per-request generation lifecycle ledger (obs/timeline.py):
         # ?trace=<id> reconstructs one request's whole story after the fact
@@ -349,6 +366,11 @@ class EngineApp:
             try:
                 if body is None:
                     body = await self._json(request)
+                # tiered prefix store, peer tier: a gateway-stamped hint
+                # means another replica holds this prompt's KV chain —
+                # pull + install it before the graph walk (no-op unless
+                # SCT_PREFIX_PEER_PULL=1 and the header is present)
+                await self._pull_prefix_from_header(request, body)
                 # opt-in per-node wall timings (meta.tags.sct_trace_ms) —
                 # request-scoped tracing the reference only had as logs
                 trace = request.headers.get("X-Seldon-Trace", "") == "1"
@@ -472,6 +494,9 @@ class EngineApp:
             return web.json_response(
                 _status_body(400, f"bad stream request: {e}"), status=400
             )
+        # peer-tier prefix pull (docs/CACHING.md): land the advertised chain
+        # before the stream's prefill, same best-effort gate as predictions
+        await self._pull_prefix_from_header(request, body)
 
         resp = web.StreamResponse(
             headers={
@@ -668,8 +693,11 @@ class EngineApp:
 
     async def stats_cache(self, request: web.Request) -> web.Response:
         """Caching & reuse plane state: response/node cache hit rates,
-        single-flight collapse counters, KV prefix-reuse index."""
-        return web.json_response({"cache": self.service.cache_snapshot()})
+        single-flight collapse counters, KV prefix-reuse index (with its
+        per-tier ledgers), and this engine's peer-pull counters."""
+        snap = self.service.cache_snapshot()
+        snap["prefix_pull"] = dict(self.prefix_pull_stats)
+        return web.json_response({"cache": snap})
 
     async def profile_start(self, request: web.Request) -> web.Response:
         import jax
@@ -784,6 +812,14 @@ class EngineApp:
                     h["code"] = "400"
                     return web.json_response(_status_body(400, str(e)), status=400)
                 prompt = np.asarray(prompt, np.int32)
+                # peer-tier prefix pull before the prefill (best-effort;
+                # gated on SCT_PREFIX_PEER_PULL + the gateway's hint header)
+                peer = request.headers.get("x-sct-prefix-peer")
+                if peer and self._peer_pull_enabled():
+                    await self._maybe_pull_prefix(
+                        unit, prompt, adapter, peer,
+                        request.headers.get("x-sct-prefix-depth"),
+                    )
                 # the request's generation span: child of the gateway/client
                 # trace, parent of the prefill + handoff spans — the frame
                 # carries the export span's id so the decode pool's import
@@ -878,6 +914,17 @@ class EngineApp:
         )
         return [int(t) for t in out], "unified-fallback"
 
+    def _ensure_handoff_session(self):
+        """Lazy shared client session for the disagg plane (KV handoffs and
+        peer prefix pulls ride the same timeout + connection pool)."""
+        if self._handoff_session is None:
+            import aiohttp
+
+            self._handoff_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self._handoff_timeout_s)
+            )
+        return self._handoff_session
+
     async def _send_handoff(self, frame: bytes) -> list[int]:
         """POST one handoff frame to a decode peer — power-of-two-choices
         on outstanding handoffs when several are configured."""
@@ -891,12 +938,7 @@ class EngineApp:
             target = min(
                 (ups[a], ups[b]), key=lambda u: self._handoff_inflight.get(u, 0)
             )
-        if self._handoff_session is None:
-            import aiohttp
-
-            self._handoff_session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=self._handoff_timeout_s)
-            )
+        self._ensure_handoff_session()
         from seldon_core_tpu.qos.context import outgoing_qos_headers
         from seldon_core_tpu.utils.tracectx import outgoing_headers
 
@@ -1002,6 +1044,236 @@ class EngineApp:
             finally:
                 ticket.release()
 
+    # -- peer tier of the tiered prefix store (docs/CACHING.md) -------------
+
+    async def disagg_prefix_pull(self, request: web.Request) -> web.Response:
+        """Serve a serialized prefix chain to a peer replica.
+
+        Request: JSON ``{"tokens": [...], "adapter": ..., "max_blocks": N}``.
+        Response: one codec-framed chain (``encode_prefix_chain``) covering
+        the deepest contiguous run of FULL blocks this replica holds in HBM
+        or host DRAM — adapter-salted, so a wrong-adapter pull is a plain
+        404 miss.  The export pins the chain's refcounts for the duration
+        of the device fetch (``PrefixIndex.acquire``), so a concurrent
+        eviction can never tear the bytes mid-flight."""
+        import numpy as np
+
+        dep, pred = self.service.deployment_name, self.service.predictor.name
+        with self.metrics.time_server_request(
+            dep, pred, "disagg_prefix_pull", "POST"
+        ) as h:
+            unit, reason = self._single_generative_unit()
+            if unit is None:
+                h["code"] = "400"
+                return web.json_response(_status_body(400, reason), status=400)
+            try:
+                body = await self._json(request)
+            except CodecError as e:
+                h["code"] = "400"
+                return web.json_response(_status_body(400, str(e)), status=400)
+            toks = body.get("tokens")
+            if not (
+                isinstance(toks, (list, tuple))
+                and toks
+                and all(
+                    isinstance(t, int) and not isinstance(t, bool) for t in toks
+                )
+            ):
+                h["code"] = "400"
+                return web.json_response(
+                    _status_body(400, "prefix pull takes a flat 'tokens' list"),
+                    status=400,
+                )
+            adapter = body.get("adapter")
+            adapter = str(adapter) if adapter else None
+            try:
+                max_blocks = int(body.get("max_blocks", 64))
+            except (TypeError, ValueError):
+                h["code"] = "400"
+                return web.json_response(
+                    _status_body(400, "bad max_blocks"), status=400
+                )
+            try:
+                exported = await asyncio.to_thread(
+                    unit.model.export_prefix_kv,
+                    np.asarray(toks, np.int32),
+                    adapter=adapter,
+                    max_blocks=max_blocks,
+                )
+            except GraphUnitError as e:
+                h["code"] = "500"
+                return web.json_response(_status_body(500, str(e)), status=500)
+            if exported is None:
+                self.prefix_pull_stats["serve_misses"] += 1
+                h["code"] = "404"
+                return web.json_response(
+                    _status_body(404, "no prefix chain for these tokens"),
+                    status=404,
+                )
+            depth, k, v, k_scale, v_scale = exported
+            from seldon_core_tpu.disagg.handoff import encode_prefix_chain
+
+            frame = await asyncio.to_thread(
+                encode_prefix_chain,
+                np.asarray(toks, np.int32),
+                k,
+                v,
+                block_size=unit.model.kv_block_size,
+                k_scale=k_scale,
+                v_scale=v_scale,
+                adapter=adapter,
+            )
+            self.prefix_pull_stats["serves_ok"] += 1
+            return web.Response(
+                body=frame,
+                content_type="application/octet-stream",
+                headers={"x-sct-prefix-depth": str(int(depth))},
+            )
+
+    @staticmethod
+    def _peer_pull_enabled() -> bool:
+        return os.environ.get("SCT_PREFIX_PEER_PULL", "0") == "1"
+
+    async def _pull_prefix_from_header(self, request: web.Request, body) -> None:
+        """Gateway-hinted peer pull for the predictions paths: when the
+        router stamped ``x-sct-prefix-peer`` (a replica advertising this
+        prompt's chain) and peer pull is enabled, fetch + install the chain
+        before the request queues, so its prefill covers only the novel
+        suffix.  Best-effort by design — any miss or failure just leaves
+        plain prefill to do what it always did."""
+        peer = request.headers.get("x-sct-prefix-peer")
+        if not peer or not self._peer_pull_enabled():
+            return
+        units = self.service.generative_units()
+        if len(units) != 1:
+            return
+        import json as _json
+
+        b = body
+        if isinstance(b, dict) and "strData" in b:
+            try:
+                b = _json.loads(b["strData"])
+            except (TypeError, ValueError):
+                return
+        if not isinstance(b, dict):
+            return
+        toks = b.get("tokens")
+        if not (
+            isinstance(toks, (list, tuple))
+            and toks
+            and all(isinstance(t, int) and not isinstance(t, bool) for t in toks)
+        ):
+            return
+        adapter = b.get("adapter")
+        adapter = str(adapter) if isinstance(adapter, str) and adapter else None
+        await self._maybe_pull_prefix(
+            units[0], toks, adapter, peer,
+            request.headers.get("x-sct-prefix-depth"),
+        )
+
+    async def _maybe_pull_prefix(
+        self, unit, tokens, adapter, peer: str, depth_hint=None
+    ) -> bool:
+        """POST ``/disagg/prefix/pull`` to ``peer`` and install the returned
+        chain at the scheduler's next sync point.  Skips when the local
+        tiers (HBM index + DRAM store) already cover the hinted depth.
+        ANY failure — network, 4xx, torn frame, version skew, install
+        race — lands in the ledger and falls back to plain suffix prefill
+        with zero blocks or DRAM bytes leaked (the chain only enters the
+        pool through ``install_prefix_chain``'s all-or-nothing path)."""
+        import numpy as np
+
+        from seldon_core_tpu.utils.tracectx import current_trace_id
+
+        model = getattr(unit, "model", None)
+        index = getattr(model, "prefix_index", None)
+        if index is None or getattr(model, "_multihost", False):
+            return False
+        tokens = np.asarray(tokens, np.int32).ravel()
+        bs = int(model.kv_block_size)
+        cap = min(tokens.size // bs, int(model.max_blocks_per_slot))
+        if cap < 1:
+            return False
+        try:
+            hint = int(depth_hint) if depth_hint else 0
+        except (TypeError, ValueError):
+            hint = 0
+        from seldon_core_tpu.cache.prefix import adapter_salt
+
+        salt = adapter_salt(adapter)
+        have = index.peek_depth(tokens, cap, salt)
+        if model.host_store is not None and have < cap:
+            have = max(
+                have, model.host_store.peek_depth(tokens, have + 1, cap, salt)
+            )
+        if have >= cap or (hint and have >= hint):
+            return False  # nothing a pull could add
+        try:
+            from seldon_core_tpu.qos.context import outgoing_qos_headers
+            from seldon_core_tpu.utils.tracectx import outgoing_headers
+
+            req: dict[str, Any] = {
+                "tokens": [int(t) for t in tokens],
+                "max_blocks": int(cap),
+            }
+            if adapter:
+                req["adapter"] = adapter
+            session = self._ensure_handoff_session()
+            with RECORDER.span(
+                "prefix.pull", service=self.service.deployment_name
+            ) as sp:
+                async with session.post(
+                    f"http://{peer}/disagg/prefix/pull",
+                    json=req,
+                    headers={**outgoing_headers(), **outgoing_qos_headers()},
+                ) as resp:
+                    if resp.status == 404:
+                        # the peer's advertisement went stale (evicted,
+                        # restarted, wrong adapter): a miss, not a failure
+                        self.prefix_pull_stats["pull_misses"] += 1
+                        return False
+                    if resp.status != 200:
+                        text = (await resp.text())[:200]
+                        raise RuntimeError(
+                            f"peer {peer} answered {resp.status}: {text}"
+                        )
+                    frame = await resp.read()
+                if sp is not None:
+                    sp.set_attr("peer", peer)
+                    sp.set_attr("bytes", len(frame))
+            from seldon_core_tpu.disagg.handoff import decode_prefix_chain
+
+            payload = decode_prefix_chain(frame)
+            absorbed = await unit.scheduler.install_prefix(
+                payload["tokens"],
+                payload["k"],
+                payload["v"],
+                k_scale=payload.get("k_scale"),
+                v_scale=payload.get("v_scale"),
+                adapter=adapter,
+            )
+            self.prefix_pull_stats["pulls_ok"] += 1
+            self.prefix_pull_stats["pull_bytes"] += len(frame)
+            self.prefix_pull_stats["pull_blocks"] += int(absorbed)
+            TIMELINE.note(
+                current_trace_id(), "prefix-pull",
+                peer=peer, blocks=int(absorbed), bytes=len(frame),
+            )
+            return absorbed > 0
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.prefix_pull_stats["pulls_failed"] += 1
+            log.warning(
+                "peer prefix pull from %s failed (%s); plain suffix prefill",
+                peer, e,
+            )
+            TIMELINE.note(
+                current_trace_id(), "prefix-pull-failed",
+                peer=peer, error=str(e)[:200],
+            )
+            return False
+
     async def stats_disagg(self, request: web.Request) -> web.Response:
         """Disagg plane state: this engine's role, its decode peers, and
         the handoff/import ledger."""
@@ -1012,6 +1284,7 @@ class EngineApp:
                     "decode_upstreams": list(self.decode_upstreams),
                     "handoff_inflight": dict(self._handoff_inflight),
                     **self.disagg_stats,
+                    "prefix_pull": dict(self.prefix_pull_stats),
                 }
             }
         )

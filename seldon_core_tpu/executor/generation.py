@@ -112,6 +112,7 @@ class GenerativeModel:
         kv_block_size: int = 16,
         kv_blocks: int | None = None,
         prefix_reuse: bool | None = None,
+        prefix_dram_gb: float | None = None,
         top_k: int = 0,
         spec_draft: int | None = None,
         spec_ngram: int | None = None,
@@ -387,14 +388,53 @@ class GenerativeModel:
             )
             prefix_reuse = False
         self.prefix_index = None
+        # host-DRAM prefix tier (cache/tiers.py; docs/CACHING.md "Tiered
+        # prefix store"): index evictions demote their blocks into a
+        # byte-bounded host store instead of dropping them; a later radix
+        # match promotes them back with one fused scatter.  Opt-in via the
+        # ``prefix_dram_gb`` graph parameter or SCT_PREFIX_DRAM_GB.
+        self.host_store = None
         if prefix_reuse:
             from seldon_core_tpu.cache.prefix import PrefixIndex
 
             self.prefix_index = PrefixIndex(kv_block_size)
+            if prefix_dram_gb is None:
+                prefix_dram_gb = float(
+                    os.environ.get("SCT_PREFIX_DRAM_GB", "0") or 0
+                )
+            dram_bytes = int(float(prefix_dram_gb) * (1 << 30))
+            if dram_bytes > 0 and self._multihost:
+                # demotion needs a coordinator-side device fetch of the
+                # victim blocks, which a multi-host slice cannot address
+                # (same constraint as export_slot_kv)
+                log.warning(
+                    "generative model %r: host-DRAM prefix tier is not "
+                    "supported on a multi-host slice; disabled", name,
+                )
+            elif dram_bytes > 0:
+                from seldon_core_tpu.cache.tiers import HostPrefixStore
+
+                self.host_store = HostPrefixStore(
+                    kv_block_size, dram_bytes, on_bytes=self._note_dram_bytes
+                )
+        # peer-replica prefix tier bookkeeping: chain-level keys installed
+        # from a peer pull that no admission has hit yet (the first hit is
+        # credited to the peer tier, later ones to plain HBM), plus the
+        # pull/serve counters for the per-tier telemetry
+        self._peer_chains: set = set()
+        self.peer_hits = 0  # admissions whose prefix came from a peer pull
+        self.peer_installs = 0  # chain levels installed from peer pulls
+        self.peer_serves = 0  # chains exported to pulling peers
+        self.dram_hits = 0  # admissions that promoted >=1 level from DRAM
         # per-slot reuse bookkeeping: the prompt (for index insertion at
         # release) and how many leading blocks were matched (shared refs)
         self._slot_prompt: dict[int, np.ndarray] = {}
         self._slot_matched: dict[int, int] = {}
+        # which tier satisfied the slot's prefix match (hbm/dram/peer/none)
+        # + how many levels the admission promoted from DRAM — stamped
+        # into the timeline admit event via reservation_snapshot
+        self._slot_tier: dict[int, str] = {}
+        self._slot_promoted: dict[int, int] = {}
         # full table row per reserved slot (shared-prefix blocks included):
         # the disagg KV export reads the slot's prompt blocks through it
         self._slot_row: dict[int, np.ndarray] = {}
@@ -1059,6 +1099,22 @@ class GenerativeModel:
     def release_memory(self) -> None:
         """Drop this model's HBM ledger reservation (component close)."""
         self.memory.release(self._mem_key)
+        if self.host_store is not None:
+            from seldon_core_tpu.executor.memory import host_memory
+
+            host_memory().release(self._mem_key)
+
+    def _note_dram_bytes(self, nbytes: int) -> None:
+        """HostPrefixStore byte callback: ledger the DRAM tier's live
+        bytes in the HOST memory manager (never the HBM one) and refresh
+        the gauge.  Runs only at demote/promote/evict time — admission
+        sync points, never the decode hot path."""
+        from seldon_core_tpu.executor.memory import host_memory
+
+        host_memory().reserve(self._mem_key, {"prefix_dram": int(nbytes)})
+        DEFAULT_METRICS.prefix_tier_bytes.labels(self.name, "dram").set(
+            int(nbytes)
+        )
 
     # ------------------------------------------------------------------ ops
 
@@ -1168,12 +1224,24 @@ class GenerativeModel:
                 matched = self.prefix_index.match(
                     prompt, min(max_reuse, need), salt=salt
                 )
+        # DRAM tier lookup: demoted chain levels that EXTEND the HBM match
+        # can be promoted back for the price of one fused scatter — they
+        # come out of the free pool like owned blocks (and re-enter the
+        # index when the slot releases), so the free-pool requirement is
+        # unchanged whether or not the promotion happens
+        promoted: list[tuple] = []
+        if self.host_store is not None and prompt is not None:
+            max_reuse = (int(prompt.size) - 1) // self.kv_block_size
+            stop = min(max_reuse, need)
+            if stop > len(matched):
+                promoted = self.host_store.match(
+                    prompt, len(matched) + 1, stop, salt=salt
+                )
         own_need = need - len(matched)
         if len(self._free_blocks) < own_need and self.prefix_index is not None:
             # reclaim unreferenced index blocks before failing admission
-            self._free_blocks.extend(
-                self.prefix_index.evict(own_need - len(self._free_blocks))
-            )
+            # (demoting their KV into the host store when the tier is on)
+            self._demote_and_free(own_need - len(self._free_blocks))
         if len(self._free_blocks) < own_need:
             if matched:
                 self.prefix_index.release(prompt, len(matched), salt=salt)
@@ -1185,6 +1253,26 @@ class GenerativeModel:
         got = self._free_blocks[-own_need:] if own_need else []
         if own_need:
             del self._free_blocks[-own_need:]
+        n_promoted = 0
+        if promoted:
+            # scatter the demoted levels into the LEADING owned blocks —
+            # they hold complete prompt KV, so release_slot's normal
+            # insertion absorbs them back into the index at completion
+            try:
+                self._exec_promote(
+                    self._promote_payload(got[: len(promoted)], promoted)
+                )
+                n_promoted = len(promoted)
+                self.host_store.drop([e[0] for e in promoted])
+                self.dram_hits += 1
+            except Exception:
+                # a failed promotion costs only the shortcut: the blocks
+                # stay slot-owned and the suffix prefill covers them
+                log.warning(
+                    "generative model %r: DRAM prefix promotion failed; "
+                    "falling back to plain prefill", self.name, exc_info=True,
+                )
+                n_promoted = 0
         used = (self.kv_blocks - 1) - len(self._free_blocks)
         if used > self._blocks_high_water:
             self._blocks_high_water = used
@@ -1195,15 +1283,20 @@ class GenerativeModel:
         if self.prefix_index is not None and prompt is not None:
             self._slot_prompt[slot] = np.asarray(prompt, np.int32).copy()
             self._slot_matched[slot] = len(matched)
+            self._slot_promoted[slot] = n_promoted
+            self._slot_tier[slot] = self._match_tier(
+                prompt, len(matched), n_promoted, salt
+            )
         row = np.zeros(self.max_blocks_per_slot, np.int32)
         row[: len(matched)] = matched
         row[len(matched):need] = got
         self._slot_row[slot] = row.copy()
-        if matched:
+        reused = len(matched) + n_promoted
+        if reused:
             DEFAULT_METRICS.prefix_tokens_reused.labels(self.name).inc(
-                len(matched) * self.kv_block_size
+                reused * self.kv_block_size
             )
-        return row, len(matched) * self.kv_block_size
+        return row, reused * self.kv_block_size
 
     def release_slot(self, slot: int) -> None:
         """Return ``slot``'s owned blocks to the pool and drop its shared-
@@ -1216,6 +1309,8 @@ class GenerativeModel:
         prompt = self._slot_prompt.pop(slot, None)
         blocks = self._slot_blocks.pop(slot, None)
         salt = self._slot_salt.pop(slot, b"")
+        self._slot_tier.pop(slot, None)
+        self._slot_promoted.pop(slot, None)
         aidx = int(self._slot_aidx[slot])
         if aidx:
             self._slot_aidx[slot] = 0
@@ -1487,6 +1582,358 @@ class GenerativeModel:
                 out["hist"] = hist
             self._cache = out
 
+    # --------------------------------------------- tiered prefix store
+    # (docs/CACHING.md "Tiered prefix store"): demotion catches index
+    # evictions into host DRAM; promotion scatters them back; the peer
+    # tier exports/installs whole chains across replicas.  Every device
+    # touch below happens at a scheduler sync point (reservations and
+    # external installs), never inside the fused decode loop, so the
+    # ≤1-host-sync-per-block audit holds with tiers on.
+
+    def _demote_and_free(self, shortfall: int) -> None:
+        """Evict up to ``shortfall`` blocks' worth of zero-ref prefix
+        chains into the free pool, demoting the victims' KV into the
+        host-DRAM store first (ONE batched device fetch for the whole
+        victim set).  Without the DRAM tier this is plain eviction."""
+        if self.prefix_index is None or shortfall <= 0:
+            return
+        victims = self.prefix_index.evict_entries(shortfall)
+        if not victims:
+            return
+        if self.host_store is not None:
+            try:
+                phys = np.asarray([b for _k, _d, b in victims], np.int32)
+                with self._lock:
+                    k = np.asarray(jax.device_get(self._cache["k"][:, phys]))
+                    v = np.asarray(jax.device_get(self._cache["v"][:, phys]))
+                    ks = vs = None
+                    if self.kv_dtype:
+                        ks = np.asarray(
+                            jax.device_get(self._cache["k_scale"][:, phys])
+                        )
+                        vs = np.asarray(
+                            jax.device_get(self._cache["v_scale"][:, phys])
+                        )
+                # shallowest level first so each chain stays contiguous
+                # in the store (a rejected level truncates the chain's
+                # tail instead of stranding it)
+                order = sorted(
+                    range(len(victims)),
+                    key=lambda j: (victims[j][0][0], len(victims[j][0][1])),
+                )
+                rejected: list[tuple] = []
+                for i in order:
+                    key, depth, _block = victims[i]
+                    if any(
+                        key[0] == r[0] and key[1].startswith(r[1])
+                        for r in rejected
+                    ):
+                        continue
+                    ok = self.host_store.put(
+                        key, depth,
+                        np.ascontiguousarray(k[:, i]),
+                        np.ascontiguousarray(v[:, i]),
+                        np.ascontiguousarray(ks[:, i]) if ks is not None else None,
+                        np.ascontiguousarray(vs[:, i]) if vs is not None else None,
+                    )
+                    if not ok:
+                        rejected.append(key)
+            except Exception:
+                log.warning(
+                    "generative model %r: DRAM prefix demotion failed; "
+                    "dropping %d evicted blocks", self.name, len(victims),
+                    exc_info=True,
+                )
+        self._free_blocks.extend(b for _k, _d, b in victims)
+
+    def _match_tier(
+        self, prompt: np.ndarray, n_matched: int, n_promoted: int, salt: bytes
+    ) -> str:
+        """Which tier satisfied the slot's prefix match: ``peer`` when a
+        matched level was installed by a peer pull no admission has used
+        yet (the credit is consumed — later hits are plain ``hbm``),
+        ``dram`` when levels were promoted from the host store, ``hbm``
+        for a plain index match, ``none`` otherwise."""
+        if n_matched and self._peer_chains:
+            from seldon_core_tpu.cache.tiers import HostPrefixStore
+
+            toks = np.asarray(prompt, np.int32).ravel()
+            consumed = False
+            for lvl in range(1, n_matched + 1):
+                key = HostPrefixStore.level_key(
+                    toks, lvl, self.kv_block_size, salt
+                )
+                if key in self._peer_chains:
+                    self._peer_chains.discard(key)
+                    consumed = True
+            if consumed:
+                self.peer_hits += 1
+                return "peer"
+        if n_promoted:
+            return "dram"
+        return "hbm" if n_matched else "none"
+
+    def _promote_payload(self, blocks: list, entries: list) -> dict:
+        """Stack the store entries' per-block arrays into the scatter
+        payload shape ``(layers, n, block_size, kv_heads, head_dim)``."""
+        payload = {
+            "phys": np.asarray(blocks, np.int32),
+            "k": np.ascontiguousarray(np.stack([e[2] for e in entries], 1)),
+            "v": np.ascontiguousarray(np.stack([e[3] for e in entries], 1)),
+        }
+        if self.kv_dtype:
+            payload["k_scale"] = np.ascontiguousarray(
+                np.stack([e[4] for e in entries], 1)
+            )
+            payload["v_scale"] = np.ascontiguousarray(
+                np.stack([e[5] for e in entries], 1)
+            )
+        return payload
+
+    @staticmethod
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def _promote_scatter(k, v, phys, impk, impv):
+        """Donated in-place scatter of promoted blocks — no pos/table
+        writes (prefill sets those when the slot dispatches)."""
+        k = k.at[:, phys].set(impk.astype(k.dtype))
+        v = v.at[:, phys].set(impv.astype(v.dtype))
+        return k, v
+
+    @staticmethod
+    @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def _promote_scatter_q(k, v, ks, vs, phys, impk, impv, impks, impvs):
+        """Int8-pool variant: quantized blocks AND scales scatter
+        verbatim — the store's bytes become the pool's bytes."""
+        k = k.at[:, phys].set(impk)
+        v = v.at[:, phys].set(impv)
+        ks = ks.at[:, phys].set(impks.astype(ks.dtype))
+        vs = vs.at[:, phys].set(impvs.astype(vs.dtype))
+        return k, v, ks, vs
+
+    def _exec_promote(self, payload: dict) -> None:
+        """Scatter promoted/pulled chain blocks into the pool (single
+        fused device op; mesh path pins the result back to the pool's
+        sharding like :meth:`_exec_import`)."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            c = self._cache
+            phys = np.asarray(payload["phys"], np.int32)
+            if not phys.size:
+                return
+            newk, newv = c["k"], c["v"]
+            newks, newvs = c.get("k_scale"), c.get("v_scale")
+            quant = self.kv_dtype is not None
+            k = self._unpack_bf16(np.asarray(payload["k"]), newk.dtype)
+            v = self._unpack_bf16(np.asarray(payload["v"]), newv.dtype)
+            ks = vs = None
+            if quant:
+                ks = self._unpack_bf16(
+                    np.asarray(payload["k_scale"]), newks.dtype
+                )
+                vs = self._unpack_bf16(
+                    np.asarray(payload["v_scale"]), newvs.dtype
+                )
+            if self.mesh is None:
+                args = (jnp.asarray(phys), jnp.asarray(k), jnp.asarray(v))
+                if quant:
+                    newk, newv, newks, newvs = (
+                        GenerativeModel._promote_scatter_q(
+                            newk, newv, newks, newvs,
+                            args[0], args[1], args[2],
+                            jnp.asarray(ks), jnp.asarray(vs),
+                        )
+                    )
+                else:
+                    newk, newv = GenerativeModel._promote_scatter(
+                        newk, newv, *args
+                    )
+            else:
+                newk = newk.at[:, phys].set(jnp.asarray(k).astype(newk.dtype))
+                newv = newv.at[:, phys].set(jnp.asarray(v).astype(newv.dtype))
+                newk = jax.device_put(newk, c["k"].sharding)
+                newv = jax.device_put(newv, c["v"].sharding)
+                if quant:
+                    newks = newks.at[:, phys].set(
+                        jnp.asarray(ks).astype(newks.dtype)
+                    )
+                    newvs = newvs.at[:, phys].set(
+                        jnp.asarray(vs).astype(newvs.dtype)
+                    )
+                    newks = jax.device_put(newks, c["k_scale"].sharding)
+                    newvs = jax.device_put(newvs, c["v_scale"].sharding)
+            out = dict(c)
+            out.update(k=newk, v=newv)
+            if quant:
+                out["k_scale"] = newks
+                out["v_scale"] = newvs
+            self._cache = out
+
+    def export_prefix_kv(
+        self,
+        tokens: np.ndarray,
+        adapter: str | None = None,
+        max_blocks: int = 64,
+    ) -> tuple | None:
+        """Serve a peer's prefix pull: the longest chain this replica
+        holds for ``tokens`` (HBM index levels, extended by contiguous
+        DRAM-store levels), as ``(depth, k, v, k_scale, v_scale)`` with
+        KV shaped ``(layers, depth, block_size, kv_heads, head_dim)``.
+        Returns None on no match — including a wrong-adapter probe, whose
+        salt never matches the exporting adapter's chains.  HBM levels
+        are REF-PINNED for the duration of the device fetch, so a
+        concurrent admission's eviction cannot free or demote them
+        mid-export."""
+        if self._multihost or self.prefix_index is None:
+            return None
+        from seldon_core_tpu.cache.prefix import adapter_salt
+
+        salt = adapter_salt(adapter)
+        tokens = np.asarray(tokens, np.int32).ravel()
+        cap = min(
+            int(max_blocks),
+            int(tokens.size) // self.kv_block_size,
+            self.max_blocks_per_slot,
+        )
+        if cap < 1:
+            return None
+        k = v = ks = vs = None
+        pinned = self.prefix_index.acquire(tokens, cap, salt=salt)
+        depth = len(pinned)
+        if pinned:
+            try:
+                phys = np.asarray([b for _k, _d, b in pinned], np.int32)
+                with self._lock:
+                    k = np.asarray(jax.device_get(self._cache["k"][:, phys]))
+                    v = np.asarray(jax.device_get(self._cache["v"][:, phys]))
+                    if self.kv_dtype:
+                        ks = np.asarray(
+                            jax.device_get(self._cache["k_scale"][:, phys])
+                        )
+                        vs = np.asarray(
+                            jax.device_get(self._cache["v_scale"][:, phys])
+                        )
+            finally:
+                self.prefix_index.release(tokens, depth, salt=salt)
+        if self.host_store is not None and depth < cap:
+            # DRAM levels that contiguously extend the HBM chain ride the
+            # same frame — the puller sees one deeper chain
+            ext = self.host_store.match(tokens, depth + 1, cap, salt=salt)
+            if ext:
+                ek = np.stack([e[2] for e in ext], 1)
+                ev = np.stack([e[3] for e in ext], 1)
+                k = ek if k is None else np.concatenate([k, ek], axis=1)
+                v = ev if v is None else np.concatenate([v, ev], axis=1)
+                if self.kv_dtype:
+                    eks = np.stack([e[4] for e in ext], 1)
+                    evs = np.stack([e[5] for e in ext], 1)
+                    ks = eks if ks is None else np.concatenate([ks, eks], 1)
+                    vs = evs if vs is None else np.concatenate([vs, evs], 1)
+                depth += len(ext)
+        if not depth:
+            return None
+        self.peer_serves += 1
+        return depth, k, v, ks, vs
+
+    def install_prefix_chain(
+        self,
+        tokens: np.ndarray,
+        k: np.ndarray,
+        v: np.ndarray,
+        k_scale: "np.ndarray | None" = None,
+        v_scale: "np.ndarray | None" = None,
+        adapter: str | None = None,
+    ) -> int:
+        """Install a peer-pulled prefix chain into the pool + index
+        (called from the scheduler's sync point, never concurrently with
+        an admission).  Only levels deeper than what is already resident
+        are installed, as ZERO-REF index entries — evictable like any
+        absorbed prompt.  Returns the number of levels installed; any
+        failure frees every block it took (zero leaks) and the caller
+        falls back to plain prefill."""
+        if self.prefix_index is None:
+            raise GraphUnitError(
+                f"model {self.name!r} has no prefix index to install into"
+            )
+        if self._multihost:
+            raise GraphUnitError(
+                "peer prefix install is not supported on a multi-host slice"
+            )
+        from seldon_core_tpu.cache.prefix import adapter_salt
+        from seldon_core_tpu.cache.tiers import HostPrefixStore
+
+        if adapter and (
+            self.lora_pool is None or adapter not in self.lora_pool
+        ):
+            raise GraphUnitError(
+                f"pulled chain names adapter {adapter!r} but it is not "
+                "resident on this pool"
+            )
+        tokens = np.asarray(tokens, np.int32).ravel()
+        bs = self.kv_block_size
+        k = np.asarray(k)
+        v = np.asarray(v)
+        depth = int(k.shape[1]) if k.ndim == 5 else -1
+        expect = (
+            self.cfg.n_layers, depth, bs, self.cfg.n_kv_heads,
+            self.cfg.head_dim,
+        )
+        if depth < 1 or tuple(k.shape) != expect or tuple(v.shape) != expect:
+            raise GraphUnitError(
+                f"pulled chain KV shape {tuple(k.shape)} does not match "
+                f"this pool's {expect} (config or block-size skew)"
+            )
+        if int(tokens.size) < depth * bs:
+            raise GraphUnitError("pulled chain tokens do not cover its blocks")
+        if bool(self.kv_dtype) != (k_scale is not None):
+            raise GraphUnitError(
+                f"pulled chain dtype skew: pool is "
+                f"{self.kv_dtype or 'float'} but the frame "
+                f"{'carries' if k_scale is not None else 'lacks'} int8 "
+                "scales"
+            )
+        salt = adapter_salt(adapter)
+        have = self.prefix_index.peek_depth(tokens, depth, salt=salt)
+        if have >= depth:
+            return 0
+        n_new = depth - have
+        if len(self._free_blocks) < n_new:
+            self._demote_and_free(n_new - len(self._free_blocks))
+        if len(self._free_blocks) < n_new:
+            return 0  # pool too hot to cache a pull; nothing taken
+        got = self._free_blocks[-n_new:]
+        del self._free_blocks[-n_new:]
+        try:
+            payload = {
+                "phys": np.asarray(got, np.int32),
+                "k": np.ascontiguousarray(k[:, have:]),
+                "v": np.ascontiguousarray(v[:, have:]),
+            }
+            if k_scale is not None:
+                payload["k_scale"] = np.ascontiguousarray(
+                    np.asarray(k_scale)[:, have:]
+                )
+                payload["v_scale"] = np.ascontiguousarray(
+                    np.asarray(v_scale)[:, have:]
+                )
+            self._exec_promote(payload)
+            rejected = self.prefix_index.insert(tokens, got, have, salt=salt)
+        except Exception:
+            self._free_blocks.extend(got)
+            raise
+        if rejected:
+            # level raced into the index between peek and insert (no such
+            # caller today — installs and admissions share the sync
+            # point); the duplicate blocks are unreferenced, free them
+            self._free_blocks.extend(rejected)
+        absorbed = n_new - len(rejected)
+        for lvl in range(have + 1, depth + 1):
+            self._peer_chains.add(
+                HostPrefixStore.level_key(tokens, lvl, bs, salt)
+            )
+        self.peer_installs += absorbed
+        return absorbed
+
     def admit_dispatch(
         self,
         slot: int,
@@ -1681,6 +2128,15 @@ class GenerativeModel:
 
     # ---------------------------------------------- device-frontier stats
 
+    def kv_bytes_per_block(self) -> int:
+        """HBM bytes one KV block costs in this pool's layout (scales
+        included on an int8 pool) — sizes the HBM tier's byte telemetry."""
+        return sum(
+            int(self._cache[key].nbytes) // self.kv_blocks
+            for key in ("k", "v", "k_scale", "v_scale")
+            if key in self._cache
+        )
+
     def kv_bytes_per_slot(self) -> int:
         """HBM bytes one max_seq slot costs in this pool's layout."""
         fam = self.family
@@ -1722,10 +2178,14 @@ class GenerativeModel:
         if self._slot_row.get(slot) is None:
             return None
         matched = self._slot_matched.get(slot, 0)
+        promoted = self._slot_promoted.get(slot, 0)
         return {
             "blocks_reused": matched,
+            "blocks_promoted": promoted,
             "blocks_allocated": len(self._slot_blocks.get(slot, ())),
-            "prefix_tokens": matched * self.kv_block_size,
+            "prefix_tokens": (matched + promoted) * self.kv_block_size,
+            # which tier satisfied the prefix match (hbm/dram/peer/none)
+            "tier": self._slot_tier.get(slot, "none"),
         }
 
     def pool_snapshot(self) -> dict:
@@ -1745,6 +2205,11 @@ class GenerativeModel:
             if "k_scale" in self._cache
             else 0
         )
+        host_snap = None
+        if self.host_store is not None:
+            from seldon_core_tpu.executor.memory import host_memory
+
+            host_snap = host_memory().snapshot()
         snap = {
             "blocks": {
                 "total": total,
@@ -1759,11 +2224,16 @@ class GenerativeModel:
                 "kv_pool": kv_bytes,
                 "kv_scales": scale_bytes,
                 "adapter_pool": self.lora_bytes,
+                "prefix_dram": (
+                    self.host_store.bytes if self.host_store is not None else 0
+                ),
                 "per_slot": self.kv_bytes_per_slot(),
             },
             # chip-level arbitration (executor/memory.py): every resident
             # deployment's classes against the shared HBM budget
             "hbm": self.memory.snapshot(),
+            # host-DRAM arbitration for the tiered prefix store
+            "host": host_snap,
             "prefix_evictions": (
                 self.prefix_index.evicted if self.prefix_index is not None else 0
             ),
@@ -2366,6 +2836,13 @@ class GenerativeModel:
             # prompts; a reset must leave the index empty) — zero-ref only,
             # and after the release loop every entry IS zero-ref
             self._free_blocks.extend(self.prefix_index.flush())
+        if self.host_store is not None:
+            # a reset empties every tier: demoted warmup chains must not
+            # survive to be promoted into a clean pool
+            self.host_store.flush()
+        self._peer_chains.clear()
+        self._slot_tier.clear()
+        self._slot_promoted.clear()
         if self.driver is not None:
             self.driver.lead(self._mh_reset_key, {})
             return
@@ -2384,6 +2861,57 @@ class GenerativeModel:
         # compact routing digest: the gateway's prefix-aware router polls
         # this to steer shared-prefix requests at the warm replica
         snap["digest"] = self.prefix_index.digest()
+        # per-tier telemetry (docs/CACHING.md "Tiered prefix store"): the
+        # same six fields for every tier, zero-filled where a tier has no
+        # such flow, so dashboards can stack them without schema checks
+        idx = self.prefix_index.snapshot()
+        tiers: dict[str, dict] = {
+            "hbm": {
+                "hits": idx["hits"],
+                "misses": idx["misses"],
+                "promotions": 0,
+                "demotions": idx["evicted"],
+                "bytes": len(self.prefix_index) * self.kv_bytes_per_block(),
+                "pull_count": 0,
+            },
+            "peer": {
+                "hits": self.peer_hits,
+                "misses": 0,
+                "promotions": self.peer_installs,
+                "demotions": 0,
+                "bytes": 0,
+                "pull_count": self.peer_serves,
+            },
+        }
+        if self.host_store is not None:
+            st = self.host_store.snapshot()
+            tiers["dram"] = {
+                "hits": st["hits"],
+                "misses": st["misses"],
+                "promotions": st["promotions"],
+                "demotions": st["demotions"],
+                "bytes": st["bytes"],
+                "pull_count": 0,
+                "entries": st["entries"],
+                "budget_bytes": st["budget_bytes"],
+                "evictions": st["evictions"],
+                "rejected": st["rejected"],
+            }
+            # the DRAM digest rides the same gossip as the HBM one: a
+            # replica holding a chain in DRAM still serves it warm (one
+            # promotion scatter), so the router should route/pull for it
+            tiers["dram"]["digest"] = self.host_store.digest()
+        snap["tiers"] = tiers
+        m = DEFAULT_METRICS
+        for tier, t in tiers.items():
+            m.prefix_tier_hits.labels(self.name, tier).set(t["hits"])
+            m.prefix_tier_promotions.labels(self.name, tier).set(
+                t["promotions"]
+            )
+            m.prefix_tier_demotions.labels(self.name, tier).set(
+                t["demotions"]
+            )
+            m.prefix_tier_bytes.labels(self.name, tier).set(t["bytes"])
         return snap
 
 
@@ -2479,6 +3007,10 @@ class GenerationScheduler:
         # chunk's latency.  Their slots are reserved but not decode-active.
         self._prefilling: list[dict] = []
         self._prefill_slots: set[int] = set()
+        # peer-pulled prefix chains waiting to install (docs/CACHING.md
+        # "Tiered prefix store"): the scatter grabs pool blocks, so it
+        # only runs at a sync point, like external releases
+        self._prefix_installs: list[tuple] = []
         self._task: asyncio.Task | None = None
         self._closed = False
         # Random base so temperature>0 sampling differs across restarts and
@@ -2750,6 +3282,56 @@ class GenerationScheduler:
             self._external.discard(slot)
             self.model.release_slot(slot)
 
+    async def install_prefix(
+        self,
+        tokens: np.ndarray,
+        k: np.ndarray,
+        v: np.ndarray,
+        *,
+        k_scale: np.ndarray | None = None,
+        v_scale: np.ndarray | None = None,
+        adapter: str | None = None,
+    ) -> int:
+        """Install a peer-pulled prefix chain into the pool + index at
+        the run loop's next sync point (the scatter takes free blocks, so
+        it must never race a dispatched decode block).  Resolves to the
+        number of chain levels installed (0 when everything was already
+        resident or the pool is too hot to cache the pull)."""
+        if self._closed:
+            raise RuntimeError("GenerationScheduler is closed")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._prefix_installs.append(
+            (
+                {
+                    "tokens": tokens, "k": k, "v": v,
+                    "k_scale": k_scale, "v_scale": v_scale,
+                    "adapter": adapter,
+                },
+                fut,
+            )
+        )
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+        self._wake.set()
+        return await fut
+
+    async def _drain_prefix_installs(self) -> None:
+        while self._prefix_installs:
+            payload, fut = self._prefix_installs.pop(0)
+            try:
+                n = await asyncio.to_thread(
+                    self.model.install_prefix_chain,
+                    payload["tokens"], payload["k"], payload["v"],
+                    payload["k_scale"], payload["v_scale"],
+                    payload["adapter"],
+                )
+            except Exception as e:
+                if not fut.done():
+                    fut.set_exception(e)
+                continue
+            if not fut.done():
+                fut.set_result(n)
+
     async def close(self) -> None:
         self._closed = True
         if self._task is not None:
@@ -2763,6 +3345,10 @@ class GenerationScheduler:
             if not req.future.done():
                 req.future.set_exception(err)
         self._waiting.clear()
+        for _payload, fut in self._prefix_installs:
+            if not fut.done():
+                fut.set_exception(err)
+        self._prefix_installs.clear()
 
     # ---------------------------------------------------------------- loop
 
@@ -2982,12 +3568,17 @@ class GenerationScheduler:
                     # handoff slots released with no block in flight: safe
                     # to return their blocks to the pool right here
                     self._drain_external_releases()
+                if pending is None and self._prefix_installs:
+                    # peer-pulled chains: the install scatter takes pool
+                    # blocks, legal only with no decode block in flight
+                    await self._drain_prefix_installs()
                 if (
                     pending is None
                     and not active.any()
                     and not self._overflow
                     and not self._waiting
                     and not self._prefilling
+                    and not self._prefix_installs
                 ):
                     # fully idle: park until a submit wakes us (no await
                     # between the emptiness check and clear, so a submit

@@ -24,8 +24,10 @@ import os
 import threading
 
 #: byte classes the ledger recognises (free-form keys are allowed; these
-#: are the ones the generative plane reports and the gauges label)
-CLASSES = ("weights", "kv_pool", "kv_scales", "adapter_pool")
+#: are the ones the generative plane reports and the gauges label);
+#: ``prefix_dram`` lives in the HOST ledger (:func:`host_memory`), not the
+#: HBM one — demoted prefix KV occupies host DRAM, not chip memory
+CLASSES = ("weights", "kv_pool", "kv_scales", "adapter_pool", "prefix_dram")
 
 
 class HBMOverCommit(RuntimeError):
@@ -134,3 +136,23 @@ class MemoryManager:
 #: process-wide default ledger (one chip per engine process); tests build
 #: their own with explicit budgets
 MEMORY = MemoryManager()
+
+
+_HOST_MEMORY: MemoryManager | None = None
+_HOST_LOCK = threading.Lock()
+
+
+def host_memory() -> MemoryManager:
+    """Process-wide HOST-DRAM ledger, separate from the HBM one so the
+    tiered prefix store's bytes (class ``prefix_dram``) never eat the
+    chip budget or trip ``SCT_HBM_ENFORCE``.  Budget defaults to
+    ``SCT_PREFIX_DRAM_GB`` (0 GiB — the DRAM tier is opt-in); built
+    lazily so tests that tweak the env var before first touch see it."""
+    global _HOST_MEMORY
+    with _HOST_LOCK:
+        if _HOST_MEMORY is None:
+            budget = int(
+                float(os.environ.get("SCT_PREFIX_DRAM_GB", "0")) * (1 << 30)
+            )
+            _HOST_MEMORY = MemoryManager(budget, enforce=False)
+        return _HOST_MEMORY

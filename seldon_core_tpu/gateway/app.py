@@ -329,6 +329,7 @@ class GatewayApp:
         # costs nothing for digest-less pools), p2c on load otherwise
         endpoints = rec.replica_endpoints
         ep = None
+        peer_hint = None
         if len(endpoints) > 1:
             from seldon_core_tpu.disagg.router import extract_prompt_request
 
@@ -337,7 +338,9 @@ class GatewayApp:
                 if self.router.has_digests(rec.oauth_key)
                 else (None, None)
             )
-            ep = self.router.pick(rec.oauth_key, endpoints, tokens, adapter)
+            ep, peer_hint = self.router.pick_with_peer(
+                rec.oauth_key, endpoints, tokens, adapter
+            )
             self.router.note_start(rec.oauth_key, ep.key)
         pool = self._pool(rec, ep)
         wire = WIRE.counter(WIRE_GATEWAY_REST, rec.name)
@@ -346,7 +349,14 @@ class GatewayApp:
 
         # traceparent + the decremented deadline budget / priority class
         # cross the gateway->engine hop
-        fwd_headers = {**outgoing_headers(), **outgoing_qos_headers()} or None
+        fwd_headers = {**outgoing_headers(), **outgoing_qos_headers()}
+        if peer_hint is not None:
+            # tiered prefix store, peer tier (docs/CACHING.md): tell the
+            # chosen replica which peer advertises this prompt's KV chain
+            # (and how deep) so it can pull instead of re-prefilling
+            fwd_headers["x-sct-prefix-peer"] = peer_hint[0]
+            fwd_headers["x-sct-prefix-depth"] = str(int(peer_hint[1]))
+        fwd_headers = fwd_headers or None
 
         async def attempt(i: int) -> tuple[int, bytes]:
             try:

@@ -927,6 +927,9 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                 self.gateway.stream_timeout_s if streaming else self.gateway.timeout_s
             )
             self.deadline = self.frontend.loop.time() + timeout
+            # the pick may splice a peer prefix hint into the head, so it
+            # runs BEFORE the job captures the bytes (docs/CACHING.md)
+            pool, raw = self.frontend.pool_and_hint(rec, raw, content_length)
             self._req_bytes = len(raw)
             self._resp_bytes = 0
             # collapse leader: capture the response body for the cache and
@@ -937,7 +940,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
             job = _Job(self, raw, streaming)
             self.job = job
             self.frontend.spliced += 1
-            self.frontend.pool_for(rec, raw, content_length).submit(job)
+            pool.submit(job)
             return
 
     def _parse_request_head(self, head: bytes, idx: int) -> tuple | None:
@@ -1288,8 +1291,22 @@ class H1SpliceFrontend:
         records pick a replica per request — prefix-aware against polled
         digests when the request body carries tokens, p2c on load
         otherwise (disagg/router.py)."""
+        return self.pool_and_hint(rec, raw, content_length)[0]
+
+    def pool_and_hint(
+        self, rec, raw: bytes | None = None, content_length: int = 0
+    ) -> "tuple[_UpstreamPool, bytes | None]":
+        """:meth:`pool_for` plus the tiered-prefix peer hint: when the
+        router yields prefix affinity to load (docs/CACHING.md "Tiered
+        prefix store"), the advertising replica + chain depth are spliced
+        into the request head as ``x-sct-prefix-peer`` /
+        ``x-sct-prefix-depth`` so the chosen engine pulls the chain instead
+        of re-prefilling.  Returns ``(pool, raw)`` — ``raw`` is the
+        original bytes when no hint fired (the common case costs nothing
+        beyond the pick it already paid)."""
         endpoints = rec.replica_endpoints
         ep = endpoints[0]
+        hint = None
         if len(endpoints) > 1:
             router = self.gateway.router
             tokens = adapter = None
@@ -1305,13 +1322,26 @@ class H1SpliceFrontend:
                 tokens, adapter = extract_prompt_request(
                     raw[len(raw) - content_length:]
                 )
-            ep = router.pick(rec.oauth_key, endpoints, tokens, adapter)
+            ep, hint = router.pick_with_peer(
+                rec.oauth_key, endpoints, tokens, adapter
+            )
         key = (rec.oauth_key, ep.key)
         pool = self._pools.get(key)
         if pool is None:
             pool = _UpstreamPool(ep.host, ep.rest_port, self.loop)
             self._pools[key] = pool
-        return pool
+        if hint is not None and raw is not None:
+            # inject before the head's final CRLF (RFC 9112 §7.6.1 —
+            # same rebuild discipline as the traceparent splice)
+            i = raw.find(b"\r\n\r\n")
+            if i >= 0:
+                inject = (
+                    b"x-sct-prefix-peer: " + hint[0].encode() + b"\r\n"
+                    b"x-sct-prefix-depth: "
+                    + str(int(hint[1])).encode() + b"\r\n"
+                )
+                raw = raw[: i + 2] + inject + raw[i + 2:]
+        return pool, raw
 
     def wire_for(self, rec) -> "object":
         """Per-deployment wire byte counter for the splice path (cached —

@@ -353,7 +353,8 @@ def _jax_generative(parameters: dict[str, Any]) -> Any:
     ``max_new_tokens``, ``temperature``, ``top_k`` (fused on-device top-k
     sampling), ``eos_id``, ``dtype``, ``checkpoint``, ``seq_impl``,
     ``decode_block``, ``overlap`` (overlapped decode pipeline,
-    docs/PERFORMANCE.md), ``kv_prefix_reuse``, ``spec_draft`` /
+    docs/PERFORMANCE.md), ``kv_prefix_reuse``, ``prefix_dram_gb``
+    (host-DRAM prefix tier, docs/CACHING.md), ``spec_draft`` /
     ``spec_ngram`` / ``spec_hist`` (fused self-speculative decoding),
     ``kv_cache_dtype`` (``int8`` paged-KV quantization), ``prefill_chunk``
     (Sarathi-style chunked prefill interleaved with decode),

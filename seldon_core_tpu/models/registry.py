@@ -217,6 +217,7 @@ def build_generative_component(
     kv_blocks: int | None = None,
     queue_max: int | None = None,
     kv_prefix_reuse: bool | None = None,
+    prefix_dram_gb: float | None = None,
     top_k: int = 0,
     overlap: bool | None = None,
     spec_draft: int | None = None,
@@ -236,6 +237,10 @@ def build_generative_component(
 
     ``kv_block_size`` / ``kv_blocks`` size the paged KV pool (defaults:
     16-token blocks, pool big enough for every slot at full max_seq).
+    ``prefix_dram_gb`` (with ``kv_prefix_reuse``) byte-bounds the
+    host-DRAM prefix tier: index evictions demote into host memory and
+    promote back with one fused scatter (docs/CACHING.md "Tiered prefix
+    store"; env fallback ``SCT_PREFIX_DRAM_GB``).
     ``spec_draft``/``spec_ngram``/``spec_hist`` turn on fused
     self-speculative decoding; ``kv_cache_dtype="int8"`` stores the paged
     pool quantized with per-(position, head) scales;
@@ -284,6 +289,7 @@ def build_generative_component(
         kv_block_size=kv_block_size,
         kv_blocks=kv_blocks,
         prefix_reuse=kv_prefix_reuse,
+        prefix_dram_gb=prefix_dram_gb,
         top_k=top_k,
         spec_draft=spec_draft,
         spec_ngram=spec_ngram,

@@ -273,6 +273,36 @@ class MetricsRegistry:
             ["model_name"],
             registry=self.registry,
         )
+        # Tiered prefix store (docs/CACHING.md "Tiered prefix store"):
+        # per-tier (hbm/dram/peer) flow counters refreshed from the tier
+        # snapshots at scrape time — gauges over monotonic totals, like
+        # the kv_* family.
+        self.prefix_tier_hits = Gauge(
+            "seldon_prefix_tier_hits",
+            "Prefix matches satisfied by this tier (hbm/dram/peer)",
+            ["model_name", "tier"],
+            registry=self.registry,
+        )
+        self.prefix_tier_promotions = Gauge(
+            "seldon_prefix_tier_promotions",
+            "Chain levels promoted out of this tier into HBM (dram: fused "
+            "promotion scatters; peer: levels installed from pulls)",
+            ["model_name", "tier"],
+            registry=self.registry,
+        )
+        self.prefix_tier_demotions = Gauge(
+            "seldon_prefix_tier_demotions",
+            "Chain levels demoted out of this tier (hbm: index evictions; "
+            "dram levels absorbed ride the dram tier's own counter)",
+            ["model_name", "tier"],
+            registry=self.registry,
+        )
+        self.prefix_tier_bytes = Gauge(
+            "seldon_prefix_tier_bytes",
+            "Bytes of prefix KV currently held by this tier",
+            ["model_name", "tier"],
+            registry=self.registry,
+        )
         # Speculative decoding (docs/PERFORMANCE.md): the acceptance ledger
         # behind accepted_tokens_per_step — emitted tokens over (slot,
         # verify-pass) pairs; > 1.0 means the n-gram drafts pay for

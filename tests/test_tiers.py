@@ -1,0 +1,945 @@
+"""Tiered cluster-wide KV prefix store tests (docs/CACHING.md "Tiered
+prefix store"): HBM radix index -> host-DRAM store -> peer replica.
+
+Acceptance bars pinned here:
+
+* **pinned-equal** — a generation whose prefix was demoted to host DRAM
+  and promoted back, or pulled from a peer replica, is BIT-IDENTICAL to
+  the HBM-resident generation (greedy + seeded top-k, int8 paged KV,
+  adapter-salted chains, tp=2 sharded mesh);
+* **eviction ordering** — both tiers evict cheapest-to-rebuild chains
+  first (chain depth x block count), never dooming a deep chain to admit
+  a shallow one, and always dooming a victim's extensions with it;
+* **failure matrix** — torn / version-skewed / malformed pull frames
+  degrade to plain suffix prefill with status 200, zero leaked pool
+  blocks and zero leaked DRAM bytes; wrong-adapter pulls miss;
+* **refcount safety** — a chain pinned for a concurrent export can never
+  be demoted out from under the pull;
+* **host-sync audit** — demotion/promotion happen only at admission sync
+  points: decode stays <= 1 host sync per fused block with tiers on.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu.cache.prefix import PrefixIndex, adapter_salt
+from seldon_core_tpu.cache.tiers import HostPrefixStore
+from seldon_core_tpu.disagg.handoff import (
+    PREFIX_KEY,
+    HandoffError,
+    decode_prefix_chain,
+    encode_prefix_chain,
+)
+from seldon_core_tpu.executor.multihost import encode_step
+
+run = asyncio.run
+
+PREFIX = list(range(7, 39))  # 32 tokens = 2 full 16-token blocks
+BULK = list(range(60, 179))  # 119 tokens: 8-block reservation with 8 new
+
+
+def _build_tiered(
+    prefix_reuse: bool = True,
+    dram_gb: "float | None" = 0.001,
+    mesh=None,
+    n_slots: int = 2,
+    kv_blocks: "int | None" = None,
+    **kw,
+):
+    import jax
+
+    from seldon_core_tpu.executor.generation import GenerativeModel
+    from seldon_core_tpu.models import llama
+
+    cfg = llama.Config.tiny(max_seq=128)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return GenerativeModel(
+        cfg,
+        params,
+        n_slots=n_slots,
+        kv_block_size=16,
+        kv_blocks=kv_blocks,
+        prefix_reuse=prefix_reuse,
+        prefix_dram_gb=dram_gb if prefix_reuse else None,
+        mesh=mesh,
+        param_axes=llama.param_logical_axes(params) if mesh is not None else None,
+        name="tiers",
+        **kw,
+    )
+
+
+def _generate_all(
+    model, prompts, max_new=8, temperature=0.0, seed=None, adapters=None
+):
+    from seldon_core_tpu.executor.generation import GenerationScheduler
+
+    outs = []
+
+    async def go():
+        s = GenerationScheduler(model)
+        if seed is not None:
+            s._seed = int(seed)
+        for i, p in enumerate(prompts):
+            outs.append(
+                await s.submit(
+                    np.asarray(p, np.int32),
+                    max_new_tokens=max_new,
+                    temperature=temperature,
+                    adapter=(adapters[i] if adapters else None),
+                )
+            )
+        await s.close()
+
+    run(go())
+    return outs
+
+
+def _pressure_prompts():
+    """Warm a 2-block prefix chain, squeeze it out of a 10-block pool with
+    an 8-block bulk prompt, then return for the chain."""
+    return [PREFIX + [40, 41, 42], BULK, PREFIX + [50, 51, 52]]
+
+
+def _entry(depth: int, nbytes: int = 200):
+    half = nbytes // 2
+    return dict(
+        depth=depth,
+        k=np.zeros(half, np.int8),
+        v=np.zeros(nbytes - half, np.int8),
+    )
+
+
+# ---------------------------------------------------------------------------
+# unit: host-DRAM store (cache/tiers.py)
+# ---------------------------------------------------------------------------
+
+
+class TestHostPrefixStore:
+    A = np.arange(32, dtype=np.int32)
+    B = np.arange(100, 132, dtype=np.int32)
+
+    def _key(self, tokens, k, salt=b""):
+        return HostPrefixStore.level_key(tokens, k, 4, salt)
+
+    def test_byte_bound_and_oversized_rejected(self):
+        store = HostPrefixStore(4, budget_bytes=500)
+        assert not store.put(self._key(self.A, 1), **_entry(1, nbytes=501))
+        assert store.rejected == 1 and len(store) == 0 and store.bytes == 0
+        assert store.put(self._key(self.A, 1), **_entry(1))
+        assert store.bytes == 200 and store.demotions == 1
+
+    def test_chain_cost_eviction_order(self):
+        """Under byte pressure the store evicts the cheapest-to-rebuild
+        chain first, and a shallow incoming entry can never displace a
+        deeper chain (satellite: depth x blocks weighted eviction)."""
+        store = HostPrefixStore(4, budget_bytes=800)
+        for lvl in (1, 2, 3):
+            assert store.put(self._key(self.A, lvl), **_entry(lvl))
+        assert store.put(self._key(self.B, 1), **_entry(1))
+        assert store.bytes == 800
+        # a new 1-deep chain fits only by evicting B's 1-deep chain — the
+        # 3-deep A chain (cost 3 levels x depth 3) is untouchable for it
+        C = np.arange(200, 232, dtype=np.int32)
+        assert store.put(self._key(C, 1), **_entry(1))
+        assert store.evictions == 1
+        assert store.peek_depth(self.B, 1, 1) == 0
+        assert store.peek_depth(self.A, 1, 3) == 3
+
+    def test_shallow_put_never_displaces_a_deep_chain(self):
+        store = HostPrefixStore(4, budget_bytes=600)
+        for lvl in (1, 2, 3):
+            assert store.put(self._key(self.A, lvl), **_entry(lvl))
+        # only the deep A chain could make room: a shallow put must be
+        # REFUSED (rejected counter), the deep chain stays intact
+        D = np.arange(300, 332, dtype=np.int32)
+        assert not store.put(self._key(D, 1), **_entry(1))
+        assert store.rejected == 1
+        assert store.peek_depth(self.A, 1, 3) == 3
+        # a DEEPER incoming entry may displace the cheapest level (A3)
+        assert store.put(self._key(D, 4), **_entry(4))
+        assert store.peek_depth(self.A, 1, 3) == 2
+
+    def test_eviction_dooms_extensions(self):
+        """Covering a large need walks chains root-ward: evicting a root
+        always takes its extensions with it (no stranded tails)."""
+        store = HostPrefixStore(4, budget_bytes=600)
+        for lvl in (1, 2, 3):
+            assert store.put(self._key(self.A, lvl), **_entry(lvl))
+        big = self._key(self.B, 10)
+        assert store.put(big, **_entry(10, nbytes=600))
+        assert store.evictions == 3 and len(store) == 1
+        assert store.peek_depth(self.A, 1, 3) == 0
+
+    def test_match_drop_and_peek_counters(self):
+        store = HostPrefixStore(4, budget_bytes=10_000)
+        for lvl in (1, 2):
+            store.put(self._key(self.A, lvl), **_entry(lvl))
+        # peek is a pure probe: no hit/miss accounting
+        assert store.peek_depth(self.A, 1, 4) == 2
+        assert store.hits == 0 and store.misses == 0
+        got = store.match(self.A, 1, 4)
+        assert [depth for _k, depth, *_ in got] == [1, 2]
+        assert store.hits == 1
+        assert store.match(self.B, 1, 4) == []
+        assert store.misses == 1
+        # drop = promotion accounting; bytes ledger returns to zero
+        store.drop([k for k, *_ in got])
+        assert store.promotions == 2 and store.bytes == 0 and len(store) == 0
+        snap = store.snapshot()
+        assert snap["promotions"] == 2 and snap["demotions"] == 2
+
+    def test_salted_chains_never_cross(self):
+        store = HostPrefixStore(4, budget_bytes=10_000)
+        salt = adapter_salt("alpha")
+        store.put(self._key(self.A, 1, salt), **_entry(1))
+        assert store.peek_depth(self.A, 1, 1) == 0
+        assert store.match(self.A, 1, 1) == []
+        assert store.peek_depth(self.A, 1, 1, salt) == 1
+
+    def test_digest_matches_index_hash_scheme(self):
+        from seldon_core_tpu.cache.prefix import chain_hash
+
+        store = HostPrefixStore(4, budget_bytes=10_000)
+        for lvl in (1, 2):
+            store.put(self._key(self.A, lvl), **_entry(lvl))
+        digest = store.digest()
+        assert digest["block_size"] == 4 and digest["entries"] == 2
+        key = self._key(self.A, 2)
+        assert chain_hash(key[0] + key[1]) in digest["hashes"]
+        # deepest-first so a truncated digest keeps the expensive chains
+        assert digest["depths"] == [2, 1]
+
+
+# ---------------------------------------------------------------------------
+# unit: HBM index eviction ordering (satellite: depth x blocks weighting)
+# ---------------------------------------------------------------------------
+
+
+class TestIndexEvictionOrder:
+    def test_cheapest_chain_evicted_first_not_lru(self):
+        """An older deep chain outlives a NEWER shallow one: eviction is
+        weighted by rebuild cost (chain depth x block count), with LRU
+        ticks only breaking ties — the ordering this PR pins."""
+        idx = PrefixIndex(4)
+        A = np.arange(16, dtype=np.int32)
+        B = np.arange(100, 116, dtype=np.int32)
+        assert idx.insert(A, [1, 2], 0) == []  # older, 2-deep
+        assert idx.insert(B, [3], 0) == []  # newer, 1-deep
+        victims = idx.evict_entries(1)
+        assert [(d, b) for _k, d, b in victims] == [(1, 3)]  # B, not A
+        assert idx.evict_entries(0) == []
+
+    def test_evicting_a_root_dooms_its_extensions(self):
+        idx = PrefixIndex(4)
+        A = np.arange(16, dtype=np.int32)
+        idx.insert(A, [1, 2], 0)
+        victims = idx.evict_entries(1)
+        # the chain goes down whole: level 1 cannot strand level 2
+        assert sorted(d for _k, d, _b in victims) == [1, 2]
+        assert len(idx) == 0 and idx.evicted == 2
+
+    def test_referenced_chains_are_untouchable(self):
+        idx = PrefixIndex(4)
+        A = np.arange(16, dtype=np.int32)
+        idx.insert(A, [1, 2], 0)
+        assert len(idx.match(A, 2)) == 2  # refs both levels
+        assert idx.evict_entries(99) == []
+        idx.release(A, 2)
+        assert len(idx.evict_entries(99)) == 2
+
+
+# ---------------------------------------------------------------------------
+# unit: prefix-chain wire codec (disagg/handoff.py)
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixChainCodec:
+    def _chain(self, depth=2, bs=4, quant=False):
+        rng = np.random.default_rng(0)
+        shape = (2, depth, bs, 2, 8)  # (layers, depth, bs, kv_heads, hd)
+        if quant:
+            k = rng.integers(-127, 127, size=shape, dtype=np.int8)
+            v = rng.integers(-127, 127, size=shape, dtype=np.int8)
+            ks = rng.random((2, depth, bs, 2, 1), dtype=np.float32)
+            vs = rng.random((2, depth, bs, 2, 1), dtype=np.float32)
+        else:
+            k = rng.random(shape, dtype=np.float32)
+            v = rng.random(shape, dtype=np.float32)
+            ks = vs = None
+        tokens = np.arange(depth * bs, dtype=np.int32)
+        return tokens, k, v, ks, vs
+
+    def test_round_trip_float_and_int8(self):
+        for quant in (False, True):
+            tokens, k, v, ks, vs = self._chain(quant=quant)
+            frame = encode_prefix_chain(
+                tokens, k, v, block_size=4, k_scale=ks, v_scale=vs,
+                adapter="billing" if quant else None,
+            )
+            out = decode_prefix_chain(frame)
+            assert out["depth"] == 2 and out["block_size"] == 4
+            assert np.array_equal(out["tokens"], tokens)
+            assert np.array_equal(out["k"], k)
+            assert np.array_equal(out["v"], v)
+            if quant:
+                assert out["adapter"] == "billing"
+                assert np.array_equal(out["k_scale"], ks)
+                assert np.array_equal(out["v_scale"], vs)
+
+    def test_torn_frame_raises(self):
+        with pytest.raises(Exception):
+            decode_prefix_chain(b"\x00\x01 torn garbage, not a frame")
+        tokens, k, v, *_ = self._chain()
+        frame = encode_prefix_chain(tokens, k, v, block_size=4)
+        with pytest.raises(Exception):
+            decode_prefix_chain(frame[: len(frame) // 2])
+
+    def test_version_skew_refused(self):
+        with pytest.raises(HandoffError, match="newer"):
+            decode_prefix_chain(encode_step(PREFIX_KEY, {"pv": 99}))
+
+    def test_wrong_key_refused(self):
+        with pytest.raises(HandoffError, match="not a prefix chain"):
+            decode_prefix_chain(encode_step("sct:kv-handoff", {"pv": 1}))
+
+    def test_token_chain_mismatch_refused(self):
+        tokens, k, v, *_ = self._chain()
+        frame = encode_prefix_chain(tokens[:7], k, v, block_size=4)
+        with pytest.raises(HandoffError):
+            decode_prefix_chain(frame)
+
+
+# ---------------------------------------------------------------------------
+# pinned-equal: demote -> promote through the real generation plane
+# ---------------------------------------------------------------------------
+
+
+class TestDramPinnedEqual:
+    def _assert_tier_roundtrip(self, model):
+        assert model.host_store is not None
+        assert model.host_store.demotions >= 1, "eviction never demoted"
+        assert model.host_store.promotions >= 1, "chain never promoted back"
+        assert model.dram_hits >= 1
+        snap = model.prefix_snapshot()
+        assert snap["free_blocks"] + snap["entries"] == snap["pool_blocks"]
+        assert snap["tiers"]["dram"]["promotions"] >= 1
+        assert snap["tiers"]["dram"]["demotions"] >= 1
+
+    def test_promoted_generation_bit_identical_greedy(self):
+        prompts = _pressure_prompts()
+        base = _generate_all(_build_tiered(False), prompts)
+        model = _build_tiered(kv_blocks=10)
+        outs = _generate_all(model, prompts)
+        for a, b in zip(base, outs):
+            assert np.array_equal(a, b), (a.tolist(), b.tolist())
+        self._assert_tier_roundtrip(model)
+
+    def test_promoted_generation_bit_identical_seeded_topk(self):
+        prompts = _pressure_prompts()
+        base = _generate_all(
+            _build_tiered(False, top_k=4), prompts,
+            temperature=0.8, seed=1234,
+        )
+        model = _build_tiered(kv_blocks=10, top_k=4)
+        outs = _generate_all(model, prompts, temperature=0.8, seed=1234)
+        for a, b in zip(base, outs):
+            assert np.array_equal(a, b), (a.tolist(), b.tolist())
+        self._assert_tier_roundtrip(model)
+
+    def test_promoted_generation_bit_identical_int8_kv(self):
+        prompts = _pressure_prompts()
+        base = _generate_all(
+            _build_tiered(False, kv_cache_dtype="int8"), prompts
+        )
+        model = _build_tiered(kv_blocks=10, kv_cache_dtype="int8")
+        outs = _generate_all(model, prompts)
+        for a, b in zip(base, outs):
+            assert np.array_equal(a, b), (a.tolist(), b.tolist())
+        self._assert_tier_roundtrip(model)
+
+    def test_promoted_generation_bit_identical_tp_mesh(self):
+        from seldon_core_tpu.parallel import best_mesh
+
+        mesh = best_mesh(2, tp=2)
+        prompts = _pressure_prompts()
+        base = _generate_all(_build_tiered(False, mesh=mesh), prompts)
+        model = _build_tiered(kv_blocks=10, mesh=mesh)
+        outs = _generate_all(model, prompts)
+        for a, b in zip(base, outs):
+            assert np.array_equal(a, b), (a.tolist(), b.tolist())
+        self._assert_tier_roundtrip(model)
+
+    def test_adapter_salted_chains_stay_partitioned(self):
+        """A chain demoted under adapter alpha never serves adapter beta
+        (or vice versa), and the salted promotion stays bit-identical."""
+        lora = dict(lora_rank=2, lora_slots=4, lora_adapters="alpha,beta")
+        prompts = _pressure_prompts() + [PREFIX + [50, 51, 52]]
+        adapters = ["alpha", "alpha", "alpha", "beta"]
+        base = _generate_all(
+            _build_tiered(False, **lora), prompts, adapters=adapters
+        )
+        model = _build_tiered(kv_blocks=10, **lora)
+        outs = _generate_all(model, prompts, adapters=adapters)
+        for a, b in zip(base, outs):
+            assert np.array_equal(a, b), (a.tolist(), b.tolist())
+        self._assert_tier_roundtrip(model)
+        # the beta request saw alpha's demoted chain in DRAM but must not
+        # have promoted it: exactly the one alpha promotion happened
+        assert model.dram_hits == 1
+
+    def test_demote_cannot_take_a_pinned_chain(self):
+        """Refcount safety: a chain pinned for a concurrent peer export
+        cannot be demoted out from under the pull."""
+        model = _build_tiered()
+        _generate_all(model, [PREFIX + [40, 41, 42]])
+        toks = np.asarray(PREFIX + [40, 41, 42], np.int32)
+        pinned = model.prefix_index.acquire(toks, 2)
+        assert len(pinned) == 2
+        # demotion pressure while pinned: nothing movable
+        assert model.prefix_index.evict_entries(99) == []
+        model.prefix_index.release(toks, len(pinned))
+        assert len(model.prefix_index.evict_entries(99)) == 2
+
+    def test_export_install_chain_bit_identical(self):
+        """The peer tier without HTTP: export on A, install on B, then
+        B's generation is bit-identical and credited to the peer tier."""
+        prompt = PREFIX + [40, 41, 42]
+        base = _generate_all(_build_tiered(False), [prompt])
+        a = _build_tiered()
+        _generate_all(a, [prompt])
+        depth, k, v, ks, vs = a.export_prefix_kv(np.asarray(prompt, np.int32))
+        assert depth == 2 and a.peer_serves == 1
+        b = _build_tiered()
+        absorbed = b.install_prefix_chain(
+            np.asarray(prompt, np.int32), k, v, k_scale=ks, v_scale=vs
+        )
+        assert absorbed == 2
+        outs = _generate_all(b, [prompt])
+        assert np.array_equal(base[0], outs[0])
+        assert b.peer_hits == 1
+        snap = b.prefix_snapshot()
+        assert snap["tiers"]["peer"]["hits"] == 1
+        assert snap["tiers"]["peer"]["promotions"] == 2
+        assert snap["free_blocks"] + snap["entries"] == snap["pool_blocks"]
+
+    def test_export_includes_demoted_extension(self):
+        """Export serves the FULL chain across tiers: HBM levels plus the
+        DRAM levels extending them go out in one frame."""
+        model = _build_tiered(kv_blocks=10)
+        _generate_all(model, _pressure_prompts()[:2])  # warm + demote
+        assert model.host_store.demotions >= 1
+        got = model.export_prefix_kv(np.asarray(PREFIX + [40, 41], np.int32))
+        assert got is not None
+        depth, k, v, _ks, _vs = got
+        assert depth == 2 and k.shape[1] == 2
+
+    def test_host_sync_audit_green_with_tiers_on(self):
+        """Demotion/promotion run at admission sync points only: decode
+        keeps <= 1 host sync per fused block with the tiers active."""
+        from seldon_core_tpu.obs import host_sync_snapshot
+
+        model = _build_tiered(kv_blocks=10, decode_block=8)
+        before = host_sync_snapshot().get("tiers", 0)
+        max_new, prompts = 24, _pressure_prompts()
+        outs = _generate_all(model, prompts, max_new=max_new)
+        # the bulk prompt's budget clamps at max_seq; everyone generated
+        assert all(o.size >= 8 for o in outs)
+        self._assert_tier_roundtrip(model)
+        syncs = host_sync_snapshot().get("tiers", 0) - before
+        tokens = sum(o.size for o in outs)
+        # one fetch per fused block plus per-ADMISSION overhead (the
+        # demote device-get and promote scatter are admission-time, never
+        # per-token): 3 admissions' worth of slack
+        budget = tokens // 8 + 10
+        assert syncs <= budget, f"{syncs} host syncs for {tokens} tokens"
+
+    def test_timeline_admit_stamps_tier(self):
+        from seldon_core_tpu.executor.generation import GenerationScheduler
+        from seldon_core_tpu.obs import TIMELINE
+        from seldon_core_tpu.utils.tracectx import (
+            current_trace_id,
+            new_traceparent,
+            set_traceparent,
+        )
+
+        assert TIMELINE.enabled
+        model = _build_tiered()
+
+        async def go():
+            s = GenerationScheduler(model)
+            tids = []
+            try:
+                for sfx in ([40, 41, 42], [50, 51, 52]):
+                    set_traceparent(new_traceparent())
+                    tids.append(current_trace_id())
+                    await s.submit(
+                        np.asarray(PREFIX + sfx, np.int32),
+                        max_new_tokens=4,
+                        temperature=0.0,
+                    )
+            finally:
+                set_traceparent(None)
+                await s.close()
+            return tids
+
+        tids = run(go())
+
+        def admit_attrs(tid):
+            (entry,) = TIMELINE.by_trace(tid)
+            for ev in entry["events"]:
+                if ev["name"] == "admit":
+                    return ev["attrs"]
+            raise AssertionError(f"no admit event for {tid}")
+
+        assert admit_attrs(tids[0])["tier"] == "none"
+        assert admit_attrs(tids[1])["tier"] == "hbm"
+
+
+# ---------------------------------------------------------------------------
+# peer tier over the real REST surface
+# ---------------------------------------------------------------------------
+
+
+TIER_PREDICTOR = {
+    "name": "llm",
+    "graph": {
+        "name": "gen",
+        "type": "MODEL",
+        "implementation": "JAX_GENERATIVE",
+        "parameters": [
+            {"name": "family", "value": "llama", "type": "STRING"},
+            {"name": "preset", "value": "tiny", "type": "STRING"},
+            {"name": "n_slots", "value": "2", "type": "INT"},
+            {"name": "max_new_tokens", "value": "6", "type": "INT"},
+            {"name": "kv_prefix_reuse", "value": "true", "type": "BOOL"},
+            {"name": "prefix_dram_gb", "value": "0.001", "type": "FLOAT"},
+        ],
+    },
+}
+
+
+def _engine_app():
+    from seldon_core_tpu.engine.app import EngineApp
+    from seldon_core_tpu.engine.service import PredictionService
+    from seldon_core_tpu.graph.spec import PredictorSpec
+
+    service = PredictionService(PredictorSpec.model_validate(TIER_PREDICTOR))
+    return EngineApp(service)
+
+
+async def _start_engine(engine):
+    client = TestClient(TestServer(engine.build()))
+    await client.start_server()
+    for _ in range(600):
+        if (await client.get("/ready")).status == 200:
+            return client
+        await asyncio.sleep(0.05)
+    raise AssertionError("engine never became ready")
+
+
+async def _warm_chain(client, tokens):
+    """Generate once and wait for the chain to land in the digest (the
+    release that absorbs the blocks can trail the response)."""
+    resp = await client.post(
+        "/disagg/generate", json={"tokens": tokens, "max_new_tokens": 6}
+    )
+    assert resp.status == 200, await resp.text()
+    out = await resp.json()
+    for _ in range(200):
+        snap = (await (await client.get("/stats/cache")).json())["cache"]
+        (unit,) = snap["prefix"].values()
+        if unit["digest"]["entries"] >= 2:
+            return out["tokens"]
+        await asyncio.sleep(0.01)
+    raise AssertionError("chain never absorbed into the index")
+
+
+class TestPeerPullE2E:
+    def test_pull_from_peer_bit_identical(self, monkeypatch):
+        monkeypatch.setenv("SCT_PREFIX_PEER_PULL", "1")
+
+        async def go():
+            client_a = await _start_engine(_engine_app())
+            client_b = await _start_engine(_engine_app())
+            try:
+                await _warm_chain(client_a, PREFIX + [40, 41, 42])
+                req2 = {"tokens": PREFIX + [50, 51, 52], "max_new_tokens": 6}
+                resp = await client_a.post("/disagg/generate", json=req2)
+                assert resp.status == 200
+                hbm_resident = (await resp.json())["tokens"]
+
+                peer = f"127.0.0.1:{client_a.server.port}"
+                resp = await client_b.post(
+                    "/disagg/generate",
+                    json=req2,
+                    headers={
+                        "x-sct-prefix-peer": peer,
+                        "x-sct-prefix-depth": "2",
+                    },
+                )
+                assert resp.status == 200, await resp.text()
+                pulled = (await resp.json())["tokens"]
+                assert pulled == hbm_resident  # pinned-equal across pools
+
+                snap = (await (await client_b.get("/stats/cache")).json())[
+                    "cache"
+                ]
+                assert snap["prefix_pull"]["pulls_ok"] == 1
+                assert snap["prefix_pull"]["pull_blocks"] == 2
+                assert snap["prefix_pull"]["pull_bytes"] > 0
+                (unit,) = snap["prefix"].values()
+                assert unit["tiers"]["peer"]["hits"] == 1
+                assert unit["tiers"]["peer"]["promotions"] == 2
+                assert (
+                    unit["free_blocks"] + unit["entries"]
+                    == unit["pool_blocks"]
+                )
+                snap = (await (await client_a.get("/stats/disagg")).json())[
+                    "disagg"
+                ]
+                assert snap["prefix_pull"]["serves_ok"] == 1
+
+                # wrong-adapter pull against the warm peer is a MISS
+                resp = await client_a.post(
+                    "/disagg/prefix/pull",
+                    json={
+                        "tokens": PREFIX + [50, 51, 52],
+                        "adapter": "billing",
+                    },
+                )
+                assert resp.status == 404
+                snap = (await (await client_a.get("/stats/disagg")).json())[
+                    "disagg"
+                ]
+                assert snap["prefix_pull"]["serve_misses"] == 1
+            finally:
+                await client_a.close()
+                await client_b.close()
+
+        run(go())
+
+    def test_pull_disabled_without_env(self):
+        """Default-off: the header alone must not trigger a pull."""
+
+        async def go():
+            client_a = await _start_engine(_engine_app())
+            client_b = await _start_engine(_engine_app())
+            try:
+                await _warm_chain(client_a, PREFIX + [40, 41, 42])
+                peer = f"127.0.0.1:{client_a.server.port}"
+                resp = await client_b.post(
+                    "/disagg/generate",
+                    json={"tokens": PREFIX + [50, 51, 52],
+                          "max_new_tokens": 6},
+                    headers={"x-sct-prefix-peer": peer,
+                             "x-sct-prefix-depth": "2"},
+                )
+                assert resp.status == 200
+                snap = (await (await client_b.get("/stats/cache")).json())[
+                    "cache"
+                ]
+                assert snap["prefix_pull"]["pulls_ok"] == 0
+                assert snap["prefix_pull"]["pulls_failed"] == 0
+            finally:
+                await client_a.close()
+                await client_b.close()
+
+        run(go())
+
+    def test_malformed_pull_requests_rejected(self):
+        async def go():
+            client = await _start_engine(_engine_app())
+            try:
+                for body in (
+                    {"tokens": "nope"},
+                    {"tokens": []},
+                    {"tokens": [1, [2]]},
+                    {"tokens": [1, True, 3]},
+                    {"tokens": [1, 2], "max_blocks": "many"},
+                ):
+                    resp = await client.post("/disagg/prefix/pull", json=body)
+                    assert resp.status == 400, (body, await resp.text())
+                # cold engine: well-formed but unknown tokens miss
+                resp = await client.post(
+                    "/disagg/prefix/pull",
+                    json={"tokens": list(range(100, 140))},
+                )
+                assert resp.status == 404
+            finally:
+                await client.close()
+
+        run(go())
+
+    def test_torn_and_skewed_pull_frames_fall_back_clean(self, monkeypatch):
+        """The failure matrix: a peer serving torn or version-skewed
+        frames costs the puller NOTHING — the request still answers
+        bit-identically via plain suffix prefill, the pool balances, and
+        the DRAM ledger stays at baseline (zero leaked blocks/bytes)."""
+        monkeypatch.setenv("SCT_PREFIX_PEER_PULL", "1")
+
+        def fake_peer(frame: bytes):
+            async def pull(request):
+                return web.Response(
+                    body=frame,
+                    headers={"x-sct-prefix-depth": "2"},
+                    content_type="application/octet-stream",
+                )
+
+            app = web.Application()
+            app.router.add_post("/disagg/prefix/pull", pull)
+            return app
+
+        async def go():
+            ref = await _start_engine(_engine_app())
+            client_b = await _start_engine(_engine_app())
+            torn = TestServer(fake_peer(b"\x00\x01 not a codec frame"))
+            skewed = TestServer(
+                fake_peer(encode_step(PREFIX_KEY, {"pv": 99}))
+            )
+            await torn.start_server()
+            await skewed.start_server()
+            try:
+                cases = [
+                    (torn, list(range(100, 135))),
+                    (skewed, list(range(140, 175))),
+                ]
+                for fails_wanted, (server, tokens) in enumerate(cases, 1):
+                    body = {"tokens": tokens, "max_new_tokens": 6}
+                    resp = await ref.post("/disagg/generate", json=body)
+                    assert resp.status == 200
+                    want = (await resp.json())["tokens"]
+                    resp = await client_b.post(
+                        "/disagg/generate",
+                        json=body,
+                        headers={
+                            "x-sct-prefix-peer": f"127.0.0.1:{server.port}",
+                            "x-sct-prefix-depth": "2",
+                        },
+                    )
+                    assert resp.status == 200, await resp.text()
+                    assert (await resp.json())["tokens"] == want
+                    snap = (
+                        await (await client_b.get("/stats/cache")).json()
+                    )["cache"]
+                    assert snap["prefix_pull"]["pulls_failed"] == fails_wanted
+                    assert snap["prefix_pull"]["pulls_ok"] == 0
+                    (unit,) = snap["prefix"].values()
+                    assert (
+                        unit["free_blocks"] + unit["entries"]
+                        == unit["pool_blocks"]
+                    ), "pull failure leaked pool blocks"
+                    assert unit["tiers"]["dram"]["bytes"] == 0
+            finally:
+                await torn.close()
+                await skewed.close()
+                await ref.close()
+                await client_b.close()
+
+        run(go())
+
+    def test_stats_cache_exposes_tier_ledgers(self):
+        """Satellite telemetry: GET /stats/cache carries a per-tier
+        hits/misses/promotions/demotions/bytes/pull_count ledger plus the
+        engine's pull counters."""
+
+        async def go():
+            client = await _start_engine(_engine_app())
+            try:
+                await _warm_chain(client, PREFIX + [40, 41, 42])
+                snap = (await (await client.get("/stats/cache")).json())[
+                    "cache"
+                ]
+                assert set(snap["prefix_pull"]) == {
+                    "pulls_ok", "pulls_failed", "pull_misses", "pull_bytes",
+                    "pull_blocks", "serves_ok", "serve_misses",
+                }
+                (unit,) = snap["prefix"].values()
+                tiers = unit["tiers"]
+                assert set(tiers) == {"hbm", "dram", "peer"}
+                for tier in tiers.values():
+                    assert {
+                        "hits", "misses", "promotions", "demotions",
+                        "bytes", "pull_count",
+                    } <= set(tier)
+                assert tiers["hbm"]["bytes"] > 0  # chain resident in HBM
+                assert tiers["dram"]["budget_bytes"] == int(0.001 * (1 << 30))
+                assert "digest" in tiers["dram"]
+            finally:
+                await client.close()
+
+        run(go())
+
+
+# ---------------------------------------------------------------------------
+# gateway: peer-aware routing + hint stamping
+# ---------------------------------------------------------------------------
+
+
+class TestPeerRouting:
+    def _router(self, peer_pull=False, peer_yield=4):
+        from seldon_core_tpu.disagg.router import ReplicaRouter
+
+        router = ReplicaRouter()
+        router.peer_pull = peer_pull
+        router.peer_yield = peer_yield
+        return router
+
+    def test_peer_pull_off_is_legacy_pick(self):
+        from seldon_core_tpu.disagg.router import prompt_chain_hashes
+        from seldon_core_tpu.gateway.store import Endpoint
+
+        router = self._router(peer_pull=False)
+        eps = (Endpoint("warm", 8000), Endpoint("cold", 8000))
+        tokens = np.arange(64, dtype=np.int32)
+        router.update_replica(
+            "dep", "warm:8000",
+            hashes=prompt_chain_hashes(tokens, 16), block_size=16,
+        )
+        for _ in range(10):  # heavy load cannot move an un-gated pick
+            router.note_start("dep", "warm:8000")
+        ep, hint = router.pick_with_peer("dep", eps, tokens)
+        assert ep.key == "warm:8000" and hint is None
+        snap = router.snapshot()
+        assert snap["peer_pull"] is False and snap["peer_hints"] == 0
+
+    def test_yields_to_load_and_hints_the_peer(self):
+        from seldon_core_tpu.disagg.router import prompt_chain_hashes
+        from seldon_core_tpu.gateway.store import Endpoint
+
+        router = self._router(peer_pull=True, peer_yield=4)
+        eps = (Endpoint("warm", 8000), Endpoint("cold", 8000))
+        tokens = np.arange(64, dtype=np.int32)
+        router.update_replica(
+            "dep", "warm:8000",
+            hashes=prompt_chain_hashes(tokens, 16), block_size=16,
+        )
+        for _ in range(3):
+            router.note_start("dep", "warm:8000")
+        # gap 3 < yield 4: affinity still wins, no hint
+        ep, hint = router.pick_with_peer("dep", eps, tokens)
+        assert ep.key == "warm:8000" and hint is None
+        router.note_start("dep", "warm:8000")
+        # gap 4 >= yield 4: the pick yields to load, hint ships the chain
+        ep, hint = router.pick_with_peer("dep", eps, tokens)
+        assert ep.key == "cold:8000"
+        assert hint == ("warm:8000", 4)
+        snap = router.snapshot()
+        assert snap["peer_hints"] == 1 and snap["peer_yield_picks"] == 1
+
+    def test_poller_merges_dram_digest(self):
+        """A chain demoted to a replica's host-DRAM tier still counts as
+        'this replica holds it' for prefix routing."""
+        from seldon_core_tpu.disagg.router import (
+            ReplicaRouter,
+            RouterPoller,
+            prompt_chain_hashes,
+        )
+        from seldon_core_tpu.gateway.store import (
+            DeploymentRecord,
+            DeploymentStore,
+        )
+
+        sys_prompt = np.arange(0, 160, dtype=np.int32)
+        hashes = prompt_chain_hashes(sys_prompt, 16)
+
+        def replica_app(dram_hashes):
+            async def stats_cache(request):
+                return web.json_response({"cache": {"prefix": {"gen": {
+                    "digest": {
+                        "block_size": 16, "hashes": [], "depths": [],
+                        "entries": 0, "truncated": False,
+                    },
+                    "tiers": {"dram": {"digest": {
+                        "block_size": 16, "hashes": list(dram_hashes),
+                        "depths": list(range(len(dram_hashes), 0, -1)),
+                        "entries": len(dram_hashes), "truncated": False,
+                    }}},
+                }}}})
+
+            async def stats_qos(request):
+                return web.json_response({"qos": {"queue_wait_ewma_ms": 1.0}})
+
+            app = web.Application()
+            app.router.add_get("/stats/cache", stats_cache)
+            app.router.add_get("/stats/qos", stats_qos)
+            return app
+
+        async def go():
+            warm = TestServer(replica_app(hashes))
+            cold = TestServer(replica_app([]))
+            await warm.start_server()
+            await cold.start_server()
+            store = DeploymentStore()
+            store.put(DeploymentRecord(
+                name="dep", oauth_key="dep", oauth_secret="s",
+                endpoints=(
+                    f"127.0.0.1:{warm.port}", f"127.0.0.1:{cold.port}"
+                ),
+            ))
+            router = ReplicaRouter()
+            poller = RouterPoller(store, router, interval_s=999)
+            try:
+                assert await poller.poll_once() == 2
+                rec = store.get("dep")
+                ep = router.pick("dep", rec.replica_endpoints, sys_prompt)
+                assert ep.key == f"127.0.0.1:{warm.port}"
+            finally:
+                await poller.stop()
+                await warm.close()
+                await cold.close()
+
+        run(go())
+
+    def test_h1_pool_and_hint_injects_headers(self):
+        """The zero-parse splice path rebuilds the request head with the
+        peer hint before the job's raw bytes are captured."""
+        from seldon_core_tpu.disagg.router import prompt_chain_hashes
+        from seldon_core_tpu.gateway.app import GatewayApp
+        from seldon_core_tpu.gateway.h1gateway import H1SpliceFrontend
+        from seldon_core_tpu.gateway.store import (
+            DeploymentRecord,
+            DeploymentStore,
+        )
+
+        async def go():
+            store = DeploymentStore()
+            gw = GatewayApp(store)
+            fe = H1SpliceFrontend(gw)
+            fe.loop = asyncio.get_running_loop()
+            rec = DeploymentRecord(
+                name="dep", oauth_key="dep", oauth_secret="s",
+                endpoints=("10.0.0.1:9000", "10.0.0.2:9000"),
+            )
+            gw.router.peer_pull = True
+            gw.router.peer_yield = 1
+            tokens = np.asarray(PREFIX, np.int32)
+            gw.router.update_replica(
+                "dep", "10.0.0.1:9000",
+                hashes=prompt_chain_hashes(tokens, 16), block_size=16,
+            )
+            gw.router.note_start("dep", "10.0.0.1:9000")
+            body = json.dumps(
+                {"strData": json.dumps({"tokens": PREFIX})}
+            ).encode()
+            raw = (
+                b"POST /api/v0.1/predictions HTTP/1.1\r\nhost: gw\r\n"
+                b"content-length: " + str(len(body)).encode()
+                + b"\r\n\r\n" + body
+            )
+            _pool, out = fe.pool_and_hint(rec, raw, len(body))
+            head, _, tail = out.partition(b"\r\n\r\n")
+            assert b"x-sct-prefix-peer: 10.0.0.1:9000" in head
+            assert b"x-sct-prefix-depth: 2" in head
+            assert tail == body  # body bytes untouched
+
+            # hint off: bytes pass through verbatim
+            gw.router.peer_pull = False
+            _pool, out = fe.pool_and_hint(rec, raw, len(body))
+            assert out == raw
+
+        run(go())
