@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -117,6 +117,19 @@ tiers-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_tiers.py -q
 	JAX_PLATFORMS=cpu BENCH_ONLY=tiered BENCH_SECONDS=2 BENCH_RUNS=1 \
 		$(PYTHON) bench.py
+
+# chip-packing gate (docs/PACKING.md), CPU-safe: arbiter grant ordering /
+# preemption policy / hysteresis units, suspend-store byte accounting,
+# the pinned-equal suspend/resume matrix (greedy, seeded top-k, int8 KV,
+# adapter-salted, prefix reuse), the arbiter-driven E2E suspend of a real
+# batch scheduler, and the host-ledger release-accounting regression;
+# then a smoke of the bench packing stage (3 co-resident deployments:
+# interactive p99 sole vs packed, batch goodput curve, zero mid-traffic
+# compiles)
+pack-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_packing.py -q
+	JAX_PLATFORMS=cpu BENCH_ONLY=PACKING BENCH_RUNS=1 \
+		BENCH_PACK_TOKENS=16 $(PYTHON) bench.py
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -40,6 +40,13 @@ Stages (each skippable via env; ``BENCH_ONLY=name`` runs one stage):
                                          DRAM-promoted / peer-pulled /
                                          cold) on a working set 4x the
                                          HBM pool + prefill tokens saved
+  packing              BENCH_SKIP_PACKING 3 co-resident deployments time-
+                                         sharing one device: interactive
+                                         latency sole-tenant vs packed,
+                                         batch goodput with/without the
+                                         interactive burst, preemption
+                                         counters, zero mid-traffic
+                                         compiles, per-deployment ledgers
 
 Credibility discipline (round-5 postmortem — the headline swung 4.5x with
 this file byte-identical and nothing could attribute it):
@@ -1038,6 +1045,210 @@ def stage_lora(detail: dict) -> None:
     }
 
 
+def stage_packing(detail: dict) -> None:
+    """Chip packing (docs/PACKING.md): three co-resident deployments —
+    one interactive, two batch — time-share ONE device under the
+    SLO-arbitrated DeviceArbiter.  Records interactive latency
+    sole-tenant vs packed (the packed p99 must sit within noise of the
+    sole-tenant one once preemption suspends the batch tenants), batch
+    goodput with and without the interactive burst (graceful
+    degradation, not collapse), preemption/suspend/resume counters, the
+    per-deployment HBM ledger rows proving byte-level isolation, and
+    that the timed window paid ZERO mid-traffic program compiles across
+    all three deployments."""
+    import asyncio
+
+    import jax
+
+    from seldon_core_tpu.executor.arbiter import DeviceArbiter
+    from seldon_core_tpu.executor.generation import (
+        GenerationScheduler,
+        GenerativeModel,
+    )
+    from seldon_core_tpu.executor.memory import MemoryManager
+    from seldon_core_tpu.models import llama as llama_mod
+
+    cfg = llama_mod.Config.tiny(max_seq=128)
+    params = llama_mod.init_params(jax.random.PRNGKey(0), cfg)
+    max_new = int(os.environ.get("BENCH_PACK_TOKENS", "16"))
+    n_inter = int(os.environ.get("BENCH_PACK_REQUESTS", "24"))
+    # bench SLO sits just above the sole-tenant wait so the batch flood
+    # provably crosses it (production default is 250ms; this is a tiny
+    # model on a slow core)
+    slo_ms = float(os.environ.get("SCT_PACK_SLO_MS", "6"))
+    mm = MemoryManager(enforce=False)  # one chip-wide ledger, three owners
+    # distinct configs per deployment (separate program caches): the
+    # batch tenants run LONG fused blocks — the throughput shape — so an
+    # interactive wave genuinely blocks behind them until preemption
+    models = {
+        name: GenerativeModel(
+            cfg, params, n_slots=4, decode_block=blk, name=name, memory=mm,
+        )
+        for name, blk in (("inter", 8), ("bulk-0", 24), ("bulk-1", 32))
+    }
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, 10).astype(np.int32)
+        for _ in range(16)
+    ]
+
+    async def burst(sched, n, width=4):
+        """Interactive requests in waves of ``width`` concurrent users —
+        the shape whose queue waits build real deadline pressure."""
+        lats = []
+
+        async def one(i):
+            t0 = time.perf_counter()
+            await sched.submit(
+                prompts[i % len(prompts)], max_new_tokens=max_new
+            )
+            lats.append(time.perf_counter() - t0)
+
+        for base in range(0, n, width):
+            await asyncio.gather(
+                *(one(base + j) for j in range(min(width, n - base)))
+            )
+        return lats
+
+    def p(lats, q):
+        s = sorted(lats)
+        return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+
+    # -- sole-tenant baseline: the interactive deployment owns the chip
+    sole = GenerationScheduler(models["inter"])
+
+    async def sole_run():
+        try:
+            await burst(sole, 2)  # compile off the clock
+            return await burst(sole, n_inter)
+        finally:
+            await sole.close()
+
+    sole_lats = asyncio.run(sole_run())
+
+    # -- packed: same interactive workload while two batch tenants flood
+    def packed_run():
+        """One packed scenario; the first pass is the warmup that
+        compiles every program INCLUDING the suspend export / resume
+        import path off the clock (identical shape, so coverage is
+        exact)."""
+        arb = DeviceArbiter()
+        # sticky preemption for the stage: resume only once the
+        # interactive side has been quiet long enough for its pressure
+        # EWMA to decay under 5% of SLO — the default 50% floor
+        # oscillates at this tiny-model timescale (resume mid-burst,
+        # degrade, re-preempt), and the stage's bar is whole-burst
+        # interactive protection
+        arb.low = float(os.environ.get("SCT_PACK_RESUME", "") or 0.05)
+        s_i = GenerationScheduler(models["inter"])
+        s_b = [
+            GenerationScheduler(models[n]) for n in ("bulk-0", "bulk-1")
+        ]
+        state = {"stop": False, "stamps": []}
+        # batch generations are LONG (several fused blocks), so a
+        # preemption lands mid-generation and the suspend verb runs
+        bulk_new = 4 * max_new
+
+        async def bulk_loop(sched, j):
+            while not state["stop"]:
+                out = await sched.submit(
+                    prompts[j % len(prompts)], max_new_tokens=bulk_new
+                )
+                state["stamps"].append((time.perf_counter(), len(out)))
+                j += 3
+
+        async def go():
+            s_i.attach_arbiter(arb, priority="interactive", slo_ms=slo_ms)
+            s_b[0].attach_arbiter(arb, priority="batch")
+            s_b[1].attach_arbiter(arb, priority="batch")
+            try:
+                bulk = [
+                    asyncio.ensure_future(bulk_loop(s, j))
+                    for j, s in enumerate(s_b)
+                ]
+                t0 = time.perf_counter()
+                await asyncio.sleep(0.6)  # batch-only window
+                t1 = time.perf_counter()
+                lats = await burst(s_i, n_inter)
+                t2 = time.perf_counter()
+                # recovery: the burst is over — the interactive EWMA
+                # decays below the hysteresis floor and the parked
+                # victims' poll ticks resume them (no manual verb)
+                for _ in range(400):
+                    if not any(s._preempt for s in s_b):
+                        break
+                    await asyncio.sleep(0.01)
+                t3 = time.perf_counter()
+                state["stop"] = True
+                for s in s_b:
+                    s.request_resume()  # safety: drain stragglers
+                await asyncio.gather(*bulk, return_exceptions=True)
+
+                def tok_s(a, b):
+                    tok = sum(n for ts, n in state["stamps"] if a < ts <= b)
+                    return tok / max(b - a, 1e-9)
+
+                return {
+                    "lats": lats,
+                    "batch_tok_s_quiet": tok_s(t0, t1),
+                    "batch_tok_s_under_burst": tok_s(t1, t2),
+                    "recovery_s": t3 - t2,
+                    "suspends": sum(s.suspends for s in s_b),
+                    "resumes": sum(s.resumes for s in s_b),
+                    "suspend_rejected": sum(s.suspend_rejected for s in s_b),
+                    "arbiter": arb.snapshot(),
+                }
+            finally:
+                await s_i.close()
+                for s in s_b:
+                    await s.close()
+
+        return asyncio.run(go())
+
+    packed_run()  # warmup: suspend/resume programs compile here
+    compiles_before = sum(m.program_compiles for m in models.values())
+    res = packed_run()
+    mid_traffic_compiles = (
+        sum(m.program_compiles for m in models.values()) - compiles_before
+    )
+    # steady state = the burst's second half: by then preemption has
+    # cleared the batch tenants off the chip.  The full-burst p99 stays
+    # recorded too — it IS the preemption reaction time.
+    steady = res["lats"][len(res["lats"]) // 2:]
+    detail["llm_packing"] = {
+        "deployments": 3,
+        "interactive_p50_ms_sole": _sig(p(sole_lats, 0.5) * 1e3),
+        "interactive_p99_ms_sole": _sig(p(sole_lats, 0.99) * 1e3),
+        "interactive_p50_ms_packed": _sig(p(res["lats"], 0.5) * 1e3),
+        "interactive_p99_ms_packed": _sig(p(res["lats"], 0.99) * 1e3),
+        "interactive_p99_ms_packed_steady": _sig(p(steady, 0.99) * 1e3),
+        "packed_over_sole_p99": _sig(
+            p(res["lats"], 0.99) / max(p(sole_lats, 0.99), 1e-9)
+        ),
+        "packed_steady_over_sole_p99": _sig(
+            p(steady, 0.99) / max(p(sole_lats, 0.99), 1e-9)
+        ),
+        "batch_tok_s_quiet": _sig(res["batch_tok_s_quiet"]),
+        "batch_tok_s_under_burst": _sig(res["batch_tok_s_under_burst"]),
+        "recovery_s": _sig(res["recovery_s"]),
+        "preemptions": res["arbiter"]["preemptions"],
+        "arbiter_resumes": res["arbiter"]["resumes"],
+        "slot_suspends": res["suspends"],
+        "slot_resumes": res["resumes"],
+        "suspend_rejected": res["suspend_rejected"],
+        "grants": res["arbiter"]["grants"],
+        "mid_traffic_program_compiles": mid_traffic_compiles,
+        "hbm_owner_bytes": {
+            owner: sum(classes.values())
+            for owner, classes in mm.snapshot()["owners"].items()
+        },
+        "interactive_requests": n_inter,
+        "slo_ms": slo_ms,
+        "model": "llama tiny x3 (1 interactive + 2 batch), greedy, "
+                 f"{max_new} new tokens, one DeviceArbiter",
+    }
+
+
 def stage_obs_overhead(detail: dict) -> None:
     """Generation-forensics overhead (docs/OBSERVABILITY.md): decode ITL
     with the per-request timeline ledger ON vs OFF on the same tiny-llama
@@ -1953,6 +2164,7 @@ def main() -> None:
         ("SPEC", "BENCH_SKIP_SPEC", stage_spec_frontier),
         ("CHUNKED", "BENCH_SKIP_CHUNKED", stage_chunked),
         ("LORA", "BENCH_SKIP_LORA", stage_lora),
+        ("PACKING", "BENCH_SKIP_PACKING", stage_packing),
         ("RESNET", "BENCH_SKIP_RESNET", stage_resnet),
         ("LOOPBACK", "BENCH_SKIP_LOOPBACK", stage_loopback),
         ("AB", "BENCH_SKIP_AB", stage_ab),
@@ -2056,6 +2268,9 @@ _STAGE_HEADLINES = (
     ("disagg_split", "ttft_p99_vs_unified", "disagg_ttft_p99_gain"),
     ("obs_overhead", "itl_on_vs_off", "obs_itl_ledger_on_vs_off"),
     ("obs_overhead", "spans_per_s", "obs_spans_per_s"),
+    ("llm_packing", "packed_steady_over_sole_p99", "pack_p99_packed_vs_sole"),
+    ("llm_packing", "batch_tok_s_under_burst", "pack_batch_tok_s_burst"),
+    ("llm_packing", "mid_traffic_program_compiles", "pack_mid_compiles"),
 )
 
 

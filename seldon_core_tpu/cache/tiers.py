@@ -308,3 +308,83 @@ class HostPrefixStore:
                 "evictions": self.evictions,
                 "rejected": self.rejected,
             }
+
+
+class SuspendStore:
+    """Byte-bounded host-DRAM store of whole-slot suspend records — the
+    :class:`HostPrefixStore` machinery generalized from prefix-chain
+    levels to entire preempted generations (docs/PACKING.md).
+
+    Each record is ONE encoded disagg handoff frame (codec v4: prompt +
+    emitted tokens, the carry token, generation options, and the slot's
+    paged-KV blocks — int8 blocks + scales verbatim on a quantized pool),
+    so a later resume rides the donated fused-scatter import path and is
+    bit-exact by construction.
+
+    Unlike the prefix tier this store NEVER evicts: a record is a live
+    generation's only copy of its KV, so dropping one would kill the
+    request.  An over-budget ``put`` is rejected instead and the caller
+    leaves that slot resident (best-effort preemption).  ``on_bytes``
+    mirrors the prefix store's ledger callback — the generation plane
+    wires it to the host-memory ledger's ``suspend_dram`` class."""
+
+    def __init__(self, budget_bytes: int, on_bytes=None):
+        self.budget_bytes = max(0, int(budget_bytes))
+        self._frames: dict = {}
+        self._lock = threading.Lock()
+        self._on_bytes = on_bytes
+        self.bytes = 0
+        # telemetry (GET /stats/breakdown "packing" / scheduler snapshot)
+        self.puts = 0
+        self.takes = 0
+        self.rejected = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._frames)
+
+    def _note_bytes(self) -> None:
+        if self._on_bytes is not None:
+            self._on_bytes(self.bytes)
+
+    def put(self, key, frame: bytes) -> bool:
+        """Park one suspend record.  False when it cannot fit (the caller
+        keeps that slot running rather than lose the generation)."""
+        n = len(frame)
+        with self._lock:
+            if self.bytes + n > self.budget_bytes or key in self._frames:
+                self.rejected += 1
+                return False
+            self._frames[key] = frame
+            self.bytes += n
+            self.puts += 1
+            self._note_bytes()
+            return True
+
+    def take(self, key) -> "bytes | None":
+        """Pop one record for resume (or for discard when its request was
+        cancelled/expired while suspended)."""
+        with self._lock:
+            frame = self._frames.pop(key, None)
+            if frame is not None:
+                self.bytes -= len(frame)
+                self.takes += 1
+                self._note_bytes()
+            return frame
+
+    def flush(self) -> None:
+        with self._lock:
+            self._frames.clear()
+            self.bytes = 0
+            self._note_bytes()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "records": len(self._frames),
+                "bytes": self.bytes,
+                "budget_bytes": self.budget_bytes,
+                "puts": self.puts,
+                "takes": self.takes,
+                "rejected": self.rejected,
+            }

@@ -62,8 +62,14 @@ class EngineApp:
         qos_controller: "qos.AdmissionController | None" = None,
         role: str | None = None,
         decode_upstreams: list[str] | None = None,
+        co_services: "list[PredictionService] | None" = None,
     ):
         self.service = service
+        # chip packing (docs/PACKING.md): ADDITIONAL predictor services
+        # co-booted in this process (ENGINE_CO_PREDICTORS) — their
+        # generative units register with the device arbiter at startup
+        # and time-share the chip with the primary service's units
+        self.co_services = list(co_services or [])
         self.paused = False
         self.metrics = service.metrics
         # disagg plane (docs/DISAGGREGATION.md): the engine's pool role
@@ -228,6 +234,9 @@ class EngineApp:
         set_process_role(self.role)
         LOOP_LAG.start("engine")
         await self.service.start()
+        for svc in self.co_services:
+            await svc.start()
+        self._register_packed_units()
         if self.service.response_cache is not None and self.service.graph_deterministic():
             self._resp_cache = self.service.response_cache
         if self.mesh_worker:
@@ -259,12 +268,35 @@ class EngineApp:
             # compiles run; /ready stays 503 until every bucket is compiled
             self._warmup_task = asyncio.create_task(self._warm())
 
+    def _register_packed_units(self) -> None:
+        """Attach every co-resident generative unit (primary + co
+        services) to the process device arbiter (docs/PACKING.md) — only
+        when co-services actually exist: a sole-tenant engine keeps the
+        synchronous fast path and never touches the arbiter."""
+        if not self.co_services:
+            return
+        for svc in (self.service, *self.co_services):
+            try:
+                units = svc.generative_units()
+            except Exception:
+                continue
+            for unit in units:
+                reg = getattr(unit, "register_packed", None)
+                if callable(reg):
+                    reg()
+
     async def _warm(self) -> None:
         import time as _time
 
         t0 = _time.perf_counter()
         try:
             report = await self.service.warmup()
+            for svc in self.co_services:
+                # co-resident deployments warm their OWN program caches
+                # before readiness flips — a packed chip must serve its
+                # first real traffic with zero mid-traffic compiles on
+                # every co-tenant, not just the primary
+                report = await svc.warmup()
             self._warmup_total_s = round(_time.perf_counter() - t0, 3)
             log.info(
                 "warmup complete in %.1fs: %s", self._warmup_total_s, report
@@ -282,6 +314,8 @@ class EngineApp:
         if self._handoff_session is not None:
             await self._handoff_session.close()
             self._handoff_session = None
+        for svc in self.co_services:
+            await svc.close()
         await self.service.close()
 
     # -- handlers ---------------------------------------------------------
@@ -657,11 +691,36 @@ class EngineApp:
             units = self.service.generative_units()
         except AssertionError:
             units = []
-        gen = {
-            unit.model.name: unit.model.spec_snapshot() for unit in units
-        }
+        gen = {}
+        for unit in units:
+            snap = unit.model.spec_snapshot()
+            snap["packing"] = unit.scheduler.packing_snapshot()
+            gen[unit.model.name] = snap
+        # co-resident deployments (docs/PACKING.md): keyed by
+        # "<deployment>/<model>" so two co-tenants of the same preset
+        # keep separate isolation ledgers
+        for svc in self.co_services:
+            try:
+                co_units = svc.generative_units()
+            except Exception:
+                co_units = []
+            for unit in co_units:
+                snap = unit.model.spec_snapshot()
+                snap["packing"] = unit.scheduler.packing_snapshot()
+                gen[f"{svc.deployment_name}/{unit.model.name}"] = snap
         if gen:
             payload["generation"] = gen
+        if self.co_services:
+            from seldon_core_tpu.executor.arbiter import get_arbiter
+            from seldon_core_tpu.executor.memory import MEMORY, host_memory
+
+            # packed chip: the arbitration ledger plus the chip-wide byte
+            # ledgers — owners rows prove per-deployment isolation
+            payload["packing"] = get_arbiter().snapshot()
+            payload["memory"] = {
+                "hbm": MEMORY.snapshot(),
+                "host": host_memory().snapshot(),
+            }
         return web.json_response(payload)
 
     async def stats_qos(self, request: web.Request) -> web.Response:
@@ -1345,8 +1404,19 @@ def _serve(port: int, grpc_port: int, reuse_port: bool) -> None:
     service = PredictionService(
         predictor, deployment_name=os.environ.get("SELDON_DEPLOYMENT_ID", "")
     )
+    # chip packing (docs/PACKING.md): ENGINE_CO_PREDICTORS co-boots extra
+    # deployments in this process; they time-share the device via the
+    # arbiter instead of each claiming a chip
+    from seldon_core_tpu.engine.service import load_co_predictor_specs
+
+    co_services = [
+        PredictionService(spec, deployment_name=spec.name)
+        for spec in load_co_predictor_specs()
+    ]
     engine = EngineApp(
-        service, mesh_worker=mesh_cfg is not None and not mesh_cfg.is_coordinator
+        service,
+        mesh_worker=mesh_cfg is not None and not mesh_cfg.is_coordinator,
+        co_services=co_services,
     )
     app = engine.build()
     app.on_startup.append(_tune_loop)

@@ -28,6 +28,9 @@ log = logging.getLogger(__name__)
 ENGINE_PREDICTOR_ENV = "ENGINE_PREDICTOR"
 ENGINE_DEPLOYMENT_ENV = "ENGINE_SELDON_DEPLOYMENT"
 PREDICTOR_FILE_FALLBACK = "./deploymentdef.json"
+# chip packing (docs/PACKING.md): base64 JSON list of ADDITIONAL
+# predictor specs co-booted in this process, time-sharing the device
+ENGINE_CO_PREDICTORS_ENV = "ENGINE_CO_PREDICTORS"
 
 # Built-in default graph used when no spec is provided — also the benchmark
 # configuration (reference: EnginePredictor.java:131-150 falls back to a
@@ -55,6 +58,27 @@ def load_predictor_spec(environ: dict[str, str] | None = None) -> PredictorSpec:
         with open(PREDICTOR_FILE_FALLBACK) as f:
             return PredictorSpec.model_validate(json.load(f))
     return PredictorSpec.model_validate(DEFAULT_PREDICTOR)
+
+
+def load_co_predictor_specs(
+    environ: dict[str, str] | None = None,
+) -> list[PredictorSpec]:
+    """Co-resident predictor specs for chip packing (docs/PACKING.md):
+    ``ENGINE_CO_PREDICTORS`` is a base64 JSON **list** of predictor specs
+    booted as additional in-process :class:`PredictionService`\\ s that
+    time-share this engine's device under the arbiter.  Empty when unset
+    — the sole-tenant path stays untouched."""
+    env = environ if environ is not None else os.environ
+    raw = env.get(ENGINE_CO_PREDICTORS_ENV)
+    if not raw:
+        return []
+    decoded = json.loads(base64.b64decode(raw))
+    if not isinstance(decoded, list):
+        raise ValueError(
+            f"{ENGINE_CO_PREDICTORS_ENV} must decode to a JSON list of "
+            "predictor specs"
+        )
+    return [PredictorSpec.model_validate(p) for p in decoded]
 
 
 class PredictionService:

@@ -1,0 +1,302 @@
+"""Per-device arbitration: N co-resident deployments time-share one chip.
+
+PR 10's :mod:`~seldon_core_tpu.executor.memory` manager removed the
+one-deployment-owns-the-HBM assumption; this module removes the
+one-deployment-owns-the-step-time one.  A :class:`DeviceArbiter` owns a
+device's step budget: every :class:`GenerationScheduler` attached to it
+(see ``attach_arbiter``) acquires the device grant before dispatching a
+fused block and releases it at the next sync point, so co-resident
+deployments interleave whole fused blocks — each keeps its OWN warmed
+program cache (zero mid-traffic compiles) and its own KV pool, and the
+≤1-host-sync-per-fused-block audit stays green per deployment because
+arbitration happens strictly between blocks, never inside one.
+
+Grant ordering is QoS-aware: waiters are served by ``(priority class,
+deadline pressure, arrival)`` — an interactive deployment's block always
+outranks a batch deployment's, and within a class the deployment whose
+queue-wait pressure is worst goes first.
+
+**Preemption is a verb**, not an emergent property: when an interactive
+deployment's queue-wait EWMA crosses its SLO band (``SCT_PACK_SLO_MS`` x
+``SCT_PACK_PREEMPT``), the arbiter tells a batch victim to
+``request_preempt()`` — the victim's scheduler exports its active slots'
+KV through the disagg handoff codec into the host-DRAM suspend store,
+frees the blocks, and parks.  When every interactive deployment's
+pressure drops back under the hysteresis floor (``SCT_PACK_RESUME`` x
+SLO), the arbiter issues ``request_resume()`` and the victim re-imports
+its suspended generations bit-exactly (docs/PACKING.md).
+
+Single-tenant fast path: with fewer than two registrants ``acquire`` is
+a synchronous no-op — a sole deployment pays nothing for the machinery.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from seldon_core_tpu import qos
+
+log = logging.getLogger(__name__)
+
+# knobs (docs/PACKING.md "Knobs")
+PACK_ENV = "SCT_PACK"  # "1": auto-attach every GenerativeComponent
+PACK_PREEMPT_ENV = "SCT_PACK_PREEMPT"  # preempt at pressure >= slo * this
+PACK_RESUME_ENV = "SCT_PACK_RESUME"  # resume at pressure < slo * this
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class _Reg:
+    """One registered deployment: its scheduler plus packing policy."""
+
+    __slots__ = ("name", "scheduler", "priority", "slo_ms", "grants", "preempted")
+
+    def __init__(self, name, scheduler, priority, slo_ms):
+        self.name = name
+        self.scheduler = scheduler
+        self.priority = priority
+        self.slo_ms = float(slo_ms)
+        self.grants = 0
+        self.preempted = False
+
+
+class DeviceArbiter:
+    """SLO-arbitrated time-sharing of one device's step budget.
+
+    All methods run on the serving event loop (scheduler run loops +
+    engine handlers share it), so state needs no lock; ``acquire`` is the
+    only suspension point and it parks on a future the next ``release``
+    resolves."""
+
+    def __init__(self):
+        self._regs: dict[str, _Reg] = {}
+        # (seq, name, future) FIFO tiebreak inside a (priority, pressure)
+        # class; the future resolves when the grant lands
+        self._waiters: list[tuple[int, str, asyncio.Future]] = []
+        self._seq = 0
+        self._holder: str | None = None
+        self.high = _env_float(PACK_PREEMPT_ENV, 1.0)
+        self.low = _env_float(PACK_RESUME_ENV, 0.5)
+        # counters (GET /stats/breakdown "packing")
+        self.grants = 0
+        self.preemptions = 0
+        self.resumes = 0
+
+    # -------------------------------------------------------- registration
+
+    def register(self, name, *, scheduler, priority=None, slo_ms=None) -> str:
+        """Attach one deployment; returns the key it was registered
+        under.  Two co-tenants of the same preset share a model name
+        (``llama:tiny``), so colliding names are suffixed ``#2``, ``#3``
+        ... instead of silently replacing the first registrant (which
+        would put the arbiter back on the sole-tenant fast path).
+        ``priority`` is the deployment's PR 2 QoS class (interactive
+        outranks batch at every grant), ``slo_ms`` its queue-wait SLO
+        band (interactive deployments only — crossing it triggers
+        preemption of a batch victim)."""
+        key, n = name, 1
+        while key in self._regs:
+            n += 1
+            key = f"{name}#{n}"
+        self._regs[key] = _Reg(
+            key,
+            scheduler,
+            qos.parse_priority(priority) if priority else qos.PRIO_INTERACTIVE,
+            slo_ms if slo_ms is not None else qos.pack_slo_ms(),
+        )
+        return key
+
+    def unregister(self, name) -> None:
+        reg = self._regs.pop(name, None)
+        if reg is None:
+            return
+        if self._holder == name:
+            self._holder = None
+        if len(self._regs) < 2:
+            # back on the sole-tenant fast path: nothing left to arbitrate
+            # — resolve every parked waiter and lift any preemption
+            for _seq, nm, fut in self._waiters:
+                if not fut.done():
+                    self._holder = nm
+                    fut.set_result(None)
+            self._waiters.clear()
+            for other in self._regs.values():
+                if other.preempted:
+                    other.preempted = False
+                    other.scheduler.request_resume()
+                    self.resumes += 1
+            return
+        self._policy()
+        if self._holder is None:
+            self._grant_next()
+
+    @property
+    def multi(self) -> bool:
+        return len(self._regs) >= 2
+
+    # -------------------------------------------------------------- grants
+
+    async def acquire(self, name: str) -> None:
+        """Take the device grant for one fused block (or admission burst).
+        Synchronous no-op below two registrants; otherwise parks until the
+        holder's next sync point releases."""
+        reg = self._regs.get(name)
+        if reg is None or not self.multi:
+            self._holder = name
+            return
+        if self._holder == name:
+            return
+        self._policy()
+        if self._holder is None:
+            self._holder = name
+            reg.grants += 1
+            self.grants += 1
+            return
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._seq += 1
+        self._waiters.append((self._seq, name, fut))
+        try:
+            await fut
+        except asyncio.CancelledError:
+            # scheduler torn down while parked: withdraw, or hand the
+            # grant straight on if it landed between resolve and resume
+            self._waiters[:] = [w for w in self._waiters if w[2] is not fut]
+            if fut.done() and not fut.cancelled() and self._holder == name:
+                self.release(name)
+            raise
+        reg.grants += 1
+        self.grants += 1
+
+    def release(self, name: str) -> None:
+        """Give the device back (idempotent — every scheduler error path
+        calls it defensively).  The best waiter by (priority class,
+        deadline pressure, arrival) is granted immediately."""
+        if self._holder != name:
+            return
+        self._holder = None
+        self._policy()
+        self._grant_next()
+
+    def poll(self) -> None:
+        """Re-evaluate the preemption policy off a grant edge.  Parked
+        victims call this on their park tick: when the interactive side
+        goes quiet its pressure decays with NO grant edges left to
+        piggyback on, and without a poll the resume would never fire."""
+        self._policy()
+        if self._holder is None:
+            self._grant_next()
+
+    def contended(self, name: str) -> bool:
+        """True when another deployment is parked on the grant — the
+        holder's overlap pipeline breaks at the next fused block
+        (break cause ``arbiter-yield``) instead of running back-to-back
+        from the device carry."""
+        return any(nm != name for _seq, nm, _fut in self._waiters)
+
+    def _grant_next(self) -> None:
+        while self._waiters and self._holder is None:
+            self._waiters.sort(key=self._waiter_key)
+            _seq, name, fut = self._waiters.pop(0)
+            if fut.done():
+                continue
+            self._holder = name
+            fut.set_result(None)
+
+    def _waiter_key(self, waiter) -> tuple:
+        seq, name, _fut = waiter
+        reg = self._regs.get(name)
+        if reg is None:
+            return (0, 0.0, seq)  # unregistered while parked: flush first
+        return (qos.priority_rank(reg.priority), -self._pressure_ms(reg), seq)
+
+    # -------------------------------------------------------------- policy
+
+    def _pressure_ms(self, reg: _Reg) -> float:
+        """Deadline pressure: the deployment's queue-wait EWMA/oldest-
+        waiter age (scheduler-side, host bookkeeping only)."""
+        fn = getattr(reg.scheduler, "queue_pressure", None)
+        try:
+            return float(fn()) * 1e3 if fn is not None else 0.0
+        except Exception:  # a broken stand-in must not wedge arbitration
+            return 0.0
+
+    def _policy(self) -> None:
+        """Preemption policy, evaluated at every grant edge: interactive
+        pressure above the SLO band suspends ONE batch victim; pressure
+        below the hysteresis floor (``low`` x SLO) across every
+        interactive deployment resumes all victims."""
+        if not self.multi:
+            return
+        hot = False
+        cool = True
+        for reg in self._regs.values():
+            if reg.priority != qos.PRIO_INTERACTIVE or reg.slo_ms <= 0:
+                continue
+            p = self._pressure_ms(reg)
+            if p >= reg.slo_ms * self.high:
+                hot = True
+            if p >= reg.slo_ms * self.low:
+                cool = False
+        if hot:
+            victim = next(
+                (
+                    r
+                    for r in self._regs.values()
+                    if r.priority == qos.PRIO_BATCH and not r.preempted
+                ),
+                None,
+            )
+            if victim is not None:
+                victim.preempted = True
+                victim.scheduler.request_preempt()
+                self.preemptions += 1
+                log.info(
+                    "arbiter: preempting %s (interactive pressure over SLO)",
+                    victim.name,
+                )
+        elif cool:
+            for reg in self._regs.values():
+                if reg.preempted:
+                    reg.preempted = False
+                    reg.scheduler.request_resume()
+                    self.resumes += 1
+                    log.info("arbiter: resuming %s", reg.name)
+
+    # ----------------------------------------------------------- telemetry
+
+    def snapshot(self) -> dict:
+        """Arbitration ledger for ``GET /stats/breakdown`` ("packing")."""
+        return {
+            "multi": self.multi,
+            "holder": self._holder,
+            "waiting": [nm for _seq, nm, _fut in self._waiters],
+            "grants": self.grants,
+            "preemptions": self.preemptions,
+            "resumes": self.resumes,
+            "deployments": {
+                reg.name: {
+                    "priority": reg.priority,
+                    "slo_ms": reg.slo_ms,
+                    "grants": reg.grants,
+                    "preempted": reg.preempted,
+                    "pressure_ms": round(self._pressure_ms(reg), 3),
+                }
+                for reg in self._regs.values()
+            },
+        }
+
+
+# process-wide arbiter: one serving process drives one device, so one
+# arbiter covers every co-resident deployment (tests build private ones)
+ARBITER = DeviceArbiter()
+
+
+def get_arbiter() -> DeviceArbiter:
+    return ARBITER

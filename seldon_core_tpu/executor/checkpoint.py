@@ -13,7 +13,10 @@ container skeleton (the pytree with leaves replaced by ``None``; dicts,
 lists, tuples and flax FrozenDicts are supported — no pickle, so loading a
 checkpoint from an untrusted source cannot execute code) and a dtype
 manifest.  bfloat16 is stored as its uint16 bit pattern (numpy can't
-serialize it natively).  Writes are atomic (tmp + rename).
+serialize it natively) — the same framing the disagg handoff codec and the
+chip-packing suspend records use (``disagg/handoff.py``, docs/PACKING.md),
+so every persistence plane in the repo round-trips bf16 bit-exactly.
+Writes are atomic (tmp + rename).
 
 Multi-host note: ``jax.device_get`` gathers only addressable shards; on a
 multi-host slice each host must save to a shared filesystem from process 0

@@ -52,6 +52,11 @@ import numpy as np
 from seldon_core_tpu import qos
 from seldon_core_tpu.graph.units import GraphUnitError, SeldonComponent
 from seldon_core_tpu.obs import RECORDER, STAGE_DEVICE_STEP, STAGE_TTFT, TIMELINE
+from seldon_core_tpu.obs.timeline import (
+    EVENT_PREEMPT,
+    EVENT_RESUME,
+    EVENT_SUSPEND,
+)
 from seldon_core_tpu.utils.tracectx import current_trace_id
 from seldon_core_tpu.parallel.sharding import (
     DEFAULT_RULES,
@@ -881,6 +886,10 @@ class GenerativeModel:
             from seldon_core_tpu.executor.memory import MEMORY as memory
         self.memory = memory
         self._mem_key = f"{name}:{id(self):x}"
+        # host-DRAM byte classes (prefix_dram + suspend_dram): the host
+        # ledger's reserve() REPLACES an owner's class dict, so both
+        # classes re-reserve together through _note_host_bytes
+        self._host_classes: dict[str, int] = {}
         kv_bytes = int(self._cache["k"].nbytes) + int(self._cache["v"].nbytes)
         scale_bytes = (
             int(self._cache["k_scale"].nbytes)
@@ -1097,24 +1106,43 @@ class GenerativeModel:
         return snap
 
     def release_memory(self) -> None:
-        """Drop this model's HBM ledger reservation (component close)."""
+        """Drop this model's HBM **and host-DRAM** ledger reservations
+        (component close).  The host release is unconditional: suspend
+        records (docs/PACKING.md) ledger host bytes even on deployments
+        with no prefix tier, and a torn-down deployment's DRAM budget
+        must return to the pool either way."""
         self.memory.release(self._mem_key)
-        if self.host_store is not None:
-            from seldon_core_tpu.executor.memory import host_memory
+        from seldon_core_tpu.executor.memory import host_memory
 
-            host_memory().release(self._mem_key)
+        self._host_classes.clear()
+        host_memory().release(self._mem_key)
+
+    def _note_host_bytes(self, cls: str, nbytes: int) -> None:
+        """Merge one host-DRAM byte class (``prefix_dram`` /
+        ``suspend_dram``) into this model's HOST-ledger reservation.
+        ``reserve()`` REPLACES an owner's class dict, so every class this
+        model ledgers re-reserves together — a suspend-store update must
+        never wipe the prefix tier's bytes, or vice versa."""
+        from seldon_core_tpu.executor.memory import host_memory
+
+        self._host_classes[str(cls)] = int(nbytes)
+        host_memory().reserve(self._mem_key, dict(self._host_classes))
 
     def _note_dram_bytes(self, nbytes: int) -> None:
         """HostPrefixStore byte callback: ledger the DRAM tier's live
         bytes in the HOST memory manager (never the HBM one) and refresh
         the gauge.  Runs only at demote/promote/evict time — admission
         sync points, never the decode hot path."""
-        from seldon_core_tpu.executor.memory import host_memory
-
-        host_memory().reserve(self._mem_key, {"prefix_dram": int(nbytes)})
+        self._note_host_bytes("prefix_dram", int(nbytes))
         DEFAULT_METRICS.prefix_tier_bytes.labels(self.name, "dram").set(
             int(nbytes)
         )
+
+    def note_suspend_bytes(self, nbytes: int) -> None:
+        """SuspendStore byte callback (docs/PACKING.md): preempted
+        whole-slot records park in host DRAM under ``suspend_dram`` —
+        same admission-sync-point-only cadence as the prefix tier."""
+        self._note_host_bytes("suspend_dram", int(nbytes))
 
     # ------------------------------------------------------------------ ops
 
@@ -2323,6 +2351,20 @@ class GenerativeModel:
             # pool occupancy + byte classes, and program-cache churn
             "pool": self.pool_snapshot(),
             "programs": self.program_snapshot(),
+            # per-deployment isolation ledgers (docs/PACKING.md): THIS
+            # model's rows from the HBM and host-DRAM byte ledgers — on a
+            # packed chip they prove byte-level isolation per co-tenant
+            "memory": self.memory_snapshot(),
+        }
+
+    def memory_snapshot(self) -> dict:
+        """This deployment's rows in the chip-wide byte ledgers."""
+        from seldon_core_tpu.executor.memory import host_memory
+
+        return {
+            "owner": self._mem_key,
+            "hbm": self.memory.snapshot()["owners"].get(self._mem_key),
+            "host": host_memory().snapshot()["owners"].get(self._mem_key),
         }
 
     def _prefix_window(self, prefix_len: int) -> int:
@@ -3013,6 +3055,25 @@ class GenerationScheduler:
         self._prefix_installs: list[tuple] = []
         self._task: asyncio.Task | None = None
         self._closed = False
+        # chip packing (docs/PACKING.md): when attached to a DeviceArbiter
+        # the run loop brackets every fused block with the device grant,
+        # and the arbiter may preempt this deployment — active slots
+        # export into the host-DRAM suspend store (whole-slot handoff
+        # frames) and resume bit-exactly at a later sync point
+        self._arbiter = None
+        self._arb_key: str | None = None
+        self._preempt = False
+        self._suspended: list[dict] = []
+        self._suspend_store = None
+        self._suspend_seq = 0
+        # queue-wait EWMA (host bookkeeping only): the deadline-pressure
+        # signal the arbiter reads; time-decayed so a drained burst stops
+        # preempting co-tenants
+        self._qwait_ewma: float | None = None
+        self._qwait_stamp = 0.0
+        self.suspends = 0
+        self.resumes = 0
+        self.suspend_rejected = 0
         # Random base so temperature>0 sampling differs across restarts and
         # replicas; within one process the sequence stays deterministic.
         self._seed = int.from_bytes(os.urandom(4), "little")
@@ -3332,8 +3393,268 @@ class GenerationScheduler:
             if not fut.done():
                 fut.set_result(n)
 
+    # ------------------------------------------- chip packing (arbitration)
+    # docs/PACKING.md: the scheduler side of SLO-arbitrated time-sharing —
+    # the device grant brackets every fused block, and preemption/resume
+    # are verbs the arbiter invokes between blocks, never inside one.
+
+    def attach_arbiter(
+        self,
+        arbiter,
+        *,
+        priority: str = qos.PRIO_INTERACTIVE,
+        slo_ms: float | None = None,
+    ) -> None:
+        """Join a packed chip: register with ``arbiter`` under this
+        model's name (the arbiter de-duplicates colliding names) and
+        start bracketing fused blocks with its grant."""
+        self._arbiter = arbiter
+        self._arb_key = arbiter.register(
+            self.model.name, scheduler=self, priority=priority, slo_ms=slo_ms
+        )
+
+    def detach_arbiter(self) -> None:
+        if self._arbiter is not None:
+            self._arbiter.unregister(self._arb_key)
+            self._arbiter = None
+            self._arb_key = None
+
+    async def _arb_acquire(self) -> None:
+        if self._arbiter is not None:
+            await self._arbiter.acquire(self._arb_key)
+
+    def _arb_release(self) -> None:
+        # idempotent: every park and error path releases defensively — a
+        # parked co-tenant must never wait on a scheduler that is itself
+        # waiting
+        if self._arbiter is not None:
+            self._arbiter.release(self._arb_key)
+
+    def _arb_contended(self) -> bool:
+        return self._arbiter is not None and self._arbiter.contended(
+            self._arb_key
+        )
+
+    def queue_pressure(self) -> float:
+        """Deadline pressure in seconds: max of the (time-decayed)
+        queue-wait EWMA and the oldest live waiter's age.  Host
+        bookkeeping only — the arbiter polls this at grant edges."""
+        now = time.perf_counter()
+        oldest = max(
+            (now - r.t0 for r in self._waiting if not r.future.done()),
+            default=0.0,
+        )
+        ewma = 0.0
+        if self._qwait_ewma is not None:
+            # 1 s half-life: a drained burst's pressure fades instead of
+            # preempting co-tenants forever
+            ewma = self._qwait_ewma * (0.5 ** max(0.0, now - self._qwait_stamp))
+        return max(ewma, oldest)
+
+    def _note_queue_wait(self, req: _Request) -> None:
+        """Fold one admission's queue wait into the EWMA.  Resumed
+        suspend records skip it: their t0 is the ORIGINAL submission, so
+        counting them would report the suspension as queue pressure."""
+        if req.imported is not None and req.imported.get("resumed"):
+            return
+        wait = max(0.0, time.perf_counter() - req.t0)
+        e = self._qwait_ewma
+        self._qwait_ewma = wait if e is None else (0.8 * e + 0.2 * wait)
+        self._qwait_stamp = time.perf_counter()
+
+    def request_preempt(self) -> None:
+        """Arbiter verb: suspend this deployment's active slots at the
+        next sync point and hold admissions until resumed."""
+        self._preempt = True
+        self._wake.set()
+
+    def request_resume(self) -> None:
+        """Arbiter verb: lift the preemption — suspended records re-queue
+        at the next sync point and resume bit-exactly."""
+        self._preempt = False
+        self._wake.set()
+
+    def _suspend_budget_bytes(self) -> int:
+        return int(
+            float(os.environ.get("SCT_PACK_SUSPEND_GB", "1") or 1) * (1 << 30)
+        )
+
+    def _get_suspend_store(self):
+        if self._suspend_store is None:
+            from seldon_core_tpu.cache.tiers import SuspendStore
+
+            # getattr: duck-typed stand-in models (tests) predate the
+            # host-DRAM ledger
+            self._suspend_store = SuspendStore(
+                self._suspend_budget_bytes(),
+                on_bytes=getattr(self.model, "note_suspend_bytes", None),
+            )
+        return self._suspend_store
+
+    async def _suspend_active(self, slots, cur, temps, active) -> int:
+        """The preemption verb's device half, at a sync point only: for
+        every active slot, export its KV (prompt + emitted tokens so far)
+        as ONE disagg handoff frame — int8 blocks + scales verbatim —
+        park it in the suspend store, and free the slot's blocks.  The
+        request object stays alive (future, streaming hook, span,
+        timeline); only its device residency is taken.  A record the
+        store cannot hold leaves its slot RUNNING — best-effort
+        preemption never kills a generation.  Returns slots suspended."""
+        from seldon_core_tpu.disagg.handoff import encode_handoff
+
+        store = self._get_suspend_store()
+        n_susp = 0
+        for i in range(len(slots)):
+            req = slots[i]
+            if req is None or not active[i] or not req.out:
+                continue
+            self._tl(req, EVENT_PREEMPT, victim=self.model.name)
+            n = len(req.out)
+            # KV covers prompt + out[:-1] (the carry token's KV is not
+            # written yet); out[-1] rides as the frame's first_token, so
+            # the resume reserves (L+n-1) + (max_new-n+1) = L + max_new —
+            # exactly the uninterrupted reservation
+            hist = np.concatenate(
+                [req.prompt, np.asarray(req.out[:-1], np.int32)]
+            )
+
+            def export(slot=i, hist=hist, req=req, carry=int(req.out[-1]), n=n):
+                kv = self.model.export_slot_kv(slot, int(hist.size))
+                k, v = kv[0], kv[1]
+                ks, vs = (kv[2], kv[3]) if len(kv) == 4 else (None, None)
+                return encode_handoff(
+                    hist, carry, k, v,
+                    block_size=self.model.kv_block_size,
+                    max_new_tokens=req.max_new_tokens - n + 1,
+                    temperature=req.temperature,
+                    eos_id=req.eos_id,
+                    k_scale=ks, v_scale=vs,
+                    priority=req.priority,
+                    adapter=req.adapter,
+                )
+
+            try:
+                frame = await asyncio.to_thread(export)
+            except Exception:
+                log.exception(
+                    "suspend export failed for slot %d; leaving it resident", i
+                )
+                continue
+            self._suspend_seq += 1
+            key = (id(req), self._suspend_seq)
+            if not store.put(key, frame):
+                # over the suspend budget: this slot keeps running
+                self.suspend_rejected += 1
+                self._tl(req, "suspend-rejected", bytes=len(frame))
+                continue
+            # free_block_count is a property; stand-in models may lack it
+            before = int(getattr(self.model, "free_block_count", 0) or 0)
+            self.model.release_slot(i)
+            freed = int(getattr(self.model, "free_block_count", 0) or 0) - before
+            self._suspended.append({"req": req, "key": key, "bytes": len(frame)})
+            slots[i] = None
+            active[i] = False
+            self.suspends += 1
+            n_susp += 1
+            self._tl(
+                req, EVENT_SUSPEND,
+                victim=self.model.name, tokens=n,
+                blocks_freed=int(freed), bytes=len(frame),
+            )
+        return n_susp
+
+    def _drain_resumes(self) -> None:
+        """Resume verb, at a sync point with preemption lifted: decode
+        each suspend record back into an imported admission — the donated
+        fused-scatter path — and re-queue the ORIGINAL request (its t0
+        sorts it ahead of younger work in its class)."""
+        from seldon_core_tpu.disagg.handoff import decode_handoff
+
+        while self._suspended:
+            rec = self._suspended.pop(0)
+            req = rec["req"]
+            frame = (
+                self._suspend_store.take(rec["key"])
+                if self._suspend_store is not None
+                else None
+            )
+            if req.future.done():
+                self._end_tl(req, "disconnect", stage="suspended")
+                continue
+            if frame is None:
+                req.future.set_exception(
+                    GraphUnitError("suspend record lost from the store")
+                )
+                self._end_tl(req, "error", stage="suspended")
+                continue
+            payload = decode_handoff(frame)
+            req.imported = {
+                "first_token": int(payload["first_token"]),
+                "k": payload["k"],
+                "v": payload["v"],
+                "k_scale": payload.get("k_scale"),
+                "v_scale": payload.get("v_scale"),
+                "prompt": np.asarray(payload["prompt"], np.int32),
+                "reserve_tokens": int(payload["max_new_tokens"]),
+                "resumed": True,
+            }
+            self.resumes += 1
+            self._tl(req, "resume-queued", span=False)
+            self._waiting.append(req)
+
+    def _reap_suspended(self) -> None:
+        """QoS sweep over parked suspend records: a cancelled or expired
+        request must not hold suspend-store bytes until resume."""
+        if not self._suspended:
+            return
+        now = time.monotonic()
+        keep = []
+        for rec in self._suspended:
+            req = rec["req"]
+            if req.future.done():
+                if self._suspend_store is not None:
+                    self._suspend_store.take(rec["key"])
+                self._end_tl(req, "disconnect", stage="suspended")
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                if self._suspend_store is not None:
+                    self._suspend_store.take(rec["key"])
+                req.future.set_exception(qos.DeadlineExceeded(
+                    f"deadline expired while suspended after "
+                    f"{len(req.out)} tokens"
+                ))
+                DEFAULT_METRICS.qos_deadline_miss.labels(
+                    self.model.name, "suspended"
+                ).inc()
+                qos.note_deadline_miss("suspended", req.priority)
+                self._end_tl(
+                    req, "deadline-reap", stage="suspended",
+                    tokens=len(req.out),
+                )
+                continue
+            keep.append(rec)
+        self._suspended[:] = keep
+
+    def packing_snapshot(self) -> dict:
+        """Per-deployment packing ledger (``GET /stats/breakdown``)."""
+        return {
+            "arbitrated": self._arbiter is not None,
+            "preempted": self._preempt,
+            "suspended": len(self._suspended),
+            "suspends": self.suspends,
+            "resumes": self.resumes,
+            "suspend_rejected": self.suspend_rejected,
+            "queue_pressure_ms": round(self.queue_pressure() * 1e3, 3),
+            "suspend_store": (
+                self._suspend_store.snapshot()
+                if self._suspend_store is not None
+                else None
+            ),
+        }
+
     async def close(self) -> None:
         self._closed = True
+        self.detach_arbiter()
         if self._task is not None:
             self._task.cancel()
             try:
@@ -3564,6 +3885,7 @@ class GenerationScheduler:
         try:
             while True:
                 self._reap_queues()
+                self._reap_suspended()
                 if pending is None and self._external_release:
                     # handoff slots released with no block in flight: safe
                     # to return their blocks to the pool right here
@@ -3572,6 +3894,48 @@ class GenerationScheduler:
                     # peer-pulled chains: the install scatter takes pool
                     # blocks, legal only with no decode block in flight
                     await self._drain_prefix_installs()
+                if pending is None and self._preempt and active.any():
+                    # preemption verb (docs/PACKING.md): at this sync
+                    # point, export every active slot into the suspend
+                    # store and free its blocks — the device carry no
+                    # longer matches host bookkeeping afterwards
+                    if await self._suspend_active(slots, cur, temps, active):
+                        carry_dirty = True
+                if pending is None and self._suspended and not self._preempt:
+                    # resume verb: suspended records re-queue as imported
+                    # admissions (donated fused-scatter path, bit-exact)
+                    self._drain_resumes()
+                if (
+                    pending is None
+                    and self._preempt
+                    and not active.any()
+                    and not self._prefilling
+                ):
+                    # preempted: the arbiter gave the device to a
+                    # co-tenant — hold admissions (and the grant) until
+                    # request_resume lifts the flag.  The timeout keeps
+                    # deadline reaping of parked/suspended work at ~50ms
+                    # granularity; spinning would starve the co-tenant's
+                    # event-loop turns.
+                    self._arb_release()
+                    for q in (self._waiting, self._overflow):
+                        for r in q:
+                            self._tl(
+                                r, "paused", span=False, cause="preempted"
+                            )
+                    self._wake.clear()
+                    if self._arbiter is not None:
+                        # off-edge policy tick: with the interactive side
+                        # gone quiet there may be no grant edge left to
+                        # trigger our resume
+                        self._arbiter.poll()
+                    if not self._preempt:
+                        continue
+                    try:
+                        await asyncio.wait_for(self._wake.wait(), timeout=0.05)
+                    except asyncio.TimeoutError:
+                        pass
+                    continue
                 if (
                     pending is None
                     and not active.any()
@@ -3582,7 +3946,10 @@ class GenerationScheduler:
                 ):
                     # fully idle: park until a submit wakes us (no await
                     # between the emptiness check and clear, so a submit
-                    # landing now still sets the event we wait on)
+                    # landing now still sets the event we wait on).  The
+                    # device grant goes back first — an idle co-tenant
+                    # must never hold the chip.
+                    self._arb_release()
                     self._wake.clear()
                     await self._wake.wait()
                     self._reap_queues()
@@ -3598,9 +3965,13 @@ class GenerationScheduler:
                     # round trip
                     batch: list[_Request] = []
                     # capacity excludes slots pinned by in-flight handoffs
-                    # and slots mid-chunked-prefill
+                    # and slots mid-chunked-prefill; a preempted scheduler
+                    # admits NOTHING (its free blocks belong to the
+                    # co-tenant until the arbiter resumes it)
                     cap_free = (
-                        S - int(active.sum()) - len(self._external)
+                        0
+                        if self._preempt
+                        else S - int(active.sum()) - len(self._external)
                         - len(self._prefill_slots)
                     )
                     while self._overflow and len(batch) < cap_free:
@@ -3611,6 +3982,12 @@ class GenerationScheduler:
                         )
                         while self._waiting and len(batch) < cap_free:
                             batch.append(self._waiting.pop(0))
+                    if batch or self._prefilling or active.any():
+                        # packed chip (docs/PACKING.md): all device work
+                        # below — prefills, chunk advances, the fused
+                        # block dispatch — runs under the device grant;
+                        # a co-tenant's block never interleaves inside it
+                        await self._arb_acquire()
                     if batch:
                         await self._admit_batch(batch, slots, cur, temps, active)
                     if self._prefilling:
@@ -3620,6 +3997,9 @@ class GenerationScheduler:
                         await self._advance_prefill(slots, cur, temps, active)
                     self._reap_slots(slots, active)
                     if not active.any():
+                        # nothing to dispatch: the grant goes back before
+                        # any park or spin below
+                        self._arb_release()
                         if self._prefilling:
                             # chunks still advancing: loop straight back —
                             # each iteration does real device work
@@ -3680,10 +4060,14 @@ class GenerationScheduler:
                                 "decode step failed; failing %d in-flight requests",
                                 int(active.sum()),
                             )
+                            self._arb_release()
                             self._fail_inflight(slots, active, exc)
                             continue
                         self._deliver(toks[None], active.copy()[None], slots, cur, active)
                         self._reap_slots(slots, active)
+                        # single-step path: every step IS a sync point, so
+                        # the grant rotates per step on a packed chip
+                        self._arb_release()
                         continue
                     # one dispatch yields up to k tokens per slot; the
                     # device enforces per-slot eos + budget so finished
@@ -3718,6 +4102,7 @@ class GenerationScheduler:
                             "decode dispatch failed; failing %d in-flight requests",
                             int(active.sum()),
                         )
+                        self._arb_release()
                         self._fail_inflight(slots, active, exc)
                         continue
                     carry_dirty = False
@@ -3737,6 +4122,11 @@ class GenerationScheduler:
                     # for "this request's ITL spiked right here")
                     if carry_dirty:
                         break_cause = "carry-dirty"
+                    elif self._preempt or self._arb_contended():
+                        # packed chip: a co-tenant wants (or was granted)
+                        # the device — yield at the block boundary instead
+                        # of chaining another block off the carry
+                        break_cause = "arbiter-yield"
                     elif self._waiting:
                         break_cause = "admission"
                     elif self._overflow:
@@ -3785,9 +4175,15 @@ class GenerationScheduler:
                             pass
                     pending = None
                     carry_dirty = True
+                    self._arb_release()
                     self._fail_inflight(slots, active, exc)
                     continue
                 pending = nxt
+                if pending is None:
+                    # pipeline drained to a sync point: rotate the grant
+                    # BEFORE host-side delivery so a parked co-tenant's
+                    # dispatch overlaps our bookkeeping
+                    self._arb_release()
                 self._deliver(toks_seq, act_seq, slots, cur, active)
                 if self._reap_slots(slots, active):
                     # host-side reap: the chip still thinks those slots are
@@ -3812,6 +4208,14 @@ class GenerationScheduler:
                     req.future.set_exception(err)
                 self._end_tl(req, "error", cause="closed")
             self._overflow.clear()
+            for rec in self._suspended:
+                if not rec["req"].future.done():
+                    rec["req"].future.set_exception(err)
+                self._end_tl(rec["req"], "error", cause="closed")
+            self._suspended.clear()
+            if self._suspend_store is not None:
+                self._suspend_store.flush()
+            self._arb_release()
             raise
 
     async def _admit_batch(self, batch, slots, cur, temps, active) -> None:
@@ -3843,11 +4247,18 @@ class GenerationScheduler:
                 try:
                     if req.imported is not None:
                         # disagg import: the prompt KV arrived from a
-                        # prefill engine — reserve + scatter, no prefill
+                        # prefill engine — reserve + scatter, no prefill.
+                        # A resumed suspend record (docs/PACKING.md) rides
+                        # the same path with its EXTENDED prompt (original
+                        # prompt + tokens emitted before suspension) and
+                        # the frame's remaining-token reservation.
                         imp = req.imported
                         self.model.attach_imported(
-                            slot, req.prompt, imp["k"], imp["v"],
-                            reserve_tokens=req.max_new_tokens,
+                            slot, imp.get("prompt", req.prompt),
+                            imp["k"], imp["v"],
+                            reserve_tokens=int(
+                                imp.get("reserve_tokens", req.max_new_tokens)
+                            ),
                             k_scale=imp.get("k_scale"),
                             v_scale=imp.get("v_scale"),
                             first_token=imp["first_token"],
@@ -3898,6 +4309,7 @@ class GenerationScheduler:
                 self.model.release_slot(slot)
                 self._end_tl(req, "disconnect", stage="prefill")
                 continue
+            self._note_queue_wait(req)
             self._prefilling.append(
                 {"req": req, "slot": slot, "plan": plan, "i": 0}
             )
@@ -3934,11 +4346,29 @@ class GenerationScheduler:
                     req.future.set_result((slot, int(tok)))
                     self._end_tl(req, "exported", slot=slot)
                 continue
+            self._note_queue_wait(req)
             attrs = resnap(slot) or {}
-            if req.imported is not None:
-                attrs["imported"] = True
             if req.adapter:
                 attrs["adapter"] = req.adapter
+            if req.imported is not None and req.imported.get("resumed"):
+                # resumed suspend record (docs/PACKING.md): the carry
+                # token was already delivered to the client before the
+                # suspension — running it through _token_done again would
+                # double-deliver it.  Re-arm the slot directly; the
+                # remaining-token budget derives from len(out) as usual.
+                req.imported = None  # free the record's host arrays
+                req.t_last_tok = time.perf_counter()  # ITL skips the gap
+                self._tl(
+                    req, EVENT_RESUME, slot=slot, tokens=len(req.out),
+                    **attrs,
+                )
+                slots[slot] = req
+                cur[slot] = int(tok)
+                temps[slot] = req.temperature
+                active[slot] = True
+                continue
+            if req.imported is not None:
+                attrs["imported"] = True
             self._tl(req, "admit", slot=slot, **attrs)
             if self._token_done(req, int(tok)):
                 self._complete(req)
@@ -4061,6 +4491,8 @@ class GenerativeComponent(SeldonComponent):
         queue_max: int | None = None,
         overlap: bool | None = None,
         adapter: str | None = None,
+        pack_class: str | None = None,
+        pack_slo_ms: float | None = None,
     ):
         self.model = model
         self.scheduler = GenerationScheduler(
@@ -4074,6 +4506,32 @@ class GenerativeComponent(SeldonComponent):
         # and canary machinery splits traffic between two adapter ids of
         # one base deployment by giving each predictor a different default
         self.adapter = adapter or None
+        # chip packing (docs/PACKING.md): this deployment's QoS class and
+        # queue-wait SLO band on a packed device.  Registration with the
+        # process arbiter is explicit (register_packed / the engine's
+        # multi-deployment boot) or via SCT_PACK=1 — a sole-tenant
+        # deployment never touches the arbiter.
+        self.pack_class = (
+            qos.parse_priority(pack_class) if pack_class else None
+        )
+        self.pack_slo_ms = float(pack_slo_ms) if pack_slo_ms else None
+        if os.environ.get("SCT_PACK", "0") == "1":
+            self.register_packed()
+
+    def register_packed(self, arbiter=None) -> None:
+        """Attach this deployment's scheduler to the device arbiter
+        (process-wide one by default) under its packing class/SLO."""
+        if self.scheduler._arbiter is not None:
+            return
+        if arbiter is None:
+            from seldon_core_tpu.executor.arbiter import get_arbiter
+
+            arbiter = get_arbiter()
+        self.scheduler.attach_arbiter(
+            arbiter,
+            priority=self.pack_class or qos.PRIO_INTERACTIVE,
+            slo_ms=self.pack_slo_ms,
+        )
 
     def warmup(self) -> int:
         return self.model.warmup()

@@ -25,9 +25,14 @@ import threading
 
 #: byte classes the ledger recognises (free-form keys are allowed; these
 #: are the ones the generative plane reports and the gauges label);
-#: ``prefix_dram`` lives in the HOST ledger (:func:`host_memory`), not the
-#: HBM one — demoted prefix KV occupies host DRAM, not chip memory
-CLASSES = ("weights", "kv_pool", "kv_scales", "adapter_pool", "prefix_dram")
+#: ``prefix_dram`` and ``suspend_dram`` live in the HOST ledger
+#: (:func:`host_memory`), not the HBM one — demoted prefix KV and
+#: preempted whole-slot suspend records (docs/PACKING.md) occupy host
+#: DRAM, not chip memory
+CLASSES = (
+    "weights", "kv_pool", "kv_scales", "adapter_pool",
+    "prefix_dram", "suspend_dram",
+)
 
 
 class HBMOverCommit(RuntimeError):
@@ -144,15 +149,22 @@ _HOST_LOCK = threading.Lock()
 
 def host_memory() -> MemoryManager:
     """Process-wide HOST-DRAM ledger, separate from the HBM one so the
-    tiered prefix store's bytes (class ``prefix_dram``) never eat the
-    chip budget or trip ``SCT_HBM_ENFORCE``.  Budget defaults to
-    ``SCT_PREFIX_DRAM_GB`` (0 GiB — the DRAM tier is opt-in); built
-    lazily so tests that tweak the env var before first touch see it."""
+    tiered prefix store's bytes (class ``prefix_dram``) and preemption
+    suspend records (class ``suspend_dram``, docs/PACKING.md) never eat
+    the chip budget or trip ``SCT_HBM_ENFORCE``.  Budget is the sum of
+    the two host tiers' own budgets — ``SCT_PREFIX_DRAM_GB`` (0 GiB, the
+    DRAM prefix tier is opt-in) and ``SCT_PACK_SUSPEND_GB`` (1 GiB, the
+    per-deployment suspend-store bound); built lazily so tests that
+    tweak the env vars before first touch see them."""
     global _HOST_MEMORY
     with _HOST_LOCK:
         if _HOST_MEMORY is None:
             budget = int(
-                float(os.environ.get("SCT_PREFIX_DRAM_GB", "0")) * (1 << 30)
+                (
+                    float(os.environ.get("SCT_PREFIX_DRAM_GB", "0") or 0)
+                    + float(os.environ.get("SCT_PACK_SUSPEND_GB", "1") or 1)
+                )
+                * (1 << 30)
             )
             _HOST_MEMORY = MemoryManager(budget, enforce=False)
         return _HOST_MEMORY

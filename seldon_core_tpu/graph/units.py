@@ -360,8 +360,10 @@ def _jax_generative(parameters: dict[str, Any]) -> Any:
     (Sarathi-style chunked prefill interleaved with decode),
     ``decode_kernel`` (fused Pallas paged decode-attention kernel),
     ``lora_rank`` / ``lora_slots`` / ``lora_targets`` / ``lora_adapters``
-    / ``adapter`` (batched multi-LoRA serving, docs/MULTITENANT.md), plus
-    model-config overrides.
+    / ``adapter`` (batched multi-LoRA serving, docs/MULTITENANT.md),
+    ``pack_class`` / ``pack_slo_ms`` (chip packing: this deployment's QoS
+    class and queue-wait SLO band on a time-shared device,
+    docs/PACKING.md), plus model-config overrides.
     """
     from seldon_core_tpu.models import registry as model_registry
 

@@ -231,6 +231,8 @@ def build_generative_component(
     lora_targets: str | None = None,
     lora_adapters: Any = None,
     adapter: str | None = None,
+    pack_class: str | None = None,
+    pack_slo_ms: float | None = None,
     **overrides,
 ):
     """Build a continuous-batching generative graph unit (JAX_GENERATIVE).
@@ -250,7 +252,11 @@ def build_generative_component(
     ``lora_rank``/``lora_slots``/``lora_targets``/``lora_adapters`` turn
     on batched multi-LoRA serving (stacked adapter pool, per-slot gather
     fused into decode — docs/MULTITENANT.md); ``adapter`` sets the
-    deployment-default adapter a request may override per call."""
+    deployment-default adapter a request may override per call.
+    ``pack_class`` (``interactive``/``batch``) and ``pack_slo_ms`` set
+    this deployment's QoS class and queue-wait SLO band on a packed chip
+    (docs/PACKING.md) — read when the engine registers co-resident
+    deployments with the device arbiter."""
     from seldon_core_tpu.executor.generation import (
         GenerativeComponent,
         GenerativeModel,
@@ -310,4 +316,6 @@ def build_generative_component(
         queue_max=queue_max,
         overlap=overlap,
         adapter=adapter,
+        pack_class=pack_class,
+        pack_slo_ms=pack_slo_ms,
     )

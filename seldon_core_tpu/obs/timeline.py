@@ -35,6 +35,16 @@ ENABLE_ENV = "SCT_TIMELINE"
 MAX_REQUESTS_ENV = "SCT_TIMELINE_MAX"
 MAX_EVENTS_ENV = "SCT_TIMELINE_EVENTS"
 
+# chip-packing verbs (docs/PACKING.md), stamped by the scheduler when the
+# device arbiter preempts a batch deployment: ``preempt`` marks the
+# victim decision (attrs: victim deployment), ``suspend`` the KV export
+# into the host-DRAM suspend store (attrs: blocks freed, record bytes),
+# and ``resume`` the bit-exact re-import at a later admission sync point.
+# All three mirror onto the request's span via the scheduler's ``_tl``.
+EVENT_PREEMPT = "preempt"
+EVENT_SUSPEND = "suspend"
+EVENT_RESUME = "resume"
+
 
 class Timeline:
     """One request's bounded, append-only event ledger."""

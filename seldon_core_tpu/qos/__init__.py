@@ -37,6 +37,7 @@ from seldon_core_tpu.qos.context import (  # noqa: F401
     set_retry_after,
     outgoing_qos_headers,
     parse_deadline_ms,
+    pack_slo_ms,
     parse_priority,
     priority_rank,
     remaining_s,

@@ -18,10 +18,16 @@ transport calls all see the ingress deadline for free.
 from __future__ import annotations
 
 import contextvars
+import os
 import time
 
 DEADLINE_HEADER = "x-sct-deadline-ms"
 PRIORITY_HEADER = "x-sct-priority"
+
+# chip packing (docs/PACKING.md): default interactive queue-wait SLO band
+# — a packed interactive deployment whose queue-wait pressure crosses it
+# triggers preemption of a co-resident batch deployment
+PACK_SLO_ENV = "SCT_PACK_SLO_MS"
 
 PRIO_INTERACTIVE = "interactive"
 PRIO_BATCH = "batch"
@@ -80,6 +86,16 @@ def parse_priority(value) -> str:
 def priority_rank(priority: str) -> int:
     """Lower rank pops first."""
     return 0 if priority == PRIO_INTERACTIVE else 1
+
+
+def pack_slo_ms(default: float = 250.0) -> float:
+    """Interactive queue-wait SLO band for chip packing (``SCT_PACK_SLO_MS``,
+    docs/PACKING.md): the device arbiter preempts a batch co-tenant when an
+    interactive deployment's queue-wait pressure crosses this band."""
+    try:
+        return float(os.environ.get(PACK_SLO_ENV, "") or default)
+    except ValueError:
+        return default
 
 
 def set_budget_ms(budget_ms: float | None) -> None:
