@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check lint-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -130,6 +130,14 @@ pack-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_packing.py -q
 	JAX_PLATFORMS=cpu BENCH_ONLY=PACKING BENCH_RUNS=1 \
 		BENCH_PACK_TOKENS=16 $(PYTHON) bench.py
+
+# invariant-aware static analysis (docs/STATIC_ANALYSIS.md): host-sync,
+# program-key, pairing, env-registry, async-discipline, test-hygiene.
+# Stdlib-only (no jax), so the bare CI lint job runs it without installs;
+# fails on any finding not in sctlint-baseline.json and on stale
+# baseline entries
+lint-check:
+	$(PYTHON) -m seldon_core_tpu.tools.sctlint
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -34,9 +34,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 
 from seldon_core_tpu import qos
+from seldon_core_tpu.runtime import settings
 
 log = logging.getLogger(__name__)
 
@@ -48,8 +48,8 @@ PACK_RESUME_ENV = "SCT_PACK_RESUME"  # resume at pressure < slo * this
 
 def _env_float(name: str, default: float) -> float:
     try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
+        return settings.get_float(name)
+    except KeyError:
         return default
 
 

@@ -517,6 +517,10 @@ class GenerativeModel:
         def _replicate(x):
             """Token outputs replicate across the slice so the coordinator
             can read the full result locally (no-op single-host)."""
+            # topology is fixed per process and the program caches are
+            # per-instance, so two configs differing in _multihost can
+            # never share a compiled program
+            # sct: program-key-ok fixed per-process topology
             if not self._multihost:
                 return x
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -897,6 +901,9 @@ class GenerativeModel:
             if "k_scale" in self._cache
             else 0
         )
+        # held for the model's lifetime; release_memory() releases both
+        # the HBM and host ledgers
+        # sct: pairing-ok ownership transfer to release_memory()
         self.memory.reserve(
             self._mem_key,
             {
@@ -1126,6 +1133,9 @@ class GenerativeModel:
         from seldon_core_tpu.executor.memory import host_memory
 
         self._host_classes[str(cls)] = int(nbytes)
+        # reserve() replaces this owner's class dict (idempotent merge);
+        # release_memory() drops the whole key
+        # sct: pairing-ok ownership transfer to release_memory()
         host_memory().reserve(self._mem_key, dict(self._host_classes))
 
     def _note_dram_bytes(self, nbytes: int) -> None:
@@ -1396,11 +1406,20 @@ class GenerativeModel:
         nb = -(-int(prompt_len) // self.kv_block_size)
         phys = np.asarray(row[:nb], np.int32)
         with self._lock:
-            k = np.asarray(jax.device_get(self._cache["k"][:, phys]))
-            v = np.asarray(jax.device_get(self._cache["v"][:, phys]))
+            # once per migrated slot, off the per-token path (DISAGG.md)
+            k = np.asarray(  # sct: host-sync-ok handoff export
+                jax.device_get(self._cache["k"][:, phys])
+            )
+            v = np.asarray(  # sct: host-sync-ok handoff export
+                jax.device_get(self._cache["v"][:, phys])
+            )
             if self.kv_dtype:
-                ks = np.asarray(jax.device_get(self._cache["k_scale"][:, phys]))
-                vs = np.asarray(jax.device_get(self._cache["v_scale"][:, phys]))
+                ks = np.asarray(  # sct: host-sync-ok handoff export
+                    jax.device_get(self._cache["k_scale"][:, phys])
+                )
+                vs = np.asarray(  # sct: host-sync-ok handoff export
+                    jax.device_get(self._cache["v_scale"][:, phys])
+                )
                 return k, v, ks, vs
         return k, v
 
@@ -1632,14 +1651,18 @@ class GenerativeModel:
             try:
                 phys = np.asarray([b for _k, _d, b in victims], np.int32)
                 with self._lock:
-                    k = np.asarray(jax.device_get(self._cache["k"][:, phys]))
-                    v = np.asarray(jax.device_get(self._cache["v"][:, phys]))
+                    k = np.asarray(  # sct: host-sync-ok tier demotion
+                        jax.device_get(self._cache["k"][:, phys])
+                    )
+                    v = np.asarray(  # sct: host-sync-ok tier demotion
+                        jax.device_get(self._cache["v"][:, phys])
+                    )
                     ks = vs = None
                     if self.kv_dtype:
-                        ks = np.asarray(
+                        ks = np.asarray(  # sct: host-sync-ok tier demotion
                             jax.device_get(self._cache["k_scale"][:, phys])
                         )
-                        vs = np.asarray(
+                        vs = np.asarray(  # sct: host-sync-ok tier demotion
                             jax.device_get(self._cache["v_scale"][:, phys])
                         )
                 # shallowest level first so each chain stays contiguous
@@ -2498,7 +2521,9 @@ class GenerativeModel:
         else:
             toks = self._exec_decode(payload)
         self._pos_ceiling[np.asarray(active, bool)] += 1
-        out = np.asarray(jax.device_get(toks))
+        out = np.asarray(  # sct: host-sync-ok unfused single-step fetch
+            jax.device_get(toks)
+        )
         self._record_step(
             time.perf_counter() - t0, int(np.asarray(active, bool).sum())
         )
@@ -2598,6 +2623,9 @@ class GenerativeModel:
         ONE device_get for both arrays: two separate fetches would pay two
         host round trips per block on a tunnel-attached chip."""
         toks_seq, act_seq, t0, disp_active, k = handle
+        # the runtime audit (tests/test_perf.py) budgets exactly one
+        # host sync per fused k-block: this is it
+        # sct: host-sync-ok THE one fused-block fetch
         toks_np, act_np = jax.device_get((toks_seq, act_seq))
         act_np = np.asarray(act_np)
         if self.spec_draft and disp_active is not None and disp_active.any():
@@ -3421,6 +3449,8 @@ class GenerationScheduler:
 
     async def _arb_acquire(self) -> None:
         if self._arbiter is not None:
+            # _arb_release() pairs it on every park and error path
+            # sct: pairing-ok ownership transfer to _arb_release()
             await self._arbiter.acquire(self._arb_key)
 
     def _arb_release(self) -> None:
@@ -4295,6 +4325,8 @@ class GenerationScheduler:
                     errors.append((req, exc))
             # one round trip fetches every admitted first token (imported
             # first tokens are host ints already; device_get passes them)
+            # one round trip per admitted batch, not per token
+            # sct: host-sync-ok admission sync point
             toks = jax.device_get([t for _, _, t in placed]) if placed else []
             return placed, toks, errors, starved, chunked
 

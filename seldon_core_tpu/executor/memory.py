@@ -20,8 +20,9 @@ snapshot rides ``GET /stats/breakdown``.
 
 from __future__ import annotations
 
-import os
 import threading
+
+from seldon_core_tpu.runtime import settings
 
 #: byte classes the ledger recognises (free-form keys are allowed; these
 #: are the ones the generative plane reports and the gauges label);
@@ -56,11 +57,9 @@ class MemoryManager:
         enforce: bool | None = None,
     ):
         if budget_bytes is None:
-            budget_bytes = int(
-                float(os.environ.get("SCT_HBM_GB", "16")) * (1 << 30)
-            )
+            budget_bytes = int(settings.get_float("SCT_HBM_GB") * (1 << 30))
         if enforce is None:
-            enforce = os.environ.get("SCT_HBM_ENFORCE", "0") == "1"
+            enforce = settings.get_bool("SCT_HBM_ENFORCE")
         self.budget_bytes = int(budget_bytes)
         self.enforce = bool(enforce)
         self._owners: dict[str, dict[str, int]] = {}
@@ -161,8 +160,8 @@ def host_memory() -> MemoryManager:
         if _HOST_MEMORY is None:
             budget = int(
                 (
-                    float(os.environ.get("SCT_PREFIX_DRAM_GB", "0") or 0)
-                    + float(os.environ.get("SCT_PACK_SUSPEND_GB", "1") or 1)
+                    settings.get_float("SCT_PREFIX_DRAM_GB")
+                    + settings.get_float("SCT_PACK_SUSPEND_GB")
                 )
                 * (1 << 30)
             )

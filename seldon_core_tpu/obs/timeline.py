@@ -25,11 +25,12 @@ instead of new rows.  ``SCT_TIMELINE=0`` disables recording entirely.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 from typing import Any
+
+from seldon_core_tpu.runtime import settings
 
 ENABLE_ENV = "SCT_TIMELINE"
 MAX_REQUESTS_ENV = "SCT_TIMELINE_MAX"
@@ -128,11 +129,11 @@ class TimelineLedger:
         enabled: bool | None = None,
     ):
         if max_requests is None:
-            max_requests = int(os.environ.get(MAX_REQUESTS_ENV, "512") or 512)
+            max_requests = settings.get_int(MAX_REQUESTS_ENV)
         if max_events is None:
-            max_events = int(os.environ.get(MAX_EVENTS_ENV, "256") or 256)
+            max_events = settings.get_int(MAX_EVENTS_ENV)
         if enabled is None:
-            enabled = os.environ.get(ENABLE_ENV, "1") != "0"
+            enabled = settings.get_bool(ENABLE_ENV)
         self.enabled = bool(enabled)
         self.max_events = max(8, int(max_events))
         self._entries: deque[Timeline] = deque(maxlen=max(1, int(max_requests)))

@@ -1,6 +1,20 @@
-"""User-model microservice runtime (the reference's `wrappers/python`)."""
+"""User-model microservice runtime (the reference's `wrappers/python`).
 
-from seldon_core_tpu.runtime.server import MicroserviceApp, serve
-from seldon_core_tpu.runtime.microservice import load_component
+Lazy exports (PEP 562): ``runtime.settings`` — the jax-free SCT_* env
+registry — must be importable from control-plane processes (operator,
+sctlint, docs generation) without dragging in the server stack.
+"""
 
 __all__ = ["MicroserviceApp", "serve", "load_component"]
+
+
+def __getattr__(name):
+    if name in ("MicroserviceApp", "serve"):
+        from seldon_core_tpu.runtime import server
+
+        return getattr(server, name)
+    if name == "load_component":
+        from seldon_core_tpu.runtime.microservice import load_component
+
+        return load_component
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

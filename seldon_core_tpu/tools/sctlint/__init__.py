@@ -1,0 +1,34 @@
+"""sct-lint — invariant-aware static analysis for the serving plane.
+
+An AST-based (stdlib-only, jax-free) analyzer enforcing the invariants
+the runtime test audits check after the fact, at the line where they
+break (docs/STATIC_ANALYSIS.md):
+
+``host-sync``        no host transfer on the decode hot path beyond the
+                     one annotated fused-block fetch
+``program-key``      every graph param a jitted program factory closes
+                     over is folded into ``_program_config``
+``pairing``          acquire/release, reserve/release and refcount
+                     pin/unpin pair on every path through a function
+``env-registry``     every ``SCT_*`` env read is declared in
+                     runtime/settings.py and docs reference only
+                     declared vars (docs/CONFIG.md stays generated)
+``async-discipline`` no blocking calls inside ``async def`` in
+                     gateway/engine/disagg; no fire-and-forget
+                     ``create_task``
+``test-hygiene``     subprocess / non-CPU-safe tests carry the ``slow``
+                     marker so tier-1 scope stays exact
+
+Suppress a finding in place with ``# sct: <rule>-ok <reason>`` (the
+reason is mandatory); pre-existing debt lives in the checked-in
+``sctlint-baseline.json`` (empty for executor/, models/, cache/,
+disagg/ — the hot path carries no debt).
+"""
+
+from seldon_core_tpu.tools.sctlint.core import (  # noqa: F401
+    Finding,
+    Source,
+    load_sources,
+    run_rules,
+)
+from seldon_core_tpu.tools.sctlint.rules import RULES  # noqa: F401

@@ -1,0 +1,161 @@
+"""sct-lint CLI.
+
+    python -m seldon_core_tpu.tools.sctlint            # lint the tree
+    python -m seldon_core_tpu.tools.sctlint --explain pairing
+    python -m seldon_core_tpu.tools.sctlint --write-baseline
+    python -m seldon_core_tpu.tools.sctlint --write-config-docs
+
+Exit codes: 0 clean (or everything baselined), 1 new findings or a
+stale/forbidden baseline entry, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from seldon_core_tpu.tools.sctlint.core import (
+    BASELINE_NAME,
+    load_baseline,
+    load_sources,
+    run_rules,
+    write_baseline,
+)
+from seldon_core_tpu.tools.sctlint.rules import BY_ID, RULES
+
+
+def repo_root() -> Path:
+    # tools/sctlint/__main__.py -> package -> repo
+    return Path(__file__).resolve().parents[3]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="sctlint",
+        description="invariant-aware static analysis for the serving "
+        "plane (docs/STATIC_ANALYSIS.md)",
+    )
+    ap.add_argument("paths", nargs="*", type=Path,
+                    help="files/dirs to lint (default: seldon_core_tpu, "
+                    "tests, docs, README.md)")
+    ap.add_argument("--root", type=Path, default=None,
+                    help="repo root (default: auto)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help=f"baseline file (default: <root>/{BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report every finding)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                    "(outside executor/, models/, cache/, disagg/)")
+    ap.add_argument("--explain", metavar="RULE",
+                    help="print a rule's full rationale and exit")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings")
+    ap.add_argument("--write-config-docs", action="store_true",
+                    help="regenerate docs/CONFIG.md from "
+                    "runtime/settings.py and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r.id:18s} {r.summary}")
+        return 0
+
+    if args.explain:
+        rule = BY_ID.get(args.explain)
+        if rule is None:
+            print(f"unknown rule {args.explain!r}; known: "
+                  f"{', '.join(BY_ID)}", file=sys.stderr)
+            return 2
+        print(f"[{rule.id}] {rule.summary}\n")
+        print(rule.explain.strip())
+        return 0
+
+    root = (args.root or repo_root()).resolve()
+
+    if args.write_config_docs:
+        from seldon_core_tpu.tools.sctlint.rules.env_registry import (
+            load_registry,
+        )
+        _, mod = load_registry(root)
+        out = root / "docs" / "CONFIG.md"
+        out.write_text(mod.markdown_table() + "\n")
+        print(f"wrote {out}")
+        return 0
+
+    rules = RULES
+    if args.rules:
+        unknown = [r for r in args.rules.split(",") if r not in BY_ID]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+        rules = [BY_ID[r] for r in args.rules.split(",")]
+
+    paths = args.paths or [
+        root / "seldon_core_tpu",
+        root / "tests",
+        root / "docs",
+        root / "README.md",
+    ]
+    paths = [p if p.is_absolute() else root / p for p in paths]
+    ctx = load_sources(root, paths)
+
+    baseline_path = args.baseline or (root / BASELINE_NAME)
+    baseline = [] if args.no_baseline else load_baseline(baseline_path)
+    report = run_rules(ctx, rules, baseline)
+
+    if args.write_baseline:
+        keep = [
+            f for f in report.findings
+            if not f.path.startswith((
+                "seldon_core_tpu/executor/", "seldon_core_tpu/models/",
+                "seldon_core_tpu/cache/", "seldon_core_tpu/disagg/",
+            ))
+        ]
+        write_baseline(baseline_path, keep)
+        dropped = len(report.findings) - len(keep)
+        print(f"wrote {baseline_path} ({len(keep)} entries; {dropped} "
+              "findings in baseline-forbidden dirs NOT written — fix or "
+              "annotate those)")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.__dict__ for f in report.new],
+            "baselined": [f.__dict__ for f in report.baselined],
+            "stale_baseline": report.stale_baseline,
+        }, indent=2))
+    else:
+        for f in report.new:
+            print(f.render())
+        for e in report.stale_baseline:
+            print(f"{e['path']}: [stale-baseline] entry no longer "
+                  f"matches any finding (rule {e['rule']}): "
+                  f"{e['snippet']!r} — regenerate with --write-baseline")
+        for e in report.bad_baseline:
+            print(f"{e['path']}: [baseline-forbidden] {e['rule']} entry "
+                  "in a must-be-clean dir — fix or annotate in place")
+        n_rules = len(rules)
+        print(
+            f"sctlint: {len(ctx.py)} py + {len(ctx.docs)} doc files, "
+            f"{n_rules} rules: {len(report.new)} new, "
+            f"{len(report.baselined)} baselined, "
+            f"{len(report.stale_baseline)} stale-baseline",
+            file=sys.stderr,
+        )
+    return 1 if report.failed else 0
+
+
+if __name__ == "__main__":
+    # behave like a unix filter under `| head`
+    import contextlib
+    import signal
+
+    with contextlib.suppress(AttributeError, ValueError):
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Name-based call-graph reachability over a set of analyzed modules.
+
+Deliberately over-approximate: an attribute call ``self.model.release_slot``
+or ``fam.decode_slots_paged`` resolves to EVERY indexed function with that
+bare name.  For hot-path auditing over-approximation is the safe
+direction — a function wrongly pulled into the hot set shows up as an
+annotated (not silent) site; a missed edge would hide a host sync.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Iterable
+
+from seldon_core_tpu.tools.sctlint.core import Source, iter_funcs
+
+
+@dataclass(frozen=True)
+class FuncRef:
+    rel: str        # repo-relative path of the defining module
+    qual: str       # dotted qualname within the module
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+
+    @property
+    def bare(self) -> str:
+        return self.qual.rsplit(".", 1)[-1]
+
+
+class Index:
+    def __init__(self, sources: Iterable[Source]):
+        self.funcs: dict[tuple[str, str], FuncRef] = {}
+        self.by_bare: dict[str, list[FuncRef]] = {}
+        for src in sources:
+            if src.tree is None:
+                continue
+            for qual, node in iter_funcs(src.tree):
+                ref = FuncRef(src.rel, qual, node)
+                self.funcs[(src.rel, qual)] = ref
+                self.by_bare.setdefault(node.name, []).append(ref)
+
+    def roots(self, patterns: list[tuple[str, str]]) -> list[FuncRef]:
+        """``patterns``: (path-suffix, qualname-regex) pairs."""
+        out = []
+        for suffix, rx in patterns:
+            crx = re.compile(rx)
+            for (rel, qual), ref in self.funcs.items():
+                if rel.endswith(suffix) and crx.search(qual):
+                    out.append(ref)
+        return out
+
+    @staticmethod
+    def _called_names(node: ast.AST) -> set[str]:
+        names: set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                # direct calls, plus first-class function references
+                # passed as arguments (asyncio.to_thread(self.model.f),
+                # functools.partial(f, ...), executor.submit(f))
+                for cand in [n.func, *n.args]:
+                    if isinstance(cand, ast.Name):
+                        names.add(cand.id)
+                    elif isinstance(cand, ast.Attribute):
+                        names.add(cand.attr)
+        return names
+
+    def reachable(self, roots: list[FuncRef]) -> set[FuncRef]:
+        seen: set[tuple[str, str]] = set()
+        out: set[FuncRef] = set()
+        stack = list(roots)
+        while stack:
+            ref = stack.pop()
+            key = (ref.rel, ref.qual)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.add(ref)
+            for name in self._called_names(ref.node):
+                for cal in self.by_bare.get(name, ()):
+                    if (cal.rel, cal.qual) not in seen:
+                        stack.append(cal)
+        return out

@@ -1,0 +1,262 @@
+"""sctlint engine: sources, suppressions, baseline, rule runner.
+
+Pure stdlib.  A rule is a ``Rule(id, summary, explain, check)`` whose
+``check(ctx)`` yields :class:`Finding`.  The engine owns everything
+rules share: parsed sources, per-line ``# sct: <rule>-ok <reason>``
+suppressions, and the checked-in baseline of pre-existing findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+SUPPRESS_RE = re.compile(r"#\s*sct:\s*([a-z0-9-]+)-ok\b[ \t]*(.*)")
+
+BASELINE_NAME = "sctlint-baseline.json"
+
+# baseline entries are forbidden under these prefixes: the hot path and
+# its feeders carry annotations with reasons, never silent debt
+BASELINE_CLEAN_PREFIXES = (
+    "seldon_core_tpu/executor/",
+    "seldon_core_tpu/models/",
+    "seldon_core_tpu/cache/",
+    "seldon_core_tpu/disagg/",
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+    snippet: str  # stripped source line: the baseline fingerprint
+
+    def key(self) -> tuple[str, str, str]:
+        # line numbers drift; (rule, path, source line) is stable across
+        # unrelated edits while still pinning the exact construct
+        return (self.rule, self.path, self.snippet)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Source:
+    """One parsed file.  ``tree`` is None for non-Python files (docs)."""
+
+    path: Path
+    rel: str
+    text: str
+    lines: list[str]
+    tree: ast.AST | None
+    # lineno -> [(rule, reason)]
+    suppressions: dict[int, list[tuple[str, str]]] = field(default_factory=dict)
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        """A ``# sct: <rule>-ok reason`` suppresses its own physical line
+        and the line below it (comment-above style for long statements)."""
+        for ln in (line, line - 1):
+            for r, _reason in self.suppressions.get(ln, ()):
+                if r == rule:
+                    return True
+        return False
+
+
+@dataclass
+class Context:
+    root: Path
+    py: list[Source]
+    docs: list[Source]
+
+    def by_rel(self, suffix: str) -> Source | None:
+        for s in self.py:
+            if s.rel.endswith(suffix):
+                return s
+        return None
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+    explain: str
+    check: Callable[[Context], Iterable[Finding]]
+
+
+def _scan_suppressions(src: Source) -> list[Finding]:
+    """Record suppression comments; a suppression with no reason is
+    itself a finding (the reason is the review artifact)."""
+    bad = []
+    for i, line in enumerate(src.lines, 1):
+        for m in SUPPRESS_RE.finditer(line):
+            rule, reason = m.group(1), m.group(2).strip()
+            src.suppressions.setdefault(i, []).append((rule, reason))
+            if not reason:
+                bad.append(Finding(
+                    "annotation", src.rel, i,
+                    f"suppression '# sct: {rule}-ok' carries no reason — "
+                    "say why the invariant holds here",
+                    src.snippet(i),
+                ))
+    return bad
+
+
+def load_sources(root: Path, paths: list[Path]) -> Context:
+    py: list[Source] = []
+    docs: list[Source] = []
+    seen: set[Path] = set()
+
+    def add(p: Path) -> None:
+        if p in seen or not p.is_file():
+            return
+        seen.add(p)
+        rel = p.relative_to(root).as_posix()
+        try:
+            text = p.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError):
+            return
+        lines = text.splitlines()
+        if p.suffix == ".py":
+            try:
+                tree = ast.parse(text, filename=str(p))
+            except SyntaxError as e:
+                tree = None
+                docs.append(Source(p, rel, text, lines, None))
+                _ = e
+                return
+            py.append(Source(p, rel, text, lines, tree))
+        elif p.suffix in (".md", ".rst"):
+            docs.append(Source(p, rel, text, lines, None))
+
+    for path in paths:
+        if path.is_dir():
+            for p in sorted(path.rglob("*.py")):
+                if "__pycache__" in p.parts:
+                    continue
+                add(p)
+            for p in sorted(path.rglob("*.md")):
+                add(p)
+        else:
+            add(path)
+    return Context(root=root, py=py, docs=docs)
+
+
+def load_baseline(path: Path) -> list[dict]:
+    if not path.is_file():
+        return []
+    data = json.loads(path.read_text())
+    return list(data.get("findings", []))
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    entries = sorted(
+        {f.key() for f in findings},
+    )
+    path.write_text(json.dumps({
+        "version": 1,
+        "comment": (
+            "pre-existing sctlint findings; new code must be clean or "
+            "annotated in place (# sct: <rule>-ok <reason>).  Regenerate "
+            "with --write-baseline; CI fails on stale entries so the "
+            "file only ever shrinks."
+        ),
+        "findings": [
+            {"rule": r, "path": p, "snippet": s} for (r, p, s) in entries
+        ],
+    }, indent=2) + "\n")
+
+
+@dataclass
+class Report:
+    findings: list[Finding]          # all raw findings (unsuppressed)
+    new: list[Finding]               # not in baseline -> fail
+    baselined: list[Finding]
+    stale_baseline: list[dict]       # baseline entries matching nothing
+    bad_baseline: list[dict]         # baseline entries in must-be-clean dirs
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.new or self.stale_baseline or self.bad_baseline)
+
+
+def run_rules(
+    ctx: Context,
+    rules: Iterable[Rule],
+    baseline: list[dict] | None = None,
+) -> Report:
+    findings: list[Finding] = []
+    for src in ctx.py + ctx.docs:
+        findings.extend(_scan_suppressions(src))
+    for rule in rules:
+        for f in rule.check(ctx):
+            src = next(
+                (s for s in ctx.py + ctx.docs if s.rel == f.path), None
+            )
+            if src is not None and src.is_suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    base_keys = {
+        (e["rule"], e["path"], e["snippet"]) for e in (baseline or [])
+    }
+    new = [f for f in findings if f.key() not in base_keys]
+    baselined = [f for f in findings if f.key() in base_keys]
+    live_keys = {f.key() for f in findings}
+    stale = [
+        e for e in (baseline or [])
+        if (e["rule"], e["path"], e["snippet"]) not in live_keys
+    ]
+    bad = [
+        e for e in (baseline or [])
+        if e["path"].startswith(BASELINE_CLEAN_PREFIXES)
+    ]
+    return Report(findings, new, baselined, stale, bad)
+
+
+# -- shared AST helpers -----------------------------------------------------
+
+def dotted(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, '' when not a plain chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Call):
+        inner = dotted(node.func)
+        return f"{inner}()" if inner else ""
+    return ""
+
+
+def iter_funcs(
+    tree: ast.AST,
+) -> Iterator[tuple[str, ast.FunctionDef | ast.AsyncFunctionDef]]:
+    """All function defs with dotted qualnames (Class.method,
+    outer.<locals>.inner collapses to outer.inner)."""
+
+    def walk(node: ast.AST, prefix: str) -> Iterator:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, child
+                yield from walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, f"{prefix}{child.name}.")
+            else:
+                yield from walk(child, prefix)
+
+    yield from walk(tree, "")

@@ -1,0 +1,22 @@
+"""Rule registry: one module per rule, ordered as docs/STATIC_ANALYSIS.md
+presents them."""
+
+from seldon_core_tpu.tools.sctlint.rules import (
+    async_discipline,
+    env_registry,
+    host_sync,
+    pairing,
+    program_key,
+    test_hygiene,
+)
+
+RULES = [
+    host_sync.RULE,
+    program_key.RULE,
+    pairing.RULE,
+    env_registry.RULE,
+    async_discipline.RULE,
+    test_hygiene.RULE,
+]
+
+BY_ID = {r.id: r for r in RULES}

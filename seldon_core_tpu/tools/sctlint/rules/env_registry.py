@@ -1,0 +1,125 @@
+"""env-registry: every ``SCT_*`` env var is declared exactly once.
+
+``seldon_core_tpu/runtime/settings.py`` is the single source of truth
+for the serving plane's env knobs (name, default, type, one-line doc).
+This rule holds three edges of that contract:
+
+* every quoted ``SCT_*`` literal in package code must be a declared
+  name (or a declared prefix — the QoS controller composes
+  ``{prefix}_{KNOB}`` names from ``SCT_QOS``/``SCT_GW_QOS``);
+* every ``SCT_*`` token a docs page or README mentions must be
+  declared — stale knob references rot fastest in docs;
+* ``docs/CONFIG.md`` must byte-match the generated table
+  (``python -m seldon_core_tpu.tools.sctlint --write-config-docs``).
+
+The registry module is loaded by file path (stdlib-only, jax-free), so
+the rule sees the post-expansion table, not just literal declare()
+calls.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import re
+import sys
+from pathlib import Path
+from typing import Iterable
+
+from seldon_core_tpu.tools.sctlint.core import Context, Finding, Rule
+
+TOKEN_RE = re.compile(r"SCT_[A-Z0-9_]*[A-Z0-9]")
+LITERAL_RE = re.compile(r"""["']
+    (SCT_[A-Z0-9_]*[A-Z0-9_])
+    ["']""", re.X)
+
+CONFIG_DOC = "docs/CONFIG.md"
+
+
+def load_registry(root: Path) -> dict:
+    """The live registry, imported standalone so no package __init__
+    (and no jax) is touched."""
+    path = root / "seldon_core_tpu" / "runtime" / "settings.py"
+    spec = importlib.util.spec_from_file_location("_sct_settings", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves sys.modules[cls.__module__] at class-creation
+    # time, so the module must be registered before exec
+    sys.modules["_sct_settings"] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop("_sct_settings", None)
+    return mod.REGISTRY, mod
+
+
+def _declared(name: str, registry: dict) -> bool:
+    # prefix family roots (SCT_QOS, SCT_GW_QOS) are declared as entries
+    # themselves, so wildcard references like "SCT_QOS_*" (the token
+    # regex stops at the root) and composed literals like "SCT_QOS_"
+    # both resolve through a plain lookup
+    return name.rstrip("_") in registry
+
+
+def check(ctx: Context) -> Iterable[Finding]:
+    try:
+        registry, mod = load_registry(ctx.root)
+    except (OSError, AttributeError, ImportError) as e:
+        return [Finding(
+            "env-registry", "seldon_core_tpu/runtime/settings.py", 1,
+            f"cannot load the settings registry: {e}", "",
+        )]
+    out: list[Finding] = []
+
+    for src in ctx.py:
+        if not src.rel.startswith("seldon_core_tpu/"):
+            continue
+        if src.rel.endswith("runtime/settings.py") \
+                or "/tools/sctlint/" in src.rel:
+            continue
+        for i, line in enumerate(src.lines, 1):
+            for m in LITERAL_RE.finditer(line):
+                name = m.group(1)
+                if not _declared(name, registry):
+                    out.append(Finding(
+                        "env-registry", src.rel, i,
+                        f"env var {name} is not declared in "
+                        "runtime/settings.py — declare() it with a "
+                        "default and one-line doc",
+                        src.snippet(i),
+                    ))
+
+    for src in ctx.docs:
+        if not src.rel.endswith(".md"):
+            continue
+        for i, line in enumerate(src.lines, 1):
+            for m in TOKEN_RE.finditer(line):
+                name = m.group(0)
+                if not _declared(name, registry):
+                    out.append(Finding(
+                        "env-registry", src.rel, i,
+                        f"docs reference {name}, which is not declared "
+                        "in runtime/settings.py — fix the reference or "
+                        "declare the var",
+                        src.snippet(i),
+                    ))
+
+    cfg = ctx.root / CONFIG_DOC
+    want = mod.markdown_table() + "\n"
+    have = cfg.read_text() if cfg.is_file() else ""
+    if have != want:
+        out.append(Finding(
+            "env-registry", CONFIG_DOC, 1,
+            "docs/CONFIG.md is stale — regenerate with "
+            "`python -m seldon_core_tpu.tools.sctlint "
+            "--write-config-docs`",
+            "(generated file drift)",
+        ))
+    return out
+
+
+RULE = Rule(
+    id="env-registry",
+    summary="SCT_* env vars declared centrally; docs reference only "
+            "declared vars",
+    explain=__doc__,
+    check=check,
+)

@@ -1,0 +1,170 @@
+"""host-sync: the static twin of the runtime <=1-sync-per-block audit.
+
+The decode hot path — everything reachable from the generation run loop
+(``executor/generation.py`` ``GenerationScheduler._run``) plus the
+decode program bodies in ``models/llama.py`` — must not touch the
+device from the host.  One host fetch per fused decode block is the
+budget (tests/test_perf.py holds it at runtime); every other transfer
+is a per-token round trip that shows up directly as ITL.
+
+Flagged inside the hot call graph:
+
+* ``jax.device_get(...)`` / ``jax.block_until_ready`` /
+  ``.block_until_ready()`` / ``.item()`` — always (the one legitimate
+  fused-block fetch carries an annotation saying so);
+* ``np.asarray`` / ``np.array`` / ``np.copy`` / ``float()`` / ``int()``
+  / ``bool()`` applied to a DEVICE value (a local traced back to a
+  jitted-program result, a ``jax.*`` call, the paged KV cache, or the
+  overlap carry) — each implicitly syncs and copies.
+
+Intentional sync points (admission, export/suspend, the block fetch)
+stay visible as ``# sct: host-sync-ok <reason>`` annotations instead of
+silent regressions-in-waiting.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from seldon_core_tpu.tools.sctlint.callgraph import Index
+from seldon_core_tpu.tools.sctlint.core import (
+    Context,
+    Finding,
+    Rule,
+    dotted,
+)
+
+ROOTS = [
+    ("executor/generation.py", r"^GenerationScheduler\._run$"),
+    # decode program factories trace at dispatch time: a host op inside
+    # one is a trace-time sync baked into the hot path
+    ("executor/generation.py", r"^GenerativeModel\.__init__\._decode"),
+    ("models/llama.py", r"^_?decode"),
+]
+
+# device-value producers: calls whose result lives on device
+_TAINT_CALL_SUBSTR = ("_jit", "_prefill", "_decode")
+_TAINT_ATTRS = ("_cache", "_carry", "lora_pool_params")
+_COERCIONS = {"float", "int", "bool"}
+_NP_SINKS = {"np.asarray", "np.array", "np.copy", "numpy.asarray",
+             "numpy.array", "numpy.copy"}
+
+
+def _tainted_names(fn: ast.AST) -> set[str]:
+    """Locals holding device values: fixpoint over simple assignments."""
+
+    def seeds(expr: ast.AST, tainted: set[str]) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d.startswith("jax.") and not d.startswith((
+                    "jax.device_get", "jax.tree", "jax.random.PRNGKey",
+                )):
+                    return True
+                bare = d.rsplit(".", 1)[-1]
+                if any(s in bare for s in _TAINT_CALL_SUBSTR):
+                    return True
+            if isinstance(n, ast.Attribute) and n.attr in _TAINT_ATTRS:
+                return True
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return True
+        return False
+
+    tainted: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and seeds(n.value, tainted):
+                for t in n.targets:
+                    for el in ast.walk(t):
+                        # Store context only: `self._cache = fn(...)`
+                        # must not taint the Load-context `self` inside
+                        # the attribute target
+                        if isinstance(el, ast.Name) \
+                                and isinstance(el.ctx, ast.Store):
+                            if el.id not in tainted:
+                                tainted.add(el.id)
+                                changed = True
+    return tainted
+
+
+def _scan(fn: ast.AST, where: str) -> Iterator[tuple[int, str]]:
+    tainted = _tainted_names(fn)
+
+    def is_tainted(expr: ast.AST) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in _TAINT_ATTRS:
+                return True
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                bare = d.rsplit(".", 1)[-1]
+                if d.startswith("jax.") or any(
+                    s in bare for s in _TAINT_CALL_SUBSTR
+                ):
+                    return True
+        return False
+
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Call):
+            continue
+        d = dotted(n.func)
+        if d in ("jax.device_get", "jax.block_until_ready"):
+            yield n.lineno, (
+                f"{d} on the decode hot path ({where}): a host round "
+                "trip per call — fold it into the one fused-block fetch "
+                "or annotate the sync point"
+            )
+        elif isinstance(n.func, ast.Attribute) and n.func.attr in (
+            "block_until_ready", "item"
+        ):
+            yield n.lineno, (
+                f".{n.func.attr}() on the decode hot path ({where}): "
+                "implicit device sync"
+            )
+        elif (d in _NP_SINKS or d.rsplit(".", 1)[-1] in _COERCIONS
+              and isinstance(n.func, ast.Name)):
+            if n.args and is_tainted(n.args[0]):
+                yield n.lineno, (
+                    f"{d}(...) coerces a device value to host on the "
+                    f"decode hot path ({where}): implicit transfer — "
+                    "keep it on device or annotate the sync point"
+                )
+
+
+def check(ctx: Context) -> Iterable[Finding]:
+    hot_sources = [
+        s for s in ctx.py
+        if s.rel.endswith(("executor/generation.py", "models/llama.py",
+                           "executor/speculative.py", "executor/lora.py",
+                           "executor/compiled.py", "executor/memory.py",
+                           "cache/prefix.py"))
+    ]
+    if not hot_sources:
+        return []
+    idx = Index(hot_sources)
+    roots = idx.roots(ROOTS)
+    reach = idx.reachable(roots)
+    by_rel = {s.rel: s for s in hot_sources}
+    out: dict[tuple[str, int], Finding] = {}
+    for ref in reach:
+        src = by_rel[ref.rel]
+        where = f"reachable from the run loop via {ref.qual}"
+        for line, msg in _scan(ref.node, where):
+            key = (ref.rel, line)
+            if key not in out:
+                out[key] = Finding(
+                    "host-sync", ref.rel, line, msg, src.snippet(line)
+                )
+    return [out[k] for k in sorted(out)]
+
+
+RULE = Rule(
+    id="host-sync",
+    summary="no host transfers on the decode hot path",
+    explain=__doc__,
+    check=check,
+)

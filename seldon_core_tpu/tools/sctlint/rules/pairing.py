@@ -1,0 +1,190 @@
+"""pairing: acquire/release must pair on every path through a function.
+
+Four ledgers keep the serving plane honest and each has a paired verb:
+
+=============  =========================  ======================
+resource       acquire                    release
+=============  =========================  ======================
+DeviceArbiter  ``.acquire(name)``         ``.release(name)``
+MemoryManager  ``.reserve(owner, ...)``   ``.release(owner)``
+AdapterPool    ``.acquire(adapter)``      ``.release_ref(idx)``
+PrefixIndex    ``.acquire(tokens, ...)``  ``.release(tokens, ...)``
+=============  =========================  ======================
+
+A function that acquires one of these and has no matching release is a
+leak on SOME path (the PR 10/12 bug class: an error branch between
+reserve and release strands blocks/refs/bytes until restart).  Two
+findings:
+
+* ``missing release`` — the function never releases what it acquired.
+  Ownership transfer (the release lives in a different function, e.g.
+  ``reserve_for_prompt`` acquires what ``release_slot`` releases) is
+  legitimate and annotated: ``# sct: pairing-ok <who releases and when>``.
+* ``unprotected release`` — a release exists but only on the straight
+  path: a ``raise``/``return`` between acquire and release can skip it
+  and no release sits in a ``finally``/``except``.  Restructure with
+  try/finally or annotate why the in-between code cannot raise.
+
+Receivers are classified by name (``*arbiter*``/``*_arb*``,
+``*memory*``/``host_memory()``, ``*lora_pool*``/``*adapter_pool*``,
+``*prefix_index*``); a lock's ``.acquire()`` does not match.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from seldon_core_tpu.tools.sctlint.core import Context, Finding, Rule, dotted
+
+# kind -> (receiver substrings, acquire verbs, release verbs)
+KINDS = {
+    "DeviceArbiter": (("arbiter", "_arb"), {"acquire"}, {"release"}),
+    "MemoryManager": (("memory",), {"reserve"}, {"release"}),
+    "AdapterPool": (("lora_pool", "adapter_pool"), {"acquire"},
+                    {"release_ref"}),
+    "PrefixIndex": (("prefix_index",), {"acquire"}, {"release"}),
+}
+
+
+def _classify(call: ast.Call) -> tuple[str, str] | None:
+    """(kind, 'acquire'|'release') for a tracked ledger call."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    recv = dotted(f.value).lower()
+    if not recv:
+        return None
+    for kind, (substrs, acq, rel) in KINDS.items():
+        if any(s in recv for s in substrs):
+            if f.attr in acq:
+                return kind, "acquire"
+            if f.attr in rel:
+                return kind, "release"
+    return None
+
+
+def _guard_raises(fn: ast.AST, acqs: list[ast.Call]) -> set[int]:
+    """Raise lines inside an ``except`` handler whose ``try`` body
+    contains one of the acquires: if that handler runs, the acquire
+    itself failed and nothing is held, so the raise cannot leak."""
+    acq_ids = {id(c) for c in acqs}
+    out: set[int] = set()
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Try):
+            continue
+        if not any(
+            id(sub) in acq_ids for s in n.body for sub in ast.walk(s)
+        ):
+            continue
+        for h in n.handlers:
+            for s in h.body:
+                for sub in ast.walk(s):
+                    if isinstance(sub, ast.Raise):
+                        out.add(sub.lineno)
+    return out
+
+
+def _protected_lines(fn: ast.AST) -> set[int]:
+    """Lines inside ``finally`` or ``except`` blocks: releases there run
+    on the exceptional path too."""
+    out: set[int] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Try):
+            for h in n.handlers:
+                for s in h.body:
+                    for sub in ast.walk(s):
+                        if hasattr(sub, "lineno"):
+                            out.add(sub.lineno)
+            for s in n.finalbody:
+                for sub in ast.walk(s):
+                    if hasattr(sub, "lineno"):
+                        out.add(sub.lineno)
+    return out
+
+
+def check(ctx: Context) -> Iterable[Finding]:
+    out: list[Finding] = []
+    for src in ctx.py:
+        if src.tree is None or "/tools/sctlint/" in src.rel:
+            continue
+        if src.rel.startswith("tests/"):
+            continue
+        for n in ast.walk(src.tree):
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            out.extend(_check_fn(src, n))
+    return out
+
+
+def _check_fn(src, fn) -> Iterable[Finding]:
+    acquires: dict[str, list[ast.Call]] = {}
+    releases: dict[str, list[ast.Call]] = {}
+    # skip nested defs: they pair on their own (and closures that
+    # acquire for a deferred release are ownership transfers anyway)
+    def walk_no_nested(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and child is not fn:
+                continue
+            yield child
+            yield from walk_no_nested(child)
+
+    calls = [n for n in walk_no_nested(fn) if isinstance(n, ast.Call)]
+    for call in calls:
+        hit = _classify(call)
+        if hit is None:
+            continue
+        kind, verb = hit
+        (acquires if verb == "acquire" else releases).setdefault(
+            kind, []
+        ).append(call)
+
+    out: list[Finding] = []
+    protected = _protected_lines(fn)
+    for kind, acqs in acquires.items():
+        rels = releases.get(kind, [])
+        own = KINDS[kind]
+        release_names = "/".join(sorted(own[2]))
+        if not rels:
+            for call in acqs:
+                out.append(Finding(
+                    "pairing", src.rel, call.lineno,
+                    f"{kind}.{call.func.attr}() has no matching "
+                    f".{release_names}() in '{fn.name}' — leaked on "
+                    "every path; pair it here or annotate the "
+                    "ownership transfer",
+                    src.snippet(call.lineno),
+                ))
+            continue
+        # release exists: is any protected, or can an early exit skip it?
+        if any(r.lineno in protected for r in rels):
+            continue
+        first_acq = min(c.lineno for c in acqs)
+        last_rel = max(r.lineno for r in rels)
+        guard = _guard_raises(fn, acqs)
+        escapes = [
+            n for n in ast.walk(fn)
+            if isinstance(n, (ast.Raise, ast.Return))
+            and first_acq < n.lineno < last_rel
+            and n.lineno not in guard
+        ]
+        if escapes:
+            out.append(Finding(
+                "pairing", src.rel, first_acq,
+                f"{kind} release at line {last_rel} of '{fn.name}' can "
+                f"be skipped by the raise/return at line "
+                f"{escapes[0].lineno} — move the release into a "
+                "finally (or annotate why the branch releases "
+                "elsewhere)",
+                src.snippet(first_acq),
+            ))
+    return out
+
+
+RULE = Rule(
+    id="pairing",
+    summary="ledger acquire/release pair on every path",
+    explain=__doc__,
+    check=check,
+)

@@ -1,0 +1,220 @@
+"""program-key: every graph param a jitted program factory reads must be
+folded into ``_program_config``.
+
+Compiled-program cache keys are ``(bucket/k/window,) + _program_config``.
+A factory that closes over a config attribute NOT in that tuple bakes
+the value into the traced program while the cache key says it doesn't
+matter — two configurations silently share one compiled step, or a
+mid-traffic value change recompiles under load.  This is the bug class
+the runtime key-audit tests (tests/test_spec.py, test_chunked.py,
+test_lora.py) catch one PR late; here it fails on the exact line.
+
+Mechanics: in the method that assigns ``self._program_config = (...)``
+the rule finds every program factory (a nested ``def`` passed to
+``jax.jit`` or stored on a ``self.*_factory`` attribute, plus the
+helpers those factories call), computes what each closes over, and
+chases free variables back through single assignments to the
+``self.<attr>`` they were derived from.  Each such attribute must
+appear in the key tuple; ``os.environ`` reads inside a factory are
+flagged unconditionally (fold the value through an attribute).
+Deliberately-unkeyed values (they cannot affect the traced program)
+are annotated ``# sct: program-key-ok <reason>`` where they are read.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from seldon_core_tpu.tools.sctlint.core import Context, Finding, Rule, dotted
+
+
+def _key_attrs(assign: ast.Assign) -> set[str] | None:
+    """Attribute names in ``self._program_config = (self.a, self.b, ...)``."""
+    v = assign.value
+    if not isinstance(v, ast.Tuple):
+        return None
+    out = set()
+    for el in v.elts:
+        if isinstance(el, ast.Attribute) and isinstance(el.value, ast.Name) \
+                and el.value.id == "self":
+            out.add(el.attr)
+    return out
+
+
+def _is_program_config_assign(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Assign)
+        and len(node.targets) == 1
+        and isinstance(node.targets[0], ast.Attribute)
+        and node.targets[0].attr == "_program_config"
+    )
+
+
+def _factory_names(method: ast.FunctionDef) -> set[str]:
+    """Nested defs that become compiled programs: passed to jax.jit or
+    assigned to a ``self.*`` slot whose name mentions ``factory``."""
+    nested = {
+        n.name for n in ast.iter_child_nodes(method)
+        if isinstance(n, ast.FunctionDef)
+    }
+    out: set[str] = set()
+    for n in ast.walk(method):
+        if isinstance(n, ast.Call) and dotted(n.func) in (
+            "jax.jit", "jax.pjit", "pjit", "jit"
+        ):
+            for a in n.args:
+                if isinstance(a, ast.Name) and a.id in nested:
+                    out.add(a.id)
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            t = n.targets[0]
+            if isinstance(t, ast.Attribute) and "factory" in t.attr:
+                for sub in ast.walk(n.value):
+                    if isinstance(sub, ast.Name) and sub.id in nested:
+                        out.add(sub.id)
+    return out
+
+
+def _bound_names(fn: ast.FunctionDef) -> set[str]:
+    bound = {a.arg for a in (
+        fn.args.args + fn.args.posonlyargs + fn.args.kwonlyargs
+    )}
+    if fn.args.vararg:
+        bound.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        bound.add(fn.args.kwarg.arg)
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                for el in ast.walk(t):
+                    if isinstance(el, ast.Name):
+                        bound.add(el.id)
+        elif isinstance(n, (ast.For, ast.comprehension)):
+            tgt = n.target
+            for el in ast.walk(tgt):
+                if isinstance(el, ast.Name):
+                    bound.add(el.id)
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(n.name)
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            for el in ast.walk(n.optional_vars):
+                if isinstance(el, ast.Name):
+                    bound.add(el.id)
+    return bound
+
+
+def check(ctx: Context) -> Iterable[Finding]:
+    out: list[Finding] = []
+    for src in ctx.py:
+        if src.tree is None:
+            continue
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for method in cls.body:
+                if not isinstance(method, ast.FunctionDef):
+                    continue
+                key_assign = next(
+                    (n for n in ast.walk(method)
+                     if _is_program_config_assign(n)), None
+                )
+                if key_assign is None:
+                    continue
+                keys = _key_attrs(key_assign)
+                if keys is None:
+                    out.append(Finding(
+                        "program-key", src.rel, key_assign.lineno,
+                        "_program_config must be a literal tuple of "
+                        "self.<attr> reads so the key audit can "
+                        "cross-reference it",
+                        src.snippet(key_assign.lineno),
+                    ))
+                    continue
+                out.extend(_check_method(src, cls, method, keys))
+    return out
+
+
+def _check_method(src, cls, method, keys) -> Iterable[Finding]:
+    nested = {
+        n.name: n for n in ast.iter_child_nodes(method)
+        if isinstance(n, ast.FunctionDef)
+    }
+    factories = _factory_names(method)
+    if not factories:
+        return []
+    # factories plus the nested helpers they call, transitively
+    todo, scope = list(factories), set()
+    while todo:
+        name = todo.pop()
+        if name in scope or name not in nested:
+            continue
+        scope.add(name)
+        for n in ast.walk(nested[name]):
+            if isinstance(n, ast.Name) and n.id in nested:
+                todo.append(n.id)
+
+    # one assignment map for the enclosing method body (top level only)
+    assigns: dict[str, ast.Assign] = {}
+    for n in ast.iter_child_nodes(method):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    assigns[t.id] = n
+
+    out: list[Finding] = []
+    flagged: set[tuple[int, str]] = set()
+
+    def flag(line: int, attr: str, via: str) -> None:
+        if (line, attr) in flagged:
+            return
+        flagged.add((line, attr))
+        out.append(Finding(
+            "program-key", src.rel, line,
+            f"program factory reads self.{attr}{via} but "
+            f"'{attr}' is not folded into _program_config — two "
+            "configs differing only in it would share a compiled "
+            "program (or annotate why it cannot affect the trace)",
+            src.snippet(line),
+        ))
+
+    for fname in scope:
+        fn = nested[fname]
+        bound = _bound_names(fn)
+        for n in ast.walk(fn):
+            # direct self.<attr> read inside a factory
+            if isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name) \
+                    and n.value.id == "self" and isinstance(n.ctx, ast.Load):
+                if n.attr not in keys and n.attr != "_program_config":
+                    flag(n.lineno, n.attr, "")
+            # env read at trace time
+            if isinstance(n, ast.Call):
+                d = dotted(n.func)
+                if d.startswith(("os.environ", "os.getenv")):
+                    out.append(Finding(
+                        "program-key", src.rel, n.lineno,
+                        "program factory reads the environment at trace "
+                        "time — fold the value through a keyed "
+                        "self.<attr> instead",
+                        src.snippet(n.lineno),
+                    ))
+            # free variable derived from an unkeyed self.<attr>
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id not in bound and n.id in assigns \
+                    and n.id not in scope:
+                rhs = assigns[n.id]
+                for sub in ast.walk(rhs.value):
+                    if isinstance(sub, ast.Attribute) \
+                            and isinstance(sub.value, ast.Name) \
+                            and sub.value.id == "self" \
+                            and sub.attr not in keys:
+                        flag(rhs.lineno, sub.attr,
+                             f" (via local '{n.id}')")
+    return out
+
+
+RULE = Rule(
+    id="program-key",
+    summary="jitted factories only read params folded into _program_config",
+    explain=__doc__,
+    check=check,
+)

@@ -1,0 +1,145 @@
+"""test-hygiene: tier-1 scope is exactly what the verify command selects.
+
+ROADMAP's tier-1 command runs ``pytest -m 'not slow'`` with
+``JAX_PLATFORMS=cpu``.  That contract only holds if the ``slow`` marker
+is complete: a test that spawns subprocesses, drives real sockets for
+minutes, or needs non-CPU devices must carry it — otherwise tier-1
+inherits a flaky multi-minute e2e, and the seed count stops meaning
+anything.
+
+A test function is **non-tier-1-safe** when its body (or a module-level
+helper it calls) does any of:
+
+* ``subprocess.Popen`` / ``run`` / ``check_*`` / ``call`` — spawned
+  servers and worker processes;
+* ``jax.distributed.initialize`` — multi-process mesh formation;
+* ``jax.devices("tpu")`` — a hard device requirement.
+
+Such a test must be marked ``slow`` (function, class, or module
+``pytestmark``) or annotated ``# sct: test-hygiene-ok <reason>`` (e.g.
+a sub-second one-shot build step).  The inverse audit — ``slow`` on a
+test with none of the signals — is deliberately NOT flagged: slowness
+has more causes than this rule can see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from seldon_core_tpu.tools.sctlint.core import Context, Finding, Rule, dotted
+
+_SUBPROCESS = (
+    "subprocess.Popen", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+)
+
+
+def _has_slow(deco_list) -> bool:
+    for d in deco_list:
+        name = dotted(d if not isinstance(d, ast.Call) else d.func)
+        if name.endswith("mark.slow") or name == "slow":
+            return True
+    return False
+
+
+def _module_marked_slow(tree: ast.Module) -> bool:
+    for n in tree.body:
+        if isinstance(n, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "pytestmark"
+            for t in n.targets
+        ):
+            for sub in ast.walk(n.value):
+                if isinstance(sub, ast.Attribute) and sub.attr == "slow":
+                    return True
+    return False
+
+
+def _signals(node: ast.AST) -> list[tuple[int, str]]:
+    out = []
+    for n in ast.walk(node):
+        if not isinstance(n, ast.Call):
+            continue
+        d = dotted(n.func)
+        if d in _SUBPROCESS:
+            out.append((n.lineno, d))
+        elif d == "jax.distributed.initialize":
+            out.append((n.lineno, d))
+        elif d == "jax.devices" and n.args \
+                and isinstance(n.args[0], ast.Constant) \
+                and n.args[0].value == "tpu":
+            out.append((n.lineno, 'jax.devices("tpu")'))
+    return out
+
+
+def check(ctx: Context) -> Iterable[Finding]:
+    out: list[Finding] = []
+    for src in ctx.py:
+        if src.tree is None or not src.rel.startswith("tests/"):
+            continue
+        if _module_marked_slow(src.tree):
+            continue
+        # module-level helpers a test may call: name -> signal list
+        helpers: dict[str, list[tuple[int, str]]] = {}
+        for n in src.tree.body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and not n.name.startswith("test"):
+                helpers[n.name] = _signals(n)
+
+        def fn_signals(fn) -> list[tuple[int, str]]:
+            sig = _signals(fn)
+            for c in ast.walk(fn):
+                if isinstance(c, ast.Call):
+                    d = dotted(c.func)
+                    bare = d.rsplit(".", 1)[-1]
+                    if bare in helpers and helpers[bare]:
+                        sig.append((c.lineno, f"{bare}() -> "
+                                    f"{helpers[bare][0][1]}"))
+            return sig
+
+        def visit(body, class_slow: bool, methods: dict):
+            for n in body:
+                if isinstance(n, ast.ClassDef):
+                    own_methods = {
+                        m.name: m for m in n.body
+                        if isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                    }
+                    visit(n.body, class_slow or _has_slow(n.decorator_list),
+                          own_methods)
+                elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n.name.startswith("test"):
+                    if class_slow or _has_slow(n.decorator_list):
+                        continue
+                    sig = fn_signals(n)
+                    # class-local helpers (self._launch style)
+                    for c in ast.walk(n):
+                        if isinstance(c, ast.Call) \
+                                and isinstance(c.func, ast.Attribute) \
+                                and c.func.attr in methods \
+                                and c.func.attr != n.name:
+                            hsig = _signals(methods[c.func.attr])
+                            if hsig:
+                                sig.append((c.lineno,
+                                            f"self.{c.func.attr}() -> "
+                                            f"{hsig[0][1]}"))
+                    if sig:
+                        line, what = sig[0]
+                        out.append(Finding(
+                            "test-hygiene", src.rel, n.lineno,
+                            f"test '{n.name}' is not tier-1-safe "
+                            f"({what} at line {line}) but carries no "
+                            "'slow' marker — mark it or annotate why "
+                            "it is cheap",
+                            src.snippet(n.lineno),
+                        ))
+        visit(src.tree.body, False, {})
+    return out
+
+
+RULE = Rule(
+    id="test-hygiene",
+    summary="non-tier-1-safe tests carry the slow marker",
+    explain=__doc__,
+    check=check,
+)

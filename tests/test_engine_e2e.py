@@ -11,6 +11,7 @@ import asyncio
 import os
 
 import numpy as np
+import pytest
 from aiohttp.test_utils import TestClient, TestServer
 
 from seldon_core_tpu.engine.app import EngineApp
@@ -702,6 +703,7 @@ class TestMultiWorkerIngress:
     docs/benchmarking.md:19-36).  Each worker owns its own service +
     sub-batchers; kernel accept balancing spreads connections."""
 
+    @pytest.mark.slow
     def test_two_workers_share_one_port(self):
         import json as _json
         import subprocess

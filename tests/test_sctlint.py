@@ -1,0 +1,617 @@
+"""sct-lint: engine, the six rules, the CLI, and the repo meta-invariants.
+
+Each rule gets the four-quadrant treatment on synthetic trees under
+tmp_path: a seeded violation (CLI exits non-zero), a clean negative, a
+suppressed positive (``# sct: <rule>-ok reason``), and a
+baseline-matched positive.  The meta-tests then hold the REAL repo to
+the same standard: ``make lint-check`` green, the checked-in baseline
+minimal (no stale entries) and empty for the must-be-clean dirs, and
+the env-var registry covering every quoted ``SCT_*`` literal.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from seldon_core_tpu.runtime import settings
+from seldon_core_tpu.tools.sctlint import core
+from seldon_core_tpu.tools.sctlint.__main__ import main as sctlint_main
+from seldon_core_tpu.tools.sctlint.rules import BY_ID, RULES
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def build(tmp_path: Path, files: dict[str, str]) -> Path:
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return tmp_path
+
+
+def run(root: Path, *args: str) -> int:
+    return sctlint_main(["--root", str(root), *args])
+
+
+def write_baseline(root: Path, entries: list[tuple[str, str, str]]) -> None:
+    (root / core.BASELINE_NAME).write_text(json.dumps({
+        "version": 1,
+        "findings": [
+            {"rule": r, "path": p, "snippet": s} for r, p, s in entries
+        ],
+    }))
+
+
+# ---------------------------------------------------------------- host-sync
+
+HOT = """\
+    import jax
+    import numpy as np
+
+    class GenerationScheduler:
+        def _run(self):
+            return self._fetch()
+
+        def _fetch(self):
+            toks = self._decode_jit()
+            host = np.asarray(toks)
+            return jax.device_get(host)
+    """
+
+
+def test_host_sync_positive(tmp_path, capsys):
+    root = build(tmp_path, {"seldon_core_tpu/executor/generation.py": HOT})
+    assert run(root, "--rules", "host-sync", "--no-baseline") == 1
+    out = capsys.readouterr().out
+    assert "[host-sync]" in out
+    assert "jax.device_get" in out
+    assert "np.asarray" in out  # tainted local coerced to host
+
+
+def test_host_sync_negative_cold_function(tmp_path):
+    # the same syncs OUTSIDE the hot call graph are not the rule's business
+    root = build(tmp_path, {"seldon_core_tpu/executor/generation.py": """\
+        import jax
+
+        class GenerationScheduler:
+            def _run(self):
+                return 0
+
+            def debug_dump(self):
+                return jax.device_get(self._cache)
+        """})
+    assert run(root, "--rules", "host-sync", "--no-baseline") == 0
+
+
+def test_host_sync_suppressed(tmp_path):
+    root = build(tmp_path, {"seldon_core_tpu/executor/generation.py": """\
+        import jax
+
+        class GenerationScheduler:
+            def _run(self):
+                # sct: host-sync-ok the one budgeted fetch
+                return jax.device_get(self._cache)
+        """})
+    assert run(root, "--rules", "host-sync", "--no-baseline") == 0
+
+
+def test_host_sync_baseline_matched(tmp_path):
+    root = build(tmp_path, {"seldon_core_tpu/gateway/hot.py": ""})
+    build(root, {"seldon_core_tpu/executor/generation.py": HOT})
+    # note: executor/ baseline entries are forbidden in the real repo;
+    # the ENGINE still honours them (bad_baseline fails the run), so use
+    # a custom baseline path to test matching alone
+    write_baseline(root, [
+        ("host-sync", "seldon_core_tpu/executor/generation.py",
+         "host = np.asarray(toks)"),
+        ("host-sync", "seldon_core_tpu/executor/generation.py",
+         "return jax.device_get(host)"),
+    ])
+    # matched entries stop being "new" but executor/ entries are
+    # themselves findings (baseline-forbidden): the run still fails
+    assert run(root, "--rules", "host-sync") == 1
+
+
+# -------------------------------------------------------------- program-key
+
+def test_program_key_positive(tmp_path, capsys):
+    root = build(tmp_path, {"seldon_core_tpu/executor/generation.py": """\
+        import jax
+
+        class GenerativeModel:
+            def __init__(self):
+                self._program_config = (self.top_k,)
+
+                def _decode(x):
+                    return x[: self.window] * self.top_k
+
+                self._decode_fn = jax.jit(_decode)
+        """})
+    assert run(root, "--rules", "program-key", "--no-baseline") == 1
+    out = capsys.readouterr().out
+    assert "self.window" in out and "top_k" not in out.replace(
+        "self.top_k", ""
+    )
+
+
+def test_program_key_negative(tmp_path):
+    root = build(tmp_path, {"seldon_core_tpu/executor/generation.py": """\
+        import jax
+
+        class GenerativeModel:
+            def __init__(self):
+                self._program_config = (self.top_k, self.window)
+
+                def _decode(x):
+                    return x[: self.window] * self.top_k
+
+                self._decode_fn = jax.jit(_decode)
+        """})
+    assert run(root, "--rules", "program-key", "--no-baseline") == 0
+
+
+def test_program_key_env_read_in_factory(tmp_path, capsys):
+    root = build(tmp_path, {"seldon_core_tpu/executor/generation.py": """\
+        import jax
+        import os
+
+        class GenerativeModel:
+            def __init__(self):
+                self._program_config = (self.top_k,)
+
+                def _decode(x):
+                    return x * int(os.environ.get("SCT_K", "1"))
+
+                self._decode_fn = jax.jit(_decode)
+        """})
+    assert run(root, "--rules", "program-key", "--no-baseline") == 1
+    assert "environment at trace time" in capsys.readouterr().out
+
+
+def test_program_key_free_var_chased_to_attr(tmp_path, capsys):
+    root = build(tmp_path, {"seldon_core_tpu/executor/generation.py": """\
+        import jax
+
+        class GenerativeModel:
+            def __init__(self):
+                self._program_config = (self.top_k,)
+                rank = self.lora_rank or 0
+
+                def _decode(x):
+                    return x * rank
+
+                self._decode_fn = jax.jit(_decode)
+        """})
+    assert run(root, "--rules", "program-key", "--no-baseline") == 1
+    assert "via local 'rank'" in capsys.readouterr().out
+
+
+def test_program_key_suppressed(tmp_path):
+    root = build(tmp_path, {"seldon_core_tpu/executor/generation.py": """\
+        import jax
+
+        class GenerativeModel:
+            def __init__(self):
+                self._program_config = (self.top_k,)
+
+                def _decode(x):
+                    # sct: program-key-ok shape-only, cannot change trace
+                    return x[: self.window]
+
+                self._decode_fn = jax.jit(_decode)
+        """})
+    assert run(root, "--rules", "program-key", "--no-baseline") == 0
+
+
+# ------------------------------------------------------------------ pairing
+
+def test_pairing_missing_release(tmp_path, capsys):
+    root = build(tmp_path, {"seldon_core_tpu/engine/pool.py": """\
+        class Handler:
+            def grab(self, name):
+                idx = self.lora_pool.acquire(name)
+                return idx
+        """})
+    assert run(root, "--rules", "pairing", "--no-baseline") == 1
+    assert "no matching .release_ref()" in capsys.readouterr().out
+
+
+def test_pairing_negative_paired(tmp_path):
+    root = build(tmp_path, {"seldon_core_tpu/engine/pool.py": """\
+        class Handler:
+            def use(self, name):
+                idx = self.lora_pool.acquire(name)
+                try:
+                    return self.work(idx)
+                finally:
+                    self.lora_pool.release_ref(idx)
+        """})
+    assert run(root, "--rules", "pairing", "--no-baseline") == 0
+
+
+def test_pairing_unprotected_release(tmp_path, capsys):
+    root = build(tmp_path, {"seldon_core_tpu/engine/pool.py": """\
+        class Handler:
+            def use(self, name, budget):
+                self.memory.reserve(name, {"kv": budget})
+                if budget > self.limit:
+                    raise ValueError(budget)
+                self.work(name)
+                self.memory.release(name)
+        """})
+    assert run(root, "--rules", "pairing", "--no-baseline") == 1
+    assert "can be skipped by the raise/return" in capsys.readouterr().out
+
+
+def test_pairing_raise_in_acquire_guard_is_not_a_leak(tmp_path):
+    # a raise inside the except handler wrapping the acquire itself
+    # means the acquire failed: nothing is held, nothing leaks
+    root = build(tmp_path, {"seldon_core_tpu/engine/pool.py": """\
+        class Handler:
+            def use(self, name):
+                try:
+                    idx = self.lora_pool.acquire(name)
+                except KeyError as e:
+                    raise ValueError(name) from e
+                self.work(idx)
+                self.lora_pool.release_ref(idx)
+        """})
+    assert run(root, "--rules", "pairing", "--no-baseline") == 0
+
+
+def test_pairing_ownership_transfer_annotation(tmp_path):
+    root = build(tmp_path, {"seldon_core_tpu/engine/pool.py": """\
+        class Handler:
+            def grab(self, name):
+                # sct: pairing-ok released by drop() at request end
+                idx = self.lora_pool.acquire(name)
+                return idx
+        """})
+    assert run(root, "--rules", "pairing", "--no-baseline") == 0
+
+
+def test_pairing_lock_acquire_not_matched(tmp_path):
+    root = build(tmp_path, {"seldon_core_tpu/engine/pool.py": """\
+        class Handler:
+            def work(self):
+                self._lock.acquire()
+                return 1
+        """})
+    assert run(root, "--rules", "pairing", "--no-baseline") == 0
+
+
+# ------------------------------------------------------------- env-registry
+
+ENV_FILES = {
+    "seldon_core_tpu/runtime/settings.py": """\
+        REGISTRY = {"SCT_GOOD": None}
+
+        def markdown_table():
+            return "| table |"
+        """,
+    "docs/CONFIG.md": "| table |\n",
+}
+
+
+def test_env_registry_undeclared_literal(tmp_path, capsys):
+    root = build(tmp_path, dict(ENV_FILES))
+    build(root, {"seldon_core_tpu/mod.py": """\
+        import os
+        X = os.environ.get("SCT_BOGUS", "")
+        """})
+    assert run(root, "--rules", "env-registry", "--no-baseline") == 1
+    assert "SCT_BOGUS" in capsys.readouterr().out
+
+
+def test_env_registry_undeclared_docs_reference(tmp_path, capsys):
+    root = build(tmp_path, dict(ENV_FILES))
+    build(root, {"docs/OPS.md": "Set SCT_NOPE=1 to enable.\n"})
+    assert run(root, "--rules", "env-registry", "--no-baseline") == 1
+    assert "SCT_NOPE" in capsys.readouterr().out
+
+
+def test_env_registry_clean(tmp_path):
+    root = build(tmp_path, dict(ENV_FILES))
+    build(root, {
+        "seldon_core_tpu/mod.py": """\
+            import os
+            X = os.environ.get("SCT_GOOD", "")
+            """,
+        "docs/OPS.md": "Set SCT_GOOD=1 to enable.\n",
+    })
+    assert run(root, "--rules", "env-registry", "--no-baseline") == 0
+
+
+def test_env_registry_stale_config_md(tmp_path, capsys):
+    root = build(tmp_path, dict(ENV_FILES))
+    (root / "docs" / "CONFIG.md").write_text("| hand-edited |\n")
+    assert run(root, "--rules", "env-registry", "--no-baseline") == 1
+    assert "docs/CONFIG.md is stale" in capsys.readouterr().out
+
+
+def test_write_config_docs(tmp_path, capsys):
+    root = build(tmp_path, dict(ENV_FILES))
+    (root / "docs" / "CONFIG.md").unlink()
+    assert run(root, "--write-config-docs") == 0
+    assert (root / "docs" / "CONFIG.md").read_text() == "| table |\n"
+
+
+# --------------------------------------------------------- async-discipline
+
+def test_async_blocking_call(tmp_path, capsys):
+    root = build(tmp_path, {"seldon_core_tpu/gateway/app.py": """\
+        import time
+
+        async def handler(request):
+            time.sleep(0.5)
+            return request
+        """})
+    assert run(root, "--rules", "async-discipline", "--no-baseline") == 1
+    assert "time.sleep" in capsys.readouterr().out
+
+
+def test_async_blocking_scope_excludes_executor(tmp_path):
+    # the executor is thread-land; only the asyncio planes are scoped
+    root = build(tmp_path, {"seldon_core_tpu/executor/helper.py": """\
+        import time
+
+        async def warmup():
+            time.sleep(0.5)
+        """})
+    assert run(root, "--rules", "async-discipline", "--no-baseline") == 0
+
+
+def test_fire_and_forget_create_task(tmp_path, capsys):
+    root = build(tmp_path, {"seldon_core_tpu/gateway/app.py": """\
+        import asyncio
+
+        async def boot(work):
+            asyncio.create_task(work())
+        """})
+    assert run(root, "--rules", "async-discipline", "--no-baseline") == 1
+    assert "fire-and-forget" in capsys.readouterr().out
+
+
+def test_dropped_task_handle(tmp_path, capsys):
+    root = build(tmp_path, {"seldon_core_tpu/gateway/app.py": """\
+        import asyncio
+
+        async def boot(work):
+            t = asyncio.create_task(work())
+            return None
+        """})
+    assert run(root, "--rules", "async-discipline", "--no-baseline") == 1
+    assert "never used after" in capsys.readouterr().out
+
+
+def test_retained_task_is_clean(tmp_path):
+    root = build(tmp_path, {"seldon_core_tpu/gateway/app.py": """\
+        import asyncio
+
+        async def boot(work):
+            t = asyncio.create_task(work())
+            t.add_done_callback(print)
+
+        class App:
+            def start(self, loop, work):
+                self._task = loop.create_task(work())
+        """})
+    assert run(root, "--rules", "async-discipline", "--no-baseline") == 0
+
+
+def test_async_suppressed(tmp_path):
+    root = build(tmp_path, {"seldon_core_tpu/gateway/app.py": """\
+        import time
+
+        async def handler(request):
+            # sct: async-discipline-ok sub-ms busy-wait in tests only
+            time.sleep(0.0001)
+            return request
+        """})
+    assert run(root, "--rules", "async-discipline", "--no-baseline") == 0
+
+
+# ------------------------------------------------------------- test-hygiene
+
+def test_hygiene_unmarked_subprocess_test(tmp_path, capsys):
+    root = build(tmp_path, {"tests/test_spawn.py": """\
+        import subprocess
+
+        def test_spawns_server():
+            subprocess.run(["true"])
+        """})
+    assert run(root, "--rules", "test-hygiene", "--no-baseline") == 1
+    assert "not tier-1-safe" in capsys.readouterr().out
+
+
+def test_hygiene_slow_marker_satisfies(tmp_path):
+    root = build(tmp_path, {"tests/test_spawn.py": """\
+        import subprocess
+        import pytest
+
+        @pytest.mark.slow
+        def test_spawns_server():
+            subprocess.run(["true"])
+        """})
+    assert run(root, "--rules", "test-hygiene", "--no-baseline") == 0
+
+
+def test_hygiene_module_pytestmark_satisfies(tmp_path):
+    root = build(tmp_path, {"tests/test_spawn.py": """\
+        import subprocess
+        import pytest
+
+        pytestmark = pytest.mark.slow
+
+        def test_spawns_server():
+            subprocess.run(["true"])
+        """})
+    assert run(root, "--rules", "test-hygiene", "--no-baseline") == 0
+
+
+def test_hygiene_signal_through_helper(tmp_path, capsys):
+    root = build(tmp_path, {"tests/test_spawn.py": """\
+        import subprocess
+
+        def _launch():
+            return subprocess.Popen(["sleep", "60"])
+
+        def test_uses_helper():
+            _launch()
+        """})
+    assert run(root, "--rules", "test-hygiene", "--no-baseline") == 1
+    assert "_launch()" in capsys.readouterr().out
+
+
+# ------------------------------------------------- engine: baseline + CLI
+
+def test_annotation_without_reason_is_a_finding(tmp_path, capsys):
+    # the reasonless marker is assembled at runtime so linting THIS
+    # file does not trip over the fixture literal
+    marker = "# sct: pairing-" + "ok"
+    root = build(tmp_path, {"seldon_core_tpu/engine/pool.py": f"""\
+        class Handler:
+            def grab(self, name):
+                {marker}
+                idx = self.lora_pool.acquire(name)
+                return idx
+        """})
+    assert run(root, "--rules", "pairing", "--no-baseline") == 1
+    assert "[annotation]" in capsys.readouterr().out
+
+
+def test_baseline_matched_finding_passes(tmp_path):
+    root = build(tmp_path, {"seldon_core_tpu/engine/pool.py": """\
+        class Handler:
+            def grab(self, name):
+                idx = self.lora_pool.acquire(name)
+                return idx
+        """})
+    write_baseline(root, [
+        ("pairing", "seldon_core_tpu/engine/pool.py",
+         'idx = self.lora_pool.acquire(name)'),
+    ])
+    assert run(root, "--rules", "pairing") == 0
+
+
+def test_stale_baseline_entry_fails(tmp_path, capsys):
+    root = build(tmp_path, {"seldon_core_tpu/engine/pool.py": "X = 1\n"})
+    write_baseline(root, [
+        ("pairing", "seldon_core_tpu/engine/pool.py", "ghost = acquire()"),
+    ])
+    assert run(root, "--rules", "pairing") == 1
+    assert "stale-baseline" in capsys.readouterr().out
+
+
+def test_write_baseline_refuses_clean_dirs(tmp_path, capsys):
+    root = build(tmp_path, {
+        "seldon_core_tpu/engine/pool.py": """\
+            class Handler:
+                def grab(self, name):
+                    idx = self.lora_pool.acquire(name)
+                    return idx
+            """,
+        "seldon_core_tpu/executor/slots.py": """\
+            class Slots:
+                def grab(self, name):
+                    idx = self.adapter_pool.acquire(name)
+                    return idx
+            """,
+    })
+    assert run(root, "--rules", "pairing", "--write-baseline") == 0
+    data = json.loads((root / core.BASELINE_NAME).read_text())
+    paths = [e["path"] for e in data["findings"]]
+    assert paths == ["seldon_core_tpu/engine/pool.py"]
+    assert "NOT written" in capsys.readouterr().out
+
+
+def test_cli_list_rules(capsys):
+    assert sctlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in RULES:
+        assert rule.id in out
+
+
+def test_cli_explain(capsys):
+    for rule_id in BY_ID:
+        assert sctlint_main(["--explain", rule_id]) == 0
+        assert rule_id in capsys.readouterr().out
+    assert sctlint_main(["--explain", "no-such-rule"]) == 2
+
+
+def test_cli_unknown_rule_filter(tmp_path):
+    assert run(tmp_path, "--rules", "bogus") == 2
+
+
+def test_cli_json_output(tmp_path, capsys):
+    root = build(tmp_path, {"seldon_core_tpu/engine/pool.py": """\
+        class Handler:
+            def grab(self, name):
+                idx = self.lora_pool.acquire(name)
+                return idx
+        """})
+    assert run(root, "--rules", "pairing", "--no-baseline", "--json") == 1
+    data = json.loads(capsys.readouterr().out)
+    assert data["new"] and data["new"][0]["rule"] == "pairing"
+
+
+# ----------------------------------------------------- repo meta-invariants
+
+def test_repo_lint_is_green():
+    """The tree itself passes `make lint-check`: all six rules, the
+    checked-in baseline, non-zero on anything new."""
+    assert sctlint_main([]) == 0
+
+
+def test_baseline_is_minimal_and_clean_dirs_are_empty():
+    entries = core.load_baseline(REPO / core.BASELINE_NAME)
+    for e in entries:
+        assert not e["path"].startswith(core.BASELINE_CLEAN_PREFIXES), (
+            f"baseline entry in must-be-clean dir: {e}"
+        )
+    # minimality: every entry still matches a live finding (no stale
+    # debt).  sctlint_main([]) above fails on stale entries; assert the
+    # property directly too so the intent survives CLI refactors
+    paths = [
+        REPO / "seldon_core_tpu", REPO / "tests", REPO / "docs",
+        REPO / "README.md",
+    ]
+    ctx = core.load_sources(REPO, paths)
+    report = core.run_rules(ctx, RULES, entries)
+    assert report.stale_baseline == []
+    assert report.bad_baseline == []
+
+
+def test_registry_covers_every_quoted_literal():
+    """Every quoted SCT_* literal in the package resolves in the
+    registry (prefix families count via their declared root)."""
+    lit = re.compile(r"""["'](SCT_[A-Z0-9_]*[A-Z0-9_])["']""")
+    missing = []
+    for p in sorted((REPO / "seldon_core_tpu").rglob("*.py")):
+        if "sctlint" in p.parts or p.name == "settings.py":
+            continue
+        for name in lit.findall(p.read_text()):
+            if name.rstrip("_") not in settings.REGISTRY:
+                missing.append((p.name, name))
+    assert not missing
+
+
+def test_registry_typed_getters():
+    env = {"SCT_HBM_GB": "8", "SCT_GEN_OVERLAP": "off"}
+    assert settings.get_float("SCT_HBM_GB", env) == 8.0
+    assert settings.get_bool("SCT_GEN_OVERLAP", env) is False
+    # defaults flow through when unset
+    assert settings.get_float("SCT_HBM_GB", {}) == 16.0
+    assert settings.get_bool("SCT_GEN_OVERLAP", {}) is True
+    with pytest.raises(KeyError):
+        settings.get_raw("SCT_NOT_DECLARED", {})
+
+
+def test_config_md_matches_registry():
+    want = settings.markdown_table() + "\n"
+    assert (REPO / "docs" / "CONFIG.md").read_text() == want

@@ -362,6 +362,7 @@ class TestOutlierDetectorService:
         with _pytest.raises(TypeError, match="score"):
             OutlierDetectorAdapter(NoScore())
 
+    @pytest.mark.slow
     def test_cli_end_to_end(self, tmp_path):
         """sct-microservice --service-type OUTLIER_DETECTOR over a real
         socket: the reference flow a user migrating a detector follows."""
