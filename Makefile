@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check lint-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check chaos-check lint-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -130,6 +130,19 @@ pack-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_packing.py -q
 	JAX_PLATFORMS=cpu BENCH_ONLY=PACKING BENCH_RUNS=1 \
 		BENCH_PACK_TOKENS=16 $(PYTHON) bench.py
+
+# chaos-plane gate (docs/RESILIENCE.md), CPU-safe: fault-plan grammar +
+# selector determinism + disarmed inertness, retry-budget/circuit-breaker
+# degradation, the live-migration bit-identity matrix (greedy, seeded
+# top-k, int8 KV, LoRA-salted) with abort/no-peer/torn-frame fallbacks,
+# the fake-apiserver control-plane e2e (retry ladder, token rotation,
+# watch 410 storms); then the chaos bench smoke (recovery p50/p99,
+# dropped/corrupted streams must be 0, disarmed gate cost)
+chaos-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chaos.py \
+		tests/test_kubesim.py -q
+	JAX_PLATFORMS=cpu BENCH_ONLY=CHAOS BENCH_RUNS=1 \
+		BENCH_CHAOS_ROUNDS=3 $(PYTHON) bench.py
 
 # invariant-aware static analysis (docs/STATIC_ANALYSIS.md): host-sync,
 # program-key, pairing, env-registry, async-discipline, test-hygiene.

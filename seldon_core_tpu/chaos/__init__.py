@@ -1,0 +1,197 @@
+"""Deterministic fault injection for the serving plane (docs/RESILIENCE.md).
+
+Seeded, site-registered fault points threaded through the hops that can
+actually fail in production — the gateway's upstream POSTs and h1
+splice, the disagg KV-handoff and prefix-pull clients, the multihost
+step broadcast, and the apiserver client — so every recovery path we
+ship is exercised by *injected* failure, not by the one hand-written
+unit test that imagined it.
+
+Activation is one env var::
+
+    SCT_CHAOS_PLAN="disagg.handoff.send:torn:hits=2;kube.watch:gone:times=3"
+    SCT_CHAOS_SEED=7     # probabilistic rules replay identically per seed
+
+With the plan unset (every production build), :data:`ENABLED` is False
+and every site costs ONE module-attribute check — the decode hot loop
+itself carries no sites at all (the audit in tests/test_perf.py keeps
+that honest).  Plan grammar + the site registry live in
+:mod:`seldon_core_tpu.chaos.plan`.
+
+Site idiom — ONE verb call per hop, so each request counts one arrival::
+
+    from seldon_core_tpu import chaos
+    ...
+    if chaos.ENABLED:
+        frame = await chaos.act("disagg.handoff.send", frame)
+
+:func:`act` interprets every kind at once: raisable kinds raise
+(reset → ``ConnectionResetError``, timeout → ``TimeoutError``,
+ioerror → ``OSError``, exit → ``os._exit``), slow/hang await their
+delay, torn returns a truncated payload.  Sync-only hops use
+:func:`fire` (raisable kinds) or :func:`mangle` (torn); sites with
+their own fault semantics (kube's 410 ``Gone``, a watch-stream drop)
+call :func:`check` directly and translate the rule kind themselves.
+All verbs are no-ops for rules bound to other sites.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import threading
+
+from seldon_core_tpu.chaos.plan import (  # noqa: F401  (re-exported)
+    KINDS,
+    SITES,
+    FaultPlan,
+    PlanError,
+    Rule,
+    parse_plan,
+)
+
+__all__ = [
+    "ENABLED", "SITES", "KINDS", "FaultPlan", "PlanError", "Rule",
+    "parse_plan", "configure", "configure_from_env", "reset", "check",
+    "fire", "mangle", "pause", "act", "snapshot",
+]
+
+# THE production-overhead gate: False means every site is one attribute
+# check and nothing below ever runs.
+ENABLED = False
+
+_plan: FaultPlan | None = None
+_rng = random.Random(0)
+_arrivals: dict[str, int] = {}
+_fired: dict[str, int] = {}
+_lock = threading.Lock()
+
+
+def configure(plan_text: str | None, seed: int = 0) -> None:
+    """(Re)arm the chaos plane from a plan string; None/empty disarms."""
+    global ENABLED, _plan, _rng
+    with _lock:
+        _arrivals.clear()
+        _fired.clear()
+        if not plan_text:
+            ENABLED = False
+            _plan = None
+            return
+        _plan = parse_plan(plan_text, seed)
+        _rng = random.Random(seed)
+        ENABLED = bool(_plan.rules)
+
+
+def configure_from_env(environ=None) -> None:
+    from seldon_core_tpu.runtime import settings
+
+    configure(
+        settings.get_str("SCT_CHAOS_PLAN", environ),
+        settings.get_int("SCT_CHAOS_SEED", environ),
+    )
+
+
+def reset() -> None:
+    """Disarm and zero all counters (test teardown)."""
+    configure(None)
+
+
+def check(site: str) -> Rule | None:
+    """Record one arrival at ``site``; the triggered rule, or None.
+
+    The generic verbs below are built on this — sites with their own
+    fault semantics (kube's 410 ``Gone``, a watch-stream drop) call it
+    directly and translate the rule kind themselves.
+    """
+    if site not in SITES:
+        raise PlanError(f"unregistered chaos site {site!r}")
+    if _plan is None:
+        return None
+    with _lock:
+        _arrivals[site] = arrival = _arrivals.get(site, 0) + 1
+        for rule in _plan.for_site(site):
+            if rule.matches(arrival, _rng):
+                _fired[site] = _fired.get(site, 0) + 1
+                return rule
+    return None
+
+
+def _raise_kind(site: str, rule: Rule) -> None:
+    if rule.kind == "reset":
+        raise ConnectionResetError(f"chaos[{site}]: injected connection reset")
+    if rule.kind == "timeout":
+        raise TimeoutError(f"chaos[{site}]: injected timeout")
+    if rule.kind == "ioerror":
+        raise OSError(f"chaos[{site}]: injected I/O error")
+    if rule.kind == "exit":
+        os._exit(rule.code)
+
+
+def fire(site: str) -> None:
+    """Raise the site's injected failure, if the plan says so now."""
+    rule = check(site)
+    if rule is not None:
+        _raise_kind(site, rule)
+    # torn/slow/hang/gone/drop/status are handled by mangle/pause/act/
+    # check call sites; a fire() arrival alone does not consume their
+    # semantics
+
+
+async def act(site: str, payload: bytes | None = None) -> bytes | None:
+    """ONE arrival, full interpretation — the idiom for hops where
+    several fault kinds apply (the handoff send, the gateway forward):
+    raisable kinds raise, slow/hang await their delay, torn returns the
+    truncated ``payload``; anything else passes ``payload`` through.
+    Calling fire+mangle+pause separately would count three arrivals per
+    hop and make hit-based plans unwritable."""
+    rule = check(site)
+    if rule is None:
+        return payload
+    _raise_kind(site, rule)
+    if rule.kind == "torn" and payload is not None:
+        return payload[: max(1, int(len(payload) * rule.frac))]
+    if rule.kind in ("slow", "hang"):
+        delay = (
+            rule.delay_ms if rule.kind == "slow" else max(rule.delay_ms, 60_000.0)
+        )
+        await asyncio.sleep(delay / 1e3)
+    return payload
+
+
+def mangle(site: str, data: bytes) -> bytes:
+    """Tear a byte payload (handoff frame, watch line) per the plan."""
+    rule = check(site)
+    if rule is None or rule.kind != "torn":
+        return data
+    keep = max(1, int(len(data) * rule.frac))
+    return data[:keep]
+
+
+async def pause(site: str) -> None:
+    """Inject a slow/hung peer: await the rule's delay."""
+    rule = check(site)
+    if rule is None or rule.kind not in ("slow", "hang"):
+        return
+    delay = rule.delay_ms if rule.kind == "slow" else max(rule.delay_ms, 60_000.0)
+    await asyncio.sleep(delay / 1e3)
+
+
+def snapshot() -> dict:
+    """Per-site arrival/fired counters — the chaos matrix's evidence
+    that a scenario actually injected what it claims."""
+    with _lock:
+        return {
+            "enabled": ENABLED,
+            "arrivals": dict(_arrivals),
+            "fired": dict(_fired),
+            "rules": [
+                {"site": r.site, "kind": r.kind, "fired": r.fired}
+                for r in (_plan.rules if _plan else [])
+            ],
+        }
+
+
+# arm from the environment at import: engines/gateways pick the plan up
+# with zero call-site wiring, and production (plan unset) stays inert
+configure_from_env()

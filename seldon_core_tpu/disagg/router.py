@@ -105,10 +105,50 @@ def prompt_chain_hashes(
     ]
 
 
+class _Breaker:
+    """Per-replica circuit breaker (docs/RESILIENCE.md).  Consecutive
+    forward failures ``open`` the circuit: the replica is ejected from
+    routing for the ejection window instead of letting p2c keep
+    re-picking a corpse.  After the window, exactly ONE request is
+    elected as the half-open probe; its success ``close``s the circuit,
+    its failure re-opens a fresh window.  All transitions run under the
+    router lock."""
+
+    __slots__ = ("fails", "until", "probing", "opens", "closes")
+
+    def __init__(self) -> None:
+        self.fails = 0          # consecutive forward failures
+        self.until = 0.0        # monotonic deadline of the ejection window
+        self.probing = False    # a half-open probe request is in flight
+        self.opens = 0
+        self.closes = 0
+
+    @property
+    def is_open(self) -> bool:
+        return self.until > 0.0
+
+    def open(self, until: float) -> None:
+        self.until = until
+        self.probing = False
+        self.opens += 1
+
+    def close(self) -> None:
+        self.fails = 0
+        self.until = 0.0
+        self.probing = False
+        self.closes += 1
+
+    def probe_open(self) -> None:
+        self.probing = True
+
+    def probe_close(self) -> None:
+        self.probing = False
+
+
 class _ReplicaState:
     __slots__ = (
         "hashes", "block_size", "queue_wait_ms", "inflight", "picked",
-        "updated",
+        "updated", "breaker",
     )
 
     def __init__(self) -> None:
@@ -118,6 +158,7 @@ class _ReplicaState:
         self.inflight: int = 0
         self.picked: int = 0
         self.updated: float = 0.0
+        self.breaker = _Breaker()
 
 
 def endpoint_key(ep: Any) -> str:
@@ -146,6 +187,17 @@ class ReplicaRouter:
         self.peer_yield = int(os.environ.get("SCT_GW_PEER_YIELD", "4") or 4)
         self.peer_hints = 0
         self.peer_yield_picks = 0
+        # circuit breaker (docs/RESILIENCE.md): SCT_GW_CB_FAILS consecutive
+        # forward failures eject a replica for SCT_GW_CB_EJECT_S, then one
+        # half-open probe re-admits it; 0 disables the breaker entirely
+        from seldon_core_tpu.runtime import settings as _settings
+
+        self.cb_fails = _settings.get_int("SCT_GW_CB_FAILS")
+        self.cb_eject_s = _settings.get_float("SCT_GW_CB_EJECT_S")
+        self.cb_opens = 0
+        self.cb_closes = 0
+        self.cb_probes = 0
+        self.cb_skipped_picks = 0
 
     # -- state feeds ---------------------------------------------------------
 
@@ -188,6 +240,64 @@ class ReplicaRouter:
             st = self._state(dep, ep_key)
             if st.inflight > 0:
                 st.inflight -= 1
+
+    def note_failure(self, dep: str, ep_key: str) -> None:
+        """One forward attempt against this replica failed (connect error,
+        timeout, or retryable 5xx).  Consecutive failures open the
+        breaker; a failed half-open probe re-opens a fresh window."""
+        if not self.cb_fails:
+            return
+        with self._lock:
+            breaker = self._state(dep, ep_key).breaker
+            was_probe = breaker.probing
+            breaker.probe_close()
+            breaker.fails += 1
+            if was_probe or breaker.fails >= self.cb_fails:
+                # sct: pairing-ok state machine — note_success closes the
+                # breaker when the replica's half-open probe succeeds
+                breaker.open(time.monotonic() + self.cb_eject_s)
+                self.cb_opens += 1
+
+    def note_success(self, dep: str, ep_key: str) -> None:
+        """A forward against this replica completed: reset the failure
+        streak and close an open circuit (successful half-open probe)."""
+        if not self.cb_fails:
+            return
+        with self._lock:
+            breaker = self._state(dep, ep_key).breaker
+            if breaker.is_open or breaker.probing or breaker.fails:
+                breaker.close()
+                self.cb_closes += 1
+
+    def _admissible(self, reps: dict, endpoints: Sequence[Any]) -> list:
+        """Endpoints the breaker lets this pick consider.  Expired-window
+        replicas elect the pick as their half-open probe (routed ahead of
+        prefix/p2c so exactly one request tests the replica); when every
+        endpoint is ejected the breaker fails static and routing proceeds
+        over all of them (shedding everything would turn a replica brownout
+        into a total outage)."""
+        now = time.monotonic()
+        usable: list[Any] = []
+        probe = None
+        for ep in endpoints:
+            st = reps.get(endpoint_key(ep))
+            breaker = st.breaker if st is not None else None
+            if breaker is None or not breaker.is_open:
+                usable.append(ep)
+            elif probe is None and now >= breaker.until and not breaker.probing:
+                probe = ep
+        if probe is not None:
+            st = reps.get(endpoint_key(probe))
+            # ownership transfer: note_success/note_failure close the probe
+            # when the gateway reports the attempt's outcome
+            st.breaker.probe_open()  # sct: pairing-ok outcome closes it
+            self.cb_probes += 1
+            return [probe]
+        if not usable:
+            return list(endpoints)
+        if len(usable) < len(endpoints):
+            self.cb_skipped_picks += 1
+        return usable
 
     def has_digests(self, dep: str) -> bool:
         """Cheap guard: is prompt extraction worth doing for this
@@ -237,6 +347,14 @@ class ReplicaRouter:
             return endpoints[0], None
         with self._lock:
             reps = self._deployments.get(dep, {})
+            if self.cb_fails:
+                endpoints = self._admissible(reps, endpoints)
+                if len(endpoints) == 1:
+                    # sole survivor (or the elected half-open probe):
+                    # nothing to score against
+                    chosen = endpoints[0]
+                    self._state(dep, endpoint_key(chosen)).picked += 1
+                    return chosen, None
             chosen = None
             hint: "tuple[str, int] | None" = None
             best_depth = 0
@@ -326,6 +444,11 @@ class ReplicaRouter:
                 "peer_pull": self.peer_pull,
                 "peer_hints": self.peer_hints,
                 "peer_yield_picks": self.peer_yield_picks,
+                "cb_fails": self.cb_fails,
+                "cb_opens": self.cb_opens,
+                "cb_closes": self.cb_closes,
+                "cb_probes": self.cb_probes,
+                "cb_skipped_picks": self.cb_skipped_picks,
                 "deployments": {
                     dep: {
                         ep: {
@@ -334,6 +457,13 @@ class ReplicaRouter:
                             "queue_wait_ms": round(st.queue_wait_ms, 3),
                             "inflight": st.inflight,
                             "picked": st.picked,
+                            "breaker": {
+                                "open": st.breaker.is_open,
+                                "probing": st.breaker.probing,
+                                "fails": st.breaker.fails,
+                                "opens": st.breaker.opens,
+                                "closes": st.breaker.closes,
+                            },
                         }
                         for ep, st in reps.items()
                     }
@@ -348,8 +478,10 @@ class RouterPoller:
     Polls every multi-upstream deployment's replicas: ``GET /stats/cache``
     for the prefix digest, ``GET /stats/qos`` for the queue-wait EWMA.
     Single-upstream records are skipped (nothing to choose).  Poll failures
-    clear the replica's digest — a dead or restarted replica must stop
-    attracting prefix traffic — but never raise.
+    never raise; ``SCT_GW_POLL_FAILS`` CONSECUTIVE failures clear the
+    replica's digest — a dead or restarted replica must stop attracting
+    prefix traffic, but one dropped poll (a GC pause, a blipped connection)
+    must not destroy its prefix affinity.
     """
 
     def __init__(
@@ -372,10 +504,15 @@ class RouterPoller:
         # "digests disabled" mode)
         self.poll_prefix = os.environ.get("SCT_GW_ROUTE_PREFIX", "1") != "0"
         self.timeout_s = float(timeout_s)
+        from seldon_core_tpu.runtime import settings as _settings
+
+        self.poll_fails = max(1, _settings.get_int("SCT_GW_POLL_FAILS"))
+        self._fail_streaks: dict[tuple[str, str], int] = {}
         self._task: asyncio.Task | None = None
         self._session: Any = None
         self.polls = 0
         self.errors = 0
+        self.digest_clears = 0
 
     async def _ensure_session(self):
         if self._session is None:
@@ -421,11 +558,19 @@ class RouterPoller:
             raise
         except Exception:
             self.errors += 1
-            # unreachable replica: drop its digest so prefix routing stops
-            # steering traffic at it; p2c still may (connect errors there
-            # surface as retries/503s with their own handling)
-            self.router.update_replica(rec.oauth_key, key, hashes=())
+            # unreachable replica: after SCT_GW_POLL_FAILS consecutive
+            # misses, drop its digest so prefix routing stops steering
+            # traffic at it; p2c still may (connect errors there surface
+            # as retries/503s with their own handling).  A single dropped
+            # poll keeps the digest — re-prefilling a warm working set
+            # costs far more than one optimistic route.
+            streak = self._fail_streaks.get((rec.oauth_key, key), 0) + 1
+            self._fail_streaks[(rec.oauth_key, key)] = streak
+            if streak >= self.poll_fails:
+                self.router.update_replica(rec.oauth_key, key, hashes=())
+                self.digest_clears += 1
             return
+        self._fail_streaks.pop((rec.oauth_key, key), None)
         hashes: set[str] = set()
         block_size = 0
         for snap in (cache.get("prefix") or {}).values():
@@ -480,5 +625,7 @@ class RouterPoller:
             "interval_s": self.interval_s,
             "polls": self.polls,
             "errors": self.errors,
+            "poll_fails": self.poll_fails,
+            "digest_clears": self.digest_clears,
             "running": self._task is not None and not self._task.done(),
         }

@@ -29,6 +29,7 @@ from seldon_core_tpu.contract import (
     payload_from_dict,
     payload_to_dict,
 )
+from seldon_core_tpu import chaos
 from seldon_core_tpu import disagg as disagg_mod
 from seldon_core_tpu import qos
 from seldon_core_tpu.engine.service import PredictionService, load_predictor_spec
@@ -219,6 +220,16 @@ class EngineApp:
         # missing a prefix chain pulls the serialized KV from the replica
         # whose digest advertises it, instead of re-prefilling
         r.add_post("/disagg/prefix/pull", self.disagg_prefix_pull)
+        # live-migration plane (docs/RESILIENCE.md "drain runbook"):
+        # drain = pause admission, quiesce every active stream into its
+        # suspend record, then ship each record bit-exactly to a peer
+        # replica through the v4 handoff codec — or park locally until
+        # /admin/undrain when no peer is named
+        r.add_post("/admin/drain", self.admin_drain)
+        r.add_post("/admin/undrain", self.admin_undrain)
+        # chaos-plane evidence: per-site arrival/fired counters proving a
+        # scenario injected what it claims (empty when SCT_CHAOS_PLAN unset)
+        r.add_get("/stats/chaos", self.stats_chaos)
         r.add_get("/stats/disagg", self.stats_disagg)
         # per-request generation lifecycle ledger (obs/timeline.py):
         # ?trace=<id> reconstructs one request's whole story after the fact
@@ -984,19 +995,27 @@ class EngineApp:
             )
         return self._handoff_session
 
-    async def _send_handoff(self, frame: bytes) -> list[int]:
+    async def _send_handoff(
+        self,
+        frame: bytes,
+        target: str | None = None,
+        extra_headers: dict[str, str] | None = None,
+    ) -> list[int]:
         """POST one handoff frame to a decode peer — power-of-two-choices
-        on outstanding handoffs when several are configured."""
-        ups = self.decode_upstreams
-        if len(ups) == 1:
-            target = ups[0]
-        else:
-            import random
+        on outstanding handoffs when several are configured, or to an
+        explicit ``target`` (the drain endpoint's named peer)."""
+        if target is None:
+            ups = self.decode_upstreams
+            if len(ups) == 1:
+                target = ups[0]
+            else:
+                import random
 
-            a, b = random.sample(range(len(ups)), 2)
-            target = min(
-                (ups[a], ups[b]), key=lambda u: self._handoff_inflight.get(u, 0)
-            )
+                a, b = random.sample(range(len(ups)), 2)
+                target = min(
+                    (ups[a], ups[b]),
+                    key=lambda u: self._handoff_inflight.get(u, 0),
+                )
         self._ensure_handoff_session()
         from seldon_core_tpu.qos.context import outgoing_qos_headers
         from seldon_core_tpu.utils.tracectx import outgoing_headers
@@ -1006,6 +1025,13 @@ class EngineApp:
             **outgoing_headers(),
             **outgoing_qos_headers(),
         }
+        if extra_headers:
+            headers.update(extra_headers)
+        if chaos.ENABLED:
+            # injected peer death / torn frame / slow peer on the handoff
+            # hop — a raise here lands in the caller's fallback path, a
+            # torn frame is rejected by the importer's codec check
+            frame = await chaos.act("disagg.handoff.send", frame)
         self._handoff_inflight[target] = self._handoff_inflight.get(target, 0) + 1
         try:
             async with self._handoff_session.post(
@@ -1073,6 +1099,20 @@ class EngineApp:
                 frame_tp = payload.get("traceparent")
                 if frame_tp:
                     set_traceparent(str(frame_tp))
+                # drain cutover (docs/RESILIENCE.md): the draining source
+                # stamps its sampling-seed counter on each migrated frame;
+                # adopting it makes the sampled continuation bit-identical
+                # to the stream the source would have produced
+                drain_seed = request.headers.get("x-sct-drain-seed")
+                if drain_seed is not None:
+                    try:
+                        unit.scheduler.adopt_seed(int(drain_seed))
+                    except (TypeError, ValueError):
+                        h["code"] = "400"
+                        return web.json_response(
+                            _status_body(400, "bad x-sct-drain-seed"),
+                            status=400,
+                        )
                 with RECORDER.span("disagg.import", service=dep) as isp:
                     if isp is not None:
                         isp.set_attr("handoff.version", int(payload.get("hv", 1)))
@@ -1278,6 +1318,11 @@ class EngineApp:
             if adapter:
                 req["adapter"] = adapter
             session = self._ensure_handoff_session()
+            if chaos.ENABLED:
+                # injected slow/dead peer on the pull hop: a raise here is
+                # swallowed by the failure ledger below — the request falls
+                # back to plain suffix prefill, never fails
+                await chaos.act("disagg.prefix.pull")
             with RECORDER.span(
                 "prefix.pull", service=self.service.deployment_name
             ) as sp:
@@ -1347,6 +1392,129 @@ class EngineApp:
                 }
             }
         )
+
+    # -- live migration (docs/RESILIENCE.md "drain runbook") ----------------
+
+    async def admin_drain(self, request: web.Request) -> web.Response:
+        """Replace this engine under live traffic: pause admission, suspend
+        every active stream bit-exactly at the next sync point, then ship
+        each suspend record — a v4 handoff frame — to ``peer``'s
+        ``/disagg/import``.  The peer adopts this scheduler's sampling-seed
+        counter (``x-sct-drain-seed``), so each migrated stream's remaining
+        tokens are bit-identical to the uninterrupted run; the relay feeds
+        them through the original request's streaming hook, so the client
+        sees ONE stream.  A stream the peer refuses re-parks and resumes
+        locally — a failed migration never kills a generation.  With no
+        ``peer`` the records stay parked and admission stays paused until
+        ``POST /admin/undrain``.
+
+        Body (all optional): ``{"peer": "host:port", "timeout_s": 30}``."""
+        import time as _time
+
+        dep, pred = self.service.deployment_name, self.service.predictor.name
+        with self.metrics.time_server_request(dep, pred, "admin_drain", "POST") as h:
+            unit, reason = self._single_generative_unit()
+            if unit is None:
+                h["code"] = "400"
+                return web.json_response(_status_body(400, reason), status=400)
+            body: dict[str, Any] = {}
+            if request.can_read_body:
+                try:
+                    body = await self._json(request)
+                except CodecError as e:
+                    h["code"] = "400"
+                    return web.json_response(_status_body(400, str(e)), status=400)
+            peer = body.get("peer")
+            peer = str(peer) if peer else None
+            try:
+                timeout_s = float(body.get("timeout_s", 30.0))
+            except (TypeError, ValueError):
+                h["code"] = "400"
+                return web.json_response(
+                    _status_body(400, "bad timeout_s"), status=400
+                )
+            sched = unit.scheduler
+            if getattr(sched, "_draining", False):
+                h["code"] = "409"
+                return web.json_response(
+                    _status_body(409, "drain already in progress"), status=409
+                )
+            t0 = _time.perf_counter()
+            # no-peer path: the matching drain_finish lives in /admin/undrain
+            sched.drain_begin()  # sct: pairing-ok undrain lifts it
+            quiesced = await sched.drain_wait_quiesced(timeout_s)
+            migrated, failed = 0, []
+            if peer:
+                pairs = sched.drain_take()
+                # every frame carries the SAME counter value: adoption is
+                # idempotent, and the peer continues the seed sequence
+                # exactly where this scheduler stopped
+                drain_headers = {"x-sct-drain-seed": str(sched._seed)}
+                try:
+                    for req, frame in pairs:
+                        try:
+                            tokens = await self._send_handoff(
+                                frame, target=peer, extra_headers=drain_headers
+                            )
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception as e:
+                            log.warning(
+                                "drain: migrating one stream to %s failed "
+                                "(%s); it will resume locally", peer, e,
+                            )
+                            failed.append((req, frame))
+                            continue
+                        sched.complete_migrated(req, tokens)
+                        migrated += 1
+                finally:
+                    # CancelledError mid-loop must not strand unmigrated
+                    # streams: everything not relayed re-parks, then the
+                    # drain lifts and parked records resume locally
+                    for rest in pairs[migrated + len(failed):]:
+                        failed.append(rest)
+                    if failed:
+                        sched.drain_abort(failed)
+                    sched.drain_finish()
+            snap = sched.packing_snapshot()
+            return web.json_response(
+                {
+                    "quiesced": bool(quiesced),
+                    "peer": peer,
+                    "migrated": migrated,
+                    "failed": len(failed),
+                    "parked": int(snap.get("suspended", 0)),
+                    "draining": bool(snap.get("draining", False)),
+                    "duration_ms": round(
+                        (_time.perf_counter() - t0) * 1e3, 3
+                    ),
+                }
+            )
+
+    async def admin_undrain(self, request: web.Request) -> web.Response:
+        """Lift a no-peer drain: admission resumes and every parked record
+        re-queues as an imported admission, continuing bit-exactly."""
+        dep, pred = self.service.deployment_name, self.service.predictor.name
+        with self.metrics.time_server_request(
+            dep, pred, "admin_undrain", "POST"
+        ) as h:
+            unit, reason = self._single_generative_unit()
+            if unit is None:
+                h["code"] = "400"
+                return web.json_response(_status_body(400, reason), status=400)
+            sched = unit.scheduler
+            if not getattr(sched, "_draining", False):
+                h["code"] = "409"
+                return web.json_response(
+                    _status_body(409, "engine is not draining"), status=409
+                )
+            sched.drain_finish()
+            return web.json_response(
+                {"draining": False, "resuming": True}
+            )
+
+    async def stats_chaos(self, request: web.Request) -> web.Response:
+        return web.json_response({"chaos": chaos.snapshot()})
 
 
 def main(argv: list[str] | None = None) -> None:

@@ -46,6 +46,42 @@ async def retry_backoff(attempt: int) -> None:
     await asyncio.sleep(RETRY_BASE_DELAY_S * (2**attempt) * (0.5 + random.random()))
 
 
+class RetryBudget:
+    """Token-bucket retry budget (docs/RESILIENCE.md).  Each forwarded
+    request ``earn``s ``rate`` retry tokens (capped at ``burst``); each
+    retry ``spend``s one.  Under sustained upstream failure the retry
+    amplification is bounded at ~``rate`` — retries must never turn a
+    replica brownout into a self-inflicted flood.  Single-owner (one
+    event loop); callers on threads need their own instance."""
+
+    def __init__(self, burst: float, rate: float):
+        self.burst = max(0.0, float(burst))
+        self.rate = max(0.0, float(rate))
+        self.tokens = self.burst
+        self.spent = 0
+        self.denied = 0
+
+    def earn(self) -> None:
+        self.tokens = min(self.burst, self.tokens + self.rate)
+
+    def spend(self) -> bool:
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            self.spent += 1
+            return True
+        self.denied += 1
+        return False
+
+    def snapshot(self) -> dict:
+        return {
+            "tokens": round(self.tokens, 3),
+            "burst": self.burst,
+            "rate": self.rate,
+            "spent": self.spent,
+            "denied": self.denied,
+        }
+
+
 class _RetryableConnect(Exception):
     """Connection never established — safe to retry any method."""
 
@@ -60,13 +96,26 @@ class _RetryableSent(Exception):
         self.cause = cause
 
 
-async def retry_loop(attempt, *, idempotent: bool, attempts: int = RETRY_ATTEMPTS):
+async def retry_loop(
+    attempt,
+    *,
+    idempotent: bool,
+    attempts: int = RETRY_ATTEMPTS,
+    budget: "RetryBudget | None" = None,
+    backoff=None,
+):
     """THE bounded-retry skeleton for every hop (engine REST, engine gRPC,
     gateway->engine — one policy, three classifiers).  ``attempt(i)``
     returns the result or raises: ``_RetryableConnect`` (connection never
     made — retry anything), ``_RetryableSent`` (may have reached the peer —
     retry only idempotent methods), anything else (no retry).  On
-    exhaustion the LAST classified error's ``cause`` is raised."""
+    exhaustion the LAST classified error's ``cause`` is raised.
+
+    ``budget`` (when given) gates every retry through a
+    :class:`RetryBudget` — an empty bucket surfaces the last cause
+    immediately instead of amplifying a brownout.  ``backoff`` overrides
+    the default inter-attempt delay (an ``async f(i)``; the gateway
+    passes its capped jittered schedule)."""
     last: Exception | None = None
     for i in range(attempts):
         try:
@@ -78,7 +127,9 @@ async def retry_loop(attempt, *, idempotent: bool, attempts: int = RETRY_ATTEMPT
                 raise e.cause
             last = e.cause
         if i < attempts - 1:
-            await retry_backoff(i)
+            if budget is not None and not budget.spend():
+                raise last
+            await (backoff(i) if backoff is not None else retry_backoff(i))
     raise last  # type: ignore[misc]
 
 

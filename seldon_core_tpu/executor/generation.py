@@ -3102,6 +3102,15 @@ class GenerationScheduler:
         self.suspends = 0
         self.resumes = 0
         self.suspend_rejected = 0
+        # live migration (docs/RESILIENCE.md): drain_begin pauses
+        # admission and parks every active slot; the engine's /admin/drain
+        # endpoint then ships the frames to a peer (or drain_finish
+        # resumes them locally).  _quiesced fires in the run loop once no
+        # slot is device-resident.
+        self._draining = False
+        self._quiesced = asyncio.Event()
+        self.drains = 0
+        self.drained_out = 0
         # Random base so temperature>0 sampling differs across restarts and
         # replicas; within one process the sequence stays deterministic.
         self._seed = int.from_bytes(os.urandom(4), "little")
@@ -3665,11 +3674,118 @@ class GenerationScheduler:
             keep.append(rec)
         self._suspended[:] = keep
 
+    # -- live migration (docs/RESILIENCE.md "drain runbook") ---------------
+
+    def drain_begin(self) -> None:
+        """Admin verb, the device half of live migration: pause admission
+        and suspend every active slot at the next sync point (the same
+        bit-exact export preemption uses).  Pair with :meth:`drain_finish`
+        once the frames have moved to a peer — or immediately, to resume
+        everything locally when there is no peer."""
+        self._draining = True
+        # clear, never replace: drain_wait_quiesced may already hold this
+        # event, and a waiter on a replaced one would hang forever
+        self._quiesced.clear()
+        self.drains += 1
+        self._preempt = True
+        self._wake.set()
+
+    async def drain_wait_quiesced(self, timeout_s: float = 30.0) -> bool:
+        """Block until no slot is device-resident (suspend records are
+        parked; slots the store refused ran to completion)."""
+        try:
+            await asyncio.wait_for(self._quiesced.wait(), timeout_s)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    def drain_take(self) -> list[tuple["_Request", bytes]]:
+        """Pop every parked suspend record as ``(request, frame)`` — the
+        migration payload, bit-exact v4 handoff frames.  Ownership of each
+        request's completion moves to the caller (the drain endpoint
+        relays the peer's continuation through
+        :meth:`complete_migrated`)."""
+        out: list[tuple[_Request, bytes]] = []
+        while self._suspended:
+            rec = self._suspended.pop(0)
+            req = rec["req"]
+            frame = (
+                self._suspend_store.take(rec["key"])
+                if self._suspend_store is not None
+                else None
+            )
+            if req.future.done():
+                self._end_tl(req, "disconnect", stage="suspended")
+                continue
+            if frame is None:
+                req.future.set_exception(
+                    GraphUnitError("suspend record lost from the store")
+                )
+                self._end_tl(req, "error", stage="suspended")
+                continue
+            self.drained_out += 1
+            self._tl(req, "drain-export", bytes=len(frame))
+            out.append((req, frame))
+        return out
+
+    def drain_abort(self, pairs: list[tuple["_Request", bytes]]) -> None:
+        """The peer refused or died mid-migration: re-park the frames so
+        :meth:`drain_finish` resumes them locally — a failed migration
+        must never kill a generation."""
+        store = self._get_suspend_store()
+        for req, frame in pairs:
+            if req.future.done():
+                continue
+            self._suspend_seq += 1
+            key = (id(req), self._suspend_seq)
+            if store.put(key, frame):
+                self._suspended.append(
+                    {"req": req, "key": key, "bytes": len(frame)}
+                )
+                self._tl(req, "drain-abort", span=False)
+            else:
+                req.future.set_exception(
+                    GraphUnitError("drain abort: suspend store full")
+                )
+                self._end_tl(req, "error", stage="suspended")
+
+    def complete_migrated(self, req: "_Request", tokens) -> None:
+        """Finish a migrated request with the peer's continuation.
+        ``tokens[0]`` is the carry token (already delivered here before
+        the drain); the rest stream through the request's hook and the
+        future resolves with the full output — the client sees ONE
+        uninterrupted stream."""
+        for t in tokens[1:]:
+            if self._token_done(req, int(t)):
+                break
+        req.done_reason = req.done_reason or "budget"
+        self._complete(req)
+        self._finish_tl(req)
+
+    def drain_finish(self) -> None:
+        """Lift the drain: admission resumes, and any records still
+        parked (the no-peer path, or after :meth:`drain_abort`) re-queue
+        and resume locally bit-exactly."""
+        self._draining = False
+        self._preempt = False
+        self._wake.set()
+
+    def adopt_seed(self, seed: int) -> None:
+        """Drain cutover, REPLACEMENT-replica side: adopt the source's
+        sampling-seed counter so migrated sampled streams continue with
+        the exact keys the uninterrupted run would have used (greedy
+        streams don't care).  Meant for a fresh engine taking over; any
+        counter value is *valid* — this only pins determinism."""
+        self._seed = int(seed) % (2**31 - 1)
+
     def packing_snapshot(self) -> dict:
         """Per-deployment packing ledger (``GET /stats/breakdown``)."""
         return {
             "arbitrated": self._arbiter is not None,
             "preempted": self._preempt,
+            "draining": self._draining,
+            "drains": self.drains,
+            "drained_out": self.drained_out,
             "suspended": len(self._suspended),
             "suspends": self.suspends,
             "resumes": self.resumes,
@@ -3687,6 +3803,10 @@ class GenerationScheduler:
         self.detach_arbiter()
         if self._task is not None:
             self._task.cancel()
+            # a cancel landing while the loop sits on an already-completed
+            # wait_for is swallowed (bpo-42130); wake it so the loop's own
+            # _closed check at the top of the iteration still exits
+            self._wake.set()
             try:
                 await self._task
             except asyncio.CancelledError:
@@ -3914,6 +4034,10 @@ class GenerationScheduler:
         carry_dirty = True
         try:
             while True:
+                if self._closed:
+                    # close() may have lost its cancel to a completed
+                    # wait_for (bpo-42130); route through the same cleanup
+                    raise asyncio.CancelledError
                 self._reap_queues()
                 self._reap_suspended()
                 if pending is None and self._external_release:
@@ -3948,6 +4072,12 @@ class GenerationScheduler:
                     # granularity; spinning would starve the co-tenant's
                     # event-loop turns.
                     self._arb_release()
+                    if self._draining and not self._quiesced.is_set():
+                        # drain verb: nothing device-resident any more —
+                        # every active slot is parked (or ran to completion
+                        # when the store refused it); the migration's
+                        # export half may proceed
+                        self._quiesced.set()
                     for q in (self._waiting, self._overflow):
                         for r in q:
                             self._tl(

@@ -48,6 +48,8 @@ from typing import Any, Callable
 
 import numpy as np
 
+from seldon_core_tpu import chaos
+
 log = logging.getLogger(__name__)
 
 HEADER_BYTES = 64 * 1024
@@ -265,6 +267,11 @@ class MultihostDriver:
         fn = self._fns[key]
         meta = encode_step(key, payload)
         with self._lock:
+            if chaos.ENABLED:
+                # injected BEFORE the broadcast: the slice never sees a
+                # partial step, the caller sees a failed one — the
+                # scheduler's fail-inflight path, not a wedged collective
+                chaos.fire("mh.step")
             self._send(_OP_STEP, meta)
             self._last_step = time.monotonic()
             return fn(payload)
@@ -328,6 +335,12 @@ class MultihostDriver:
                 )
                 os._exit(13)
             try:
+                if chaos.ENABLED:
+                    # exit-kind rules kill the process outright (simulated
+                    # follower death); raisable kinds land in the FATAL
+                    # handler below — both end in the supervisor restart
+                    # the production failure would
+                    chaos.fire("mh.follower")
                 fn(payload)
             except Exception:
                 log.exception(

@@ -144,6 +144,19 @@ class GatewayApp:
 
         self.router = ReplicaRouter()
         self.poller = RouterPoller(store, self.router)
+        # graceful degradation (docs/RESILIENCE.md): per-deployment retry
+        # budgets bound the gateway's retry amplification under sustained
+        # upstream failure, and the jittered exponential backoff below
+        # replaces the transport default on the forward path
+        from seldon_core_tpu.runtime import settings as _settings
+
+        self._retry_budgets: dict[str, "RetryBudget"] = {}
+        self._retry_burst = _settings.get_float("SCT_GW_RETRY_BUDGET")
+        self._retry_rate = _settings.get_float("SCT_GW_RETRY_RATE")
+        self._retry_backoff_ms = _settings.get_float("SCT_GW_RETRY_BACKOFF_MS")
+        self._retry_backoff_max_ms = _settings.get_float(
+            "SCT_GW_RETRY_BACKOFF_MAX_MS"
+        )
         # removed deployments lose their live tokens immediately
         store.add_listener(self._on_deployment_event)
 
@@ -205,6 +218,29 @@ class GatewayApp:
             )
             self._qos[rec.oauth_key] = ctl
         return ctl
+
+    def retry_budget_for(self, rec: DeploymentRecord) -> "RetryBudget":
+        """Per-deployment retry budget: one tenant's failing upstream
+        must not spend another tenant's retries."""
+        from seldon_core_tpu.engine.transport import RetryBudget
+
+        budget = self._retry_budgets.get(rec.oauth_key)
+        if budget is None:
+            budget = RetryBudget(self._retry_burst, self._retry_rate)
+            self._retry_budgets[rec.oauth_key] = budget
+        return budget
+
+    async def _retry_backoff(self, i: int) -> None:
+        """Jittered exponential backoff between forward attempts,
+        capped (SCT_GW_RETRY_BACKOFF_MS / _MAX_MS): synchronized retry
+        waves against a recovering replica are their own outage."""
+        import random
+
+        delay_ms = min(
+            self._retry_backoff_max_ms,
+            self._retry_backoff_ms * (2 ** i) * (0.5 + random.random()),
+        )
+        await asyncio.sleep(delay_ms / 1e3)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -358,8 +394,24 @@ class GatewayApp:
             fwd_headers["x-sct-prefix-depth"] = str(int(peer_hint[1]))
         fwd_headers = fwd_headers or None
 
+        from seldon_core_tpu import chaos
+
+        # per-deployment retry budget: this request earns its fractional
+        # token here; the breaker feed below tells the router how the
+        # replica behaved so ejection/half-open probing can act on it
+        budget = self.retry_budget_for(rec)
+        budget.earn()
+
+        def _note(ok: bool) -> None:
+            if ep is not None:
+                (self.router.note_success if ok else self.router.note_failure)(
+                    rec.oauth_key, ep.key
+                )
+
         async def attempt(i: int) -> tuple[int, bytes]:
             try:
+                if chaos.ENABLED:
+                    await chaos.act("gw.forward")
                 resp = await pool.post(
                     path, raw, headers=fwd_headers, timeout=self.timeout_s
                 )
@@ -369,15 +421,24 @@ class GatewayApp:
                     # the last attempt returns the real response
                     and i < RETRY_ATTEMPTS - 1
                 ):
+                    _note(False)
                     raise _RetryableSent(_UpstreamError(resp.status, resp.body))
+                _note(resp.status not in RETRYABLE_HTTP)
                 return resp.status, resp.body
             except H1ConnectError as e:
+                _note(False)
                 raise _RetryableConnect(e) from e
             except (ConnectionError, asyncio.TimeoutError, OSError) as e:
+                _note(False)
                 raise _RetryableSent(e) from e
 
         try:
-            status, body = await retry_loop(attempt, idempotent=idempotent)
+            status, body = await retry_loop(
+                attempt,
+                idempotent=idempotent,
+                budget=budget,
+                backoff=self._retry_backoff,
+            )
         except _UpstreamError as e:
             status, body = e.status, e.body
         finally:

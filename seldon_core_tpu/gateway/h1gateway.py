@@ -59,6 +59,7 @@ from seldon_core_tpu.utils.tracectx import (
     new_traceparent,
     parse_traceparent,
 )
+from seldon_core_tpu import chaos
 from seldon_core_tpu.wire.h2grpc import _dual_stack_socket
 from seldon_core_tpu.wire.iobuf import WriteCoalescer
 
@@ -208,6 +209,19 @@ class _UpConn(WriteCoalescer, asyncio.Protocol):
     def send_request(self, job: _Job) -> None:
         job.up = self
         self.fifo.append(job)
+        if chaos.ENABLED:
+            rule = chaos.check("gw.h1")
+            if rule is not None:
+                # protocol context — nothing to raise into, so both kinds
+                # kill the engine conn mid-splice: torn writes a partial
+                # request first.  connection_lost runs the replay budget
+                # for the whole FIFO, exactly as a real engine death would.
+                if rule.kind == "torn":
+                    self.queue_write(
+                        job.raw[: max(1, int(len(job.raw) * rule.frac))]
+                    )
+                self.close()
+                return
         self.queue_write(job.raw)
 
     # -- response side ------------------------------------------------------

@@ -19,7 +19,7 @@ import asyncio
 import logging
 
 from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
-from seldon_core_tpu.operator.kube import Gone, KubeApi
+from seldon_core_tpu.operator.kube import Gone, KubeApi, RelistDamper
 from seldon_core_tpu.operator.names import deployment_service_name
 from seldon_core_tpu.operator.resources import ENGINE_GRPC_PORT, ENGINE_REST_PORT
 
@@ -51,6 +51,7 @@ class GatewayWatcher:
         self.namespace = namespace
         self.resync_s = resync_s
         self.resource_version = ""
+        self.damper = RelistDamper()
         self._tasks: list[asyncio.Task] = []
 
     async def start(self) -> None:
@@ -80,9 +81,11 @@ class GatewayWatcher:
                 ):
                     self._apply(event, raw)
                     self._note_rv(raw)
+                    self.damper.reset()
             except Gone:
                 log.info("gateway CR watch resourceVersion gone; relisting")
                 self.resource_version = ""
+                await self.damper.wait()
                 continue
             except asyncio.CancelledError:
                 raise

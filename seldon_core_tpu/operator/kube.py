@@ -31,6 +31,60 @@ class Gone(Exception):
     """Watch resourceVersion too old (HTTP 410) — restart from a list."""
 
 
+class RelistDamper:
+    """Backoff between consecutive 410-Gone relists (docs/RESILIENCE.md).
+
+    A compacted etcd — or an injected 410 storm — would otherwise turn
+    the watch loop into a hot list+watch spin against the very apiserver
+    that is telling it to slow down.  The first Gone relists immediately
+    (the common single-compaction case costs nothing); every consecutive
+    one after that backs off exponentially with jitter, capped
+    (``SCT_WATCH_BACKOFF_MS`` / ``SCT_WATCH_BACKOFF_MAX_MS``).  Any
+    successfully processed watch event resets the streak."""
+
+    def __init__(self, base_ms: float | None = None, max_ms: float | None = None):
+        from seldon_core_tpu.runtime import settings
+
+        self.base_ms = (
+            settings.get_float("SCT_WATCH_BACKOFF_MS")
+            if base_ms is None else float(base_ms)
+        )
+        self.max_ms = (
+            settings.get_float("SCT_WATCH_BACKOFF_MAX_MS")
+            if max_ms is None else float(max_ms)
+        )
+        self.streak = 0
+        self.relists = 0
+        self.slept_ms = 0.0
+
+    def reset(self) -> None:
+        self.streak = 0
+
+    async def wait(self) -> None:
+        import random
+
+        self.relists += 1
+        self.streak += 1
+        if self.streak <= 1:
+            return
+        delay_ms = min(
+            self.max_ms,
+            self.base_ms * (2 ** (self.streak - 2)) * (0.5 + random.random()),
+        )
+        self.slept_ms += delay_ms
+        await asyncio.sleep(delay_ms / 1e3)
+
+
+def _merge_patch(target: dict[str, Any], patch: dict[str, Any]) -> None:
+    for k, v in patch.items():
+        if v is None:
+            target.pop(k, None)
+        elif isinstance(v, dict) and isinstance(target.get(k), dict):
+            _merge_patch(target[k], v)
+        else:
+            target[k] = copy.deepcopy(v)
+
+
 class KubeApi(Protocol):
     async def get(self, kind: str, namespace: str, name: str) -> dict[str, Any]: ...
 
@@ -41,6 +95,10 @@ class KubeApi(Protocol):
     async def create(self, kind: str, namespace: str, obj: dict[str, Any]) -> dict[str, Any]: ...
 
     async def update(self, kind: str, namespace: str, obj: dict[str, Any]) -> dict[str, Any]: ...
+
+    async def patch(
+        self, kind: str, namespace: str, name: str, patch: dict[str, Any]
+    ) -> dict[str, Any]: ...
 
     async def delete(self, kind: str, namespace: str, name: str) -> None: ...
 
@@ -122,6 +180,19 @@ class FakeKube:
             raise NotFound(f"{kind}/{namespace}/{name}")
         obj = self._stamp(obj)
         obj["metadata"].setdefault("namespace", namespace)
+        self._objects[key] = obj
+        self._emit("MODIFIED", kind, obj)
+        return copy.deepcopy(obj)
+
+    async def patch(self, kind, namespace, name, patch) -> dict[str, Any]:
+        """RFC 7386 JSON merge-patch: dicts merge recursively, ``None``
+        deletes a key, everything else replaces."""
+        key = self._key(kind, namespace, name)
+        if key not in self._objects:
+            raise NotFound(f"{kind}/{namespace}/{name}")
+        obj = copy.deepcopy(self._objects[key])
+        _merge_patch(obj, patch)
+        obj = self._stamp(obj)
         self._objects[key] = obj
         self._emit("MODIFIED", kind, obj)
         return copy.deepcopy(obj)

@@ -10,6 +10,7 @@ loop relists (reference: SeldonDeploymentWatcher.java:113-117).
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import os
@@ -17,12 +18,19 @@ from typing import Any, AsyncIterator
 
 import httpx
 
+from seldon_core_tpu import chaos
 from seldon_core_tpu.operator.crd import CRD_GROUP, CRD_PLURAL
 from seldon_core_tpu.operator.kube import Conflict, Gone, NotFound
 
 log = logging.getLogger(__name__)
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# Backoff between retried API-server calls: the server's Retry-After wins
+# when present (priority-and-fairness sends one on every 429); otherwise
+# capped jittered exponential.  Attempt count: SCT_KUBE_RETRIES.
+_RETRY_BASE_S = 0.1
+_RETRY_MAX_S = 5.0
 
 _KIND_PATHS = {
     "Deployment": ("/apis/apps/v1", "deployments"),
@@ -51,11 +59,23 @@ class HttpKube:
     """KubeApi over httpx.  ``base_url`` default: in-cluster; pass
     ``http://127.0.0.1:8001`` for `kubectl proxy`."""
 
-    def __init__(self, base_url: str | None = None, timeout_s: float = 30.0):
+    def __init__(
+        self,
+        base_url: str | None = None,
+        timeout_s: float = 30.0,
+        token_path: str | None = None,
+    ):
         if base_url is None:
             base_url, headers, verify = in_cluster_config()
+            token_path = token_path or os.path.join(SA_DIR, "token")
         else:
             headers, verify = {}, None
+            if token_path and os.path.exists(token_path):
+                with open(token_path) as f:
+                    headers["Authorization"] = f"Bearer {f.read().strip()}"
+        self._token_path = token_path
+        self.retries = 0
+        self.token_rereads = 0
         self._client = httpx.AsyncClient(
             base_url=base_url,
             headers=headers,
@@ -65,6 +85,91 @@ class HttpKube:
 
     async def close(self) -> None:
         await self._client.aclose()
+
+    # -- request plumbing --------------------------------------------------
+
+    def _reread_token(self) -> bool:
+        """On 401, re-read the SA token file — kubelet rotates projected
+        tokens and a long-lived client must pick up the new one instead of
+        crash-looping on stale credentials.  -> True when the header
+        actually changed (else retrying is pointless)."""
+        if not self._token_path:
+            return False
+        try:
+            with open(self._token_path) as f:
+                token = f.read().strip()
+        except OSError:
+            return False
+        header = f"Bearer {token}"
+        if not token or self._client.headers.get("Authorization") == header:
+            return False
+        self._client.headers["Authorization"] = header
+        return True
+
+    @staticmethod
+    async def _retry_pause(attempt: int, retry_after: str | None) -> None:
+        import random
+
+        if retry_after is not None:
+            try:
+                delay_s = min(float(retry_after), _RETRY_MAX_S)
+            except ValueError:
+                delay_s = _RETRY_BASE_S
+        else:
+            delay_s = min(
+                _RETRY_MAX_S, _RETRY_BASE_S * (2**attempt) * (0.5 + random.random())
+            )
+        await asyncio.sleep(delay_s)
+
+    async def _req(
+        self,
+        method: str,
+        path: str,
+        *,
+        json_body: dict[str, Any] | None = None,
+        content: bytes | str | None = None,
+        params: dict[str, Any] | None = None,
+        headers: dict[str, str] | None = None,
+        idempotent: bool = True,
+    ) -> httpx.Response:
+        """One API-server call with bounded retry: transport errors and
+        429 retry for any method (the request was never processed);
+        5xx retries only idempotent verbs.  401 re-reads the SA token and
+        retries once per rotation."""
+        from seldon_core_tpu.runtime import settings
+
+        attempts = max(1, settings.get_int("SCT_KUBE_RETRIES"))
+        resp: httpx.Response | None = None
+        for i in range(attempts):
+            try:
+                if chaos.ENABLED:
+                    chaos.fire("kube.request")
+                resp = await self._client.request(
+                    method,
+                    path,
+                    json=json_body,
+                    content=content,
+                    params=params,
+                    headers=headers,
+                )
+            except (httpx.TransportError, OSError):
+                # connection-layer failure: the request may not have been
+                # sent at all; retry regardless of verb
+                if i >= attempts - 1:
+                    raise
+                self.retries += 1
+                await self._retry_pause(i, None)
+                continue
+            if resp.status_code == 401 and self._reread_token():
+                self.token_rereads += 1
+                continue
+            if resp.status_code == 429 or (idempotent and resp.status_code >= 500):
+                if i < attempts - 1:
+                    self.retries += 1
+                    await self._retry_pause(i, resp.headers.get("Retry-After"))
+                    continue
+            return resp
+        return resp  # type: ignore[return-value]  # exhausted on retryable status
 
     @staticmethod
     def _path(kind: str, namespace: str, name: str | None = None) -> str:
@@ -85,7 +190,7 @@ class HttpKube:
     # -- protocol ----------------------------------------------------------
 
     async def get(self, kind: str, namespace: str, name: str) -> dict[str, Any]:
-        resp = await self._client.get(self._path(kind, namespace, name))
+        resp = await self._req("GET", self._path(kind, namespace, name))
         self._raise(resp, f"{kind}/{namespace}/{name}")
         return resp.json()
 
@@ -93,23 +198,39 @@ class HttpKube:
         params = {}
         if label_selector:
             params["labelSelector"] = ",".join(f"{k}={v}" for k, v in label_selector.items())
-        resp = await self._client.get(self._path(kind, namespace), params=params)
+        resp = await self._req("GET", self._path(kind, namespace), params=params)
         self._raise(resp, f"{kind}/{namespace}")
         return resp.json().get("items", [])
 
     async def create(self, kind, namespace, obj) -> dict[str, Any]:
-        resp = await self._client.post(self._path(kind, namespace), json=obj)
+        # a create that reached the server is NOT retried on 5xx: the
+        # object may exist and a blind retry would surface Conflict
+        resp = await self._req(
+            "POST", self._path(kind, namespace), json_body=obj, idempotent=False
+        )
         self._raise(resp, f"{kind}/{namespace}/{obj['metadata']['name']}")
         return resp.json()
 
     async def update(self, kind, namespace, obj) -> dict[str, Any]:
         name = obj["metadata"]["name"]
-        resp = await self._client.put(self._path(kind, namespace, name), json=obj)
+        resp = await self._req("PUT", self._path(kind, namespace, name), json_body=obj)
+        self._raise(resp, f"{kind}/{namespace}/{name}")
+        return resp.json()
+
+    async def patch(self, kind, namespace, name, patch) -> dict[str, Any]:
+        """RFC 7386 JSON merge-patch — read-free field updates, the verb
+        the drain runbook uses to flip a replica's traffic weight."""
+        resp = await self._req(
+            "PATCH",
+            self._path(kind, namespace, name),
+            content=json.dumps(patch),
+            headers={"Content-Type": "application/merge-patch+json"},
+        )
         self._raise(resp, f"{kind}/{namespace}/{name}")
         return resp.json()
 
     async def delete(self, kind, namespace, name) -> None:
-        resp = await self._client.delete(self._path(kind, namespace, name))
+        resp = await self._req("DELETE", self._path(kind, namespace, name))
         self._raise(resp, f"{kind}/{namespace}/{name}")
 
     async def update_status(self, kind, namespace, name, status) -> dict[str, Any]:
@@ -117,8 +238,8 @@ class HttpKube:
         .status once the CRD enables ``subresources: {status: {}}``)."""
         current = await self.get(kind, namespace, name)
         current["status"] = status
-        resp = await self._client.put(
-            self._path(kind, namespace, name) + "/status", json=current
+        resp = await self._req(
+            "PUT", self._path(kind, namespace, name) + "/status", json_body=current
         )
         self._raise(resp, f"{kind}/{namespace}/{name}/status")
         return resp.json()
@@ -129,6 +250,8 @@ class HttpKube:
         params: dict[str, Any] = {"watch": "true"}
         if resource_version:
             params["resourceVersion"] = resource_version
+        if chaos.ENABLED and self._watch_chaos():
+            return
         async with self._client.stream(
             "GET", self._path(kind, namespace), params=params, timeout=None
         ) as resp:
@@ -138,6 +261,8 @@ class HttpKube:
             async for line in resp.aiter_lines():
                 if not line.strip():
                     continue
+                if chaos.ENABLED and self._watch_chaos():
+                    return
                 event = json.loads(line)
                 if event.get("type") == "ERROR":
                     code = event.get("object", {}).get("code")
@@ -146,6 +271,20 @@ class HttpKube:
                     log.warning("watch error event: %s", event)
                     continue
                 yield event["type"], event["object"]
+
+    @staticmethod
+    def _watch_chaos() -> bool:
+        """Injected watch fault: 410 storm, mid-stream disconnect, or a
+        silent stream end.  -> True when the stream should just end (the
+        watcher relists without an error path)."""
+        rule = chaos.check("kube.watch")
+        if rule is None:
+            return False
+        if rule.kind == "drop":
+            return True
+        if rule.kind == "gone":
+            raise Gone("chaos: kube.watch 410")
+        raise ConnectionResetError("chaos: kube.watch reset")
 
     # -- bootstrap ---------------------------------------------------------
 

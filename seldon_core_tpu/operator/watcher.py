@@ -13,7 +13,7 @@ import logging
 
 from seldon_core_tpu.operator.controller import CR_KIND, Controller
 from seldon_core_tpu.operator.crd import LABEL_SELDON_TYPE, SeldonDeployment
-from seldon_core_tpu.operator.kube import Gone, KubeApi
+from seldon_core_tpu.operator.kube import Gone, KubeApi, RelistDamper
 
 log = logging.getLogger(__name__)
 
@@ -32,6 +32,7 @@ class OperatorLoop:
         self.resync_s = resync_s
         self._tasks: list[asyncio.Task] = []
         self.resource_version: str = ""
+        self.damper = RelistDamper()
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -62,9 +63,11 @@ class OperatorLoop:
                 ):
                     await self._dispatch(event, raw)
                     self._note_rv(raw)
+                    self.damper.reset()
             except Gone:
                 log.info("CR watch resourceVersion gone; relisting")
                 self.resource_version = ""
+                await self.damper.wait()
                 continue
             except asyncio.CancelledError:
                 raise

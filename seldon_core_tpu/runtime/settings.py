@@ -315,6 +315,57 @@ declare("SCT_GW_PEER_YIELD", "4", "int",
         "install.",
         section="gateway")
 
+# -- resilience / chaos plane (docs/RESILIENCE.md) --------------------------
+declare("SCT_CHAOS_PLAN", None, "str",
+        "Deterministic fault-injection plan "
+        "(``site:kind[:key=value...];...`` — see docs/RESILIENCE.md). "
+        "Unset = chaos plane fully inert (production default).",
+        section="resilience")
+declare("SCT_CHAOS_SEED", "0", "int",
+        "Seed for probabilistic chaos rules (``p=``): one seed replays "
+        "the identical fault sequence.",
+        section="resilience")
+declare("SCT_GW_POLL_FAILS", "2", "int",
+        "Consecutive failed /stats/cache polls before the router clears "
+        "a replica's prefix digests (one dropped poll must not destroy "
+        "prefix affinity).",
+        section="resilience")
+declare("SCT_GW_RETRY_BUDGET", "10", "float",
+        "Per-deployment retry-budget burst: retries available to an "
+        "idle deployment before the refill rate gates them.",
+        section="resilience")
+declare("SCT_GW_RETRY_RATE", "0.2", "float",
+        "Retry-budget refill: retries earned per forwarded request "
+        "(0.2 = at most ~20% retry amplification under sustained "
+        "failure).",
+        section="resilience")
+declare("SCT_GW_RETRY_BACKOFF_MS", "25", "float",
+        "Base delay of the gateway's jittered exponential retry "
+        "backoff (ms).",
+        section="resilience")
+declare("SCT_GW_RETRY_BACKOFF_MAX_MS", "1000", "float",
+        "Cap on the gateway's per-attempt retry backoff (ms).",
+        section="resilience")
+declare("SCT_GW_CB_FAILS", "3", "int",
+        "Consecutive forward failures that eject a replica from p2c "
+        "routing (circuit breaker opens).",
+        section="resilience")
+declare("SCT_GW_CB_EJECT_S", "5", "float",
+        "Ejection window before an open circuit admits one half-open "
+        "probe request.",
+        section="resilience")
+declare("SCT_WATCH_BACKOFF_MS", "50", "float",
+        "Base delay of the watch-relist backoff after consecutive 410 "
+        "Gone (storm damping in gateway/operator watchers).",
+        section="resilience")
+declare("SCT_WATCH_BACKOFF_MAX_MS", "5000", "float",
+        "Cap on the watch-relist backoff (ms).",
+        section="resilience")
+declare("SCT_KUBE_RETRIES", "4", "int",
+        "Apiserver request attempts on 429/5xx before the error "
+        "surfaces (Retry-After honored, capped jittered backoff).",
+        section="resilience")
+
 # -- observability ----------------------------------------------------------
 declare("SCT_TIMELINE", "1", "bool",
         "Per-request lifecycle timelines (GET /stats/timeline; "
@@ -391,6 +442,7 @@ _SECTION_TITLES = {
     "packing": "Chip packing / device arbiter",
     "disagg": "Disaggregated prefill/decode",
     "gateway": "Gateway data plane",
+    "resilience": "Resilience / chaos plane",
     "observability": "Observability",
     "mesh": "Multi-host mesh boot contract",
     "general": "General",

@@ -1,0 +1,453 @@
+"""kubesim: a stdlib fake Kubernetes API server for end-to-end tests.
+
+:class:`FakeKube` (operator/kube.py) exercises the controller *above* the
+KubeApi protocol; nothing in the repo exercised the real wire binding
+(operator/kube_http.py) — SA bearer auth, resourceVersion semantics,
+merge-PATCH, chunked JSON-lines watch streams, 410 relists — until this
+module.  :class:`KubeSim` is a ``ThreadingHTTPServer`` speaking just enough
+of the apiserver's REST dialect for ``HttpKube``, the operator loop, and
+the gateway watcher to run unmodified against ``http://127.0.0.1:<port>``.
+
+Faults are injectable per-instance (``fault_429`` / ``fault_500`` /
+``watch_gone`` / ``watch_disconnect_after`` / ``set_token``) so the client
+retry ladder — Retry-After honoring, SA-token re-read on 401, relist storm
+damping — is testable deterministically (docs/RESILIENCE.md).
+
+Stdlib only: no aiohttp/httpx server dependency, importable anywhere.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+from urllib.parse import parse_qs, urlparse
+
+from seldon_core_tpu.operator.crd import CRD_GROUP, CRD_PLURAL
+
+# URL segment -> canonical kind, mirroring kube_http._KIND_PATHS
+_PLURAL_KINDS = {
+    "deployments": "Deployment",
+    "statefulsets": "StatefulSet",
+    "pods": "Pod",
+    "services": "Service",
+    CRD_PLURAL: "SeldonDeployment",
+}
+
+_WATCH_POLL_S = 0.1
+
+
+def _status_body(code: int, message: str) -> bytes:
+    return json.dumps(
+        {"kind": "Status", "apiVersion": "v1", "code": code, "message": message}
+    ).encode()
+
+
+def _merge_patch(target: dict[str, Any], patch: dict[str, Any]) -> None:
+    for k, v in patch.items():
+        if v is None:
+            target.pop(k, None)
+        elif isinstance(v, dict) and isinstance(target.get(k), dict):
+            _merge_patch(target[k], v)
+        else:
+            target[k] = copy.deepcopy(v)
+
+
+class KubeSim:
+    """In-process fake apiserver.  ``with KubeSim(token="t") as sim: ...``
+    or explicit ``start()``/``stop()``."""
+
+    def __init__(self, token: str | None = None, gone_after: int = 10_000):
+        self.token = token
+        self.gone_after = gone_after
+        self._lock = threading.Lock()
+        self._objects: dict[tuple[str, str, str], dict[str, Any]] = {}
+        self._rv = 0
+        self._history: list[tuple[int, str, str, str, dict[str, Any]]] = []
+        self._watch_queues: list[tuple[str, str, queue.Queue]] = []
+        self._stopping = threading.Event()
+        # fault injectors (counts decrement as they fire)
+        self._fault_429 = 0
+        self._retry_after = "0"
+        self._fault_500 = 0
+        self._watch_gone = 0
+        self._watch_disconnect_after = -1  # <0 = disabled
+        # observability for assertions
+        self.requests = 0
+        self.auth_failures = 0
+        self.watch_opens = 0
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "KubeSim":
+        handler = type("_Handler", (_KubeSimHandler,), {"sim": self})
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "KubeSim":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def base_url(self) -> str:
+        assert self._server is not None, "KubeSim not started"
+        return f"http://127.0.0.1:{self._server.server_address[1]}"
+
+    # -- fault injection ---------------------------------------------------
+
+    def set_token(self, token: str | None) -> None:
+        """Rotate the accepted SA token (the kubelet analogue: clients
+        holding the old one start seeing 401)."""
+        with self._lock:
+            self.token = token
+
+    def fault_429(self, n: int, retry_after: str = "0") -> None:
+        with self._lock:
+            self._fault_429 = n
+            self._retry_after = retry_after
+
+    def fault_500(self, n: int) -> None:
+        with self._lock:
+            self._fault_500 = n
+
+    def watch_gone(self, n: int) -> None:
+        """Next ``n`` watch opens answer HTTP 410 (a relist storm)."""
+        with self._lock:
+            self._watch_gone = n
+
+    def watch_disconnect_after(self, k: int) -> None:
+        """Next watch stream drops the connection mid-chunk after ``k``
+        events — no terminal chunk, the client sees a torn stream."""
+        with self._lock:
+            self._watch_disconnect_after = k
+
+    # -- store -------------------------------------------------------------
+
+    def _stamp(self, obj: dict[str, Any]) -> dict[str, Any]:
+        self._rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
+        return obj
+
+    def _emit(self, event: str, kind: str, ns: str, obj: dict[str, Any]) -> None:
+        rv = int(obj["metadata"]["resourceVersion"])
+        self._history.append((rv, event, kind, ns, copy.deepcopy(obj)))
+        for wkind, wns, q in self._watch_queues:
+            if wkind == kind and wns in (ns, ""):
+                q.put((event, copy.deepcopy(obj)))
+
+    def seed(self, kind: str, namespace: str, obj: dict[str, Any]) -> dict[str, Any]:
+        """Insert an object directly (test setup without the wire)."""
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            obj.setdefault("metadata", {}).setdefault("namespace", namespace)
+            self._stamp(obj)
+            self._objects[(kind, namespace, obj["metadata"]["name"])] = obj
+            self._emit("ADDED", kind, namespace, obj)
+            return copy.deepcopy(obj)
+
+    def object(self, kind: str, namespace: str, name: str) -> dict[str, Any] | None:
+        with self._lock:
+            obj = self._objects.get((kind, namespace, name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def object_names(self, kind: str) -> set[str]:
+        with self._lock:
+            return {name for (k, _, name) in self._objects if k == kind}
+
+
+class _KubeSimHandler(BaseHTTPRequestHandler):
+    sim: KubeSim  # bound per-instance by KubeSim.start()
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args) -> None:  # quiet test output
+        pass
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _send(self, code: int, body: bytes, headers: dict[str, str] | None = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_obj(self, obj: dict[str, Any], code: int = 200) -> None:
+        self._send(code, json.dumps(obj).encode())
+
+    def _read_body(self) -> dict[str, Any]:
+        length = int(self.headers.get("Content-Length", "0"))
+        raw = self.rfile.read(length) if length else b"{}"
+        return json.loads(raw or b"{}")
+
+    def _authed(self) -> bool:
+        with self.sim._lock:
+            token = self.sim.token
+        if token is None:
+            return True
+        if self.headers.get("Authorization") == f"Bearer {token}":
+            return True
+        self.sim.auth_failures += 1
+        self._send(401, _status_body(401, "Unauthorized"))
+        return False
+
+    def _faulted(self) -> bool:
+        with self.sim._lock:
+            if self.sim._fault_429 > 0:
+                self.sim._fault_429 -= 1
+                retry_after = self.sim._retry_after
+                code = 429
+            elif self.sim._fault_500 > 0:
+                self.sim._fault_500 -= 1
+                retry_after, code = None, 500
+            else:
+                return False
+        headers = {"Retry-After": retry_after} if retry_after is not None else None
+        self._send(code, _status_body(code, "chaos"), headers)
+        return True
+
+    def _route(self) -> tuple[str, str, str | None, bool, dict] | None:
+        """-> (kind, namespace, name|None, is_status, query) or None."""
+        parsed = urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        query = parse_qs(parsed.query)
+        # /api/v1/... or /apis/<group>/<version>/namespaces/<ns>/<plural>[/<name>[/status]]
+        try:
+            idx = parts.index("namespaces")
+        except ValueError:
+            return None
+        ns = parts[idx + 1]
+        plural = parts[idx + 2]
+        kind = _PLURAL_KINDS.get(plural)
+        if kind is None:
+            return None
+        rest = parts[idx + 3:]
+        name = rest[0] if rest else None
+        is_status = len(rest) > 1 and rest[1] == "status"
+        return kind, ns, name, is_status, query
+
+    def _dispatch(self, method: str) -> None:
+        self.sim.requests += 1
+        if not self._authed():
+            return
+        # CRD bootstrap endpoint: accept and forget
+        if self.path.startswith("/apis/apiextensions.k8s.io/"):
+            self._send_obj(self._read_body() if method == "POST" else {}, 201)
+            return
+        if self._faulted():
+            return
+        route = self._route()
+        if route is None:
+            self._send(404, _status_body(404, f"no route for {self.path}"))
+            return
+        kind, ns, name, is_status, query = route
+        try:
+            handler = getattr(self, f"_do_{method.lower()}")
+            handler(kind, ns, name, is_status, query)
+        except BrokenPipeError:
+            raise
+        except Exception as e:  # pragma: no cover - defensive
+            self._send(500, _status_body(500, f"kubesim internal: {e!r}"))
+
+    do_GET = lambda self: self._dispatch("GET")  # noqa: E731
+    do_POST = lambda self: self._dispatch("POST")  # noqa: E731
+    do_PUT = lambda self: self._dispatch("PUT")  # noqa: E731
+    do_PATCH = lambda self: self._dispatch("PATCH")  # noqa: E731
+    do_DELETE = lambda self: self._dispatch("DELETE")  # noqa: E731
+
+    # -- verbs -------------------------------------------------------------
+
+    def _do_get(self, kind, ns, name, is_status, query) -> None:
+        if name is None and query.get("watch", ["false"])[0] == "true":
+            rv = query.get("resourceVersion", [""])[0]
+            self._watch(kind, ns, rv)
+            return
+        with self.sim._lock:
+            if name is None:
+                selector = query.get("labelSelector", [""])[0]
+                wanted = dict(
+                    pair.split("=", 1) for pair in selector.split(",") if "=" in pair
+                )
+                items = []
+                for (k, ons, _), obj in self.sim._objects.items():
+                    if k != kind or (ns and ons != ns):
+                        continue
+                    labels = obj.get("metadata", {}).get("labels", {})
+                    if any(labels.get(lk) != lv for lk, lv in wanted.items()):
+                        continue
+                    items.append(copy.deepcopy(obj))
+                body = {
+                    "kind": f"{kind}List",
+                    "items": items,
+                    "metadata": {"resourceVersion": str(self.sim._rv)},
+                }
+                self._send_obj(body)
+                return
+            obj = self.sim._objects.get((kind, ns, name))
+        if obj is None:
+            self._send(404, _status_body(404, f"{kind} {ns}/{name} not found"))
+        else:
+            self._send_obj(obj)
+
+    def _do_post(self, kind, ns, name, is_status, query) -> None:
+        obj = self._read_body()
+        oname = obj.get("metadata", {}).get("name")
+        if not oname:
+            self._send(422, _status_body(422, "metadata.name required"))
+            return
+        with self.sim._lock:
+            key = (kind, ns, oname)
+            if key in self.sim._objects:
+                self._send(409, _status_body(409, f"{kind} {ns}/{oname} exists"))
+                return
+            obj.setdefault("metadata", {}).setdefault("namespace", ns)
+            if not obj["metadata"].get("uid"):
+                obj["metadata"]["uid"] = f"uid-{kind}-{ns}-{oname}"
+            self.sim._stamp(obj)
+            self.sim._objects[key] = obj
+            self.sim._emit("ADDED", kind, ns, obj)
+            out = copy.deepcopy(obj)
+        self._send_obj(out, 201)
+
+    def _do_put(self, kind, ns, name, is_status, query) -> None:
+        obj = self._read_body()
+        with self.sim._lock:
+            key = (kind, ns, name)
+            current = self.sim._objects.get(key)
+            if current is None:
+                self._send(404, _status_body(404, f"{kind} {ns}/{name} not found"))
+                return
+            if is_status:
+                # status subresource: only .status moves
+                merged = copy.deepcopy(current)
+                merged["status"] = obj.get("status", {})
+                obj = merged
+            else:
+                # optimistic concurrency: a stale resourceVersion conflicts
+                sent_rv = obj.get("metadata", {}).get("resourceVersion", "")
+                have_rv = current["metadata"]["resourceVersion"]
+                if sent_rv and sent_rv != have_rv:
+                    self._send(
+                        409, _status_body(409, f"rv {sent_rv} != {have_rv}")
+                    )
+                    return
+            obj.setdefault("metadata", {}).setdefault("namespace", ns)
+            self.sim._stamp(obj)
+            self.sim._objects[key] = obj
+            self.sim._emit("MODIFIED", kind, ns, obj)
+            out = copy.deepcopy(obj)
+        self._send_obj(out)
+
+    def _do_patch(self, kind, ns, name, is_status, query) -> None:
+        if self.headers.get("Content-Type") != "application/merge-patch+json":
+            self._send(415, _status_body(415, "merge-patch+json only"))
+            return
+        patch = self._read_body()
+        with self.sim._lock:
+            key = (kind, ns, name)
+            current = self.sim._objects.get(key)
+            if current is None:
+                self._send(404, _status_body(404, f"{kind} {ns}/{name} not found"))
+                return
+            obj = copy.deepcopy(current)
+            _merge_patch(obj, patch)
+            self.sim._stamp(obj)
+            self.sim._objects[key] = obj
+            self.sim._emit("MODIFIED", kind, ns, obj)
+            out = copy.deepcopy(obj)
+        self._send_obj(out)
+
+    def _do_delete(self, kind, ns, name, is_status, query) -> None:
+        with self.sim._lock:
+            obj = self.sim._objects.pop((kind, ns, name), None)
+            if obj is None:
+                self._send(404, _status_body(404, f"{kind} {ns}/{name} not found"))
+                return
+            self.sim._emit("DELETED", kind, ns, obj)
+        self._send_obj({"kind": "Status", "status": "Success"})
+
+    # -- watch -------------------------------------------------------------
+
+    def _chunk(self, data: bytes) -> None:
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _watch(self, kind: str, ns: str, resource_version: str) -> None:
+        sim = self.sim
+        sim.watch_opens += 1
+        with sim._lock:
+            if sim._watch_gone > 0:
+                sim._watch_gone -= 1
+                gone = True
+            else:
+                gone = False
+        since = int(resource_version) if resource_version else 0
+        with sim._lock:
+            too_old = since and sim._rv - since > sim.gone_after
+        if gone or too_old:
+            self._send(410, _status_body(410, f"resourceVersion {since} too old"))
+            return
+        with sim._lock:
+            disconnect_after = sim._watch_disconnect_after
+            if disconnect_after >= 0:
+                sim._watch_disconnect_after = -1
+            q: queue.Queue = queue.Queue()
+            entry = (kind, ns, q)
+            sim._watch_queues.append(entry)
+            backlog = [
+                (ev, copy.deepcopy(obj))
+                for rv, ev, k, ons, obj in sim._history
+                if k == kind and ons == ns and rv > since
+            ]
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        sent = 0
+        try:
+            pending = list(backlog)
+            while not sim._stopping.is_set():
+                for event, obj in pending:
+                    if disconnect_after >= 0 and sent >= disconnect_after:
+                        # torn stream: vanish without the terminal chunk
+                        self.close_connection = True
+                        return
+                    line = json.dumps({"type": event, "object": obj}) + "\n"
+                    self._chunk(line.encode())
+                    sent += 1
+                pending = []
+                try:
+                    pending.append(q.get(timeout=_WATCH_POLL_S))
+                except queue.Empty:
+                    if disconnect_after >= 0 and sent >= disconnect_after:
+                        self.close_connection = True
+                        return
+            self._chunk(b"")  # "0\r\n\r\n": graceful terminal chunk on shutdown
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away
+        finally:
+            with sim._lock:
+                if entry in sim._watch_queues:
+                    sim._watch_queues.remove(entry)
+            self.close_connection = True

@@ -2,14 +2,17 @@
 
 Four ledgers keep the serving plane honest and each has a paired verb:
 
-=============  =========================  ======================
-resource       acquire                    release
-=============  =========================  ======================
-DeviceArbiter  ``.acquire(name)``         ``.release(name)``
-MemoryManager  ``.reserve(owner, ...)``   ``.release(owner)``
-AdapterPool    ``.acquire(adapter)``      ``.release_ref(idx)``
-PrefixIndex    ``.acquire(tokens, ...)``  ``.release(tokens, ...)``
-=============  =========================  ======================
+==============  =========================  ==========================
+resource        acquire                    release
+==============  =========================  ==========================
+DeviceArbiter   ``.acquire(name)``         ``.release(name)``
+MemoryManager   ``.reserve(owner, ...)``   ``.release(owner)``
+AdapterPool     ``.acquire(adapter)``      ``.release_ref(idx)``
+PrefixIndex     ``.acquire(tokens, ...)``  ``.release(tokens, ...)``
+CircuitBreaker  ``.open(until)`` /         ``.close()`` /
+                ``.probe_open()``          ``.probe_close()``
+DrainGuard      ``.drain_begin()``         ``.drain_finish()``
+==============  =========================  ==========================
 
 A function that acquires one of these and has no matching release is a
 leak on SOME path (the PR 10/12 bug class: an error branch between
@@ -44,6 +47,13 @@ KINDS = {
     "AdapterPool": (("lora_pool", "adapter_pool"), {"acquire"},
                     {"release_ref"}),
     "PrefixIndex": (("prefix_index",), {"acquire"}, {"release"}),
+    # chaos plane (docs/RESILIENCE.md): an opened breaker that no path
+    # closes ejects a healthy replica forever; a drain that no path
+    # finishes leaves admission paused until restart
+    "CircuitBreaker": (("breaker",), {"open", "probe_open"},
+                       {"close", "probe_close"}),
+    "DrainGuard": (("sched", "scheduler"), {"drain_begin"},
+                   {"drain_finish"}),
 }
 
 

@@ -1,0 +1,602 @@
+"""Chaos plane tests (docs/RESILIENCE.md).
+
+The acceptance bars this suite holds:
+
+* **Inert when unset** — with no ``SCT_CHAOS_PLAN`` every verb is a
+  no-op that records nothing; arming is a parse-checked plan string and
+  a typo'd site fails loudly.
+* **Deterministic injection** — selectors (``hits``/``only``/``times``)
+  address exact arrivals; probabilistic rules replay identically per
+  seed; ``act()`` burns exactly ONE arrival per hop.
+* **Graceful degradation** — the retry budget bounds amplification, the
+  per-replica circuit breaker ejects a corpse and heals through a
+  single half-open probe.
+* **Live migration** — a generation drained mid-stream through the v4
+  handoff codec onto a PEER scheduler finishes bit-identical to an
+  uninterrupted run (greedy, seeded top-k, int8 KV, LoRA-salted), with
+  the suspend store drained and zero pool blocks leaked; a refused or
+  torn migration re-parks and resumes locally — a failed migration
+  never kills a generation.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from seldon_core_tpu import chaos
+from seldon_core_tpu.disagg.handoff import HandoffError, decode_handoff
+from seldon_core_tpu.disagg.router import ReplicaRouter, endpoint_key
+from seldon_core_tpu.engine.transport import (
+    RetryBudget,
+    _RetryableConnect,
+    _RetryableSent,
+    retry_loop,
+)
+from seldon_core_tpu.executor.generation import GenerationScheduler, GenerativeModel
+from seldon_core_tpu.gateway.store import Endpoint
+from seldon_core_tpu.models import llama
+
+run = asyncio.run
+
+PROMPT = [5, 9, 2, 17, 3]
+MAX_NEW = 24
+LORA_KW = dict(lora_rank=2, lora_slots=4, lora_adapters="alpha,beta")
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    cfg = llama.Config.tiny(max_seq=64)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# Plan grammar + selectors
+# ---------------------------------------------------------------------------
+
+class TestPlan:
+    def test_parse_rules_and_params(self):
+        plan = chaos.parse_plan(
+            "disagg.handoff.send:torn:hits=2:frac=0.25;kube.watch:gone:times=3"
+        )
+        torn, gone = plan.rules
+        assert (torn.site, torn.kind, torn.hits, torn.frac) == (
+            "disagg.handoff.send", "torn", 2, 0.25,
+        )
+        assert (gone.site, gone.kind, gone.times) == ("kube.watch", "gone", 3)
+
+    def test_unknown_site_is_a_parse_error(self):
+        with pytest.raises(chaos.PlanError):
+            chaos.parse_plan("gw.fwrward:reset")  # typo must fail loudly
+
+    def test_unknown_kind_is_a_parse_error(self):
+        with pytest.raises(chaos.PlanError):
+            chaos.parse_plan("gw.forward:explode")
+
+    def test_bad_selector_value_is_a_parse_error(self):
+        with pytest.raises(chaos.PlanError):
+            chaos.parse_plan("gw.forward:reset:hits=soon")
+
+    def test_unregistered_site_raises_at_the_call_site(self):
+        chaos.configure("gw.forward:reset")
+        with pytest.raises(chaos.PlanError):
+            chaos.check("gw.not_a_site")
+
+    def test_hits_fires_from_the_nth_arrival_on(self):
+        chaos.configure("gw.forward:reset:hits=3")
+        fired = [chaos.check("gw.forward") is not None for _ in range(5)]
+        assert fired == [False, False, True, True, True]
+
+    def test_only_fires_exactly_once(self):
+        chaos.configure("gw.forward:reset:only=2")
+        fired = [chaos.check("gw.forward") is not None for _ in range(4)]
+        assert fired == [False, True, False, False]
+
+    def test_times_caps_total_firings(self):
+        chaos.configure("gw.forward:reset:times=2")
+        fired = [chaos.check("gw.forward") is not None for _ in range(5)]
+        assert fired == [True, True, False, False, False]
+
+    def test_probabilistic_rules_replay_per_seed(self):
+        def pattern(seed):
+            chaos.configure("gw.forward:reset:p=0.5", seed=seed)
+            return [chaos.check("gw.forward") is not None for _ in range(32)]
+
+        a, b = pattern(7), pattern(7)
+        assert a == b  # a seed replays the identical fault sequence
+        assert any(a) and not all(a)
+        assert pattern(8) != a  # and it IS the seed doing the work
+
+    def test_snapshot_counts_arrivals_and_firings(self):
+        chaos.configure("gw.forward:reset:only=2")
+        for _ in range(3):
+            chaos.check("gw.forward")
+        snap = chaos.snapshot()
+        assert snap["enabled"] is True
+        assert snap["arrivals"]["gw.forward"] == 3
+        assert snap["fired"]["gw.forward"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Inertness: the production default costs nothing and records nothing
+# ---------------------------------------------------------------------------
+
+class TestInertWhenUnset:
+    def test_disarmed_verbs_are_noops(self):
+        chaos.reset()
+        assert chaos.ENABLED is False
+        assert chaos.check("gw.forward") is None
+        chaos.fire("gw.forward")  # nothing raised
+        assert chaos.mangle("disagg.handoff.send", b"frame") == b"frame"
+        assert run(chaos.act("disagg.handoff.send", b"frame")) == b"frame"
+
+    def test_disarmed_records_no_arrivals(self):
+        chaos.reset()
+        for _ in range(5):
+            chaos.check("gw.forward")
+        snap = chaos.snapshot()
+        assert snap["arrivals"] == {} and snap["fired"] == {}
+
+    def test_empty_plan_stays_disarmed(self):
+        chaos.configure("")
+        assert chaos.ENABLED is False
+        chaos.configure(None)
+        assert chaos.ENABLED is False
+
+
+# ---------------------------------------------------------------------------
+# act(): ONE arrival per hop, full kind interpretation
+# ---------------------------------------------------------------------------
+
+class TestAct:
+    def test_one_arrival_per_call(self):
+        # only=2 with ONE verb call per hop: the second act() is the
+        # second request — multi-verb sites would burn arrivals and make
+        # hit-addressed plans unwritable
+        chaos.configure("gw.forward:reset:only=2")
+        run(chaos.act("gw.forward"))
+        with pytest.raises(ConnectionResetError):
+            run(chaos.act("gw.forward"))
+
+    def test_raisable_kinds(self):
+        chaos.configure("gw.forward:timeout")
+        with pytest.raises(TimeoutError):
+            run(chaos.act("gw.forward"))
+        chaos.configure("gw.forward:ioerror")
+        with pytest.raises(OSError):
+            run(chaos.act("gw.forward"))
+
+    def test_torn_truncates_the_payload(self):
+        chaos.configure("disagg.handoff.send:torn:frac=0.5")
+        out = run(chaos.act("disagg.handoff.send", b"x" * 10))
+        assert out == b"x" * 5
+
+    def test_slow_delays_then_passes_through(self):
+        chaos.configure("gw.forward:slow:delay_ms=30")
+
+        async def go():
+            t0 = asyncio.get_event_loop().time()
+            out = await chaos.act("gw.forward", b"payload")
+            return out, asyncio.get_event_loop().time() - t0
+
+        out, dt = run(go())
+        assert out == b"payload"
+        assert dt >= 0.025
+
+    def test_rules_bound_to_other_sites_pass_through(self):
+        chaos.configure("kube.request:reset")
+        assert run(chaos.act("gw.forward", b"p")) == b"p"
+
+
+# ---------------------------------------------------------------------------
+# Retry budget + the bounded-retry skeleton
+# ---------------------------------------------------------------------------
+
+def _no_backoff(_i):
+    return asyncio.sleep(0)
+
+
+class TestRetryBudget:
+    def test_bucket_spends_and_denies(self):
+        b = RetryBudget(burst=2, rate=0)
+        assert b.spend() and b.spend()
+        assert not b.spend()
+        assert (b.spent, b.denied) == (2, 1)
+
+    def test_earn_caps_at_burst(self):
+        b = RetryBudget(burst=1.5, rate=1.0)
+        b.earn()
+        assert b.tokens == 1.5
+        assert b.spend()
+        b.earn()
+        assert b.tokens == 1.5
+
+    def test_retry_loop_retries_connect_errors_for_any_verb(self):
+        calls = []
+
+        async def attempt(i):
+            calls.append(i)
+            if i < 2:
+                raise _RetryableConnect(ConnectionRefusedError("down"))
+            return "ok"
+
+        out = run(retry_loop(attempt, idempotent=False, backoff=_no_backoff))
+        assert out == "ok" and calls == [0, 1, 2]
+
+    def test_retry_loop_never_replays_sent_non_idempotent(self):
+        calls = []
+
+        async def attempt(i):
+            calls.append(i)
+            raise _RetryableSent(ConnectionResetError("mid-body"))
+
+        with pytest.raises(ConnectionResetError):
+            run(retry_loop(attempt, idempotent=False, backoff=_no_backoff))
+        assert calls == [0]  # the request may have landed: no replay
+
+    def test_retry_loop_replays_sent_idempotent(self):
+        calls = []
+
+        async def attempt(i):
+            calls.append(i)
+            raise _RetryableSent(ConnectionResetError("mid-body"))
+
+        with pytest.raises(ConnectionResetError):
+            run(retry_loop(attempt, idempotent=True, backoff=_no_backoff))
+        assert calls == [0, 1, 2]
+
+    def test_empty_budget_stops_the_retry_ladder(self):
+        budget = RetryBudget(burst=0, rate=0)
+        calls = []
+
+        async def attempt(i):
+            calls.append(i)
+            raise _RetryableConnect(ConnectionRefusedError("down"))
+
+        with pytest.raises(ConnectionRefusedError):
+            run(retry_loop(
+                attempt, idempotent=True, budget=budget, backoff=_no_backoff,
+            ))
+        assert calls == [0]  # brownout: no amplification
+        assert budget.denied == 1
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker (ReplicaRouter)
+# ---------------------------------------------------------------------------
+
+ENDPOINTS = (Endpoint("warm", 8000), Endpoint("cold", 8000))
+WARM, COLD = (endpoint_key(ep) for ep in ENDPOINTS)
+
+
+@pytest.fixture
+def cb_router(monkeypatch):
+    monkeypatch.setenv("SCT_GW_CB_FAILS", "3")
+    monkeypatch.setenv("SCT_GW_CB_EJECT_S", "0.05")
+    return ReplicaRouter()
+
+
+class TestCircuitBreaker:
+    def test_opens_after_consecutive_failures(self, cb_router):
+        r = cb_router
+        for _ in range(2):
+            r.note_failure("dep", COLD)
+        assert not r._state("dep", COLD).breaker.is_open
+        r.note_failure("dep", COLD)
+        assert r._state("dep", COLD).breaker.is_open
+        assert r.cb_opens == 1
+        # every pick lands on the survivor while the window runs
+        for _ in range(8):
+            assert r.pick("dep", ENDPOINTS) is ENDPOINTS[0]
+
+    def test_success_resets_the_streak(self, cb_router):
+        r = cb_router
+        r.note_failure("dep", COLD)
+        r.note_failure("dep", COLD)
+        r.note_success("dep", COLD)
+        r.note_failure("dep", COLD)
+        assert not r._state("dep", COLD).breaker.is_open
+
+    def test_half_open_probe_elects_exactly_one_pick(self, cb_router):
+        import time
+
+        r = cb_router
+        for _ in range(3):
+            r.note_failure("dep", COLD)
+        time.sleep(0.06)  # ejection window expires
+        probe = r.pick("dep", ENDPOINTS)
+        assert probe is ENDPOINTS[1]  # the expired replica gets the probe
+        assert r.cb_probes == 1
+        # with the probe in flight every other pick avoids the replica
+        for _ in range(4):
+            assert r.pick("dep", ENDPOINTS) is ENDPOINTS[0]
+        # probe outcome closes (success) — traffic mixes again
+        r.note_success("dep", COLD)
+        assert not r._state("dep", COLD).breaker.is_open
+        assert r.cb_closes == 1
+
+    def test_failed_probe_reopens_a_fresh_window(self, cb_router):
+        import time
+
+        r = cb_router
+        for _ in range(3):
+            r.note_failure("dep", COLD)
+        time.sleep(0.06)
+        r.pick("dep", ENDPOINTS)  # elects the probe
+        r.note_failure("dep", COLD)  # probe failed
+        breaker = r._state("dep", COLD).breaker
+        assert breaker.is_open and not breaker.probing
+        assert r.cb_opens == 2
+
+    def test_all_ejected_fails_static(self, cb_router):
+        r = cb_router
+        for _ in range(3):
+            r.note_failure("dep", WARM)
+            r.note_failure("dep", COLD)
+        # shedding everything would turn a brownout into an outage:
+        # routing proceeds over the full set instead
+        picks = {r.pick("dep", ENDPOINTS) for _ in range(8)}
+        assert picks  # served, not refused
+
+
+# ---------------------------------------------------------------------------
+# Live migration: drain -> migrate -> bit-identical continuation
+# ---------------------------------------------------------------------------
+
+def _uninterrupted(model, *, seed, temperature=0.0, adapter=None):
+    sched = GenerationScheduler(model)
+    sched._seed = seed
+    kw = {"adapter": adapter} if adapter else {}
+
+    async def go():
+        try:
+            return await sched.submit(
+                np.asarray(PROMPT, np.int32), max_new_tokens=MAX_NEW,
+                temperature=temperature, **kw,
+            )
+        finally:
+            await asyncio.wait_for(sched.close(), 20)
+
+    return run(go())
+
+
+def _drained(model_src, model_dst, *, seed, temperature=0.0, adapter=None,
+             after=3):
+    """Drain the source mid-stream, migrate the frame onto a fresh peer
+    scheduler (seed adopted), relay the continuation back.  Returns the
+    full token stream the CLIENT saw — which must be one uninterrupted
+    sequence."""
+    src = GenerationScheduler(model_src)
+    src._seed = seed
+    kw = {"adapter": adapter} if adapter else {}
+    seen = []
+
+    def hook(tok):
+        seen.append(tok)
+        if len(seen) == after:
+            src.drain_begin()
+
+    free0 = model_src.free_block_count
+
+    async def go():
+        dst = GenerationScheduler(model_dst)
+        try:
+            task = asyncio.ensure_future(src.submit(
+                np.asarray(PROMPT, np.int32), max_new_tokens=MAX_NEW,
+                temperature=temperature, on_token=hook, **kw,
+            ))
+            assert await src.drain_wait_quiesced(30.0), "drain never quiesced"
+            pairs = src.drain_take()
+            assert len(pairs) == 1
+            # export drained the store and returned every pool block
+            assert src._suspend_store.bytes == 0
+            assert model_src.free_block_count >= free0
+            dst.adopt_seed(src._seed)
+            for req, frame in pairs:
+                payload = decode_handoff(frame)
+                out = await dst.submit_imported(
+                    payload["prompt"],
+                    first_token=int(payload["first_token"]),
+                    k=payload["k"], v=payload["v"],
+                    max_new_tokens=int(payload["max_new_tokens"]),
+                    temperature=float(payload.get("temperature", 0.0)),
+                    k_scale=payload.get("k_scale"),
+                    v_scale=payload.get("v_scale"),
+                    adapter=payload.get("adapter"),
+                )
+                src.complete_migrated(req, [int(t) for t in out])
+            src.drain_finish()
+            return await asyncio.wait_for(task, 30)
+        finally:
+            # bounded closes: a drain cycle once left the run loop alive
+            # when the cancel landed on a completed wait_for (bpo-42130)
+            await asyncio.wait_for(src.close(), 20)
+            await asyncio.wait_for(dst.close(), 20)
+
+    got = run(go())
+    # the streaming hook saw every token exactly once, in order: the
+    # client observes ONE stream across the migration
+    np.testing.assert_array_equal(np.asarray(seen), got)
+    assert src.drains == 1 and src.drained_out == 1
+    return got
+
+
+class TestDrainBitIdentity:
+    def test_greedy(self, tiny):
+        cfg, params = tiny
+        m_a = GenerativeModel(cfg, params, n_slots=2, decode_block=4)
+        m_src = GenerativeModel(cfg, params, n_slots=2, decode_block=4)
+        m_dst = GenerativeModel(cfg, params, n_slots=2, decode_block=4)
+        expect = _uninterrupted(m_a, seed=123)
+        got = _drained(m_src, m_dst, seed=123)
+        np.testing.assert_array_equal(got, expect)
+        assert m_src.free_block_count == m_src.kv_blocks - 1  # no leak
+
+    def test_seeded_top_k(self, tiny):
+        """Sampled streams: the peer adopts the source's seed counter, so
+        the migrated continuation draws the exact keys the uninterrupted
+        run would have."""
+        cfg, params = tiny
+        mk = dict(n_slots=2, decode_block=4, top_k=4)
+        m_a = GenerativeModel(cfg, params, **mk)
+        m_src = GenerativeModel(cfg, params, **mk)
+        m_dst = GenerativeModel(cfg, params, **mk)
+        expect = _uninterrupted(m_a, seed=4242, temperature=0.9)
+        got = _drained(m_src, m_dst, seed=4242, temperature=0.9)
+        np.testing.assert_array_equal(got, expect)
+
+    def test_int8_kv(self, tiny):
+        cfg, params = tiny
+        mk = dict(n_slots=2, decode_block=4, kv_cache_dtype="int8")
+        m_a = GenerativeModel(cfg, params, **mk)
+        m_src = GenerativeModel(cfg, params, **mk)
+        m_dst = GenerativeModel(cfg, params, **mk)
+        expect = _uninterrupted(m_a, seed=77)
+        got = _drained(m_src, m_dst, seed=77)
+        np.testing.assert_array_equal(got, expect)
+
+    def test_lora_salted(self, tiny):
+        cfg, params = tiny
+        mk = dict(n_slots=2, decode_block=4, **LORA_KW)
+        m_a = GenerativeModel(cfg, params, **mk)
+        m_src = GenerativeModel(cfg, params, **mk)
+        m_dst = GenerativeModel(cfg, params, **mk)
+        expect = _uninterrupted(m_a, seed=9, adapter="alpha")
+        got = _drained(m_src, m_dst, seed=9, adapter="alpha")
+        np.testing.assert_array_equal(got, expect)
+        # and the salt was live: differs from the base model's stream
+        base = _uninterrupted(
+            GenerativeModel(cfg, params, **mk), seed=9,
+        )
+        assert not np.array_equal(got, base)
+
+
+class TestDrainDegradedPaths:
+    def test_no_peer_drain_parks_then_finish_resumes_locally(self, tiny):
+        cfg, params = tiny
+        m_a = GenerativeModel(cfg, params, n_slots=2, decode_block=4)
+        m_b = GenerativeModel(cfg, params, n_slots=2, decode_block=4)
+        expect = _uninterrupted(m_a, seed=123)
+
+        sched = GenerationScheduler(m_b)
+        sched._seed = 123
+        seen = []
+
+        def hook(tok):
+            seen.append(tok)
+            if len(seen) == 3:
+                sched.drain_begin()
+
+        async def go():
+            try:
+                task = asyncio.ensure_future(sched.submit(
+                    np.asarray(PROMPT, np.int32), max_new_tokens=MAX_NEW,
+                    on_token=hook,
+                ))
+                assert await sched.drain_wait_quiesced(30.0)
+                assert sched._draining and len(sched._suspended) == 1
+                # parked, not progressing: admission stays paused until
+                # the operator lifts the drain (/admin/undrain)
+                await asyncio.sleep(0.05)
+                assert not task.done()
+                sched.drain_finish()
+                return await asyncio.wait_for(task, 30)
+            finally:
+                await asyncio.wait_for(sched.close(), 20)
+
+        got = run(go())
+        np.testing.assert_array_equal(got, expect)
+        np.testing.assert_array_equal(np.asarray(seen), got)
+        assert sched.suspends == 1 and sched.resumes == 1
+        assert sched._suspend_store.bytes == 0
+
+    def test_aborted_migration_resumes_locally(self, tiny):
+        """The peer refused the frames: drain_abort re-parks, finish
+        resumes locally, and the stream is STILL bit-identical."""
+        cfg, params = tiny
+        m_a = GenerativeModel(cfg, params, n_slots=2, decode_block=4)
+        m_b = GenerativeModel(cfg, params, n_slots=2, decode_block=4)
+        expect = _uninterrupted(m_a, seed=123)
+
+        sched = GenerationScheduler(m_b)
+        sched._seed = 123
+        seen = []
+
+        def hook(tok):
+            seen.append(tok)
+            if len(seen) == 3:
+                sched.drain_begin()
+
+        async def go():
+            try:
+                task = asyncio.ensure_future(sched.submit(
+                    np.asarray(PROMPT, np.int32), max_new_tokens=MAX_NEW,
+                    on_token=hook,
+                ))
+                assert await sched.drain_wait_quiesced(30.0)
+                pairs = sched.drain_take()
+                assert len(pairs) == 1
+                sched.drain_abort(pairs)  # peer dead mid-migration
+                assert len(sched._suspended) == 1
+                sched.drain_finish()
+                return await asyncio.wait_for(task, 30)
+            finally:
+                await asyncio.wait_for(sched.close(), 20)
+
+        got = run(go())
+        np.testing.assert_array_equal(got, expect)
+        np.testing.assert_array_equal(np.asarray(seen), got)
+        assert sched._suspend_store.bytes == 0  # resume drained the park
+
+    def test_torn_migration_frame_is_detected_then_aborted(self, tiny):
+        """The handoff failure matrix's torn edge: a frame mangled by the
+        chaos plane fails loudly at decode, and the ORIGINAL frame still
+        resumes locally after the abort — bit-identical."""
+        cfg, params = tiny
+        m_a = GenerativeModel(cfg, params, n_slots=2, decode_block=4)
+        m_b = GenerativeModel(cfg, params, n_slots=2, decode_block=4)
+        expect = _uninterrupted(m_a, seed=123)
+
+        sched = GenerationScheduler(m_b)
+        sched._seed = 123
+        seen = []
+
+        def hook(tok):
+            seen.append(tok)
+            if len(seen) == 3:
+                sched.drain_begin()
+
+        chaos.configure("disagg.handoff.send:torn:frac=0.5")
+
+        async def go():
+            try:
+                task = asyncio.ensure_future(sched.submit(
+                    np.asarray(PROMPT, np.int32), max_new_tokens=MAX_NEW,
+                    on_token=hook,
+                ))
+                assert await sched.drain_wait_quiesced(30.0)
+                pairs = sched.drain_take()
+                (req, frame), = pairs
+                torn = await chaos.act("disagg.handoff.send", frame)
+                assert len(torn) < len(frame)
+                with pytest.raises((HandoffError, ValueError)):
+                    decode_handoff(torn)  # the peer would refuse this
+                sched.drain_abort(pairs)  # original frame survives
+                sched.drain_finish()
+                return await asyncio.wait_for(task, 30)
+            finally:
+                await asyncio.wait_for(sched.close(), 20)
+
+        got = run(go())
+        np.testing.assert_array_equal(got, expect)
+        snap = chaos.snapshot()
+        assert snap["fired"]["disagg.handoff.send"] == 1

@@ -679,10 +679,18 @@ class TestRouterPoller:
             )
             poller = RouterPoller(store, router, timeout_s=0.5, interval_s=999)
             try:
+                # one dropped poll must NOT destroy prefix affinity
+                # (SCT_GW_POLL_FAILS, default 2 — docs/RESILIENCE.md)
+                await poller.poll_once()
+                snap = router.snapshot()["deployments"]["dep"]
+                assert snap["127.0.0.1:1"]["digest_entries"] == len(hashes)
+                assert poller.errors >= 1
+                assert poller.digest_clears == 0
+                # the second consecutive failure clears it
                 await poller.poll_once()
                 snap = router.snapshot()["deployments"]["dep"]
                 assert snap["127.0.0.1:1"]["digest_entries"] == 0
-                assert poller.errors >= 1
+                assert poller.digest_clears == 1
             finally:
                 await poller.stop()
                 await warm.close()

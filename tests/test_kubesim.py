@@ -1,0 +1,397 @@
+"""The fake apiserver (testing/kubesim.py) driving the REAL wire client
+(operator/kube_http.py), the operator loop, and the gateway watcher —
+nothing mocked below the KubeApi protocol.
+
+FakeKube (test_operator.py / test_gateway_watch.py) tests the control
+loops above the protocol; this file closes the last untested layer:
+bearer auth, resourceVersion semantics, merge-PATCH, chunked JSON-lines
+watch streams, Retry-After honoring, SA-token re-read, and the relist
+damper — each under injected apiserver faults (docs/RESILIENCE.md)."""
+
+import asyncio
+import json
+
+import httpx
+import pytest
+
+from seldon_core_tpu.gateway.store import DeploymentStore
+from seldon_core_tpu.gateway.watch import CR_KIND, GatewayWatcher
+from seldon_core_tpu.operator.controller import Controller
+from seldon_core_tpu.operator.crd import SeldonDeployment
+from seldon_core_tpu.operator.kube import Conflict, Gone, NotFound, RelistDamper
+from seldon_core_tpu.operator.kube_http import HttpKube, crd_manifest
+from seldon_core_tpu.operator.watcher import OperatorLoop
+from seldon_core_tpu.testing.kubesim import KubeSim
+
+run = asyncio.run
+
+
+def _cr(name: str, secret: str = "s3cret") -> dict:
+    return {
+        "apiVersion": "machinelearning.seldon.io/v1alpha2",
+        "kind": CR_KIND,
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "name": name,
+            "oauth_key": f"{name}-key",
+            "oauth_secret": secret,
+            "predictors": [
+                {"name": "p", "graph": {"name": "m", "type": "MODEL",
+                                        "implementation": "SIMPLE_MODEL"}}
+            ],
+        },
+    }
+
+
+async def _settle(predicate, timeout=5.0, interval=0.02):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if predicate():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError("condition never settled")
+
+
+def _run_with_kube(sim, body, **kube_kw):
+    """Construct HttpKube, run ``body(kube)``, close — all on ONE event
+    loop (httpx transports bind to the loop they first run on)."""
+
+    async def go():
+        kube = HttpKube(base_url=sim.base_url, **kube_kw)
+        try:
+            await body(kube)
+        finally:
+            await kube.close()
+
+    run(go())
+
+
+class TestHttpKubeCrud:
+    """Every KubeApi verb across the real wire."""
+
+    def test_crud_roundtrip(self):
+        async def go(kube):
+            created = await kube.create(CR_KIND, "default", _cr("a"))
+            assert created["metadata"]["resourceVersion"]
+            got = await kube.get(CR_KIND, "default", "a")
+            assert got["spec"]["oauth_key"] == "a-key"
+
+            got["spec"]["oauth_secret"] = "rotated"
+            updated = await kube.update(CR_KIND, "default", got)
+            assert updated["spec"]["oauth_secret"] == "rotated"
+            assert updated["metadata"]["resourceVersion"] != created["metadata"]["resourceVersion"]
+
+            items = await kube.list(CR_KIND, "default")
+            assert [i["metadata"]["name"] for i in items] == ["a"]
+
+            await kube.delete(CR_KIND, "default", "a")
+            with pytest.raises(NotFound):
+                await kube.get(CR_KIND, "default", "a")
+
+        with KubeSim() as sim:
+            _run_with_kube(sim, go)
+
+    def test_conflicts_and_merge_patch(self):
+        async def go(kube):
+            # duplicate create -> 409 Conflict
+            await kube.create(CR_KIND, "default", _cr("a"))
+            with pytest.raises(Conflict):
+                await kube.create(CR_KIND, "default", _cr("a"))
+
+            # stale resourceVersion on update -> 409 (optimistic concurrency)
+            stale = await kube.get(CR_KIND, "default", "a")
+            fresh = await kube.get(CR_KIND, "default", "a")
+            fresh["spec"]["oauth_secret"] = "new"
+            await kube.update(CR_KIND, "default", fresh)
+            stale["spec"]["oauth_secret"] = "lost"
+            with pytest.raises(Conflict):
+                await kube.update(CR_KIND, "default", stale)
+
+            # merge-patch touches only the named fields
+            patched = await kube.patch(
+                CR_KIND, "default", "a", {"spec": {"oauth_secret": "patched"}}
+            )
+            assert patched["spec"]["oauth_secret"] == "patched"
+            assert patched["spec"]["oauth_key"] == "a-key"
+
+            # status subresource moves .status and nothing else
+            out = await kube.update_status(CR_KIND, "default", "a", {"state": "Available"})
+            assert out["status"] == {"state": "Available"}
+            assert out["spec"]["oauth_secret"] == "patched"
+
+        with KubeSim() as sim:
+            _run_with_kube(sim, go)
+
+    def test_patch_requires_merge_patch_content_type(self):
+        # the sim is strict so the client can't silently regress to a
+        # strategic-merge content type the real server would also accept
+        async def go():
+            async with httpx.AsyncClient(base_url=sim.base_url) as c:
+                path = "/apis/machinelearning.seldon.io/v1alpha2/namespaces/default/seldondeployments/a"
+                resp = await c.request(
+                    "PATCH", path, content=json.dumps({"spec": {}}),
+                    headers={"Content-Type": "application/json"},
+                )
+                assert resp.status_code == 415
+
+        with KubeSim() as sim:
+            sim.seed(CR_KIND, "default", _cr("a"))
+            run(go())
+
+    def test_ensure_crd_bootstrap(self):
+        async def go(kube):
+            await kube.ensure_crd()  # sim's bootstrap endpoint accepts it
+
+        with KubeSim() as sim:
+            _run_with_kube(sim, go)
+        assert crd_manifest()["spec"]["versions"][0]["subresources"] == {"status": {}}
+
+
+class TestRetryLadder:
+    """_req's bounded retry: 429 any verb, 5xx idempotent-only, 401 re-read."""
+
+    def test_429_retried_with_retry_after(self):
+        async def go(kube):
+            sim.fault_429(2, retry_after="0")
+            got = await kube.get(CR_KIND, "default", "a")
+            assert got["metadata"]["name"] == "a"
+            assert kube.retries == 2
+
+        with KubeSim() as sim:
+            sim.seed(CR_KIND, "default", _cr("a"))
+            _run_with_kube(sim, go)
+
+    def test_500_retried_for_get_but_not_create(self):
+        async def go(kube):
+            sim.fault_500(1)
+            got = await kube.get(CR_KIND, "default", "a")  # idempotent: retried
+            assert got["metadata"]["name"] == "a"
+            assert kube.retries == 1
+
+            sim.fault_500(1)
+            with pytest.raises(httpx.HTTPStatusError):
+                # a create that reached the server must NOT be replayed
+                await kube.create(CR_KIND, "default", _cr("b"))
+            assert kube.retries == 1  # unchanged
+            assert sim.object(CR_KIND, "default", "b") is None
+
+        with KubeSim() as sim:
+            sim.seed(CR_KIND, "default", _cr("a"))
+            _run_with_kube(sim, go)
+
+    def test_401_rereads_rotated_token(self, tmp_path):
+        token_file = tmp_path / "token"
+        token_file.write_text("old-token")
+
+        async def go(kube):
+            assert (await kube.get(CR_KIND, "default", "a"))["metadata"]["name"] == "a"
+            # kubelet rotates the projected token; server stops taking the old one
+            sim.set_token("new-token")
+            token_file.write_text("new-token")
+            got = await kube.get(CR_KIND, "default", "a")
+            assert got["metadata"]["name"] == "a"
+            assert kube.token_rereads == 1
+            assert sim.auth_failures == 1
+
+            # rotation the file did NOT pick up: 401 surfaces, no retry spin
+            sim.set_token("unknowable")
+            with pytest.raises(httpx.HTTPStatusError):
+                await kube.get(CR_KIND, "default", "a")
+
+        with KubeSim(token="old-token") as sim:
+            sim.seed(CR_KIND, "default", _cr("a"))
+            _run_with_kube(sim, go, token_path=str(token_file))
+
+
+class TestWatch:
+    """Chunked JSON-lines watch: backlog, live events, 410, torn streams."""
+
+    def test_backlog_and_live_events(self):
+        async def go(kube):
+            events = []
+
+            async def consume():
+                async for ev, obj in kube.watch(CR_KIND, "default"):
+                    events.append((ev, obj["metadata"]["name"]))
+
+            task = asyncio.ensure_future(consume())
+            try:
+                await _settle(lambda: ("ADDED", "a") in events)
+                await kube.create(CR_KIND, "default", _cr("b"))
+                await kube.delete(CR_KIND, "default", "b")
+                await _settle(lambda: ("DELETED", "b") in events)
+                assert events[:1] == [("ADDED", "a")]  # backlog replays first
+                assert ("ADDED", "b") in events
+            finally:
+                task.cancel()
+                await asyncio.gather(task, return_exceptions=True)
+
+        with KubeSim() as sim:
+            sim.seed(CR_KIND, "default", _cr("a"))
+            _run_with_kube(sim, go)
+        assert sim.watch_opens == 1
+
+    def test_watch_gone_raises_gone(self):
+        async def go(kube):
+            sim.watch_gone(1)
+            with pytest.raises(Gone):
+                async for _ in kube.watch(CR_KIND, "default", "1"):
+                    pass
+
+        with KubeSim() as sim:
+            _run_with_kube(sim, go)
+
+    def test_mid_stream_disconnect_is_a_transport_error(self):
+        async def go(kube):
+            sim.watch_disconnect_after(1)
+            seen = []
+            with pytest.raises(httpx.TransportError):
+                async for ev, obj in kube.watch(CR_KIND, "default"):
+                    seen.append(obj["metadata"]["name"])
+            assert seen == ["a"]  # one event, then the torn stream
+
+        with KubeSim() as sim:
+            sim.seed(CR_KIND, "default", _cr("a"))
+            sim.seed(CR_KIND, "default", _cr("b"))
+            _run_with_kube(sim, go)
+
+
+def _operator_cr(name="mydep"):
+    return SeldonDeployment.from_dict(
+        {
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {
+                "name": name,
+                "oauth_key": "k",
+                "oauth_secret": "s",
+                "predictors": [
+                    {
+                        "name": "p1",
+                        "replicas": 1,
+                        "graph": {"name": "classifier", "type": "MODEL"},
+                        "componentSpecs": [
+                            {"spec": {"containers": [
+                                {"name": "classifier", "image": "user/classifier:1"}
+                            ]}}
+                        ],
+                    }
+                ],
+            },
+        }
+    ).to_dict()
+
+
+class TestControlPlaneEndToEnd:
+    """The operator loop and the gateway watcher, run unmodified against
+    the fake apiserver through the real HTTP client."""
+
+    def test_operator_reconciles_over_the_wire(self, tmp_path):
+        token_file = tmp_path / "token"
+        token_file.write_text("t0k3n")
+
+        async def go(kube):
+            op = OperatorLoop(kube, Controller(kube), resync_s=30.0)
+            await op.start()
+            try:
+                await kube.create(CR_KIND, "default", _operator_cr())
+                await _settle(
+                    lambda: sim.object_names("Deployment")
+                    == {"mydep-p1-engine", "mydep-p1-0"}
+                )
+                await _settle(
+                    lambda: (sim.object(CR_KIND, "default", "mydep") or {})
+                    .get("status", {}).get("state") is not None
+                )
+
+                # CR deletion GCs the owned workloads
+                await kube.delete(CR_KIND, "default", "mydep")
+                await _settle(lambda: sim.object_names("Deployment") == set())
+            finally:
+                await op.stop()
+
+        with KubeSim(token="t0k3n") as sim:
+            _run_with_kube(sim, go, token_path=str(token_file))
+
+    def test_gateway_watcher_tracks_crs_over_the_wire(self):
+        async def go(kube):
+            store = DeploymentStore()
+            watcher = GatewayWatcher(kube, store)
+            await watcher.start()
+            try:
+                await kube.create(CR_KIND, "default", _cr("depA"))
+                await _settle(lambda: store.get("depA-key") is not None)
+                assert store.get("depA-key").oauth_secret == "s3cret"
+
+                await kube.patch(
+                    CR_KIND, "default", "depA",
+                    {"spec": {"oauth_secret": "rotated"}},
+                )
+                await _settle(lambda: store.get("depA-key").oauth_secret == "rotated")
+
+                await kube.delete(CR_KIND, "default", "depA")
+                await _settle(lambda: store.get("depA-key") is None)
+            finally:
+                await watcher.stop()
+
+        with KubeSim() as sim:
+            _run_with_kube(sim, go)
+
+    def test_gateway_watcher_survives_410_storm(self):
+        async def go(kube):
+            store = DeploymentStore()
+            watcher = GatewayWatcher(kube, store)
+            watcher.damper.base_ms = 1.0
+            watcher.damper.max_ms = 5.0
+            sim.watch_gone(3)
+            await watcher.start()
+            try:
+                # the storm: three watch opens answered 410, each damped
+                await _settle(lambda: watcher.damper.relists >= 3)
+                # then the plane heals and events flow again
+                await kube.create(CR_KIND, "default", _cr("depA"))
+                await _settle(lambda: store.get("depA-key") is not None)
+            finally:
+                await watcher.stop()
+
+        with KubeSim() as sim:
+            _run_with_kube(sim, go)
+        assert sim.watch_opens >= 3
+
+
+class TestRelistDamper:
+    def test_first_gone_is_free(self):
+        d = RelistDamper(base_ms=50.0, max_ms=200.0)
+
+        async def go():
+            t0 = asyncio.get_event_loop().time()
+            await d.wait()
+            return asyncio.get_event_loop().time() - t0
+
+        assert run(go()) < 0.04
+        assert d.relists == 1
+        assert d.slept_ms == 0.0
+
+    def test_streak_backs_off_exponentially_and_caps(self):
+        d = RelistDamper(base_ms=8.0, max_ms=20.0)
+
+        async def go():
+            for _ in range(6):
+                await d.wait()
+
+        run(go())
+        assert d.relists == 6
+        # 5 charged waits, each jittered in [0.5, 1.5] x base x 2^k, capped
+        assert 0.5 * 8.0 <= d.slept_ms <= 5 * 20.0
+
+    def test_processed_event_resets_the_streak(self):
+        d = RelistDamper(base_ms=8.0, max_ms=20.0)
+
+        async def go():
+            await d.wait()
+            await d.wait()
+            d.reset()  # a watch event landed: next Gone is a fresh streak
+            await d.wait()
+
+        run(go())
+        assert d.streak == 1
+        assert d.slept_ms <= 20.0
