@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check chaos-check lint-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check chaos-check fleet-check lint-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -144,8 +144,16 @@ chaos-check:
 	JAX_PLATFORMS=cpu BENCH_ONLY=CHAOS BENCH_RUNS=1 \
 		BENCH_CHAOS_ROUNDS=3 $(PYTHON) bench.py
 
+# fleet telemetry plane (docs/OBSERVABILITY.md): cluster aggregation,
+# history rings, SLO burn rates; the bench stage proves counter-exact
+# merges and an ok->page->ok burn transition under open-loop overload
+fleet-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fleet.py -q
+	JAX_PLATFORMS=cpu BENCH_ONLY=FLEET BENCH_RUNS=1 $(PYTHON) bench.py
+
 # invariant-aware static analysis (docs/STATIC_ANALYSIS.md): host-sync,
-# program-key, pairing, env-registry, async-discipline, test-hygiene.
+# program-key, pairing, env-registry, async-discipline, test-hygiene,
+# ring-growth.
 # Stdlib-only (no jax), so the bare CI lint job runs it without installs;
 # fails on any finding not in sctlint-baseline.json and on stale
 # baseline entries

@@ -203,6 +203,9 @@ class EngineApp:
         r.add_get("/stats/wire", self.stats_wire)
         # caching & reuse plane state (docs/CACHING.md)
         r.add_get("/stats/cache", self.stats_cache)
+        # fleet-collector scrape: qos+breakdown+cache+wire+mergeable
+        # stage histograms in ONE round trip (docs/OBSERVABILITY.md)
+        r.add_get("/stats/summary", self.stats_summary)
         # compile-warmup plane: programs compiled + seconds per unit
         # (docs/PERFORMANCE.md) — the readiness-tail attribution
         r.add_get("/stats/warmup", self.stats_warmup)
@@ -697,6 +700,9 @@ class EngineApp:
         (``kv_slots_per_chip``, layout dtype), and per-slot inter-token
         latency (``itl_p50_ms``/``itl_p99_ms`` — prefill-induced decode
         stalls land here; docs/PERFORMANCE.md §7)."""
+        return web.json_response(self._breakdown_payload())
+
+    def _breakdown_payload(self) -> dict:
         payload: dict = {"stages": RECORDER.breakdown()}
         try:
             units = self.service.generative_units()
@@ -732,7 +738,7 @@ class EngineApp:
                 "hbm": MEMORY.snapshot(),
                 "host": host_memory().snapshot(),
             }
-        return web.json_response(payload)
+        return payload
 
     async def stats_qos(self, request: web.Request) -> web.Response:
         """QoS plane state: admission caps, shed counters by reason,
@@ -765,9 +771,27 @@ class EngineApp:
         """Caching & reuse plane state: response/node cache hit rates,
         single-flight collapse counters, KV prefix-reuse index (with its
         per-tier ledgers), and this engine's peer-pull counters."""
+        return web.json_response({"cache": self._cache_payload()})
+
+    def _cache_payload(self) -> dict:
         snap = self.service.cache_snapshot()
         snap["prefix_pull"] = dict(self.prefix_pull_stats)
-        return web.json_response({"cache": snap})
+        return snap
+
+    async def stats_summary(self, request: web.Request) -> web.Response:
+        """One cheap scrape for the fleet collector
+        (docs/OBSERVABILITY.md "Fleet telemetry"): the qos, breakdown,
+        cache, and wire payloads bundled into a single round trip, plus
+        the MERGEABLE per-stage histogram bucket counts (shared
+        ``obs/history.BUCKET_EDGES`` grid) that fleet p50/p99 are
+        computed from — replica quantiles themselves never merge."""
+        return web.json_response({
+            "qos": self.qos.snapshot(),
+            "breakdown": self._breakdown_payload(),
+            "cache": self._cache_payload(),
+            "wire": wire_stats_payload(),
+            "stage_hist": RECORDER.stage_histograms(),
+        })
 
     async def profile_start(self, request: web.Request) -> web.Response:
         import jax

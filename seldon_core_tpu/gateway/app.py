@@ -157,6 +157,15 @@ class GatewayApp:
         self._retry_backoff_max_ms = _settings.get_float(
             "SCT_GW_RETRY_BACKOFF_MAX_MS"
         )
+        # fleet telemetry plane (docs/OBSERVABILITY.md): the gateway runs
+        # its own collector over the SAME store, re-exporting
+        # /stats/fleet + /stats/slo on both REST fronts.  Always
+        # constructed (the timeline fan-out reuses its endpoint
+        # enumeration + session); polling starts only when SCT_FLEET.
+        from seldon_core_tpu.obs.fleet import FleetCollector
+
+        self.fleet = FleetCollector(store, service="gateway")
+        self._fleet_enabled = _settings.get_bool("SCT_FLEET")
         # removed deployments lose their live tokens immediately
         store.add_listener(self._on_deployment_event)
 
@@ -250,10 +259,13 @@ class GatewayApp:
         # replica-state refresh for multi-upstream records (digest + queue
         # wait); single-upstream-only stores make every sweep a no-op
         self.poller.start()
+        if self._fleet_enabled:
+            await self.fleet.start()
         return None  # pools connect lazily per deployment
 
     async def close(self) -> None:
         await self.poller.stop()
+        await self.fleet.stop()
         pools, self._pools = list(self._pools.values()), {}
         for pool in pools:
             await pool.close()
@@ -281,6 +293,11 @@ class GatewayApp:
         r.add_get("/stats/wire", self.stats_wire)
         r.add_get("/stats/cache", self.stats_cache)
         r.add_get("/stats/route", self.stats_route)
+        # fleet telemetry plane (docs/OBSERVABILITY.md "Fleet telemetry")
+        r.add_get("/stats/fleet", self.stats_fleet)
+        r.add_get("/stats/slo", self.stats_slo)
+        # replica-set timeline fan-out: one query stitches every leg
+        r.add_get("/stats/timeline", self.stats_timeline)
 
         async def _startup(app_: web.Application) -> None:
             await self.start()
@@ -753,6 +770,34 @@ class GatewayApp:
     async def stats_route(self, request: web.Request) -> web.Response:
         return web.json_response({"route": self.route_snapshot()})
 
+    def fleet_snapshot(self) -> dict:
+        """Per-deployment fleet aggregates (shared by both REST fronts'
+        /stats/fleet): summed counters, histogram-merged percentiles,
+        staleness-annotated replica lists, bounded history tail."""
+        return {"enabled": self._fleet_enabled, **self.fleet.fleet_snapshot()}
+
+    def slo_snapshot(self) -> dict:
+        """SLO burn-rate engine state (shared by both REST fronts'
+        /stats/slo)."""
+        return self.fleet.slo_snapshot()
+
+    async def stats_fleet(self, request: web.Request) -> web.Response:
+        return web.json_response({"fleet": self.fleet_snapshot()})
+
+    async def stats_slo(self, request: web.Request) -> web.Response:
+        return web.json_response({"slo": self.slo_snapshot()})
+
+    async def stats_timeline(self, request: web.Request) -> web.Response:
+        """Replica-set timeline fan-out: ``?trace=<id>`` queries every
+        replica endpoint of every deployment and returns the stitched
+        legs (a split prefill/decode trace is one query, not N)."""
+        trace = request.query.get("trace")
+        if not trace:
+            return web.json_response(
+                {"error": "trace query parameter required"}, status=400
+            )
+        return web.json_response(await self.fleet.fan_timeline(trace))
+
 
 def main(argv: list[str] | None = None) -> None:
     parser = argparse.ArgumentParser(description="seldon-core-tpu API gateway")
@@ -848,6 +893,10 @@ def _run_h1(gateway: GatewayApp, store: DeploymentStore, args) -> None:
         frontend = H1SpliceFrontend(gateway)
         await frontend.start(args.port)
         log.info("gateway REST (h1 splice) on :%d", frontend.bound_port)
+        # fleet telemetry rides the same loop (gateway.close() below
+        # stops it); the splice path itself never touches the collector
+        if gateway._fleet_enabled:
+            await gateway.fleet.start()
 
         watcher = None
         if args.watch:

@@ -746,7 +746,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                     cache[head] = parsed
             (method, route, content_length, auth, traceparent,
              deadline_ms, priority, chunked, expect, close_after,
-             rewritten_head, splice_base) = parsed
+             rewritten_head, splice_base, query) = parsed
             if chunked:
                 # nothing we serve needs chunked uploads; keep the parser
                 # simple and honest
@@ -778,7 +778,8 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
                 # through the fallback would not be.
                 service = None
             if service is None:
-                head_headers = (auth, traceparent, deadline_ms, priority)
+                head_headers = (auth, traceparent, deadline_ms, priority,
+                                query)
                 body = bytes(buf[idx + 4 : total])
                 del buf[:total]
                 self.awaiting = True
@@ -967,8 +968,10 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
             self._close()
             return None
         method, path, version = parts
-        # strip query string for routing (forwarded verbatim regardless)
-        route = path.split(b"?", 1)[0]
+        # strip query string for routing (forwarded verbatim; fallback
+        # cores that need it — /stats/timeline?trace= — get it from the
+        # parsed tuple, which memoizes with the head it came from)
+        route, _, query = path.partition(b"?")
         content_length = None
         auth = ""
         traceparent = None
@@ -1044,7 +1047,7 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
         return (
             method, route, content_length or 0, auth, traceparent,
             deadline_ms, priority, chunked, expect, close_after, rewritten,
-            base,
+            base, query,
         )
 
     # -- splice callbacks ---------------------------------------------------
@@ -1228,11 +1231,11 @@ class _DownConn(WriteCoalescer, asyncio.Protocol):
     # -- fallback (full-parse) path -----------------------------------------
 
     async def _fallback(self, method: bytes, route: bytes, meta, body: bytes) -> None:
-        auth, traceparent, deadline_ms, priority = meta
+        auth, traceparent, deadline_ms, priority, query = meta
         try:
             status, payload, ctype = await self.frontend.handle_fallback(
                 method, route, auth, traceparent, body,
-                deadline_ms=deadline_ms, priority=priority,
+                deadline_ms=deadline_ms, priority=priority, query=query,
             )
         except asyncio.CancelledError:
             raise
@@ -1495,6 +1498,7 @@ class H1SpliceFrontend:
         body: bytes,
         deadline_ms: float | None = None,
         priority: str = qos.PRIO_INTERACTIVE,
+        query: bytes = b"",
     ) -> tuple[int, bytes, bytes]:
         gw = self.gateway
         # ingress_core re-parses header VALUES, so hand the already-parsed
@@ -1571,6 +1575,24 @@ class H1SpliceFrontend:
         if route == b"/stats/route":
             return 200, json.dumps(
                 {"route": gw.route_snapshot()}
+            ).encode(), b"application/json"
+        if route == b"/stats/fleet":
+            return 200, json.dumps(
+                {"fleet": gw.fleet_snapshot()}
+            ).encode(), b"application/json"
+        if route == b"/stats/slo":
+            return 200, json.dumps(
+                {"slo": gw.slo_snapshot()}
+            ).encode(), b"application/json"
+        if route == b"/stats/timeline":
+            form = urllib.parse.parse_qs(query.decode("latin-1"))
+            trace = (form.get("trace") or [""])[0]
+            if not trace:
+                return 400, json.dumps(failure_status_dict(
+                    400, "trace query parameter required"
+                )).encode(), b"application/json"
+            return 200, json.dumps(
+                await gw.fleet.fan_timeline(trace)
             ).encode(), b"application/json"
         return 404, json.dumps(
             failure_status_dict(404, f"no route {route.decode('latin-1')}")

@@ -165,7 +165,7 @@ class GatewayWatcher:
                 )
             ),
             endpoints=endpoints,
-            annotations={_SOURCE_ANNOTATION: "watch"},
+            annotations=_carried_annotations(meta.get("annotations", {})),
         )
 
     def _apply(self, event: str, raw: dict) -> None:
@@ -197,3 +197,15 @@ class GatewayWatcher:
 
 def _is_watch_sourced(rec: DeploymentRecord) -> bool:
     return rec.annotations.get(_SOURCE_ANNOTATION) == "watch"
+
+
+def _carried_annotations(cr_annotations: dict) -> dict[str, str]:
+    """Record annotations: the watch-source marker plus the CR annotations
+    the serving plane consumes downstream (the SLO spec feeds the fleet
+    collector's burn-rate engine).  The spec-hash already folds ALL CR
+    annotations in, so a changed SLO spec rolls the record."""
+    out = {_SOURCE_ANNOTATION: "watch"}
+    slo = cr_annotations.get("seldon.io/slo")
+    if slo:
+        out["seldon.io/slo"] = str(slo)
+    return out

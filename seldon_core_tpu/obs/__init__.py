@@ -49,6 +49,21 @@ from seldon_core_tpu.obs.probes import (  # noqa: F401
     host_sync_snapshot,
     record_host_sync,
 )
+from seldon_core_tpu.obs.history import (  # noqa: F401
+    BUCKET_EDGES,
+    History,
+    hist_percentile_ms,
+    merge_hist,
+    new_hist,
+)
+from seldon_core_tpu.obs.slo import (  # noqa: F401
+    SLO_ANNOTATION,
+    SloEngine,
+    SloError,
+    SloObjective,
+    parse_slo,
+)
+from seldon_core_tpu.obs.fleet import FleetCollector  # noqa: F401
 
 
 def configure_exporters_from_env(recorder: SpanRecorder | None = None) -> list:

@@ -32,6 +32,7 @@ import time
 from collections import defaultdict, deque
 from typing import Any, Iterator
 
+from seldon_core_tpu.obs import history as _history
 from seldon_core_tpu.utils.tracectx import (
     get_traceparent,
     make_span_id,
@@ -197,6 +198,11 @@ class SpanRecorder:
         # cumulative (survive ring eviction); lock-free int adds are fine
         # for stats — a lost increment under a rare thread race is noise
         self._stage_counts: dict[str, int] = defaultdict(int)
+        # cumulative per-stage bucket counts on the SHARED grid
+        # (obs/history.BUCKET_EDGES): unlike breakdown()'s ring quantiles
+        # these merge across replicas — the fleet collector sums them and
+        # derives p50/p99 from the merged counts
+        self._stage_hist: dict[str, list[int]] = defaultdict(_history.new_hist)
         self.recorded = 0
         self.sampled_out = 0
         self.exporters: list = []
@@ -337,6 +343,7 @@ class SpanRecorder:
         append is atomic), O(1)."""
         self._stages[stage].append(duration_s)
         self._stage_counts[stage] += 1
+        _history.record_hist(self._stage_hist[stage], duration_s)
 
     # -- reading -----------------------------------------------------------
 
@@ -374,6 +381,18 @@ class SpanRecorder:
                 "max_ms": round(vals[-1] * 1e3, 3),
             }
         return out
+
+    def stage_histograms(self) -> dict:
+        """Cumulative per-stage bucket counts over the shared log grid
+        (``obs/history.BUCKET_EDGES``) — the MERGEABLE form of
+        :meth:`breakdown`.  Served in ``GET /stats/summary`` so the fleet
+        collector can sum counts across replicas and compute true fleet
+        percentiles instead of averaging per-replica quantiles."""
+        return {
+            stage: list(h)
+            for stage, h in list(self._stage_hist.items())
+            if self._stage_counts.get(stage)
+        }
 
     def recent_traces(self, n: int = 20) -> list[dict]:
         """The last ``n`` traces (newest first), each with its spans in

@@ -19,8 +19,41 @@ from seldon_core_tpu.operator.controller import Controller
 from seldon_core_tpu.operator.kube_http import HttpKube
 from seldon_core_tpu.operator.resources import ENGINE_IMAGE_DEFAULT
 from seldon_core_tpu.operator.watcher import OperatorLoop
+from seldon_core_tpu.runtime import settings as _settings
 
 log = logging.getLogger(__name__)
+
+
+async def _start_fleet(kube, namespace: str):
+    """Fleet telemetry inside the operator (docs/OBSERVABILITY.md): a
+    gateway-style CR watcher feeds the replica registry, the collector
+    polls every replica's stats, and a small aiohttp app serves the
+    aggregates on SCT_FLEET_PORT.  All of it runs on the operator's loop
+    but never inside reconcile — scrapes are independent tasks."""
+    from aiohttp import web
+
+    from seldon_core_tpu.gateway.store import DeploymentStore
+    from seldon_core_tpu.gateway.watch import GatewayWatcher
+    from seldon_core_tpu.obs.fleet import FleetCollector, build_stats_app
+
+    store = DeploymentStore()
+    watcher = GatewayWatcher(kube, store, namespace=namespace)
+    await watcher.start()
+    collector = FleetCollector(store, service="operator")
+    await collector.start()
+    runner = web.AppRunner(build_stats_app(collector))
+    await runner.setup()
+    port = _settings.get_int("SCT_FLEET_PORT")
+    site = web.TCPSite(runner, "0.0.0.0", port)
+    await site.start()
+    log.info("fleet collector serving /stats/fleet on :%d", port)
+
+    async def stop() -> None:
+        await collector.stop()
+        await watcher.stop()
+        await runner.cleanup()
+
+    return stop
 
 
 async def run(kube_url: str | None, namespace: str, engine_image: str) -> None:
@@ -29,11 +62,16 @@ async def run(kube_url: str | None, namespace: str, engine_image: str) -> None:
     controller = Controller(kube, engine_image=engine_image)
     loop = OperatorLoop(kube, controller, namespace=namespace)
     await loop.start()
+    fleet_stop = None
+    if _settings.get_bool("SCT_FLEET"):
+        fleet_stop = await _start_fleet(kube, namespace)
     stop = asyncio.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         asyncio.get_running_loop().add_signal_handler(sig, stop.set)
     log.info("operator running (namespace=%s)", namespace)
     await stop.wait()
+    if fleet_stop is not None:
+        await fleet_stop()
     await loop.stop()
     await kube.close()
 

@@ -186,6 +186,19 @@ def validate(mldep: SeldonDeployment) -> None:
     (reference: SeldonDeploymentOperatorImpl.java:432-441)."""
     if not mldep.spec.predictors:
         raise ValidationError("deployment has no predictors")
+    # a malformed SLO spec must fail at ADMISSION: the fleet collector only
+    # sees the annotation after the CR is stored, where a parse error would
+    # silently disable burn-rate alerting for the deployment
+    from seldon_core_tpu.obs.slo import SLO_ANNOTATION, SloError, parse_slo
+
+    slo_spec = mldep.metadata.annotations.get(SLO_ANNOTATION, "").strip()
+    if slo_spec:
+        try:
+            parse_slo(slo_spec)
+        except SloError as exc:
+            raise ValidationError(
+                f"annotation {SLO_ANNOTATION}: {exc}"
+            ) from exc
     for predictor in mldep.spec.predictors:
         # a typo'd disagg role must fail at ADMISSION, not brick the engine
         # pod at boot (resolve_role raises there too, but that surfaces as

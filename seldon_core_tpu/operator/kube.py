@@ -135,6 +135,7 @@ class FakeKube:
     def _emit(self, event: str, kind: str, obj: dict[str, Any]) -> None:
         ns = obj.get("metadata", {}).get("namespace", "default")
         rv = int(obj["metadata"]["resourceVersion"])
+        # sct: ring-growth-ok fake-apiserver event log: resume-from-rv needs it whole, lifetime is one test/embed run
         self._history.append((rv, event, kind, ns, copy.deepcopy(obj)))
         for wkind, wns, queue in self._watchers:
             if wkind == kind and wns in (ns, ""):

@@ -403,6 +403,61 @@ declare("SCT_LOOP_LAG_INTERVAL_S", "0.25", "float",
         "Event-loop lag probe interval (seconds).",
         section="observability")
 
+# -- fleet telemetry (collector + SLO engine; docs/OBSERVABILITY.md) --------
+declare("SCT_FLEET", "1", "bool",
+        "Run the fleet collector (operator + gateway): per-deployment "
+        "aggregation of replica /stats/* into GET /stats/fleet.",
+        section="fleet")
+declare("SCT_FLEET_POLL_S", "10", "float",
+        "Fleet collector poll interval (seconds, jittered).",
+        section="fleet")
+declare("SCT_FLEET_JITTER", "0.2", "float",
+        "Poll-interval jitter fraction [0, 1] so a replica set is never "
+        "scraped in lockstep.",
+        section="fleet")
+declare("SCT_FLEET_TIMEOUT_S", "2.0", "float",
+        "Per-replica scrape HTTP timeout (seconds).",
+        section="fleet")
+declare("SCT_FLEET_STALE_POLLS", "3", "int",
+        "Polls without a successful scrape before a replica is marked "
+        "stale and excluded from aggregates (not zeroed).",
+        section="fleet")
+declare("SCT_FLEET_FAIL_DAMP", "3", "int",
+        "Consecutive scrape failures before the collector damps that "
+        "replica (skips a growing number of polls, capped).",
+        section="fleet")
+declare("SCT_FLEET_HISTORY_SLOTS", "360", "int",
+        "Slots per time-series ring per resolution (10s and 2min rings; "
+        "bounded, drop-on-full).",
+        section="fleet")
+declare("SCT_FLEET_PORT", "9109", "int",
+        "Stats port of the operator / standalone collector "
+        "(GET /stats/fleet, GET /stats/slo).",
+        section="fleet")
+declare("SCT_SLO", "1", "bool",
+        "Evaluate declared SLO objectives as multi-window burn rates.",
+        section="fleet")
+declare("SCT_SLO_DEFAULT", None, "str",
+        "Fallback SLO spec (seldon.io/slo grammar) for deployments "
+        "without the annotation (unset = no objectives).",
+        section="fleet")
+declare("SCT_SLO_FAST_WINDOW_S", "60", "float",
+        "Fast burn-rate window (seconds) — pages quickly on hard "
+        "outages.",
+        section="fleet")
+declare("SCT_SLO_SLOW_WINDOW_S", "600", "float",
+        "Slow burn-rate window (seconds) — confirms sustained burn "
+        "before paging.",
+        section="fleet")
+declare("SCT_SLO_PAGE_BURN", "14.0", "float",
+        "Burn-rate threshold (x budget) that flips warn -> page when "
+        "both windows exceed it.",
+        section="fleet")
+declare("SCT_SLO_WARN_BURN", "6.0", "float",
+        "Burn-rate threshold (x budget) that flips ok -> warn when "
+        "both windows exceed it.",
+        section="fleet")
+
 # -- multi-host mesh boot contract (operator-injected; jax-free reader in
 #    utils/mesh_contract.py) ------------------------------------------------
 declare("SCT_NUM_PROCESSES", None, "int",
@@ -444,6 +499,7 @@ _SECTION_TITLES = {
     "gateway": "Gateway data plane",
     "resilience": "Resilience / chaos plane",
     "observability": "Observability",
+    "fleet": "Fleet telemetry (collector + SLO engine)",
     "mesh": "Multi-host mesh boot contract",
     "general": "General",
 }

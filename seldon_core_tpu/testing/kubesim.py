@@ -150,6 +150,7 @@ class KubeSim:
 
     def _emit(self, event: str, kind: str, ns: str, obj: dict[str, Any]) -> None:
         rv = int(obj["metadata"]["resourceVersion"])
+        # sct: ring-growth-ok fake-apiserver event log: resume-from-rv needs it whole, lifetime is one test run
         self._history.append((rv, event, kind, ns, copy.deepcopy(obj)))
         for wkind, wns, q in self._watch_queues:
             if wkind == kind and wns in (ns, ""):

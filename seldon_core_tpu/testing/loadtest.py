@@ -65,6 +65,13 @@ class WorkerConfig:
     headers: dict[str, str]
     warmup_requests: int = 8
     grpc_lib: str = "h2"  # "h2" (wire/h2grpc client) or "grpcio"
+    # > 0 switches the REST loop to OPEN-LOOP Poisson arrivals: requests
+    # launch on an exponential-gap clock regardless of completions.  A
+    # closed loop self-throttles under overload (every slow response slows
+    # the offered rate), hiding queue growth; the open loop keeps offering
+    # load, so offered-vs-achieved exposes the capacity gap.
+    arrival_rps: float = 0.0
+    seed: int = 0
 
 
 @dataclasses.dataclass
@@ -73,16 +80,23 @@ class LoadResult:
     failures: int
     elapsed_s: float
     hist: np.ndarray
+    # open-loop runs only: arrivals DISPATCHED (>= requests completed
+    # within the drain window); 0 for closed-loop runs
+    offered: int = 0
 
     @property
     def rps(self) -> float:
         return self.requests / self.elapsed_s if self.elapsed_s > 0 else 0.0
 
+    @property
+    def offered_rps(self) -> float:
+        return self.offered / self.elapsed_s if self.elapsed_s > 0 else 0.0
+
     def percentile_ms(self, q: float) -> float:
         return _percentile(self.hist, q) * 1000.0
 
     def summary(self) -> dict[str, Any]:
-        return {
+        out = {
             "requests": self.requests,
             "failures": self.failures,
             "seconds": round(self.elapsed_s, 2),
@@ -92,14 +106,25 @@ class LoadResult:
             "p95_ms": round(self.percentile_ms(95), 3),
             "p99_ms": round(self.percentile_ms(99), 3),
         }
+        if self.offered:
+            out["offered"] = self.offered
+            out["offered_rps"] = round(self.offered_rps, 2)
+            out["achieved_ratio"] = (
+                round(self.requests / self.offered, 4) if self.offered else None
+            )
+        return out
 
 
-async def _rest_worker_loop(cfg: WorkerConfig) -> tuple[int, int, np.ndarray]:
+async def _rest_worker_loop(cfg: WorkerConfig) -> tuple[int, int, int, np.ndarray]:
     import aiohttp
 
     hist = _histogram()
     counts = [0, 0]  # ok, fail
-    connector = aiohttp.TCPConnector(limit=cfg.concurrency + 8, keepalive_timeout=60)
+    offered = 0
+    # open loop: in-flight is unbounded by design (limit=0), the server's
+    # admission control is what's under test
+    limit = 0 if cfg.arrival_rps > 0 else cfg.concurrency + 8
+    connector = aiohttp.TCPConnector(limit=limit, keepalive_timeout=60)
     headers = {"Content-Type": "application/json", **cfg.headers}
     async with aiohttp.ClientSession(connector=connector) as session:
 
@@ -117,6 +142,36 @@ async def _rest_worker_loop(cfg: WorkerConfig) -> tuple[int, int, np.ndarray]:
 
         stop_at = time.perf_counter() + cfg.duration_s
 
+        if cfg.arrival_rps > 0:
+
+            async def timed(i: int) -> None:
+                t0 = time.perf_counter()
+                ok = await one(i)
+                _record(hist, time.perf_counter() - t0)
+                counts[0 if ok else 1] += 1
+
+            rng = np.random.default_rng(cfg.seed)
+            inflight: set[asyncio.Task] = set()
+            i = 0
+            next_t = time.perf_counter()
+            while next_t < stop_at:
+                delay = next_t - time.perf_counter()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                t = asyncio.get_running_loop().create_task(timed(i))
+                inflight.add(t)
+                t.add_done_callback(inflight.discard)
+                offered += 1
+                i += 1
+                next_t += float(rng.exponential(1.0 / cfg.arrival_rps))
+            if inflight:
+                # drain window: late responses still count; stragglers
+                # past it are abandoned (they'd skew elapsed_s instead)
+                await asyncio.wait(inflight, timeout=30.0)
+                for t in list(inflight):
+                    t.cancel()
+            return counts[0], counts[1], offered, hist
+
         async def worker(wid: int) -> None:
             i = wid
             while time.perf_counter() < stop_at:
@@ -127,10 +182,10 @@ async def _rest_worker_loop(cfg: WorkerConfig) -> tuple[int, int, np.ndarray]:
                 i += cfg.concurrency
 
         await asyncio.gather(*(worker(w) for w in range(cfg.concurrency)))
-    return counts[0], counts[1], hist
+    return counts[0], counts[1], 0, hist
 
 
-async def _grpc_worker_loop(cfg: WorkerConfig) -> tuple[int, int, np.ndarray]:
+async def _grpc_worker_loop(cfg: WorkerConfig) -> tuple[int, int, int, np.ndarray]:
     if cfg.grpc_lib == "grpcio":
         return await _grpcio_worker_loop(cfg)
 
@@ -173,10 +228,10 @@ async def _grpc_worker_loop(cfg: WorkerConfig) -> tuple[int, int, np.ndarray]:
         await asyncio.gather(*(worker(w) for w in range(cfg.concurrency)))
     finally:
         await channel.close()
-    return counts[0], counts[1], hist
+    return counts[0], counts[1], 0, hist
 
 
-async def _grpcio_worker_loop(cfg: WorkerConfig) -> tuple[int, int, np.ndarray]:
+async def _grpcio_worker_loop(cfg: WorkerConfig) -> tuple[int, int, int, np.ndarray]:
     import grpc
 
     from seldon_core_tpu.proto import prediction_pb2 as pb
@@ -211,13 +266,13 @@ async def _grpcio_worker_loop(cfg: WorkerConfig) -> tuple[int, int, np.ndarray]:
                 i += cfg.concurrency
 
         await asyncio.gather(*(worker(w) for w in range(cfg.concurrency)))
-    return counts[0], counts[1], hist
+    return counts[0], counts[1], 0, hist
 
 
-def _run_worker(cfg: WorkerConfig) -> tuple[int, int, bytes]:
+def _run_worker(cfg: WorkerConfig) -> tuple[int, int, int, bytes]:
     loop = _grpc_worker_loop if cfg.grpc else _rest_worker_loop
-    ok, fail, hist = asyncio.run(loop(cfg))
-    return ok, fail, hist.tobytes()
+    ok, fail, offered, hist = asyncio.run(loop(cfg))
+    return ok, fail, offered, hist.tobytes()
 
 
 def run_load(
@@ -230,12 +285,18 @@ def run_load(
     duration_s: float = 10.0,
     headers: dict[str, str] | None = None,
     grpc_lib: str = "h2",
+    arrival_rps: float = 0.0,
+    seed: int = 0,
 ) -> LoadResult:
     """Drive ``target`` for ``duration_s``; returns merged results.
 
     ``concurrency`` is per process — total in-flight = concurrency ×
     processes.  With ``processes > 1`` client CPU (JSON encode, socket IO)
     scales past one GIL, like the reference's locust slaves.
+
+    ``arrival_rps > 0`` selects OPEN-LOOP Poisson arrivals (REST only):
+    the rate is split evenly across processes, ``concurrency`` is ignored,
+    and the result carries offered-vs-achieved throughput.
     """
     cfg = WorkerConfig(
         target=target,
@@ -245,23 +306,31 @@ def run_load(
         duration_s=duration_s,
         headers=headers or {},
         grpc_lib=grpc_lib,
+        arrival_rps=arrival_rps / max(1, processes),
+        seed=seed,
     )
+    if arrival_rps > 0 and grpc:
+        raise ValueError("open-loop arrivals are REST-only")
     t0 = time.perf_counter()
     if processes <= 1:
-        ok, fail, hist_b = _run_worker(cfg)
-        results = [(ok, fail, hist_b)]
+        results = [_run_worker(cfg)]
     else:
         ctx = multiprocessing.get_context("spawn")
+        cfgs = [dataclasses.replace(cfg, seed=cfg.seed + p) for p in range(processes)]
         with ctx.Pool(processes) as pool:
-            results = pool.map(_run_worker, [cfg] * processes)
+            results = pool.map(_run_worker, cfgs)
     elapsed = time.perf_counter() - t0
     hist = _histogram()
-    ok = fail = 0
-    for o, f, h in results:
+    ok = fail = offered = 0
+    for o, f, off, h in results:
         ok += o
         fail += f
+        offered += off
         hist += np.frombuffer(h, np.int64)
-    return LoadResult(requests=ok + fail, failures=fail, elapsed_s=elapsed, hist=hist)
+    return LoadResult(
+        requests=ok + fail, failures=fail, elapsed_s=elapsed, hist=hist,
+        offered=offered,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -339,6 +408,9 @@ def main(argv: list[str] | None = None) -> None:
                         help="in-flight requests per process")
     parser.add_argument("-P", "--processes", type=int, default=1)
     parser.add_argument("-d", "--duration", type=float, default=10.0)
+    parser.add_argument("-r", "--arrival-rps", type=float, default=0.0,
+                        help="open-loop Poisson arrival rate (REST only); "
+                             "0 = closed loop")
     parser.add_argument("-b", "--batch-size", type=int, default=1)
     parser.add_argument("--contract", help="generate payloads from contract.json")
     parser.add_argument("--data", help="literal JSON request body (REST)")
@@ -376,6 +448,7 @@ def main(argv: list[str] | None = None) -> None:
         duration_s=args.duration,
         headers=headers,
         grpc_lib=args.grpc_lib,
+        arrival_rps=args.arrival_rps,
     )
     print(json.dumps(result.summary()))
     sys.exit(0 if result.failures == 0 and result.requests > 0 else 1)

@@ -7,6 +7,7 @@ from seldon_core_tpu.tools.sctlint.rules import (
     host_sync,
     pairing,
     program_key,
+    ring_growth,
     test_hygiene,
 )
 
@@ -17,6 +18,7 @@ RULES = [
     env_registry.RULE,
     async_discipline.RULE,
     test_hygiene.RULE,
+    ring_growth.RULE,
 ]
 
 BY_ID = {r.id: r for r in RULES}

@@ -1,0 +1,94 @@
+"""ring-growth: no unbounded appends into ring/history buffers.
+
+The telemetry plane's time-series store (``obs/history.py``) and the span
+rings hold the line on memory by PREALLOCATING fixed-capacity slots and
+overwriting in place (drop-on-full) — zero allocation at steady state, no
+growth under a scrape storm or a metric-name explosion.  One stray
+``.append()`` into such a buffer silently converts it back into an
+unbounded list, and the leak only shows up days later in a long-lived
+operator or gateway.
+
+Flagged in package code (tests excluded):
+
+* ``<recv>.append(...)`` / ``.extend(...)`` / ``.insert(...)`` where the
+  receiver's dotted name names a ring buffer (contains ``ring``,
+  ``history``, ``hist``, or ``samples``);
+* ``<name> = deque()`` **without** ``maxlen`` where the target names a
+  ring buffer — an unbounded deque is the same leak one constructor
+  earlier.
+
+Legitimately bounded growth is annotated in place with the reason:
+``# sct: ring-growth-ok <why this cannot grow without bound>`` (e.g. a
+``deque(maxlen=...)`` that drops oldest, or a test-double event log whose
+lifetime is one test run).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from seldon_core_tpu.tools.sctlint.core import Context, Finding, Rule, dotted
+
+GROW_VERBS = {"append", "extend", "insert"}
+RING_NAMES = ("ring", "history", "hist", "samples")
+
+
+def _names_ring(name: str) -> bool:
+    low = name.lower()
+    return any(s in low for s in RING_NAMES)
+
+
+def _deque_without_maxlen(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    fname = dotted(value.func)
+    if fname not in ("deque", "collections.deque"):
+        return False
+    return not any(kw.arg == "maxlen" for kw in value.keywords)
+
+
+def check(ctx: Context) -> Iterable[Finding]:
+    out: list[Finding] = []
+    for src in ctx.py:
+        if src.tree is None or "/tools/sctlint/" in src.rel:
+            continue
+        if src.rel.startswith("tests/"):
+            continue
+        for n in ast.walk(src.tree):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                recv = dotted(n.func.value)
+                if n.func.attr in GROW_VERBS and recv and _names_ring(recv):
+                    out.append(Finding(
+                        "ring-growth", src.rel, n.lineno,
+                        f"{recv}.{n.func.attr}() grows a ring/history "
+                        "buffer without bound — record into preallocated "
+                        "slots (obs/history._Ring) or annotate why growth "
+                        "is bounded",
+                        src.snippet(n.lineno),
+                    ))
+            elif isinstance(n, (ast.Assign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                value = n.value
+                if value is None or not _deque_without_maxlen(value):
+                    continue
+                for t in targets:
+                    tname = dotted(t)
+                    if tname and _names_ring(tname):
+                        out.append(Finding(
+                            "ring-growth", src.rel, n.lineno,
+                            f"{tname} is a deque() with no maxlen — an "
+                            "unbounded ring buffer; pass maxlen= or "
+                            "annotate why growth is bounded",
+                            src.snippet(n.lineno),
+                        ))
+                        break
+    return out
+
+
+RULE = Rule(
+    id="ring-growth",
+    summary="ring/history buffers never grow without bound",
+    explain=__doc__,
+    check=check,
+)

@@ -415,6 +415,46 @@ class MetricsRegistry:
             ["result"],
             registry=self.registry,
         )
+        self.fleet_replicas = Gauge(
+            "seldon_fleet_replicas",
+            "Replicas per deployment as the fleet collector sees them "
+            "(status: live / stale)",
+            ["deployment", "status"],
+            registry=self.registry,
+        )
+        self.fleet_counter = Gauge(
+            "seldon_fleet_counter",
+            "Fleet-summed QoS counters per deployment (admitted_total / "
+            "shed_total / deadline_miss_total)",
+            ["deployment", "counter"],
+            registry=self.registry,
+        )
+        self.fleet_p99_ms = Gauge(
+            "seldon_fleet_p99_ms",
+            "Histogram-merged fleet p99 per flight-recorder stage (ms)",
+            ["deployment", "stage"],
+            registry=self.registry,
+        )
+        self.slo_burn_rate = Gauge(
+            "seldon_slo_burn_rate",
+            "SLO error-budget burn rate per objective and window "
+            "(1.0 = burning exactly the budget)",
+            ["deployment", "objective", "window"],
+            registry=self.registry,
+        )
+        self.slo_state = Gauge(
+            "seldon_slo_state",
+            "SLO state per objective (0 ok, 1 warn, 2 page)",
+            ["deployment", "objective"],
+            registry=self.registry,
+        )
+        self.slo_transitions = Counter(
+            "seldon_slo_transitions",
+            "SLO state-machine transitions, labeled by the state "
+            "entered",
+            ["deployment", "objective", "to"],
+            registry=self.registry,
+        )
 
     @contextmanager
     def time_server_request(
