@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check chaos-check fleet-check lint-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check chaos-check fleet-check scale-check lint-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -150,6 +150,14 @@ chaos-check:
 fleet-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_fleet.py -q
 	JAX_PLATFORMS=cpu BENCH_ONLY=FLEET BENCH_RUNS=1 $(PYTHON) bench.py
+
+# elastic pool autoscaler (docs/AUTOSCALING.md): annotation grammar +
+# admission, the policy state machine on synthetic time, drain-based
+# shrink idempotency, the kubesim 1->N->1 e2e; the bench stage proves
+# the closed loop rides a diurnal trace without flapping or shedding
+scale-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_autoscale.py -q
+	JAX_PLATFORMS=cpu BENCH_ONLY=ELASTIC BENCH_RUNS=1 $(PYTHON) bench.py
 
 # invariant-aware static analysis (docs/STATIC_ANALYSIS.md): host-sync,
 # program-key, pairing, env-registry, async-discipline, test-hygiene,

@@ -1,0 +1,15 @@
+"""Elastic pool autoscaler (docs/AUTOSCALING.md).
+
+``policy`` turns the fleet collector's merged per-deployment signals into
+per-pool target-replica decisions; ``reconciler`` actuates them through
+the kube client with drain-based shrink (zero dropped streams).
+"""
+
+from seldon_core_tpu.autoscale.policy import (  # noqa: F401
+    AUTOSCALE_ANNOTATION,
+    AutoscaleError,
+    AutoscaleSpec,
+    Decision,
+    PoolPolicy,
+    parse_autoscale,
+)

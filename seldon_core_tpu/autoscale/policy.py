@@ -1,0 +1,393 @@
+"""Autoscale policy: per-pool target-replica computation.
+
+The ``seldon.io/autoscale`` CR annotation declares replica bounds plus
+per-signal targets (docs/AUTOSCALING.md):
+
+    seldon.io/autoscale: "min=1,max=8,ttft_p99_ms=250,itl_p99_ms=40,occupancy=0.85"
+
+Signals are role-typed the way the pools are (docs/DISAGGREGATION.md):
+prefill pools react to TTFT / queue-wait p99 and shed rate, decode pools
+to ITL p99 and slot occupancy, unified pools to the max over both
+families.  Every signal comes from the fleet collector's MERGED
+aggregates (obs/fleet.py) — per-replica percentiles are never averaged.
+
+:class:`PoolPolicy` is the per-deployment control loop, evaluated on
+injectable time like the SLO burn-rate engine (obs/slo.py):
+
+* **EWMA smoothing** — raw signals are smoothed before comparison so a
+  single noisy poll never moves replicas.
+* **Slope lookahead** — each latency signal is projected forward along
+  its history-ring trend (``History.slope``); a steady ramp scales up
+  BEFORE it crosses the target.
+* **Hysteresis band** — scale up at pressure >= ``up_at`` (default 1.0
+  = at target), down only when every fresh signal sits at or below
+  ``down_at`` (default 0.5).  Oscillation inside the band holds.
+* **Per-direction hold-downs** — dwell after an up before the next up;
+  shrink additionally dwells after ANY decision (drain-based shrink is
+  deliberately the slower direction).
+* **Counter-dip tolerance** — windowed counter signals (shed rate) are
+  ``None`` whenever the fleet sum went backwards (replica churn), and
+  ``None`` observations never refresh a signal; a pool whose signals
+  all went stale holds instead of guessing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+from seldon_core_tpu.runtime import settings
+
+AUTOSCALE_ANNOTATION = "seldon.io/autoscale"
+
+# declared-target keys and the bound each accepts
+_MS_KEYS = ("ttft_p99_ms", "itl_p99_ms", "queue_wait_ms")
+_RATIO_KEYS = ("shed_rate", "occupancy")
+SIGNAL_KEYS = _MS_KEYS + _RATIO_KEYS
+
+# which signals each pool role reacts to; unified takes the max over all
+ROLE_SIGNALS = {
+    "prefill": ("ttft_p99_ms", "queue_wait_ms", "shed_rate"),
+    "decode": ("itl_p99_ms", "occupancy"),
+    "unified": SIGNAL_KEYS,
+}
+
+_MAX_REPLICAS = 512
+
+
+class AutoscaleError(ValueError):
+    """Malformed ``seldon.io/autoscale`` spec (raised at admission)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleSpec:
+    min_replicas: int
+    max_replicas: int
+    # declared signal targets: signal name -> bound (ms or ratio)
+    targets: tuple[tuple[str, float], ...]
+
+    @property
+    def target_map(self) -> dict[str, float]:
+        return dict(self.targets)
+
+    def spec_str(self) -> str:
+        parts = [f"min={self.min_replicas}", f"max={self.max_replicas}"]
+        parts += [f"{k}={v:g}" for k, v in self.targets]
+        return ",".join(parts)
+
+
+def parse_autoscale(spec: str) -> AutoscaleSpec:
+    """Parse the annotation grammar; raises :class:`AutoscaleError` on
+    anything malformed so a typo fails at ADMISSION, not silently in the
+    reconciler."""
+    seen: dict[str, float] = {}
+    lo, hi = 1, 8
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" not in entry:
+            raise AutoscaleError(f"entry {entry!r} is not key=value")
+        key, _, raw = entry.partition("=")
+        key = key.strip()
+        raw = raw.strip()
+        if key in ("min", "max"):
+            try:
+                n = int(raw)
+            except ValueError:
+                raise AutoscaleError(f"{key}={raw!r} is not an integer") from None
+            if key in seen:
+                raise AutoscaleError(f"duplicate key {key!r}")
+            seen[key] = n
+            if key == "min":
+                lo = n
+            else:
+                hi = n
+            continue
+        if key not in SIGNAL_KEYS:
+            raise AutoscaleError(
+                f"unknown key {key!r} (expected min, max, "
+                f"{', '.join(SIGNAL_KEYS)})"
+            )
+        if key in seen:
+            raise AutoscaleError(f"duplicate key {key!r}")
+        try:
+            bound = float(raw)
+        except ValueError:
+            raise AutoscaleError(f"{key}={raw!r} is not a number") from None
+        if key in _MS_KEYS and bound <= 0:
+            raise AutoscaleError(f"{key} must be > 0 ms")
+        if key in _RATIO_KEYS and not 0.0 < bound <= 1.0:
+            raise AutoscaleError(f"{key} must be in (0, 1]")
+        seen[key] = bound
+    targets = tuple((k, seen[k]) for k in SIGNAL_KEYS if k in seen)
+    if not targets:
+        raise AutoscaleError("no signal targets declared")
+    if lo < 1:
+        raise AutoscaleError("min must be >= 1 (drain-based shrink needs a peer)")
+    if hi < lo:
+        raise AutoscaleError(f"max={hi} < min={lo}")
+    if hi > _MAX_REPLICAS:
+        raise AutoscaleError(f"max={hi} exceeds the {_MAX_REPLICAS} sanity cap")
+    return AutoscaleSpec(min_replicas=lo, max_replicas=hi, targets=targets)
+
+
+@dataclasses.dataclass
+class Decision:
+    direction: str  # "up" | "down" | "hold"
+    target: int
+    reason: str
+    pressure: float | None = None
+    signals: dict = dataclasses.field(default_factory=dict)
+
+
+class PoolPolicy:
+    """Per-deployment scale state machine on injectable time."""
+
+    def __init__(
+        self,
+        spec: AutoscaleSpec,
+        role: str = "unified",
+        *,
+        ewma_alpha: float | None = None,
+        up_at: float | None = None,
+        down_at: float | None = None,
+        up_hold_s: float | None = None,
+        down_hold_s: float | None = None,
+        lookahead_s: float | None = None,
+        max_step: int | None = None,
+        stale_s: float | None = None,
+    ):
+        if role not in ROLE_SIGNALS:
+            raise AutoscaleError(f"unknown pool role {role!r}")
+        self.spec = spec
+        self.role = role
+        targets = spec.target_map
+        # the signals this pool reacts to = declared ∩ role family
+        self.targets = {
+            name: targets[name]
+            for name in ROLE_SIGNALS[role] if name in targets
+        }
+        if not self.targets:
+            raise AutoscaleError(
+                f"role {role!r} has no declared signal target "
+                f"(spec: {spec.spec_str()!r})"
+            )
+
+        def _f(name: str, override) -> float:
+            return settings.get_float(name) if override is None else float(override)
+
+        self.ewma_alpha = min(1.0, max(1e-3, _f("SCT_SCALE_EWMA_ALPHA", ewma_alpha)))
+        self.up_at = _f("SCT_SCALE_UP_AT", up_at)
+        self.down_at = _f("SCT_SCALE_DOWN_AT", down_at)
+        self.up_hold_s = _f("SCT_SCALE_UP_HOLD_S", up_hold_s)
+        self.down_hold_s = _f("SCT_SCALE_DOWN_HOLD_S", down_hold_s)
+        self.lookahead_s = _f("SCT_SCALE_LOOKAHEAD_S", lookahead_s)
+        self.max_step = (
+            settings.get_int("SCT_SCALE_MAX_STEP") if max_step is None
+            else int(max_step)
+        )
+        self.stale_s = _f("SCT_SCALE_STALE_S", stale_s)
+        self._ewma: dict[str, float] = {}
+        self._seen: dict[str, float] = {}
+        self._last_up: float | None = None
+        self._last_down: float | None = None
+        self._decisions = 0
+
+    # -- observation ---------------------------------------------------------
+
+    def observe(self, values: dict[str, float | None], now: float) -> None:
+        """Feed one sample of raw signals.  ``None`` values (signal not
+        reported this poll, or a counter dip) never refresh a signal —
+        freshness decay, not zero, is what a gap means."""
+        for name in self.targets:
+            v = values.get(name)
+            if v is None:
+                continue
+            v = float(v)
+            prev = self._ewma.get(name)
+            self._ewma[name] = (
+                v if prev is None
+                else prev + self.ewma_alpha * (v - prev)
+            )
+            self._seen[name] = now
+
+    # -- decision ------------------------------------------------------------
+
+    def decide(
+        self,
+        current: int,
+        now: float,
+        slopes: dict[str, float | None] | None = None,
+    ) -> Decision:
+        spec = self.spec
+        current = int(current)
+        if current < spec.min_replicas:
+            self._last_up = now
+            self._decisions += 1
+            return Decision("up", spec.min_replicas, "below-min-bound")
+        if current > spec.max_replicas:
+            self._last_down = now
+            self._decisions += 1
+            return Decision("down", current - 1, "above-max-bound")
+
+        fresh: dict[str, float] = {
+            name: self._ewma[name]
+            for name, seen in self._seen.items()
+            if now - seen <= self.stale_s
+        }
+        if not fresh:
+            return Decision("hold", current, "no-fresh-signals")
+
+        detail: dict[str, dict] = {}
+        p_now = 0.0
+        p_proj = 0.0
+        for name, value in fresh.items():
+            target = self.targets[name]
+            pressure = value / target
+            projected = pressure
+            slope = (slopes or {}).get(name)
+            if slope is not None and slope > 0:
+                projected = max(
+                    pressure, (value + slope * self.lookahead_s) / target
+                )
+            detail[name] = {
+                "value": round(value, 4),
+                "target": target,
+                "pressure": round(pressure, 4),
+                "projected": round(projected, 4),
+            }
+            p_now = max(p_now, pressure)
+            p_proj = max(p_proj, projected)
+
+        if p_proj >= self.up_at:
+            if current >= spec.max_replicas:
+                return Decision("hold", current, "at-max", p_proj, detail)
+            if self._last_up is not None and now - self._last_up < self.up_hold_s:
+                return Decision("hold", current, "up-hold", p_proj, detail)
+            step = min(
+                self.max_step,
+                max(1, math.ceil(current * (p_proj - 1.0))),
+            )
+            target = min(spec.max_replicas, current + step)
+            self._last_up = now
+            self._decisions += 1
+            reason = "pressure" if p_now >= self.up_at else "slope-lookahead"
+            return Decision("up", target, reason, p_proj, detail)
+
+        if p_now <= self.down_at:
+            if current <= spec.min_replicas:
+                return Decision("hold", current, "at-min", p_now, detail)
+            last_any = max(
+                t for t in (self._last_up, self._last_down, -math.inf)
+                if t is not None
+            )
+            if last_any > -math.inf and now - last_any < self.down_hold_s:
+                return Decision("hold", current, "down-hold", p_now, detail)
+            self._last_down = now
+            self._decisions += 1
+            return Decision("down", current - 1, "idle", p_now, detail)
+
+        return Decision("hold", current, "in-band", p_now, detail)
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "role": self.role,
+            "spec": self.spec.spec_str(),
+            "targets": dict(self.targets),
+            "ewma": {k: round(v, 4) for k, v in self._ewma.items()},
+            "last_up": self._last_up,
+            "last_down": self._last_down,
+            "decisions": self._decisions,
+        }
+
+
+# ---------------------------------------------------------------------------
+# signal extraction off the fleet collector's aggregates + history rings
+# ---------------------------------------------------------------------------
+
+
+def extract_signals(
+    name: str,
+    dep: dict,
+    *,
+    history=None,
+    now: float | None = None,
+    window_s: float | None = None,
+) -> dict[str, float | None]:
+    """Raw policy signals for one deployment from the collector's merged
+    aggregate (``_agg["deployments"][name]`` shape).  Latency signals
+    prefer the interval-windowed p99 (``win_p99_ms``) so an ebb is seen
+    — the lifetime percentile only ratchets.  The windowed shed rate
+    comes off the history rings and is ``None`` on a counter dip
+    (replica churn rewinds the fleet sum): a dip must never read as
+    load change."""
+    if window_s is None:
+        window_s = settings.get_float("SCT_SCALE_WINDOW_S")
+    sig: dict[str, float | None] = dict.fromkeys(SIGNAL_KEYS)
+    lat = dep.get("latency") or {}
+    for key, stage in (("ttft_p99_ms", "ttft"), ("itl_p99_ms", "itl")):
+        q = lat.get(stage) or {}
+        v = q.get("win_p99_ms")
+        sig[key] = v if v is not None else q.get("p99_ms")
+    qos = dep.get("qos") or {}
+    qw = qos.get("queue_wait_ewma_ms")
+    if isinstance(qw, dict):
+        sig["queue_wait_ms"] = qw.get("mean")
+    live = int(dep.get("replicas_live") or 0)
+    infl = qos.get("inflight")
+    cap = qos.get("max_inflight")
+    if (
+        live
+        and isinstance(infl, dict)
+        and isinstance(cap, dict)
+        and cap.get("sum")
+    ):
+        sig["occupancy"] = (float(infl["mean"]) * live) / float(cap["sum"])
+    if history is not None:
+        d_adm = history.delta(f"{name}.admitted_total", window_s, now=now)
+        d_shed = history.delta(f"{name}.shed_total", window_s, now=now)
+        if d_adm is not None and d_shed is not None:
+            if d_adm < 0 or d_shed < 0:
+                sig["shed_rate"] = None  # counter dip: churn, not load
+            else:
+                denom = d_adm + d_shed
+                sig["shed_rate"] = (d_shed / denom) if denom > 0 else 0.0
+    return sig
+
+
+# history metric backing each signal's slope lookahead; ratio signals
+# have no meaningful per-second trend at policy timescales
+_SLOPE_METRICS = {
+    "ttft_p99_ms": "{name}.ttft.win_p99_ms",
+    "itl_p99_ms": "{name}.itl.win_p99_ms",
+    "queue_wait_ms": "{name}.queue_wait_ms",
+}
+
+
+def extract_slopes(
+    name: str,
+    history,
+    *,
+    now: float | None = None,
+    window_s: float | None = None,
+) -> dict[str, float | None]:
+    """Per-signal history-ring trends (units per second) feeding the
+    policy's slope lookahead."""
+    if window_s is None:
+        window_s = settings.get_float("SCT_SCALE_WINDOW_S")
+    out: dict[str, float | None] = {}
+    for key, pattern in _SLOPE_METRICS.items():
+        out[key] = history.slope(
+            pattern.format(name=name), window_s=window_s, now=now
+        )
+    return out
+
+
+def pool_role(annotations: dict | None) -> str:
+    """Pool role off the record/CR annotations (defaults to unified)."""
+    role = (annotations or {}).get("seldon.io/engine-role", "")
+    role = str(role).strip().lower()
+    return role if role in ROLE_SIGNALS else "unified"

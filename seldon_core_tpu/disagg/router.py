@@ -231,6 +231,18 @@ class ReplicaRouter:
         with self._lock:
             self._deployments.pop(dep, None)
 
+    def forget_replica(self, dep: str, ep_key: str) -> None:
+        """Drop ONE replica's routing state (digests, load EWMA, breaker).
+        Autoscale shrink removes exactly the drained replica; survivors
+        keep their prefix digests and breaker windows, so routing quality
+        does not reset to cold on every scale event."""
+        with self._lock:
+            reps = self._deployments.get(dep)
+            if reps is not None:
+                reps.pop(ep_key, None)
+                if not reps:
+                    self._deployments.pop(dep, None)
+
     def note_start(self, dep: str, ep_key: str) -> None:
         with self._lock:
             self._state(dep, ep_key).inflight += 1

@@ -138,6 +138,13 @@ class EngineApp:
         # whole-response cacheability; node-tier caching still applies to
         # its deterministic MODEL children)
         self._resp_cache = None
+        # live drain bookkeeping (docs/AUTOSCALING.md): a second
+        # POST /admin/drain answers 409 WITH this state (phase, peer,
+        # migration progress) so the autoscale reconciler's retry can
+        # observe the in-flight drain instead of guessing, and
+        # /admin/undrain refuses to lift a drain whose peer migration is
+        # still relaying streams
+        self._drain_state: dict[str, Any] | None = None
 
     def build(self) -> web.Application:
         # wire-throughput accounting on the whole REST surface: request
@@ -1419,6 +1426,26 @@ class EngineApp:
 
     # -- live migration (docs/RESILIENCE.md "drain runbook") ----------------
 
+    def _drain_snapshot(self, sched) -> dict[str, Any]:
+        """Current drain state for 409 bodies: the in-flight handler's
+        phase + migration progress when this app started the drain, or a
+        synthesized parked view when the drain was begun at the scheduler
+        level (tests, embedded harnesses)."""
+        import time as _time
+
+        st = dict(self._drain_state or {})
+        start = st.pop("started_monotonic", None)
+        if start is not None:
+            st["elapsed_ms"] = round((_time.monotonic() - start) * 1e3, 3)
+        if not st:
+            st = {"phase": "parked", "peer": None}
+        snap = sched.packing_snapshot()
+        st["parked"] = int(snap.get("suspended", 0))
+        st["draining"] = bool(
+            snap.get("draining", getattr(sched, "_draining", False))
+        )
+        return st
+
     async def admin_drain(self, request: web.Request) -> web.Response:
         """Replace this engine under live traffic: pause admission, suspend
         every active stream bit-exactly at the next sync point, then ship
@@ -1458,17 +1485,40 @@ class EngineApp:
                     _status_body(400, "bad timeout_s"), status=400
                 )
             sched = unit.scheduler
-            if getattr(sched, "_draining", False):
+            if self._drain_state is not None or getattr(sched, "_draining", False):
+                # idempotent repeat: answer with the CURRENT drain's state
+                # (phase + migration progress), not a bare refusal — the
+                # autoscale reconciler's retry after a timeout needs to see
+                # how far the in-flight drain got
                 h["code"] = "409"
                 return web.json_response(
-                    _status_body(409, "drain already in progress"), status=409
+                    dict(
+                        _status_body(409, "drain already in progress"),
+                        drain=self._drain_snapshot(sched),
+                    ),
+                    status=409,
                 )
             t0 = _time.perf_counter()
+            self._drain_state = {
+                "phase": "quiescing",
+                "peer": peer,
+                "timeout_s": timeout_s,
+                "migrated": 0,
+                "failed": 0,
+                "started_monotonic": _time.monotonic(),
+            }
             # no-peer path: the matching drain_finish lives in /admin/undrain
             sched.drain_begin()  # sct: pairing-ok undrain lifts it
-            quiesced = await sched.drain_wait_quiesced(timeout_s)
+            try:
+                quiesced = await sched.drain_wait_quiesced(timeout_s)
+            except BaseException:
+                # a failed handler must not leave phantom in-flight state
+                # that wedges every later drain/undrain behind a 409
+                self._drain_state = None
+                raise
             migrated, failed = 0, []
             if peer:
+                self._drain_state["phase"] = "migrating"
                 pairs = sched.drain_take()
                 # every frame carries the SAME counter value: adoption is
                 # idempotent, and the peer continues the seed sequence
@@ -1488,9 +1538,11 @@ class EngineApp:
                                 "(%s); it will resume locally", peer, e,
                             )
                             failed.append((req, frame))
+                            self._drain_state["failed"] = len(failed)
                             continue
                         sched.complete_migrated(req, tokens)
                         migrated += 1
+                        self._drain_state["migrated"] = migrated
                 finally:
                     # CancelledError mid-loop must not strand unmigrated
                     # streams: everything not relayed re-parks, then the
@@ -1500,6 +1552,11 @@ class EngineApp:
                     if failed:
                         sched.drain_abort(failed)
                     sched.drain_finish()
+                    self._drain_state = None
+            else:
+                # parked drain: admission stays paused and the state stays
+                # visible until /admin/undrain lifts it
+                self._drain_state["phase"] = "parked"
             snap = sched.packing_snapshot()
             return web.json_response(
                 {
@@ -1527,12 +1584,31 @@ class EngineApp:
                 h["code"] = "400"
                 return web.json_response(_status_body(400, reason), status=400)
             sched = unit.scheduler
+            st = self._drain_state
+            if st is not None and st.get("phase") in ("quiescing", "migrating"):
+                # a drain handler is still running: lifting the drain now
+                # would fork already-relayed streams (the peer continues
+                # them while this scheduler re-queues the same records) —
+                # undrain only applies to a PARKED no-peer drain
+                h["code"] = "409"
+                return web.json_response(
+                    dict(
+                        _status_body(
+                            409,
+                            "drain in flight; undrain applies only after "
+                            "it parks or finishes",
+                        ),
+                        drain=self._drain_snapshot(sched),
+                    ),
+                    status=409,
+                )
             if not getattr(sched, "_draining", False):
                 h["code"] = "409"
                 return web.json_response(
                     _status_body(409, "engine is not draining"), status=409
                 )
             sched.drain_finish()
+            self._drain_state = None
             return web.json_response(
                 {"draining": False, "resuming": True}
             )

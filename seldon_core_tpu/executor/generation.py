@@ -3216,8 +3216,7 @@ class GenerationScheduler:
                 f"generation queue is full ({depth} waiting, cap {cap} "
                 f"for {priority})"
             )
-        if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(self._run())
+        self._ensure_run_task()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         from seldon_core_tpu.obs import current_span
 
@@ -3277,8 +3276,7 @@ class GenerationScheduler:
                 f"generation queue is full ({depth} waiting, cap {cap} "
                 f"for {req.priority})"
             )
-        if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(self._run())
+        self._ensure_run_task()
         self._tl(req, "queued", span=False, depth=len(self._waiting))
         self._waiting.append(req)
         self._wake.set()
@@ -3408,8 +3406,7 @@ class GenerationScheduler:
                 fut,
             )
         )
-        if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(self._run())
+        self._ensure_run_task()
         self._wake.set()
         return await fut
 
@@ -3689,6 +3686,13 @@ class GenerationScheduler:
         self.drains += 1
         self._preempt = True
         self._wake.set()
+        if self._task is None or self._task.done():
+            # idle scheduler: the run loop only exists while work is in
+            # flight, so nothing is device-resident and no loop turn will
+            # ever fire the event — quiesce immediately instead of making
+            # an idle victim's drain (the autoscaler's common shrink case)
+            # sit out the full timeout
+            self._quiesced.set()
 
     async def drain_wait_quiesced(self, timeout_s: float = 30.0) -> bool:
         """Block until no slot is device-resident (suspend records are
@@ -3797,6 +3801,19 @@ class GenerationScheduler:
                 else None
             ),
         }
+
+    def _ensure_run_task(self) -> None:
+        """(Re)spawn the run-loop task on the CURRENT event loop.
+
+        A fresh task gets a fresh wake event: asyncio primitives bind to
+        the loop that first awaits them, and a scheduler driven through
+        several short-lived loops (``asyncio.run`` per call — component
+        tests, CLI tools) would otherwise park the new task on an event
+        bound to a dead loop and crash it with a cross-loop RuntimeError
+        that ``close()`` later re-raises."""
+        if self._task is None or self._task.done():
+            self._wake = asyncio.Event()
+            self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def close(self) -> None:
         self._closed = True

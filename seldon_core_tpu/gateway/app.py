@@ -166,6 +166,17 @@ class GatewayApp:
 
         self.fleet = FleetCollector(store, service="gateway")
         self._fleet_enabled = _settings.get_bool("SCT_FLEET")
+        # elastic autoscaler (autoscale/reconciler.py): set by the embedded
+        # operator when SCT_SCALE so /stats/autoscale serves the decision
+        # ledger from the gateway front too; None -> {"enabled": False}
+        self.autoscaler = None
+        # diff-based endpoint churn (docs/AUTOSCALING.md): listeners below
+        # evict only the replicas an update REMOVED, so autoscale events
+        # keep survivors' warm pools/digests/breakers
+        from seldon_core_tpu.gateway.store import EndpointDiff
+
+        self._ep_diff = EndpointDiff()
+        self._ep_diff.seed(store.list())
         # removed deployments lose their live tokens immediately
         store.add_listener(self._on_deployment_event)
 
@@ -175,21 +186,26 @@ class GatewayApp:
         )
 
     def _on_deployment_event(self, event: str, rec: DeploymentRecord) -> None:
+        gone = self._ep_diff.removed(event, rec)
+        spec_rolled = self._ep_diff.spec_changed(event, rec)
         if event == "removed":
             self.tokens.revoke_for_key(rec.oauth_key)
             self._qos.pop(rec.oauth_key, None)
-        if event in ("removed", "updated") and self.cache is not None:
+        if event in ("removed", "updated") and self.cache is not None and spec_rolled:
             # rolling update / teardown: the deployment NAMESPACE flushes —
             # one namespace per deployment regardless of replica count, so
-            # every replica's cached responses go stale together
+            # every replica's cached responses go stale together.  The
+            # flush is spec-hash-driven: endpoint-only churn (an autoscale
+            # grow/shrink) keeps the hash and keeps the cache.
             self.cache.flush(rec.oauth_key)
         if event in ("removed", "updated"):
-            # the WHOLE replica set's pools evict, not just the primary's:
-            # an updated record may have re-addressed any subset of them
-            doomed = [
-                k for k in self._pools if k[0] == rec.oauth_key
-            ]
-            for k in doomed:
+            # diff the replica sets and evict ONLY the departed replicas'
+            # pools — survivors keep their warm connections across scale
+            # events (a removed record's diff is its whole set)
+            for k in [
+                k for k in self._pools
+                if k[0] == rec.oauth_key and k[1] in gone
+            ]:
                 pool = self._pools.pop(k)
                 # store events may fire on operator/poller threads; the
                 # pool's StreamWriters belong to the serving loop, so hop
@@ -198,8 +214,13 @@ class GatewayApp:
                     self._loop.call_soon_threadsafe(pool.evict)
                 else:  # no loop yet -> no sockets were ever opened
                     pool.evict()
-            # routing state rebuilds from the next poll sweep
-            self.router.forget(rec.oauth_key)
+            # routing state: drop only the departed replicas; survivors
+            # keep digests + breaker windows (full forget on teardown)
+            if event == "removed":
+                self.router.forget(rec.oauth_key)
+            else:
+                for key in gone:
+                    self.router.forget_replica(rec.oauth_key, key)
 
     def _pool(self, rec: DeploymentRecord, ep=None) -> "H1Pool":
         """Forward pool for one replica (``ep``; default the primary).
@@ -296,6 +317,7 @@ class GatewayApp:
         # fleet telemetry plane (docs/OBSERVABILITY.md "Fleet telemetry")
         r.add_get("/stats/fleet", self.stats_fleet)
         r.add_get("/stats/slo", self.stats_slo)
+        r.add_get("/stats/autoscale", self.stats_autoscale)
         # replica-set timeline fan-out: one query stitches every leg
         r.add_get("/stats/timeline", self.stats_timeline)
 
@@ -786,6 +808,16 @@ class GatewayApp:
 
     async def stats_slo(self, request: web.Request) -> web.Response:
         return web.json_response({"slo": self.slo_snapshot()})
+
+    def autoscale_snapshot(self) -> dict:
+        """Autoscaler decision ledger + per-pool policy state (shared by
+        both REST fronts' /stats/autoscale)."""
+        if self.autoscaler is None:
+            return {"enabled": False}
+        return self.autoscaler.snapshot()
+
+    async def stats_autoscale(self, request: web.Request) -> web.Response:
+        return web.json_response({"autoscale": self.autoscale_snapshot()})
 
     async def stats_timeline(self, request: web.Request) -> web.Response:
         """Replica-set timeline fan-out: ``?trace=<id>`` queries every

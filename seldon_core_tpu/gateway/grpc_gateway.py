@@ -102,6 +102,10 @@ class _ChannelCacheBase:
         self._channels: dict[tuple, object] = {}
         self._loop = loop or asyncio.get_event_loop()
         self._close_tasks: set[asyncio.Task] = set()
+        from seldon_core_tpu.gateway.store import EndpointDiff
+
+        self._ep_diff = EndpointDiff()
+        self._ep_diff.seed(gateway.store.list())
         gateway.store.add_listener(self._on_deployment_event)
 
     def _new_channel(self, rec: DeploymentRecord, ep):
@@ -123,8 +127,14 @@ class _ChannelCacheBase:
         return ch
 
     def _on_deployment_event(self, event: str, rec: DeploymentRecord) -> None:
+        gone = self._ep_diff.removed(event, rec)
         if event in ("removed", "updated"):
-            doomed = [k for k in self._channels if k[0] == rec.oauth_key]
+            # close ONLY the departed replicas' channels; survivors keep
+            # their warm HTTP/2 connections across autoscale events
+            doomed = [
+                k for k in self._channels
+                if k[0] == rec.oauth_key and k[1] in gone
+            ]
             for k in doomed:
                 ch = self._channels.pop(k)
                 self._loop.call_soon_threadsafe(self._schedule_close, ch)

@@ -1290,13 +1290,22 @@ class H1SpliceFrontend:
         self._wire_children: dict[str, object] = {}  # per-deployment counters
         self._reap_handle: asyncio.TimerHandle | None = None
         self.bound_port = 0
+        from seldon_core_tpu.gateway.store import EndpointDiff
+
+        self._ep_diff = EndpointDiff()
+        self._ep_diff.seed(gateway.store.list())
         gateway.store.add_listener(self._on_deployment_event)
 
     def _on_deployment_event(self, event: str, rec) -> None:
+        gone = self._ep_diff.removed(event, rec)
         if event in ("removed", "updated"):
-            # evict the record's WHOLE replica set (pools are keyed per
-            # (deployment, replica)), not just one upstream
-            doomed = [k for k in self._pools if k[0] == rec.oauth_key]
+            # evict ONLY the replicas the update removed (pools are keyed
+            # per (deployment, replica)); survivors keep warm connections
+            # across autoscale events
+            doomed = [
+                k for k in self._pools
+                if k[0] == rec.oauth_key and k[1] in gone
+            ]
             for k in doomed:
                 pool = self._pools.pop(k)
                 if self.loop is not None:
@@ -1583,6 +1592,10 @@ class H1SpliceFrontend:
         if route == b"/stats/slo":
             return 200, json.dumps(
                 {"slo": gw.slo_snapshot()}
+            ).encode(), b"application/json"
+        if route == b"/stats/autoscale":
+            return 200, json.dumps(
+                {"autoscale": gw.autoscale_snapshot()}
             ).encode(), b"application/json"
         if route == b"/stats/timeline":
             form = urllib.parse.parse_qs(query.decode("latin-1"))

@@ -217,6 +217,55 @@ class DeploymentStore:
         return len(records)
 
 
+class EndpointDiff:
+    """Old-vs-new replica-set diff for store listeners.
+
+    Listeners only receive the NEW record, so a front that holds
+    per-replica state (warm H1 pools, gRPC channels, router digests and
+    breaker windows) tracks the last-seen endpoint keys here and evicts
+    ONLY the replicas that actually left.  Survivors keep their warm
+    state across an autoscale event — a 7-replica pool shrinking to 6
+    must not cold-start the other 6 connections.
+    """
+
+    def __init__(self) -> None:
+        self._keys: dict[str, set[str]] = {}
+        self._spec_hash: dict[str, str] = {}
+
+    def seed(self, records) -> None:
+        """Prime the tracker with records that predate the listener —
+        a front constructed against a populated store must still diff
+        that first update instead of seeing an empty prior set."""
+        for rec in records:
+            self.removed("added", rec)
+            self.spec_changed("added", rec)
+
+    def removed(self, event: str, rec: DeploymentRecord) -> set[str]:
+        """Replica keys present last time that are gone now (all of them
+        on a ``removed`` event).  Also refreshes the tracked set."""
+        old = self._keys.get(rec.oauth_key, set())
+        if event == "removed":
+            self._keys.pop(rec.oauth_key, None)
+            return old
+        new = {ep.key for ep in rec.replica_endpoints}
+        self._keys[rec.oauth_key] = new
+        return old - new
+
+    def spec_changed(self, event: str, rec: DeploymentRecord) -> bool:
+        """True when the record's spec hash rolled (or on removal).
+        Drives the response-cache namespace flush: endpoint-only churn
+        keeps the hash (the CR watch excludes the replica-set annotation
+        from it), so scaling never dumps a deployment's cache."""
+        if event == "removed":
+            self._spec_hash.pop(rec.oauth_key, None)
+            return True
+        prev = self._spec_hash.get(rec.oauth_key)
+        self._spec_hash[rec.oauth_key] = rec.spec_hash
+        # unknown prior state flushes too — missing a flush serves stale
+        # responses; an extra one costs a few cache misses
+        return prev is None or prev != rec.spec_hash
+
+
 def load_store_from_env(store: DeploymentStore, environ: dict | None = None) -> None:
     """Standalone bootstrap: ``GATEWAY_DEPLOYMENTS`` (JSON or path) and/or
     ``TEST_CLIENT_KEY``/``TEST_CLIENT_SECRET`` creating a localhost

@@ -129,11 +129,21 @@ class GatewayWatcher:
         # spec-hash over the FULL CR spec (+ routing annotations): ANY
         # rolling-update change — image, graph, parameters — changes it,
         # which both re-keys the response cache and (via the != compare in
-        # _apply emitting "updated") flushes the old entries
+        # _apply emitting "updated") flushes the old entries.  The replica
+        # SET annotation is excluded: which replicas serve a deployment
+        # does not change what they answer, so an autoscale grow/shrink
+        # (the reconciler patches engine-endpoints) keeps the hash — the
+        # response cache survives scale events and the gateway listeners'
+        # spec-hash check skips the namespace flush.
         from seldon_core_tpu.cache.content import spec_hash as _spec_hash
 
+        hashed_annotations = {
+            k: v
+            for k, v in meta.get("annotations", {}).items()
+            if k != "seldon.io/engine-endpoints"
+        }
         cr_hash = _spec_hash(
-            {"spec": spec, "annotations": meta.get("annotations", {})}
+            {"spec": spec, "annotations": hashed_annotations}
         )
         # multi-upstream replica set (disagg/router.py): comma-separated
         # "host:rest[:grpc]" list; absent -> the single Service upstream
@@ -202,10 +212,13 @@ def _is_watch_sourced(rec: DeploymentRecord) -> bool:
 def _carried_annotations(cr_annotations: dict) -> dict[str, str]:
     """Record annotations: the watch-source marker plus the CR annotations
     the serving plane consumes downstream (the SLO spec feeds the fleet
-    collector's burn-rate engine).  The spec-hash already folds ALL CR
-    annotations in, so a changed SLO spec rolls the record."""
+    collector's burn-rate engine; the autoscale spec + pool role + pool
+    membership feed the autoscale reconciler).  The spec-hash already
+    folds ALL CR annotations in, so a changed spec rolls the record."""
     out = {_SOURCE_ANNOTATION: "watch"}
-    slo = cr_annotations.get("seldon.io/slo")
-    if slo:
-        out["seldon.io/slo"] = str(slo)
+    for key in ("seldon.io/slo", "seldon.io/autoscale",
+                "seldon.io/autoscale-pool", "seldon.io/engine-role"):
+        val = cr_annotations.get(key)
+        if val:
+            out[key] = str(val)
     return out

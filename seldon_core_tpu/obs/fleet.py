@@ -109,6 +109,10 @@ class FleetCollector:
         self.service = service
         # (deployment, replica_key) -> scrape state
         self._replicas: dict[tuple[str, str], dict] = {}
+        # (deployment, stage) -> previous poll's merged buckets, for the
+        # interval-windowed percentiles (win_p99_ms) the autoscaler needs:
+        # lifetime percentiles only ratchet, so an ebb would be invisible
+        self._prev_stage_hist: dict[tuple[str, str], list[int]] = {}
         self._agg: dict = {}
         self.polls = 0
         self.scrapes_ok = 0
@@ -236,6 +240,9 @@ class FleetCollector:
         # forget replicas that left the store entirely
         for k in [k for k in self._replicas if k not in live_keys]:
             del self._replicas[k]
+        live_names = {rec.name for rec in records}
+        for k in [k for k in self._prev_stage_hist if k[0] not in live_names]:
+            del self._prev_stage_hist[k]
         self._aggregate(records, now)
         self._feed_slo(records, now)
         return self._agg
@@ -313,15 +320,28 @@ class FleetCollector:
             qos_snaps = [p["qos"] for p in live
                          if isinstance(p.get("qos"), dict)]
             merged_hist = self._agg_stage_hist(live)
-            latency = {
-                stage: {
+            latency = {}
+            for stage, counts in merged_hist.items():
+                if not sum(counts):
+                    continue
+                entry = {
                     "count": sum(counts),
                     "p50_ms": _history.hist_percentile_ms(counts, 50.0),
                     "p99_ms": _history.hist_percentile_ms(counts, 99.0),
                 }
-                for stage, counts in merged_hist.items()
-                if sum(counts)
-            }
+                # interval window: bucket deltas since the previous poll
+                # (clamped at 0 — replica churn can rewind the sum)
+                prev = self._prev_stage_hist.get((rec.name, stage))
+                if prev is not None:
+                    delta = [max(0, a - b) for a, b in zip(counts, prev)]
+                    win = sum(delta)
+                    entry["win_count"] = win
+                    entry["win_p99_ms"] = (
+                        _history.hist_percentile_ms(delta, 99.0)
+                        if win else None
+                    )
+                self._prev_stage_hist[(rec.name, stage)] = list(counts)
+                latency[stage] = entry
             cache: dict = {}
             wire: dict = {}
             for p in live:
@@ -371,6 +391,9 @@ class FleetCollector:
         for stage, q in dep["latency"].items():
             if q["p99_ms"] is not None:
                 h.record(f"{name}.{stage}.p99_ms", q["p99_ms"], now=now)
+            if q.get("win_p99_ms") is not None:
+                h.record(f"{name}.{stage}.win_p99_ms",
+                         q["win_p99_ms"], now=now)
         h.record(f"{name}.replicas_live", dep["replicas_live"], now=now)
 
     def _export_metrics(self, name: str, dep: dict) -> None:
@@ -492,9 +515,11 @@ class FleetCollector:
 # ---------------------------------------------------------------------------
 
 
-def build_stats_app(collector: FleetCollector):
+def build_stats_app(collector: FleetCollector, autoscaler=None):
     """A minimal aiohttp app serving the collector (operator sidecar
-    surface and the standalone mode share it)."""
+    surface and the standalone mode share it).  When the operator runs
+    the autoscale reconciler, its decision ledger rides along on
+    ``GET /stats/autoscale`` (docs/AUTOSCALING.md)."""
     from aiohttp import web
 
     async def stats_fleet(request):
@@ -503,12 +528,18 @@ def build_stats_app(collector: FleetCollector):
     async def stats_slo(request):
         return web.json_response(collector.slo_snapshot())
 
+    async def stats_autoscale(request):
+        if autoscaler is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(autoscaler.snapshot())
+
     async def healthz(request):
         return web.json_response({"ok": True, "polls": collector.polls})
 
     app = web.Application()
     app.router.add_get("/stats/fleet", stats_fleet)
     app.router.add_get("/stats/slo", stats_slo)
+    app.router.add_get("/stats/autoscale", stats_autoscale)
     app.router.add_get("/ready", healthz)
     app.router.add_get("/live", healthz)
     return app

@@ -24,12 +24,15 @@ from seldon_core_tpu.runtime import settings as _settings
 log = logging.getLogger(__name__)
 
 
-async def _start_fleet(kube, namespace: str):
+async def _start_fleet(kube, namespace: str, controller=None):
     """Fleet telemetry inside the operator (docs/OBSERVABILITY.md): a
     gateway-style CR watcher feeds the replica registry, the collector
     polls every replica's stats, and a small aiohttp app serves the
     aggregates on SCT_FLEET_PORT.  All of it runs on the operator's loop
-    but never inside reconcile — scrapes are independent tasks."""
+    but never inside reconcile — scrapes are independent tasks.  With
+    SCT_SCALE on, the autoscale reconciler (docs/AUTOSCALING.md) closes
+    the loop off the same collector and serves its decision ledger on
+    GET /stats/autoscale."""
     from aiohttp import web
 
     from seldon_core_tpu.gateway.store import DeploymentStore
@@ -41,14 +44,26 @@ async def _start_fleet(kube, namespace: str):
     await watcher.start()
     collector = FleetCollector(store, service="operator")
     await collector.start()
-    runner = web.AppRunner(build_stats_app(collector))
+    autoscaler = None
+    if _settings.get_bool("SCT_SCALE"):
+        from seldon_core_tpu.autoscale.reconciler import AutoscaleReconciler
+
+        autoscaler = AutoscaleReconciler(
+            kube, store, collector,
+            namespace=namespace, controller=controller,
+        )
+        await autoscaler.start()
+    runner = web.AppRunner(build_stats_app(collector, autoscaler=autoscaler))
     await runner.setup()
     port = _settings.get_int("SCT_FLEET_PORT")
     site = web.TCPSite(runner, "0.0.0.0", port)
     await site.start()
-    log.info("fleet collector serving /stats/fleet on :%d", port)
+    log.info("fleet collector serving /stats/fleet on :%d%s", port,
+             " (autoscaler on)" if autoscaler is not None else "")
 
     async def stop() -> None:
+        if autoscaler is not None:
+            await autoscaler.stop()
         await collector.stop()
         await watcher.stop()
         await runner.cleanup()
@@ -64,7 +79,7 @@ async def run(kube_url: str | None, namespace: str, engine_image: str) -> None:
     await loop.start()
     fleet_stop = None
     if _settings.get_bool("SCT_FLEET"):
-        fleet_stop = await _start_fleet(kube, namespace)
+        fleet_stop = await _start_fleet(kube, namespace, controller=controller)
     stop = asyncio.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         asyncio.get_running_loop().add_signal_handler(sig, stop.set)

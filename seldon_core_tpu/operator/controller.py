@@ -62,6 +62,11 @@ class Controller:
         self.engine_image = engine_image
         self._spec_cache: dict[str, str] = {}  # name -> spec signature
         self._failed: dict[str, str] = {}  # name -> failed spec signature
+        # workload name -> replica count owned by the autoscale reconciler
+        # (autoscale/reconciler.py).  Applied to desired workloads before
+        # hashing so a CR edit re-rolls the pods WITHOUT snapping an
+        # autoscaled pool back to the CR's static replica count.
+        self.replica_overrides: dict[str, int] = {}
 
     # -- reconcile ---------------------------------------------------------
 
@@ -81,6 +86,10 @@ class Controller:
             defaulted = defaulting(mldep)
             validate(defaulted)
             workloads, services = create_resources(defaulted, self.engine_image)
+            for w in workloads:
+                n = self.replica_overrides.get(w["metadata"]["name"])
+                if n is not None and "replicas" in w.get("spec", {}):
+                    w["spec"]["replicas"] = n
             uid = mldep.metadata.uid
             for kind in ("Deployment", "StatefulSet"):
                 await self._apply(
@@ -206,6 +215,9 @@ class Controller:
         ns = mldep.metadata.namespace
         self._spec_cache.pop(name, None)
         self._failed.pop(name, None)
+        for wname in [w for w in self.replica_overrides
+                      if w.startswith(f"{name}-")]:
+            del self.replica_overrides[wname]
         for kind in ("Deployment", "StatefulSet", "Service"):
             for obj in await self.kube.list(kind, ns, {LABEL_DEPLOYMENT_ID: name}):
                 try:

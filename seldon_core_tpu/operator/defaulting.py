@@ -199,6 +199,24 @@ def validate(mldep: SeldonDeployment) -> None:
             raise ValidationError(
                 f"annotation {SLO_ANNOTATION}: {exc}"
             ) from exc
+    # the autoscale spec fails at ADMISSION for the same reason: a typo
+    # discovered by the reconciler would silently pin the pool static
+    from seldon_core_tpu.autoscale.policy import (
+        AUTOSCALE_ANNOTATION,
+        AutoscaleError,
+        parse_autoscale,
+    )
+
+    scale_spec = mldep.metadata.annotations.get(
+        AUTOSCALE_ANNOTATION, ""
+    ).strip()
+    if scale_spec:
+        try:
+            parse_autoscale(scale_spec)
+        except AutoscaleError as exc:
+            raise ValidationError(
+                f"annotation {AUTOSCALE_ANNOTATION}: {exc}"
+            ) from exc
     for predictor in mldep.spec.predictors:
         # a typo'd disagg role must fail at ADMISSION, not brick the engine
         # pod at boot (resolve_role raises there too, but that surfaces as

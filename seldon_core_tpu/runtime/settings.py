@@ -458,6 +458,66 @@ declare("SCT_SLO_WARN_BURN", "6.0", "float",
         "both windows exceed it.",
         section="fleet")
 
+# -- elastic autoscaler (closed-loop pool scaling; docs/AUTOSCALING.md) -----
+declare("SCT_SCALE", "1", "bool",
+        "Run the autoscale reconciler in the operator (scaling still "
+        "requires the seldon.io/autoscale annotation on a CR).",
+        section="scale")
+declare("SCT_SCALE_INTERVAL_S", "15", "float",
+        "Autoscale reconcile interval (seconds); each tick reads the "
+        "fleet collector's latest aggregates and decides per pool.",
+        section="scale")
+declare("SCT_SCALE_EWMA_ALPHA", "0.4", "float",
+        "EWMA smoothing factor (0, 1] applied to every policy signal "
+        "before threshold comparison (1 = no smoothing).",
+        section="scale")
+declare("SCT_SCALE_UP_AT", "1.0", "float",
+        "Upper hysteresis edge: scale up when max signal pressure "
+        "(smoothed value / declared target) reaches this.",
+        section="scale")
+declare("SCT_SCALE_DOWN_AT", "0.5", "float",
+        "Lower hysteresis edge: scale down only when EVERY fresh signal "
+        "pressure sits at or below this (the band between down and up "
+        "edges never moves replicas).",
+        section="scale")
+declare("SCT_SCALE_UP_HOLD_S", "60", "float",
+        "Dwell after a scale-up before the next scale-up decision.",
+        section="scale")
+declare("SCT_SCALE_DOWN_HOLD_S", "180", "float",
+        "Dwell after any scale decision before a scale-down (shrink is "
+        "drain-based and deliberately slower than growth).",
+        section="scale")
+declare("SCT_SCALE_LOOKAHEAD_S", "60", "float",
+        "Slope lookahead horizon: a signal is projected forward this "
+        "many seconds along its history-ring trend, so a steady ramp "
+        "scales up BEFORE it crosses the target.",
+        section="scale")
+declare("SCT_SCALE_MAX_STEP", "2", "int",
+        "Max replicas added by one scale-up decision (shrink is always "
+        "one drained replica per decision).",
+        section="scale")
+declare("SCT_SCALE_STALE_S", "90", "float",
+        "Signal freshness horizon: observations older than this never "
+        "drive a decision (covers collector gaps and counter dips "
+        "during replica churn).",
+        section="scale")
+declare("SCT_SCALE_WINDOW_S", "60", "float",
+        "Window for counter-derived signals (windowed shed rate) read "
+        "off the fleet history rings.",
+        section="scale")
+declare("SCT_SCALE_LEDGER", "256", "int",
+        "Decision-ledger ring size served on GET /stats/autoscale "
+        "(bounded, drops oldest).",
+        section="scale")
+declare("SCT_SCALE_DRAIN_TIMEOUT_S", "30", "float",
+        "Per-victim POST /admin/drain timeout during drain-based "
+        "shrink; a failed or refused drain aborts the decision.",
+        section="scale")
+declare("SCT_SCALE_DEFAULT", None, "str",
+        "Fallback autoscale spec (seldon.io/autoscale grammar) for "
+        "deployments without the annotation (unset = static pools).",
+        section="scale")
+
 # -- multi-host mesh boot contract (operator-injected; jax-free reader in
 #    utils/mesh_contract.py) ------------------------------------------------
 declare("SCT_NUM_PROCESSES", None, "int",
@@ -500,6 +560,7 @@ _SECTION_TITLES = {
     "resilience": "Resilience / chaos plane",
     "observability": "Observability",
     "fleet": "Fleet telemetry (collector + SLO engine)",
+    "scale": "Elastic autoscaler (policy + drain-based actuator)",
     "mesh": "Multi-host mesh boot contract",
     "general": "General",
 }

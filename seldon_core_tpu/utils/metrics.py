@@ -455,6 +455,34 @@ class MetricsRegistry:
             ["deployment", "objective", "to"],
             registry=self.registry,
         )
+        self.autoscale_target = Gauge(
+            "seldon_autoscale_target_replicas",
+            "Latest per-pool replica target computed by the autoscale "
+            "policy (docs/AUTOSCALING.md)",
+            ["deployment", "role"],
+            registry=self.registry,
+        )
+        self.autoscale_pressure = Gauge(
+            "seldon_autoscale_pressure",
+            "Max signal pressure (smoothed value / declared target) "
+            "driving the latest decision (1.0 = at target)",
+            ["deployment"],
+            registry=self.registry,
+        )
+        self.autoscale_decisions = Counter(
+            "seldon_autoscale_decisions",
+            "Autoscale decisions actuated, labeled by direction "
+            "(up / down) and the policy reason",
+            ["deployment", "direction", "reason"],
+            registry=self.registry,
+        )
+        self.autoscale_drains = Counter(
+            "seldon_autoscale_drains",
+            "Drain-based shrink outcomes (ok: victim migrated all "
+            "streams; failed: shrink aborted, replica kept)",
+            ["deployment", "outcome"],
+            registry=self.registry,
+        )
 
     @contextmanager
     def time_server_request(

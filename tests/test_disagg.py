@@ -749,10 +749,11 @@ class TestMultiUpstreamStore:
         assert a.spec_hash != b.spec_hash
         assert a != b
 
-    def test_store_listener_flushes_whole_replica_set(self):
-        """Pools for EVERY replica of an updated deployment evict, and the
-        response-cache namespace flush covers the replica set (one
-        namespace per deployment)."""
+    def test_store_listener_evicts_only_removed_replicas(self):
+        """Diff-based endpoint churn: an update evicts only the replicas
+        that LEFT the set; survivors keep their warm pools (an autoscale
+        shrink must not cold-start the rest of the pool).  Removal still
+        evicts everything."""
         from seldon_core_tpu.gateway.app import GatewayApp
 
         async def go():
@@ -766,11 +767,16 @@ class TestMultiUpstreamStore:
             for ep in rec.replica_endpoints:
                 gw._pool(rec, ep)
             assert len(gw._pools) == 2
+            survivor = gw._pools[("d", "a:1")]
             store.put(DeploymentRecord(
                 name="d", oauth_key="d", oauth_secret="s",
                 endpoints=("a:1", "c:3"),
             ))
             await asyncio.sleep(0)  # let call_soon_threadsafe evictions run
+            assert set(gw._pools) == {("d", "a:1")}
+            assert gw._pools[("d", "a:1")] is survivor
+            store.remove("d")
+            await asyncio.sleep(0)
             assert gw._pools == {}
             await gw.close()
 
