@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check chaos-check fleet-check scale-check lint-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check chaos-check fleet-check scale-check meter-check lint-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -158,6 +158,16 @@ fleet-check:
 scale-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_autoscale.py -q
 	JAX_PLATFORMS=cpu BENCH_ONLY=ELASTIC BENCH_RUNS=1 $(PYTHON) bench.py
+
+# tenant cost-attribution plane (docs/OBSERVABILITY.md "Cost attribution"):
+# usage-meter units, bounded adapter cardinality under 500 synthetic
+# adapters, the 3-tenant packed conservation test (attributed device
+# seconds == fused-block wall seconds +-1%, zero mid-traffic compiles,
+# sync audit green), counter-exact fleet merges, exemplar-linked
+# /prometheus; the bench stage proves metering-on ITL overhead is noise
+meter-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_metering.py -q
+	JAX_PLATFORMS=cpu BENCH_ONLY=USAGE BENCH_RUNS=1 $(PYTHON) bench.py
 
 # invariant-aware static analysis (docs/STATIC_ANALYSIS.md): host-sync,
 # program-key, pairing, env-registry, async-discipline, test-hygiene,

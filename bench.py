@@ -2625,6 +2625,199 @@ def stage_elastic(detail: dict) -> None:
         raise RuntimeError(f"policy flapping: {elastic}")
 
 
+def stage_usage(detail: dict) -> None:
+    """Tenant cost attribution (docs/OBSERVABILITY.md "Cost attribution"):
+    a packed 3-tenant scenario's per-tenant device-time fractions and
+    decode tokens/s from the usage meter, the meter's conservation error
+    against the wall device-step total (must be under 1%), decode ITL
+    with metering ON vs OFF (must be noise-level — the meter only runs
+    at sync points), and the /prometheus scrape cost with OpenMetrics
+    exemplar rendering on vs plain text exposition."""
+    import asyncio
+
+    import jax
+
+    from seldon_core_tpu.executor.arbiter import DeviceArbiter
+    from seldon_core_tpu.executor.generation import (
+        GenerationScheduler,
+        GenerativeModel,
+    )
+    from seldon_core_tpu.executor.memory import MemoryManager
+    from seldon_core_tpu.models import llama as llama_mod
+    from seldon_core_tpu.obs.metering import METER, split_key
+    from seldon_core_tpu.utils.metrics import (
+        MetricsRegistry,
+        observe_exemplar,
+    )
+
+    cfg = llama_mod.Config.tiny(max_seq=128)
+    params = llama_mod.init_params(jax.random.PRNGKey(0), cfg)
+    max_new = int(os.environ.get("BENCH_USAGE_TOKENS", "24"))
+    rng = np.random.default_rng(13)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, 10).astype(np.int32)
+        for _ in range(8)
+    ]
+
+    # -- packed 3-tenant attribution ------------------------------------
+    mm = MemoryManager(enforce=False)
+    tenants = (("inter", 8), ("bulk-0", 16), ("bulk-1", 24))
+    models = {
+        name: GenerativeModel(
+            cfg, params, n_slots=4, decode_block=blk, name=name, memory=mm,
+        )
+        for name, blk in tenants
+    }
+
+    def packed_round():
+        arb = DeviceArbiter()
+        scheds = {n: GenerationScheduler(m) for n, m in models.items()}
+
+        async def go():
+            scheds["inter"].attach_arbiter(arb, priority="interactive")
+            scheds["bulk-0"].attach_arbiter(arb, priority="batch")
+            scheds["bulk-1"].attach_arbiter(arb, priority="batch")
+            try:
+                t0 = time.perf_counter()
+                await asyncio.gather(*(
+                    s.submit(prompts[i % len(prompts)],
+                             max_new_tokens=max_new)
+                    for i, s in enumerate(scheds.values())
+                    for _ in range(2)
+                ))
+                return time.perf_counter() - t0
+            finally:
+                for s in scheds.values():
+                    await s.close()
+
+        return asyncio.run(go())
+
+    packed_round()  # warmup: compiles off the clock
+    compiles_before = sum(m.program_compiles for m in models.values())
+    METER.reset()
+    wall = {"s": 0.0}
+    for model in models.values():
+        orig = model.step_k_fetch
+
+        def wrapped(handle, _orig=orig, _m=model):
+            out = _orig(handle)
+            wall["s"] += _m.last_block_s
+            return out
+
+        model.step_k_fetch = wrapped
+    elapsed = packed_round()
+    mid_traffic_compiles = (
+        sum(m.program_compiles for m in models.values()) - compiles_before
+    )
+    snap = METER.snapshot()
+    per_dep: dict = {}
+    for k, row in snap["keys"].items():
+        dep = split_key(k)[0]
+        agg = per_dep.setdefault(dep, {"device_s": 0.0, "tokens_decode": 0})
+        agg["device_s"] += row.get("device_s", 0.0)
+        agg["tokens_decode"] += row.get("tokens_decode", 0)
+    tot_device = snap["total"].get("device_s", 0.0)
+    conservation_err = abs(tot_device - wall["s"]) / max(wall["s"], 1e-9)
+
+    # -- decode ITL with metering on vs off -----------------------------
+    def itl_p50(meter_on: bool) -> float | None:
+        model = GenerativeModel(
+            cfg, params, n_slots=4, decode_block=8, name="usage-bench"
+        )
+        sched = GenerationScheduler(model)
+        was = METER.enabled
+        METER.enabled = meter_on
+
+        async def go():
+            try:
+                for _ in range(2):  # first pass: compiles off the clock
+                    await asyncio.gather(*(
+                        sched.submit(p, max_new_tokens=max_new)
+                        for p in prompts[:4]
+                    ))
+            finally:
+                await sched.close()
+
+        try:
+            asyncio.run(go())
+        finally:
+            METER.enabled = was
+        return model.spec_snapshot().get("itl_p50_ms")
+
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
+    on_p50 = sorted(v for v in (itl_p50(True) for _ in range(runs)) if v)
+    off_p50 = sorted(v for v in (itl_p50(False) for _ in range(runs)) if v)
+    itl_on = on_p50[len(on_p50) // 2] if on_p50 else None
+    itl_off = off_p50[len(off_p50) // 2] if off_p50 else None
+
+    # -- /prometheus scrape cost: exemplars on vs plain -----------------
+    def scrape_ms(exemplars: bool) -> float:
+        prev = os.environ.get("SCT_METRICS_EXEMPLARS")
+        os.environ["SCT_METRICS_EXEMPLARS"] = "1" if exemplars else "0"
+        try:
+            reg = MetricsRegistry()
+            h = reg.ttft.labels("usage-bench")
+            for i in range(512):
+                observe_exemplar(h, 0.001 * (i % 50 + 1), f"{i:032x}")
+            reg.refresh_usage(METER)
+            t0 = time.perf_counter()
+            n = 20
+            for _ in range(n):
+                reg.expose()
+            return (time.perf_counter() - t0) / n * 1e3
+        finally:
+            if prev is None:
+                os.environ.pop("SCT_METRICS_EXEMPLARS", None)
+            else:
+                os.environ["SCT_METRICS_EXEMPLARS"] = prev
+
+    plain_ms = scrape_ms(False)
+    exemplar_ms = scrape_ms(True)
+
+    detail["usage_metering"] = {
+        "tenants": {
+            name: {
+                "device_frac": _sig(
+                    agg["device_s"] / max(tot_device, 1e-9)
+                ),
+                "tokens_decode_per_s": _sig(
+                    agg["tokens_decode"] / max(elapsed, 1e-9)
+                ),
+            }
+            for name, agg in sorted(per_dep.items())
+        },
+        "wall_device_s": _sig(wall["s"]),
+        "attributed_device_s": _sig(tot_device),
+        "conservation_err": _sig(conservation_err),
+        "grant_s": _sig(snap["total"].get("grant_s", 0.0)),
+        "mid_traffic_program_compiles": mid_traffic_compiles,
+        "itl_p50_ms_meter_on": _sig(itl_on) if itl_on else None,
+        "itl_p50_ms_meter_off": _sig(itl_off) if itl_off else None,
+        "itl_on_vs_off": (
+            _sig(itl_on / itl_off) if itl_on and itl_off else None
+        ),
+        "scrape_ms_plain": _sig(plain_ms),
+        "scrape_ms_exemplars": _sig(exemplar_ms),
+        "scrape_exemplars_vs_plain": _sig(
+            exemplar_ms / max(plain_ms, 1e-9)
+        ),
+        "model": "llama tiny x3 (1 interactive + 2 batch), greedy, "
+                 f"{max_new} new tokens, one DeviceArbiter",
+    }
+    METER.reset()
+    if conservation_err > 0.01:
+        raise RuntimeError(
+            f"attribution not conserved: {conservation_err:.4f} > 1%")
+    if mid_traffic_compiles:
+        raise RuntimeError(
+            f"metering caused {mid_traffic_compiles} mid-traffic compiles")
+    # noise-level bar: the meter's per-block dict folds must not move
+    # decode ITL beyond run-to-run jitter on a shared CPU core
+    if itl_on and itl_off and itl_on / itl_off > 1.5:
+        raise RuntimeError(
+            f"metering ITL overhead over noise: {itl_on / itl_off:.3f}x")
+
+
 def main() -> None:
     detail: dict = {
         "hardware": "1 CPU core, 1 tunnel-attached TPU chip (~100ms RTT)",
@@ -2652,6 +2845,7 @@ def main() -> None:
         ("OBS_OVERHEAD", "BENCH_SKIP_OBS_OVERHEAD", stage_obs_overhead),
         ("FLEET", "BENCH_SKIP_FLEET", stage_fleet),
         ("ELASTIC", "BENCH_SKIP_ELASTIC", stage_elastic),
+        ("USAGE", "BENCH_SKIP_USAGE", stage_usage),
     ]
     only = os.environ.get("BENCH_ONLY", "").upper()
     for name, skip_env, fn in stages:
@@ -2749,6 +2943,9 @@ _STAGE_HEADLINES = (
     ("llm_packing", "packed_steady_over_sole_p99", "pack_p99_packed_vs_sole"),
     ("llm_packing", "batch_tok_s_under_burst", "pack_batch_tok_s_burst"),
     ("llm_packing", "mid_traffic_program_compiles", "pack_mid_compiles"),
+    ("usage_metering", "conservation_err", "usage_conservation_err"),
+    ("usage_metering", "itl_on_vs_off", "usage_itl_ratio"),
+    ("usage_metering", "scrape_exemplars_vs_plain", "usage_scrape_ratio"),
     ("chaos_recovery", "recovery_p99_ms", "chaos_recovery_p99_ms"),
     ("chaos_recovery", "dropped_streams", "chaos_dropped_streams"),
     ("fleet", "counters_exact", "fleet_counters_exact"),

@@ -23,6 +23,7 @@ import time
 from collections import OrderedDict
 from typing import Any
 
+from seldon_core_tpu.obs.metering import METER
 from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
 
 # -- keying ------------------------------------------------------------------
@@ -160,6 +161,10 @@ class ResponseCache:
             m = self._m(DEFAULT_METRICS.cache_hits, namespace)
             if m is not None:
                 m.inc()
+            # cost attribution: a cache hit is a request the tenant got
+            # for free — metered per deployment (namespace) so the usage
+            # rows show served-from-cache volume next to device seconds
+            METER.add(namespace, requests_cached=1)
             return entry
 
     def put(

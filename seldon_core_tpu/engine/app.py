@@ -210,9 +210,12 @@ class EngineApp:
         r.add_get("/stats/wire", self.stats_wire)
         # caching & reuse plane state (docs/CACHING.md)
         r.add_get("/stats/cache", self.stats_cache)
-        # fleet-collector scrape: qos+breakdown+cache+wire+mergeable
+        # fleet-collector scrape: qos+breakdown+cache+wire+usage+mergeable
         # stage histograms in ONE round trip (docs/OBSERVABILITY.md)
         r.add_get("/stats/summary", self.stats_summary)
+        # per-tenant cost attribution (obs/metering.py): device time +
+        # tokens per (deployment, adapter, qos) key
+        r.add_get("/stats/usage", self.stats_usage)
         # compile-warmup plane: programs compiled + seconds per unit
         # (docs/PERFORMANCE.md) — the readiness-tail attribution
         r.add_get("/stats/warmup", self.stats_warmup)
@@ -665,7 +668,24 @@ class EngineApp:
             snap = getattr(unit.model, "pool_snapshot", None)
             if callable(snap):
                 snap()
-        return web.Response(body=self.metrics.expose(), content_type="text/plain")
+        # same deal for the seldon_usage_* families: re-derived from the
+        # usage meter's bounded top-K table per scrape.  With exemplar
+        # rendering on (SCT_METRICS_EXEMPLARS) the body is OpenMetrics —
+        # trace-id exemplars on the hot histograms link to /stats/timeline
+        self.metrics.refresh_usage()
+        return web.Response(
+            body=self.metrics.expose(),
+            headers={"Content-Type": self.metrics.expose_content_type()},
+        )
+
+    async def stats_usage(self, request: web.Request) -> web.Response:
+        """Per-tenant cost attribution (docs/OBSERVABILITY.md "Cost
+        attribution"): cumulative device seconds, grant seconds, and
+        token counters per ``deployment|adapter|qos`` key, all-numeric so
+        the fleet collector merges replicas counter-exactly."""
+        from seldon_core_tpu.obs.metering import METER
+
+        return web.json_response({"usage": METER.snapshot()})
 
     def _generative_units_or_empty(self) -> list:
         try:
@@ -792,11 +812,14 @@ class EngineApp:
         the MERGEABLE per-stage histogram bucket counts (shared
         ``obs/history.BUCKET_EDGES`` grid) that fleet p50/p99 are
         computed from — replica quantiles themselves never merge."""
+        from seldon_core_tpu.obs.metering import METER
+
         return web.json_response({
             "qos": self.qos.snapshot(),
             "breakdown": self._breakdown_payload(),
             "cache": self._cache_payload(),
             "wire": wire_stats_payload(),
+            "usage": METER.snapshot(),
             "stage_hist": RECORDER.stage_histograms(),
         })
 

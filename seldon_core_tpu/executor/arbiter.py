@@ -34,8 +34,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 from seldon_core_tpu import qos
+from seldon_core_tpu.obs.metering import METER
 from seldon_core_tpu.runtime import settings
 
 log = logging.getLogger(__name__)
@@ -82,6 +84,7 @@ class DeviceArbiter:
         self._waiters: list[tuple[int, str, asyncio.Future]] = []
         self._seq = 0
         self._holder: str | None = None
+        self._t_grant = 0.0  # perf_counter stamp of the current grant
         self.high = _env_float(PACK_PREEMPT_ENV, 1.0)
         self.low = _env_float(PACK_RESUME_ENV, 0.5)
         # counters (GET /stats/breakdown "packing")
@@ -118,13 +121,13 @@ class DeviceArbiter:
         if reg is None:
             return
         if self._holder == name:
-            self._holder = None
+            self._set_holder(None)
         if len(self._regs) < 2:
             # back on the sole-tenant fast path: nothing left to arbitrate
             # — resolve every parked waiter and lift any preemption
             for _seq, nm, fut in self._waiters:
                 if not fut.done():
-                    self._holder = nm
+                    self._set_holder(nm)
                     fut.set_result(None)
             self._waiters.clear()
             for other in self._regs.values():
@@ -141,6 +144,26 @@ class DeviceArbiter:
     def multi(self) -> bool:
         return len(self._regs) >= 2
 
+    def _set_holder(self, name: str | None) -> None:
+        """Every holder transition funnels through here so the usage
+        meter sees exact grant intervals: the outgoing holder is charged
+        the wall seconds it actually held the device (key suffixes like
+        ``#2`` strip back to the deployment; qos class from the
+        registration)."""
+        old = self._holder
+        if old == name:
+            return
+        now = time.perf_counter()
+        if old is not None and self._t_grant:
+            reg = self._regs.get(old)
+            METER.add(
+                old.partition("#")[0],
+                qos=reg.priority if reg is not None else "",
+                grant_s=now - self._t_grant,
+            )
+        self._t_grant = now if name is not None else 0.0
+        self._holder = name
+
     # -------------------------------------------------------------- grants
 
     async def acquire(self, name: str) -> None:
@@ -149,13 +172,13 @@ class DeviceArbiter:
         holder's next sync point releases."""
         reg = self._regs.get(name)
         if reg is None or not self.multi:
-            self._holder = name
+            self._set_holder(name)
             return
         if self._holder == name:
             return
         self._policy()
         if self._holder is None:
-            self._holder = name
+            self._set_holder(name)
             reg.grants += 1
             self.grants += 1
             return
@@ -180,7 +203,7 @@ class DeviceArbiter:
         deadline pressure, arrival) is granted immediately."""
         if self._holder != name:
             return
-        self._holder = None
+        self._set_holder(None)
         self._policy()
         self._grant_next()
 
@@ -206,7 +229,7 @@ class DeviceArbiter:
             _seq, name, fut = self._waiters.pop(0)
             if fut.done():
                 continue
-            self._holder = name
+            self._set_holder(name)
             fut.set_result(None)
 
     def _waiter_key(self, waiter) -> tuple:

@@ -41,6 +41,7 @@ from seldon_core_tpu.obs import (
     current_span,
     record_host_sync,
 )
+from seldon_core_tpu.obs.metering import METER
 from seldon_core_tpu.qos import DeadlineExceeded, QueueFull, note_deadline_miss
 from seldon_core_tpu.qos.context import get_deadline
 from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
@@ -357,6 +358,10 @@ class BatchQueue:
             record_host_sync(self.name)  # the fetch materialized one result
             dispatch_s = split[0]
             device_s = step_s - dispatch_s if 0 < dispatch_s < step_s else step_s
+            # usage attribution: queue items carry no adapter/qos, so the
+            # whole measured device slice of this step charges the owning
+            # deployment's base row (host bookkeeping at the step boundary)
+            METER.add(self.name, device_s=device_s)
             if dispatch_s > 0:
                 RECORDER.record_stage(STAGE_DEVICE_DISPATCH, dispatch_s)
                 self._m_device_frac.set(device_s / step_s if step_s > 0 else 0.0)

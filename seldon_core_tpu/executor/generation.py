@@ -52,6 +52,7 @@ import numpy as np
 from seldon_core_tpu import qos
 from seldon_core_tpu.graph.units import GraphUnitError, SeldonComponent
 from seldon_core_tpu.obs import RECORDER, STAGE_DEVICE_STEP, STAGE_TTFT, TIMELINE
+from seldon_core_tpu.obs.metering import METER
 from seldon_core_tpu.obs.timeline import (
     EVENT_PREEMPT,
     EVENT_RESUME,
@@ -1091,7 +1092,12 @@ class GenerativeModel:
         if self.lora_pool is None or not adapter:
             return
         if self.lora_pool.note_tokens_name(adapter, n):
-            DEFAULT_METRICS.lora_tokens.labels(self.name, adapter).inc(int(n))
+            # cardinality guard: past SCT_METER_ADAPTER_LABELS distinct
+            # adapters the label value rolls up into `other` (the pool's
+            # own per-name ledger stays exact)
+            DEFAULT_METRICS.lora_tokens.labels(
+                self.name, DEFAULT_METRICS.adapter_label(adapter)
+            ).inc(int(n))
 
     def slot_adapter(self, slot: int) -> str | None:
         """Resident adapter name bound to ``slot`` (None = base model)."""
@@ -2524,9 +2530,12 @@ class GenerativeModel:
         out = np.asarray(  # sct: host-sync-ok unfused single-step fetch
             jax.device_get(toks)
         )
-        self._record_step(
-            time.perf_counter() - t0, int(np.asarray(active, bool).sum())
-        )
+        step_s = time.perf_counter() - t0
+        # usage attribution: in single-step mode (decode_block=1) each
+        # step IS the fused block, so the meter's token-share split reads
+        # the same stash step_k_fetch fills on the fused path
+        self.last_block_s = step_s
+        self._record_step(step_s, int(np.asarray(active, bool).sum()))
         return out
 
     def step_k(
@@ -2654,7 +2663,12 @@ class GenerativeModel:
                 productive
             )
             DEFAULT_METRICS.spec_accepted_per_step.labels(self.name).set(ratio)
-        self._record_step(time.perf_counter() - t0, int(act_np.sum()))
+        step_s = time.perf_counter() - t0
+        # stashed for the delivery loop's usage attribution: this block's
+        # measured device seconds get split across the slots it served by
+        # token share (obs/metering.py) — host bookkeeping at the one sync
+        self.last_block_s = step_s
+        self._record_step(step_s, int(act_np.sum()))
         return np.asarray(toks_np), act_np
 
     def _decode_k_fn(self, k: int, window: int) -> tuple[Any, bool]:
@@ -3023,6 +3037,15 @@ class _Request:
     # event is stamped from host-held values only
     timeline: Any = None
     done_reason: str | None = None
+    # per-request cost accumulators (obs/metering.py): device seconds
+    # attributed by token share, prompt tokens actually prefilled, and
+    # prefix-tier tokens saved — stamped onto the timeline terminal so a
+    # single trace shows its own cost
+    u_device_s: float = 0.0
+    u_tokens_prefill: int = 0
+    u_saved_tokens: int = 0
+    u_saved_tier: str = ""
+    u_terminal_metered: bool = False
 
 
 class GenerationScheduler:
@@ -3143,22 +3166,89 @@ class GenerationScheduler:
         if span and req.span is not None and len(req.span.span.events) < 256:
             req.span.event(name, **attrs)
 
+    def _usage_attrs(self, req: _Request) -> dict:
+        """The request's final cost totals, stamped onto its terminal
+        event so one trace shows what it spent (host-held values only)."""
+        out = {
+            "device_ms": round(req.u_device_s * 1e3, 3),
+            "tokens_in": int(req.prompt.size),
+            "tokens_out": len(req.out),
+        }
+        if req.u_saved_tokens:
+            out["tokens_saved"] = int(req.u_saved_tokens)
+            out["saved_tier"] = req.u_saved_tier
+        return out
+
+    def _meter_terminal(self, req: _Request, reason: str) -> None:
+        """Fold the request's outcome into the usage meter exactly once
+        (first terminal wins, matching the timeline)."""
+        if reason in ("eos", "budget", "exported"):
+            METER.add(
+                self.model.name, req.adapter or "", req.priority,
+                requests_completed=1,
+            )
+        elif reason == "shed":
+            METER.add(
+                self.model.name, req.adapter or "", req.priority,
+                requests_shed=1,
+            )
+        else:  # deadline-reap / disconnect / error: spent, not delivered
+            METER.add(
+                self.model.name, req.adapter or "", req.priority,
+                requests_reaped=1, tokens_wasted=len(req.out),
+            )
+
+    def _meter_admit(self, req: _Request, snap: dict | None) -> None:
+        """Fold one admission's prefill cost into the usage meter: prompt
+        tokens actually prefilled on device, and prefix-tier tokens SAVED
+        (hbm/dram/peer — reuse of KV someone already paid for), both from
+        the host-side reservation bookkeeping the admit event reads."""
+        snap = snap or {}
+        prompt_n = int(req.prompt.size)
+        saved = min(int(snap.get("prefix_tokens") or 0), prompt_n)
+        tier = str(snap.get("tier") or "none")
+        fields: dict = {"tokens_prefill": max(0, prompt_n - saved)}
+        if saved and tier in ("hbm", "dram", "peer"):
+            fields[f"tokens_saved_{tier}"] = saved
+            req.u_saved_tokens += saved
+            req.u_saved_tier = tier
+        req.u_tokens_prefill = fields["tokens_prefill"]
+        METER.add(
+            self.model.name, req.adapter or "", req.priority, **fields
+        )
+
     def _end_tl(self, req: _Request, reason: str, **attrs) -> None:
         if req.done_reason is None:
             req.done_reason = reason
+        if not req.u_terminal_metered:
+            # exactly-once outcome metering: done_reason may have been
+            # stamped by the device-visible transition (_token_done)
+            # before this terminal event runs
+            req.u_terminal_metered = True
+            self._meter_terminal(req, req.done_reason)
+        attrs["usage"] = self._usage_attrs(req)
         if req.timeline is not None:
             req.timeline.end(reason, **attrs)
         if req.span is not None and len(req.span.span.events) < 256:
             req.span.event("terminal", reason=reason, **attrs)
 
-    def _note_shed(self, priority: str, depth: int, cap: int) -> None:
+    def _note_shed(
+        self, priority: str, depth: int, cap: int, adapter: str | None = None
+    ) -> None:
         """A QueueFull shed leaves a terminal-only timeline entry so the
-        trace's forensics say WHY the request never ran."""
+        trace's forensics say WHY the request never ran — and a shed-cost
+        row in the usage meter (zero device time, by construction)."""
+        METER.add(
+            self.model.name, adapter or "", priority, requests_shed=1
+        )
         tl = TIMELINE.begin(
             current_trace_id(), model=self.model.name, priority=priority
         )
         if tl is not None:
-            tl.end("shed", depth=depth, cap=cap)
+            tl.end(
+                "shed", depth=depth, cap=cap,
+                usage={"device_ms": 0.0, "tokens_in": 0, "tokens_out": 0},
+            )
 
     async def submit(
         self,
@@ -3211,7 +3301,7 @@ class GenerationScheduler:
             else self._batch_cap
         )
         if self._maxsize and depth >= cap:
-            self._note_shed(priority, depth, cap)
+            self._note_shed(priority, depth, cap, adapter)
             raise qos.QueueFull(
                 f"generation queue is full ({depth} waiting, cap {cap} "
                 f"for {priority})"
@@ -3271,7 +3361,7 @@ class GenerationScheduler:
             else self._batch_cap
         )
         if self._maxsize and depth >= cap:
-            self._note_shed(req.priority, depth, cap)
+            self._note_shed(req.priority, depth, cap, req.adapter)
             raise qos.QueueFull(
                 f"generation queue is full ({depth} waiting, cap {cap} "
                 f"for {req.priority})"
@@ -3587,7 +3677,10 @@ class GenerationScheduler:
             before = int(getattr(self.model, "free_block_count", 0) or 0)
             self.model.release_slot(i)
             freed = int(getattr(self.model, "free_block_count", 0) or 0) - before
-            self._suspended.append({"req": req, "key": key, "bytes": len(frame)})
+            self._suspended.append({
+                "req": req, "key": key, "bytes": len(frame),
+                "t_park": time.perf_counter(),
+            })
             slots[i] = None
             active[i] = False
             self.suspends += 1
@@ -3599,6 +3692,19 @@ class GenerationScheduler:
             )
         return n_susp
 
+    def _meter_unpark(self, rec: dict) -> None:
+        """Charge a suspend record's byte-seconds the moment it leaves the
+        store (resume, reap, drain, or close) — bytes held x wall seconds
+        parked, host bookkeeping only."""
+        t0 = rec.get("t_park")
+        if not t0:
+            return
+        req = rec["req"]
+        METER.add(
+            self.model.name, req.adapter or "", req.priority,
+            suspend_byte_s=rec["bytes"] * (time.perf_counter() - t0),
+        )
+
     def _drain_resumes(self) -> None:
         """Resume verb, at a sync point with preemption lifted: decode
         each suspend record back into an imported admission — the donated
@@ -3608,6 +3714,7 @@ class GenerationScheduler:
 
         while self._suspended:
             rec = self._suspended.pop(0)
+            self._meter_unpark(rec)
             req = rec["req"]
             frame = (
                 self._suspend_store.take(rec["key"])
@@ -3650,11 +3757,13 @@ class GenerationScheduler:
             if req.future.done():
                 if self._suspend_store is not None:
                     self._suspend_store.take(rec["key"])
+                self._meter_unpark(rec)
                 self._end_tl(req, "disconnect", stage="suspended")
                 continue
             if req.deadline is not None and now >= req.deadline:
                 if self._suspend_store is not None:
                     self._suspend_store.take(rec["key"])
+                self._meter_unpark(rec)
                 req.future.set_exception(qos.DeadlineExceeded(
                     f"deadline expired while suspended after "
                     f"{len(req.out)} tokens"
@@ -3712,6 +3821,7 @@ class GenerationScheduler:
         out: list[tuple[_Request, bytes]] = []
         while self._suspended:
             rec = self._suspended.pop(0)
+            self._meter_unpark(rec)
             req = rec["req"]
             frame = (
                 self._suspend_store.take(rec["key"])
@@ -3743,9 +3853,10 @@ class GenerationScheduler:
             self._suspend_seq += 1
             key = (id(req), self._suspend_seq)
             if store.put(key, frame):
-                self._suspended.append(
-                    {"req": req, "key": key, "bytes": len(frame)}
-                )
+                self._suspended.append({
+                    "req": req, "key": key, "bytes": len(frame),
+                    "t_park": time.perf_counter(),
+                })
                 self._tl(req, "drain-abort", span=False)
             else:
                 req.future.set_exception(
@@ -3865,7 +3976,16 @@ class GenerationScheduler:
             req.t_last_tok = req.t_first_token
             ttft = req.t_first_token - req.t0
             RECORDER.record_stage(STAGE_TTFT, ttft)
-            DEFAULT_METRICS.ttft.labels(self.model.name).observe(ttft)
+            # exemplar-linked observation (SCT_METRICS_EXEMPLARS): the
+            # bucket carries this request's trace id, so a p99 spike on
+            # the /prometheus histogram links straight to its
+            # GET /stats/timeline?trace= forensics
+            from seldon_core_tpu.utils.metrics import observe_exemplar
+
+            observe_exemplar(
+                DEFAULT_METRICS.ttft.labels(self.model.name), ttft,
+                req.timeline.trace_id if req.timeline is not None else None,
+            )
             if req.span is not None:
                 req.span.event("first-token", ttft_ms=round(ttft * 1e3, 3))
         req.out.append(tok)
@@ -3990,6 +4110,18 @@ class GenerationScheduler:
         # per-adapter served-token ledger (docs/MULTITENANT.md); getattr:
         # duck-typed stand-in models predate multi-LoRA
         note_adapter = getattr(self.model, "note_adapter_tokens", None)
+        # usage attribution (obs/metering.py): this fused block's measured
+        # device seconds (stashed by step_k_fetch at the one host sync)
+        # split across the slots it served BY TOKEN SHARE — a slot that
+        # emitted 3 of the block's 12 tokens is charged 25% of the block.
+        # getattr: duck-typed stand-in models predate the meter.
+        block_s = float(getattr(self.model, "last_block_s", 0.0) or 0.0)
+        block_tokens = sum(counts)
+        if block_s and not block_tokens:
+            # a block that emitted nothing (every slot went inactive at
+            # dispatch) still spent the device: charge the base row so
+            # attribution stays conservation-exact against the wall total
+            METER.add(self.model.name, device_s=block_s)
         for i in range(S):
             req = reqs[i]
             if req is None or not counts[i]:
@@ -3999,19 +4131,31 @@ class GenerationScheduler:
             if req.t_last_tok and note_itl is not None:
                 note_itl((now - req.t_last_tok) / counts[i])
             req.t_last_tok = now
+            accepted = 0
+            if spec_d and toks_seq.shape[0] % tps == 0:
+                passes = int(
+                    np.asarray(act_seq[:, i])
+                    .reshape(-1, tps)
+                    .any(axis=1)
+                    .sum()
+                )
+                accepted = max(0, counts[i] - passes)
+            share_s = (
+                block_s * counts[i] / block_tokens if block_tokens else 0.0
+            )
+            req.u_device_s += share_s
+            METER.add(
+                self.model.name, req.adapter or "", req.priority,
+                device_s=share_s, tokens_decode=counts[i],
+                tokens_spec_accepted=accepted,
+            )
             if req.timeline is not None or req.span is not None:
                 attrs = {"tokens": counts[i]}
                 if spec_d and toks_seq.shape[0] % tps == 0:
-                    passes = int(
-                        np.asarray(act_seq[:, i])
-                        .reshape(-1, tps)
-                        .any(axis=1)
-                        .sum()
-                    )
                     attrs.update(
                         passes=passes,
                         drafted=passes * spec_d,
-                        accepted=max(0, counts[i] - passes),
+                        accepted=accepted,
                     )
                 self._tl(req, "block", **attrs)
             if slots[i] is None and req.done_reason is not None:
@@ -4386,6 +4530,7 @@ class GenerationScheduler:
                 self._end_tl(req, "error", cause="closed")
             self._overflow.clear()
             for rec in self._suspended:
+                self._meter_unpark(rec)
                 if not rec["req"].future.done():
                     rec["req"].future.set_exception(err)
                 self._end_tl(rec["req"], "error", cause="closed")
@@ -4494,9 +4639,11 @@ class GenerationScheduler:
             )
             self._prefill_slots.add(slot)
             akw = {"adapter": req.adapter} if req.adapter else {}
+            snap = resnap(slot) or {}
+            self._meter_admit(req, snap)
             self._tl(
                 req, "admit", slot=slot, chunked=True,
-                chunks=len(plan["payloads"]), **akw, **(resnap(slot) or {}),
+                chunks=len(plan["payloads"]), **akw, **snap,
             )
         for req in starved:
             self._tl(req, "kv-starved", span=False)
@@ -4518,15 +4665,21 @@ class GenerationScheduler:
                 else:
                     self._external.add(slot)
                     akw = {"adapter": req.adapter} if req.adapter else {}
+                    snap = resnap(slot) or {}
+                    self._meter_admit(req, snap)
                     self._tl(
                         req, "admit", slot=slot, prefill_only=True,
-                        **akw, **(resnap(slot) or {}),
+                        **akw, **snap,
                     )
                     req.future.set_result((slot, int(tok)))
                     self._end_tl(req, "exported", slot=slot)
                 continue
             self._note_queue_wait(req)
             attrs = resnap(slot) or {}
+            if req.imported is None:
+                # imported admissions (disagg handoff / resumed suspends)
+                # prefilled nothing here — the paying engine metered it
+                self._meter_admit(req, attrs)
             if req.adapter:
                 attrs["adapter"] = req.adapter
             if req.imported is not None and req.imported.get("resumed"):

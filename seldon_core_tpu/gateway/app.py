@@ -318,6 +318,10 @@ class GatewayApp:
         r.add_get("/stats/fleet", self.stats_fleet)
         r.add_get("/stats/slo", self.stats_slo)
         r.add_get("/stats/autoscale", self.stats_autoscale)
+        # per-tenant cost attribution (docs/OBSERVABILITY.md "Cost
+        # attribution"): the gateway's own meter rows (gateway-side
+        # sheds / cache hits); fleet-merged engine rows via /stats/fleet
+        r.add_get("/stats/usage", self.stats_usage)
         # replica-set timeline fan-out: one query stitches every leg
         r.add_get("/stats/timeline", self.stats_timeline)
 
@@ -737,7 +741,23 @@ class GatewayApp:
         return web.Response(text="unpaused")
 
     async def prometheus(self, request: web.Request) -> web.Response:
-        return web.Response(body=self.metrics.expose(), content_type="text/plain")
+        self.metrics.refresh_usage()
+        return web.Response(
+            body=self.metrics.expose(),
+            headers={"Content-Type": self.metrics.expose_content_type()},
+        )
+
+    def usage_snapshot(self) -> dict:
+        """Process-local usage-meter rows (shared by both REST fronts'
+        /stats/usage).  In a gateway process these are the gateway-side
+        charges (sheds, response-cache hits); the per-replica engine rows
+        are fleet-merged under /stats/fleet."""
+        from seldon_core_tpu.obs.metering import METER
+
+        return METER.snapshot()
+
+    async def stats_usage(self, request: web.Request) -> web.Response:
+        return web.json_response({"usage": self.usage_snapshot()})
 
     async def stats_spans(self, request: web.Request) -> web.Response:
         try:

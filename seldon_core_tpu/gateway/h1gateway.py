@@ -1559,7 +1559,8 @@ class H1SpliceFrontend:
             gw._paused = False
             return 200, b"unpaused", b"text/plain"
         if route == b"/prometheus":
-            return 200, gw.metrics.expose(), b"text/plain"
+            gw.metrics.refresh_usage()
+            return 200, gw.metrics.expose(), gw.metrics.expose_content_type().encode()
         if route == b"/stats/spans":
             return 200, json.dumps(self.recorder.stats(n=20)).encode(), b"application/json"
         if route == b"/stats/breakdown":
@@ -1596,6 +1597,10 @@ class H1SpliceFrontend:
         if route == b"/stats/autoscale":
             return 200, json.dumps(
                 {"autoscale": gw.autoscale_snapshot()}
+            ).encode(), b"application/json"
+        if route == b"/stats/usage":
+            return 200, json.dumps(
+                {"usage": gw.usage_snapshot()}
             ).encode(), b"application/json"
         if route == b"/stats/timeline":
             form = urllib.parse.parse_qs(query.decode("latin-1"))

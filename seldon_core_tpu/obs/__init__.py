@@ -64,6 +64,11 @@ from seldon_core_tpu.obs.slo import (  # noqa: F401
     parse_slo,
 )
 from seldon_core_tpu.obs.fleet import FleetCollector  # noqa: F401
+from seldon_core_tpu.obs.metering import (  # noqa: F401
+    METER,
+    UsageMeter,
+    get_meter,
+)
 
 
 def configure_exporters_from_env(recorder: SpanRecorder | None = None) -> list:

@@ -344,11 +344,19 @@ class FleetCollector:
                 latency[stage] = entry
             cache: dict = {}
             wire: dict = {}
+            # usage-meter rows are cumulative counters keyed by
+            # (deployment|adapter|qos), so the recursive numeric sum IS
+            # the counter-exact fleet merge: per-key sums over live
+            # replicas equal the union, and dead replicas drop out of
+            # ``live`` entirely (excluded, not zeroed)
+            usage: dict = {}
             for p in live:
                 if isinstance(p.get("cache"), dict):
                     _merge_numeric(cache, p["cache"])
                 if isinstance(p.get("wire"), dict):
                     _merge_numeric(wire, p["wire"])
+                if isinstance(p.get("usage"), dict):
+                    _merge_numeric(usage, p["usage"])
             dep = {
                 "replicas": metas,
                 "replicas_live": len(live),
@@ -357,6 +365,7 @@ class FleetCollector:
                 "latency": latency,
                 "cache": cache,
                 "wire": wire,
+                "usage": usage,
                 "stage_hist": merged_hist,
             }
             deployments[rec.name] = dep
@@ -394,6 +403,12 @@ class FleetCollector:
             if q.get("win_p99_ms") is not None:
                 h.record(f"{name}.{stage}.win_p99_ms",
                          q["win_p99_ms"], now=now)
+        u_total = (dep.get("usage") or {}).get("total")
+        if isinstance(u_total, dict):
+            h.record(f"{name}.usage_device_s",
+                     u_total.get("device_s", 0), now=now)
+            h.record(f"{name}.usage_tokens_decode",
+                     u_total.get("tokens_decode", 0), now=now)
         h.record(f"{name}.replicas_live", dep["replicas_live"], now=now)
 
     def _export_metrics(self, name: str, dep: dict) -> None:

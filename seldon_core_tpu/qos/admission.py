@@ -317,6 +317,12 @@ class AdmissionController:
         self._events.append((now, True))
         self._maybe_enter_brownout(now)
         self.metrics.qos_shed.labels(self.name, exc.reason, priority).inc()
+        # cost attribution: a shed is tenant-attributable work refused —
+        # the (deployment, qos) row's requests_shed counter feeds the
+        # /stats/usage conservation ledger (docs/OBSERVABILITY.md)
+        from seldon_core_tpu.obs.metering import METER
+
+        METER.add(self.name, qos=priority, requests_shed=1)
         raise exc
 
     # -- estimates -----------------------------------------------------------

@@ -402,6 +402,29 @@ declare("SCT_OTLP_TIMEOUT_S", "1.0", "float",
 declare("SCT_LOOP_LAG_INTERVAL_S", "0.25", "float",
         "Event-loop lag probe interval (seconds).",
         section="observability")
+declare("SCT_METER", "1", "bool",
+        "Per-tenant usage metering: device time + tokens attributed to "
+        "(deployment, adapter, qos) keys (GET /stats/usage; "
+        "docs/OBSERVABILITY.md cost attribution).",
+        section="observability")
+declare("SCT_METER_MAX_KEYS", "512", "int",
+        "Live usage-meter key rows (LRU; evictions fold counter-exactly "
+        "into the `other` rollup).",
+        section="observability")
+declare("SCT_METER_TOP_K", "16", "int",
+        "seldon_usage_* label rows exported per scrape before the "
+        "`other` rollup row (bounded cardinality).",
+        section="observability")
+declare("SCT_METER_ADAPTER_LABELS", "32", "int",
+        "Distinct adapter label values on per-adapter metric families "
+        "(seldon_lora_tokens and friends) before new adapters roll up "
+        "into `other`.",
+        section="observability")
+declare("SCT_METRICS_EXEMPLARS", "0", "bool",
+        "Render /prometheus in OpenMetrics format with trace-id "
+        "exemplars on hot-stage latency histograms (a p99 spike links "
+        "to GET /stats/timeline?trace=).",
+        section="observability")
 
 # -- fleet telemetry (collector + SLO engine; docs/OBSERVABILITY.md) --------
 declare("SCT_FLEET", "1", "bool",

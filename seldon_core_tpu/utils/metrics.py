@@ -11,6 +11,7 @@ names here so existing Grafana dashboards keep working.
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 
@@ -25,6 +26,51 @@ from prometheus_client import (
 _BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# OpenMetrics content type served by /prometheus when exemplar rendering
+# is on (SCT_METRICS_EXEMPLARS); plain text exposition otherwise.
+OPENMETRICS_CONTENT_TYPE = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+PLAIN_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def exemplars_enabled() -> bool:
+    from seldon_core_tpu.runtime import settings
+
+    return settings.get_bool("SCT_METRICS_EXEMPLARS")
+
+
+def observe_exemplar(hist, value: float, trace_id: str | None) -> None:
+    """Observe ``value`` carrying an OpenMetrics exemplar that links the
+    bucket to ``GET /stats/timeline?trace=<trace_id>`` when exemplar
+    rendering is on.  Histogram stand-ins without exemplar support fall
+    back to a plain observe."""
+    if trace_id and exemplars_enabled():
+        try:
+            hist.observe(value, exemplar={"trace_id": trace_id})
+            return
+        except TypeError:
+            pass
+    hist.observe(value)
+
+
+# label sets exported per seldon_usage_* field group (refresh_usage)
+_USAGE_TOKEN_KINDS = (
+    ("prefill", "tokens_prefill"),
+    ("decode", "tokens_decode"),
+    ("spec_accepted", "tokens_spec_accepted"),
+    ("saved_hbm", "tokens_saved_hbm"),
+    ("saved_dram", "tokens_saved_dram"),
+    ("saved_peer", "tokens_saved_peer"),
+    ("wasted", "tokens_wasted"),
+)
+_USAGE_REQ_OUTCOMES = (
+    ("completed", "requests_completed"),
+    ("shed", "requests_shed"),
+    ("reaped", "requests_reaped"),
+    ("cached", "requests_cached"),
 )
 
 
@@ -483,6 +529,66 @@ class MetricsRegistry:
             ["deployment", "outcome"],
             registry=self.registry,
         )
+        # Per-tenant cost attribution (obs/metering.py; refreshed from the
+        # UsageMeter's top-K export at /prometheus scrape time — gauges
+        # over monotonic totals, like the prefix_tier/kv_* families.
+        # Cardinality is bounded by construction: SCT_METER_TOP_K rows
+        # plus one `other` rollup.)
+        self.usage_device_seconds = Gauge(
+            "seldon_usage_device_seconds",
+            "Device-step seconds attributed per tenant (fused blocks "
+            "split across occupied slots by token share)",
+            ["deployment", "adapter", "qos"],
+            registry=self.registry,
+        )
+        self.usage_grant_seconds = Gauge(
+            "seldon_usage_grant_seconds",
+            "Arbiter grant-interval wall seconds the deployment held the "
+            "device",
+            ["deployment", "adapter", "qos"],
+            registry=self.registry,
+        )
+        self.usage_tokens = Gauge(
+            "seldon_usage_tokens",
+            "Tokens attributed per tenant by kind (prefill / decode / "
+            "spec_accepted / saved_hbm / saved_dram / saved_peer / "
+            "wasted)",
+            ["deployment", "adapter", "qos", "kind"],
+            registry=self.registry,
+        )
+        self.usage_requests = Gauge(
+            "seldon_usage_requests",
+            "Requests attributed per tenant by outcome (completed / shed "
+            "/ reaped / cached)",
+            ["deployment", "adapter", "qos", "outcome"],
+            registry=self.registry,
+        )
+        self.usage_suspend_byte_seconds = Gauge(
+            "seldon_usage_suspend_byte_seconds",
+            "Bytes x seconds a tenant's preempted KV sat parked in the "
+            "host suspend store",
+            ["deployment", "adapter", "qos"],
+            registry=self.registry,
+        )
+        self.usage_meter_keys = Gauge(
+            "seldon_usage_meter_keys",
+            "Live usage-meter key rows (LRU-bounded by "
+            "SCT_METER_MAX_KEYS)",
+            registry=self.registry,
+        )
+        self.usage_meter_evicted = Gauge(
+            "seldon_usage_meter_evicted",
+            "Key rows LRU-evicted into the `other` rollup since boot",
+            registry=self.registry,
+        )
+        # bounded adapter->label mapping for per-adapter families
+        # (seldon_lora_tokens and friends): first SCT_METER_ADAPTER_LABELS
+        # distinct adapters keep their own label value, later ones report
+        # as `other` so tenant churn can't grow the label set unbounded
+        self._adapter_label_lock = threading.Lock()
+        self._adapter_labels: dict[str, str] = {}
+        self._adapter_label_max: int | None = None
+        self.adapter_rollups = 0
 
     @contextmanager
     def time_server_request(
@@ -514,8 +620,90 @@ class MetricsRegistry:
                     m.value
                 )
 
+    def adapter_label(self, adapter: str) -> str:
+        """Bounded label value for per-adapter metric families.  The
+        first ``SCT_METER_ADAPTER_LABELS`` distinct adapters keep their
+        own label; every later adapter reports as ``other`` (counted in
+        ``adapter_rollups``).  The null adapter passes through untouched
+        — base-deployment traffic is not a rollup tenant."""
+        if not adapter:
+            return adapter
+        with self._adapter_label_lock:
+            lbl = self._adapter_labels.get(adapter)
+            if lbl is not None:
+                return lbl
+            if self._adapter_label_max is None:
+                from seldon_core_tpu.runtime import settings
+
+                self._adapter_label_max = max(
+                    0, settings.get_int("SCT_METER_ADAPTER_LABELS")
+                )
+            if len(self._adapter_labels) < self._adapter_label_max:
+                self._adapter_labels[adapter] = adapter
+                return adapter
+            self.adapter_rollups += 1
+            return "other"
+
+    def refresh_usage(self, meter=None) -> None:
+        """Re-derive the ``seldon_usage_*`` gauge families from the usage
+        meter's bounded top-K export (called at /prometheus scrape time).
+        Label sets are rebuilt from scratch each refresh so rows that
+        fell out of the top-K don't linger as stale series."""
+        if meter is None:
+            from seldon_core_tpu.obs.metering import METER as meter
+        if not meter.enabled:
+            return
+        rows = meter.export_rows()
+        for fam in (
+            self.usage_device_seconds,
+            self.usage_grant_seconds,
+            self.usage_tokens,
+            self.usage_requests,
+            self.usage_suspend_byte_seconds,
+        ):
+            fam.clear()
+        for (dep, adapter, qos), row in rows:
+            self.usage_device_seconds.labels(dep, adapter, qos).set(
+                row.get("device_s", 0.0)
+            )
+            if "grant_s" in row:
+                self.usage_grant_seconds.labels(dep, adapter, qos).set(
+                    row["grant_s"]
+                )
+            for kind, field in _USAGE_TOKEN_KINDS:
+                if field in row:
+                    self.usage_tokens.labels(dep, adapter, qos, kind).set(
+                        row[field]
+                    )
+            for outcome, field in _USAGE_REQ_OUTCOMES:
+                if field in row:
+                    self.usage_requests.labels(dep, adapter, qos, outcome).set(
+                        row[field]
+                    )
+            if "suspend_byte_s" in row:
+                self.usage_suspend_byte_seconds.labels(dep, adapter, qos).set(
+                    row["suspend_byte_s"]
+                )
+        self.usage_meter_keys.set(meter.size())
+        self.usage_meter_evicted.set(meter.evicted)
+
     def expose(self) -> bytes:
+        """The /prometheus payload: classic text exposition, or
+        OpenMetrics (exemplars rendered) when SCT_METRICS_EXEMPLARS is
+        on — pair with :meth:`expose_content_type`."""
+        if exemplars_enabled():
+            from prometheus_client.openmetrics.exposition import (
+                generate_latest as om_generate_latest,
+            )
+
+            return om_generate_latest(self.registry)
         return generate_latest(self.registry)
+
+    def expose_content_type(self) -> str:
+        return (
+            OPENMETRICS_CONTENT_TYPE if exemplars_enabled()
+            else PLAIN_CONTENT_TYPE
+        )
 
 
 # default process-wide registry

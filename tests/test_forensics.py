@@ -204,7 +204,14 @@ class TestSchedulerTimeline:
         (entry,) = TIMELINE.by_trace(tid)
         assert entry["done"] == "eos"
         term = entry["events"][-1]
-        assert term["attrs"] == {"reason": "eos", "tokens": 2}
+        assert term["attrs"]["reason"] == "eos"
+        assert term["attrs"]["tokens"] == 2
+        # terminal events stamp the request's final usage totals so
+        # /stats/timeline?trace= shows what the request cost (metering)
+        usage = term["attrs"]["usage"]
+        assert usage["tokens_in"] == 3
+        assert usage["tokens_out"] == 2
+        assert usage["device_ms"] >= 0
 
     def test_prefix_reuse_depth_on_admit(self, tiny):
         cfg, params = tiny
