@@ -1,6 +1,6 @@
 PYTHON ?= python
 
-.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check chaos-check fleet-check scale-check meter-check lint-check clean
+.PHONY: proto test bench native obs-check qos-check profile-check cache-check perf-check disagg-check spec-check chunk-check forensics-check lora-check tiers-check pack-check chaos-check fleet-check scale-check meter-check graph-check lint-check clean
 
 proto:
 	protoc --proto_path=seldon_core_tpu/proto \
@@ -168,6 +168,15 @@ scale-check:
 meter-check:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_metering.py -q
 	JAX_PLATFORMS=cpu BENCH_ONLY=USAGE BENCH_RUNS=1 $(PYTHON) bench.py
+
+# LLM-native graphs (docs/GRAPHS.md): cascade router decision matrix +
+# pinned both-path e2e with stitched cascade.route spans, guardrail
+# policy pipeline + determinism contract both ways, embeddings endpoint
+# + pinned pooled vectors under tp=2, semantic cache tier bounds +
+# paraphrase hits + both-tier spec-roll flush, confidence-signal
+# host-sync parity
+graph-check:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_graphllm.py -q
 
 # invariant-aware static analysis (docs/STATIC_ANALYSIS.md): host-sync,
 # program-key, pairing, env-registry, async-discipline, test-hygiene,

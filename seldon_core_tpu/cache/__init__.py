@@ -11,7 +11,10 @@ Three tiers threaded through gateway -> engine -> walker -> generation:
   identical in-flight requests share one upstream computation;
 * **KV prefix reuse** (:mod:`cache.prefix`): token-prefix radix index
   over the paged KV pool — shared-prefix prompts skip prefill for the
-  blocks a previous request already produced.
+  blocks a previous request already produced;
+* **semantic tier** (:mod:`cache.semantic`): cosine-similarity index
+  over pooled prompt embeddings — paraphrases of a cached prompt hit
+  without an exact byte match, same spec-hash invalidation story.
 
 Cache hits are served BEFORE QoS admission (they consume no admission
 slot, no queue position, no deadline budget) and record ``cache.hit`` /
@@ -31,5 +34,10 @@ from seldon_core_tpu.cache.content import (  # noqa: F401
     spec_hash,
 )
 from seldon_core_tpu.cache.prefix import PrefixIndex  # noqa: F401
+from seldon_core_tpu.cache.semantic import (  # noqa: F401
+    SemanticCache,
+    semantic_cache_from_env,
+    semcache_enabled,
+)
 from seldon_core_tpu.cache.singleflight import SingleFlight  # noqa: F401
 from seldon_core_tpu.cache.tiers import HostPrefixStore  # noqa: F401

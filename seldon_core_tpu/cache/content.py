@@ -128,6 +128,7 @@ class ResponseCache:
         self.evictions = 0
         self.expirations = 0
         self.flushes = 0
+        self.flushes_by_ns: dict[str, int] = {}
 
     # metrics children are cached per namespace: the registry lock must
     # stay off the per-request path
@@ -196,19 +197,26 @@ class ResponseCache:
 
     def flush(self, namespace: str | None = None) -> int:
         """Drop one namespace's entries (spec-hash change / deployment
-        removal), or everything when ``namespace`` is None."""
+        removal), or everything when ``namespace`` is None.  Per-namespace
+        flush counts accumulate in :attr:`flushes_by_ns` so operators can
+        see WHICH deployment's rolling updates are churning the cache
+        (``GET /stats/cache``)."""
         with self._lock:
             if namespace is None:
+                flushed_ns = {k[0] for k in self._entries}
                 n = len(self._entries)
                 self._entries.clear()
                 self.bytes = 0
             else:
                 doomed = [k for k in self._entries if k[0] == namespace]
+                flushed_ns = {namespace} if doomed else set()
                 n = len(doomed)
                 for k in doomed:
                     self.bytes -= self._entries.pop(k).nbytes
             if n:
                 self.flushes += 1
+                for ns in flushed_ns:
+                    self.flushes_by_ns[ns] = self.flushes_by_ns.get(ns, 0) + 1
             self._set_gauges()
             return n
 
@@ -235,6 +243,7 @@ class ResponseCache:
                 "evictions": self.evictions,
                 "expirations": self.expirations,
                 "flushes": self.flushes,
+                "flushes_by_namespace": dict(self.flushes_by_ns),
             }
 
 

@@ -1,0 +1,255 @@
+"""Semantic response-cache tier: hit on paraphrases, not just bytes.
+
+The exact-match tier (cache/content.py) is defeated by a single changed
+token.  This tier indexes NORMALIZED embedding vectors of the prompt
+(produced by the deployment's own pooled-embedding path — the same model
+that will answer, so "similar to the cache" means similar in the model's
+own representation space) and serves a cached response when the cosine
+similarity of the best match clears ``SCT_SEMCACHE_SIM``.
+
+Invalidation mirrors the exact tier's two-layer story (docs/CACHING.md):
+
+* every entry carries the deployment ``tag`` (spec-hash) it was stored
+  under — a lookup only matches entries with the CALLER's current tag, so
+  a rolling update makes stale entries unhittable by construction;
+* the same flush listeners that drop a deployment's exact entries call
+  :meth:`flush` here, so both tiers clear together (the per-namespace
+  flush counter makes that observable on ``GET /stats/cache``).
+
+Everything is O(entries-in-namespace) per lookup under one lock — a
+brute-force dot product over a few thousand float32 vectors is
+microseconds of numpy, far below the device step a hit avoids — and
+memory is bounded by an entry count AND a byte budget (vectors + cached
+response bytes), oldest-first eviction.
+
+Hits are served BEFORE QoS admission like exact hits, marked
+``x-sct-cache: semantic``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any
+
+import numpy as np
+
+from seldon_core_tpu.obs.metering import METER
+from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
+
+
+class _Entry:
+    __slots__ = ("vec", "value", "nbytes", "expires", "tag", "status")
+
+    def __init__(self, vec, value, nbytes, expires, tag, status):
+        self.vec = vec
+        self.value = value
+        self.nbytes = nbytes
+        self.expires = expires
+        self.tag = tag
+        self.status = status
+
+
+class SemanticCache:
+    """Namespaced cosine-similarity cache over normalized prompt vectors.
+
+    ``namespace`` is the deployment (flush granularity), ``tag`` the
+    spec-hash the entry was stored under (staleness granularity).
+    """
+
+    def __init__(
+        self,
+        sim_threshold: float = 0.95,
+        max_entries: int = 2048,
+        max_bytes: int = 32 * 1024 * 1024,
+        ttl_s: float = 300.0,
+    ):
+        self.sim_threshold = float(sim_threshold)
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple[str, int], _Entry]" = OrderedDict()
+        self._next_id = 0
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.expirations = 0
+        self.flushes = 0
+        self.flushes_by_ns: dict[str, int] = {}
+        self.last_sim: float | None = None
+
+    @staticmethod
+    def _normalize(vec: np.ndarray) -> np.ndarray:
+        vec = np.asarray(vec, np.float32).ravel()
+        norm = float(np.linalg.norm(vec))
+        if norm <= 0.0 or not np.isfinite(norm):
+            return vec
+        return vec / norm
+
+    def _m(self, metric, *labels):
+        try:
+            return metric.labels(*labels) if labels else metric
+        except Exception:  # metrics must never fail a request
+            return None
+
+    def lookup(self, namespace: str, vec: np.ndarray, tag: str) -> Any | None:
+        """Best same-tag entry in ``namespace`` with cosine >= threshold,
+        or None.  ``vec`` need not be pre-normalized."""
+        q = self._normalize(vec)
+        now = time.monotonic()
+        with self._lock:
+            best: tuple[float, tuple[str, int], _Entry] | None = None
+            doomed: list[tuple[str, int]] = []
+            for key, e in self._entries.items():
+                if key[0] != namespace:
+                    continue
+                if now >= e.expires:
+                    doomed.append(key)
+                    continue
+                if e.tag != tag:
+                    # stored under an older spec-hash: unhittable (the
+                    # flush listener will clear it; matching it would
+                    # serve a pre-update answer)
+                    continue
+                if e.vec.shape != q.shape:
+                    continue
+                sim = float(e.vec @ q)
+                if sim >= self.sim_threshold and (
+                    best is None or sim > best[0]
+                ):
+                    best = (sim, key, e)
+            for key in doomed:
+                self.bytes -= self._entries.pop(key).nbytes
+                self.expirations += 1
+            if best is None:
+                self.misses += 1
+                self.last_sim = None
+                m = self._m(DEFAULT_METRICS.semcache_misses, namespace)
+                if m is not None:
+                    m.inc()
+                return None
+            sim, key, entry = best
+            self._entries.move_to_end(key)
+            self.hits += 1
+            self.last_sim = sim
+            m = self._m(DEFAULT_METRICS.semcache_hits, namespace)
+            if m is not None:
+                m.inc()
+            # cost attribution: a semantic hit is a request the tenant got
+            # for free, same ledger row as the exact tier's hits
+            METER.add(namespace, requests_cached=1)
+            return entry.value
+
+    def put(
+        self,
+        namespace: str,
+        vec: np.ndarray,
+        value: Any,
+        tag: str,
+        nbytes: int | None = None,
+        status: int = 200,
+    ) -> None:
+        q = self._normalize(vec)
+        if nbytes is None:
+            nbytes = len(value) if isinstance(value, (bytes, bytearray)) else 0
+        nbytes = int(nbytes) + int(q.nbytes)
+        if nbytes > self.max_bytes:
+            return  # bigger than the whole budget: uncacheable
+        entry = _Entry(
+            q, value, nbytes, time.monotonic() + self.ttl_s, tag, status
+        )
+        with self._lock:
+            key = (namespace, self._next_id)
+            self._next_id += 1
+            self._entries[key] = entry
+            self.bytes += entry.nbytes
+            while self._entries and (
+                len(self._entries) > self.max_entries
+                or self.bytes > self.max_bytes
+            ):
+                _, evicted = self._entries.popitem(last=False)
+                self.bytes -= evicted.nbytes
+                self.evictions += 1
+            self._set_gauges()
+
+    def flush(self, namespace: str | None = None) -> int:
+        """Drop one namespace's entries (spec change / deployment removal),
+        or everything when ``namespace`` is None.  Per-namespace flush
+        counts land in :attr:`flushes_by_ns` so the invalidation story is
+        observable on /stats/cache."""
+        with self._lock:
+            if namespace is None:
+                flushed_ns = {k[0] for k in self._entries}
+                n = len(self._entries)
+                self._entries.clear()
+                self.bytes = 0
+            else:
+                doomed = [k for k in self._entries if k[0] == namespace]
+                flushed_ns = {namespace} if doomed else set()
+                n = len(doomed)
+                for k in doomed:
+                    self.bytes -= self._entries.pop(k).nbytes
+            if n:
+                self.flushes += 1
+                for ns in flushed_ns:
+                    self.flushes_by_ns[ns] = self.flushes_by_ns.get(ns, 0) + 1
+            self._set_gauges()
+            return n
+
+    def _set_gauges(self) -> None:
+        try:
+            DEFAULT_METRICS.semcache_entries.set(len(self._entries))
+            DEFAULT_METRICS.semcache_bytes.set(self.bytes)
+        except Exception:
+            pass
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "tier": "semantic",
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+                "ttl_s": self.ttl_s,
+                "sim_threshold": self.sim_threshold,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else None,
+                "last_similarity": (
+                    round(self.last_sim, 4) if self.last_sim is not None else None
+                ),
+                "evictions": self.evictions,
+                "expirations": self.expirations,
+                "flushes": self.flushes,
+                "flushes_by_namespace": dict(self.flushes_by_ns),
+            }
+
+
+# -- env config --------------------------------------------------------------
+
+
+def semcache_enabled(environ: dict | None = None) -> bool:
+    env = environ if environ is not None else os.environ
+    return env.get("SCT_SEMCACHE", "0") == "1"
+
+
+def semantic_cache_from_env(environ: dict | None = None) -> SemanticCache | None:
+    """A configured SemanticCache, or None when the tier is off
+    (``SCT_SEMCACHE`` unset).  Knobs: ``SCT_SEMCACHE_SIM`` (default 0.95),
+    ``SCT_SEMCACHE_MAX_ENTRIES`` (2048), ``SCT_SEMCACHE_MAX_BYTES``
+    (32MiB), ``SCT_SEMCACHE_TTL_S`` (300)."""
+    env = environ if environ is not None else os.environ
+    if not semcache_enabled(env):
+        return None
+    return SemanticCache(
+        sim_threshold=float(env.get("SCT_SEMCACHE_SIM", "0.95")),
+        max_entries=int(env.get("SCT_SEMCACHE_MAX_ENTRIES", "2048")),
+        max_bytes=int(env.get("SCT_SEMCACHE_MAX_BYTES", str(32 * 1024 * 1024))),
+        ttl_s=float(env.get("SCT_SEMCACHE_TTL_S", "300")),
+    )

@@ -138,6 +138,9 @@ class EngineApp:
         # whole-response cacheability; node-tier caching still applies to
         # its deterministic MODEL children)
         self._resp_cache = None
+        # semantic tier: same determinism gate as the exact tier, plus it
+        # needs an embed-capable generative unit at runtime
+        self._sem_cache = None
         # live drain bookkeeping (docs/AUTOSCALING.md): a second
         # POST /admin/drain answers 409 WITH this state (phase, peer,
         # migration progress) so the autoscale reconciler's retry can
@@ -191,6 +194,9 @@ class EngineApp:
             # SSE token streaming for generative graphs (no reference
             # analogue; see docs in predictions_stream)
             r.add_post(f"{prefix}/predictions/stream", self.predictions_stream)
+            # pooled prompt embeddings off the generative unit's own
+            # weights (docs/GRAPHS.md) — feeds the semantic cache tier
+            r.add_post(f"{prefix}/embeddings", self.embeddings)
             r.add_post(f"{prefix}/feedback", self.feedback)
         r.add_get("/ping", self.ping)
         r.add_get("/ready", self.ready)
@@ -263,6 +269,8 @@ class EngineApp:
         self._register_packed_units()
         if self.service.response_cache is not None and self.service.graph_deterministic():
             self._resp_cache = self.service.response_cache
+        if self.service.semantic_cache is not None and self.service.graph_deterministic():
+            self._sem_cache = self.service.semantic_cache
         if self.mesh_worker:
             # worker host of a multi-host slice: the same units (and hence
             # the same registered SPMD step fns) were just built; execute the
@@ -395,14 +403,16 @@ class EngineApp:
             # admission slot, no queue position, and no deadline budget
             body = None
             cache_key = None
-            if self._resp_cache is not None:
-                from seldon_core_tpu.cache import canonical_body, request_key
-
+            sem_vec = None
+            if self._resp_cache is not None or self._sem_cache is not None:
                 try:
                     body = await self._json(request)
                 except CodecError as e:
                     h["code"] = "400"
                     return web.json_response(_status_body(400, str(e)), status=400)
+            if self._resp_cache is not None:
+                from seldon_core_tpu.cache import canonical_body, request_key
+
                 cache_key = request_key(
                     "predictions", self.service.spec_hash, canonical_body(body)
                 )
@@ -416,6 +426,27 @@ class EngineApp:
                         content_type="application/json",
                         headers={"x-sct-cache": "hit"},
                     )
+            if self._sem_cache is not None:
+                # semantic tier (docs/CACHING.md): an exact miss may still
+                # be a PARAPHRASE of a cached prompt — embed it with the
+                # deployment's own pooled-embedding path and serve the
+                # nearest same-spec entry above the similarity threshold,
+                # still before admission (no slot, no deadline budget, no
+                # generation steps)
+                sem_vec = await self._semantic_vec(body)
+                if sem_vec is not None:
+                    hit = self._sem_cache.lookup(
+                        dep, sem_vec, self.service.spec_hash
+                    )
+                    if hit is not None:
+                        with RECORDER.span("engine.cache", service=dep) as sp:
+                            if sp is not None:
+                                sp.event("cache.hit", tier="semantic")
+                        return web.Response(
+                            body=hit,
+                            content_type="application/json",
+                            headers={"x-sct-cache": "semantic"},
+                        )
             try:
                 ticket = self._admit(request)
             except qos.QosRejection as e:
@@ -441,10 +472,16 @@ class EngineApp:
                         lambda: self._predict_json_bytes(body, trace),
                     )
                     self._resp_cache.put(dep, cache_key, raw)
+                    if sem_vec is not None:
+                        self._sem_cache.put(
+                            dep, sem_vec, raw, self.service.spec_hash
+                        )
                     return web.Response(
                         body=raw, content_type="application/json"
                     )
                 raw = await self._predict_json_bytes(body, trace)
+                if sem_vec is not None:
+                    self._sem_cache.put(dep, sem_vec, raw, self.service.spec_hash)
                 return web.Response(body=raw, content_type="application/json")
             except qos.QosRejection as e:
                 # shed below admission: bounded queue overflow (429) or a
@@ -484,6 +521,118 @@ class EngineApp:
         resp = payload_to_dict(out)
         resp["status"] = {"code": 200, "status": "SUCCESS"}
         return json.dumps(resp).encode()
+
+    # -- embeddings + semantic cache tier (docs/GRAPHS.md, docs/CACHING.md) -
+
+    def _embed_unit(self):
+        """The graph's embed-capable generative unit, or None."""
+        for unit in self._generative_units_or_empty():
+            if getattr(unit.model, "embed_enabled", False):
+                return unit
+        return None
+
+    async def _semantic_vec(self, body: dict):
+        """Pooled embedding of a single-prompt generative request, or None
+        when the request doesn't qualify (batch, non-generative shape, no
+        embed-capable unit).  An embed failure degrades to a cache miss —
+        the semantic tier must never fail a request it could have missed."""
+        import json as _json
+
+        import numpy as np
+
+        raw = body.get("strData") if isinstance(body, dict) else None
+        if not isinstance(raw, str):
+            return None
+        try:
+            inner = _json.loads(raw)
+        except ValueError:
+            return None
+        prompt = inner.get("tokens") if isinstance(inner, dict) else None
+        if (
+            not isinstance(prompt, (list, tuple))
+            or not prompt
+            or isinstance(prompt[0], (list, tuple))
+        ):
+            return None  # one flat prompt only: batch rows cache per-row poorly
+        unit = self._embed_unit()
+        if unit is None:
+            return None
+        try:
+            vecs = await unit.embed_rows([np.asarray(prompt, np.int32)])
+            return vecs[0]
+        except Exception:
+            log.debug("semantic-cache embed failed; treating as miss", exc_info=True)
+            return None
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """``POST /api/v0.1/embeddings`` — mean-pooled final hidden states
+        from the graph's generative unit (its OWN weights: the vectors live
+        in the serving model's representation space).  Body: ``{"tokens":
+        [...]}`` (flat list) or a batch of lists; reply carries the (B, E)
+        float32 matrix through the typed ``rawTensor`` codec.  Each row
+        rides the generation scheduler's bounded intake, so embeddings
+        batch and shed with everything else."""
+        import json as _json
+
+        import numpy as np
+
+        from seldon_core_tpu.contract import DataKind, Payload
+
+        dep, pred = self.service.deployment_name, self.service.predictor.name
+        with self.metrics.time_server_request(dep, pred, "embeddings", "POST") as h:
+            from seldon_core_tpu.utils.tracectx import set_traceparent
+
+            set_traceparent(request.headers.get("traceparent"))
+            try:
+                ticket = self._admit(request)
+            except qos.QosRejection as e:
+                h["code"] = str(e.status)
+                return self._qos_reject(e)
+            try:
+                body = await self._json(request)
+                if "strData" in body:  # full contract wrapper also accepted
+                    body = _json.loads(body["strData"])
+                rows_in = body.get("tokens")
+                if not isinstance(rows_in, (list, tuple)) or not rows_in:
+                    raise CodecError(
+                        "embeddings takes 'tokens': a flat list or a batch of lists"
+                    )
+                if not isinstance(rows_in[0], (list, tuple)):
+                    rows_in = [rows_in]
+                rows = [np.asarray(r, np.int32) for r in rows_in]
+                unit = self._embed_unit()
+                if unit is None:
+                    h["code"] = "400"
+                    return web.json_response(
+                        _status_body(
+                            400,
+                            "no embedding-capable generative unit in this "
+                            "graph (enable SCT_EMBED=1 on a family with a "
+                            "pooled-embedding path)",
+                        ),
+                        status=400,
+                    )
+                vecs = await unit.embed_rows(rows)
+                resp = payload_to_dict(Payload(vecs, [], DataKind.RAW))
+                resp["status"] = {"code": 200, "status": "SUCCESS"}
+                return web.json_response(resp)
+            except qos.QosRejection as e:
+                h["code"] = str(e.status)
+                return self._qos_reject(e)
+            except (CodecError, TypeError, ValueError) as e:
+                h["code"] = "400"
+                return web.json_response(_status_body(400, str(e)), status=400)
+            except GraphUnitError as e:
+                h["code"] = "500"
+                return web.json_response(_status_body(500, str(e)), status=500)
+            except web.HTTPException as e:
+                h["code"] = str(e.status)
+                raise
+            except Exception:
+                h["code"] = "500"
+                raise
+            finally:
+                ticket.release()
 
     async def predictions_stream(self, request: web.Request) -> web.StreamResponse:
         """Server-sent-events token streaming for a generative graph.

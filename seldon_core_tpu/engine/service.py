@@ -108,6 +108,7 @@ class PredictionService:
             SingleFlight,
             cache_deployments,
             response_cache_from_env,
+            semantic_cache_from_env,
             spec_hash,
         )
 
@@ -118,6 +119,10 @@ class PredictionService:
         self.response_cache = (
             response_cache_from_env("engine") if cache_on else None
         )
+        # semantic tier (cache/semantic.py): paraphrase hits over pooled
+        # prompt embeddings; its own opt-in (SCT_SEMCACHE) but the same
+        # deployment allow-list and spec-hash invalidation story
+        self.semantic_cache = semantic_cache_from_env() if cache_on else None
         self.collapse = SingleFlight()
 
     async def start(self) -> None:
@@ -231,6 +236,8 @@ class PredictionService:
         }
         if self.response_cache is not None:
             out["response"] = self.response_cache.snapshot()
+        if self.semantic_cache is not None:
+            out["semantic"] = self.semantic_cache.snapshot()
         if self.node_cache is not None:
             out["node"] = self.node_cache.snapshot()
         prefix = {}

@@ -130,6 +130,8 @@ class GenerativeModel:
         lora_slots: int | None = None,
         lora_targets: str | None = None,
         lora_adapters: Any = None,
+        conf_signal: bool | None = None,
+        embed: bool | None = None,
         memory: Any = None,
     ):
         if family_mod is None:
@@ -207,6 +209,24 @@ class GenerativeModel:
                 )
         # tokens a slot can emit per fused decode step (verify width)
         self._tps = 1 + self.spec_draft
+        # cascade confidence signal (docs/GRAPHS.md): per-step top-2 logit
+        # margin computed INSIDE the fused decode programs and fetched WITH
+        # the block's tokens, so escalation decisions cost zero extra host
+        # syncs.  STATIC (a program-cache key via _program_config):
+        # deployments with and without the signal never share a compiled
+        # step.  Opt-in via the ``conf_signal`` graph parameter or
+        # SCT_CASCADE_CONF_SIGNAL=1.
+        if conf_signal is None:
+            conf_signal = os.environ.get("SCT_CASCADE_CONF_SIGNAL", "0") == "1"
+        self.conf_signal = bool(conf_signal)
+        # embeddings path (docs/GRAPHS.md): mean-pooled final hidden states
+        # via a pure forward — no KV write, no slot.  The flag only gates
+        # warmup compilation of the per-bucket embed programs;
+        # embed_dispatch works whenever the family provides embed_pooled.
+        # Opt-in via the ``embed`` graph parameter or SCT_EMBED=1.
+        if embed is None:
+            embed = os.environ.get("SCT_EMBED", "0") == "1"
+        self.embed_enabled = bool(embed) and hasattr(family_mod, "embed_pooled")
         # int8 paged-KV quantization: ~2x sequences per HBM byte; opt-in
         # via the kv_cache_dtype graph param or SCT_KV_DTYPE=int8
         if kv_cache_dtype is None:
@@ -535,6 +555,19 @@ class GenerativeModel:
         # path when enabled, the XLA gather path otherwise (both ride the
         # program cache keys via _program_config)
         dec_kw = {"kernel": True} if self.decode_kernel else {}
+        # cascade confidence: static branch — programs with the signal emit
+        # one extra (rows, S) float32 output riding the existing fetch
+        conf_on = self.conf_signal
+
+        def _conf_margin(logits):
+            """Top-2 logit margin per row: equal to the top-2 LOGPROB
+            margin (softmax is shift-invariant), so thresholds written in
+            logprob space apply directly.  Runs inside the compiled step —
+            the host never sees logits."""
+            import jax.numpy as jnp
+
+            top2 = jax.lax.top_k(logits.astype(jnp.float32), 2)[0]
+            return top2[..., 0] - top2[..., 1]
 
         def _prefill(params, tokens, length, slot, blocks, temperature, seed,
                      hist_seed, aid, lora, cache):
@@ -559,6 +592,12 @@ class GenerativeModel:
                 )
                 key = jax.random.PRNGKey(seed)
                 toks = _sample(logits, temperature, key)
+                if conf_on:
+                    return (
+                        _replicate(toks),
+                        _replicate(_conf_margin(logits)),
+                        cache,
+                    )
                 return _replicate(toks), cache
 
             return fn
@@ -602,14 +641,17 @@ class GenerativeModel:
                     remaining = jnp.where(active, remaining - 1, remaining)
                     done = (toks == eos) | (remaining <= 0)
                     active2 = active & ~done
-                    return (toks, active2, remaining, cache), (toks, active)
+                    ys = (
+                        (toks, active, _conf_margin(logits))
+                        if conf_on
+                        else (toks, active)
+                    )
+                    return (toks, active2, remaining, cache), ys
 
-                (tokens, active, remaining, cache), (toks_seq, act_seq) = lax.scan(
+                (tokens, active, remaining, cache), ys = lax.scan(
                     body, (tokens, active, remaining, cache), jnp.arange(k)
                 )
-                return (
-                    _replicate(toks_seq),
-                    _replicate(act_seq),
+                return tuple(_replicate(y) for y in ys) + (
                     _replicate(tokens),
                     _replicate(active),
                     _replicate(remaining),
@@ -693,18 +735,19 @@ class GenerativeModel:
                         jnp.where(emitted, out, old)
                     )
                     cache["pos"] = jnp.where(active, pos + n_em, pos)
-                    return (tokens, active2, remaining, cache), (out.T, emitted.T)
+                    ys = (
+                        (out.T, emitted.T, _conf_margin(logits).T)
+                        if conf_on
+                        else (out.T, emitted.T)
+                    )
+                    return (tokens, active2, remaining, cache), ys
 
-                (tokens, active, remaining, cache), (toks_seq, emit_seq) = lax.scan(
+                (tokens, active, remaining, cache), ys = lax.scan(
                     body, (tokens, active, remaining, cache), jnp.arange(k)
                 )
                 # (k, L, S) -> (k*L, S): chronological rows, same shape
                 # contract the host delivery loop already speaks
-                toks_seq = toks_seq.reshape(k * L, S)
-                emit_seq = emit_seq.reshape(k * L, S)
-                return (
-                    _replicate(toks_seq),
-                    _replicate(emit_seq),
+                return tuple(_replicate(y.reshape(k * L, S)) for y in ys) + (
                     _replicate(tokens),
                     _replicate(active),
                     _replicate(remaining),
@@ -734,6 +777,16 @@ class GenerativeModel:
 
             return fn
 
+        def _embed(params, tokens, length):
+            """Pooled-embedding forward (docs/GRAPHS.md): pure — no cache
+            argument, nothing donated, no slot consumed.  One compiled
+            program per prompt bucket, like prefill."""
+            return _replicate(
+                fam.embed_pooled(
+                    params, tokens, length, cfg, mesh=mesh, seq_impl=seq_impl
+                )
+            )
+
         # cache buffers are donated: each step reuses the previous buffers
         # in place instead of holding two live copies of a multi-GB cache
         # (the lora pool arg is NOT donated — it persists across steps
@@ -745,6 +798,11 @@ class GenerativeModel:
         self._decode_jit: dict[tuple, Any] = {}  # (window, config) -> step
         self._decode_k_factory = _decode_k_spec if self.spec_draft else _decode_k
         self._decode_k_jit: dict[tuple, Any] = {}  # (k, window, config)
+        # pooled-embedding program (POST /embeddings): jitted once, one
+        # compile per prompt bucket via shape specialization; the seen-set
+        # only drives compile telemetry
+        self._embed_jit = jax.jit(_embed)
+        self._embed_buckets_seen: set[int] = set()
         # static program configuration folded into every compiled-program
         # cache key: two deployments differing only in sampling/speculation/
         # quantization/chunking/kernel config must NEVER share a compiled
@@ -753,7 +811,7 @@ class GenerativeModel:
         self._program_config = (
             self.top_k, self.spec_draft, self.spec_ngram, self.spec_hist,
             self.kv_dtype, self.prefill_chunk, self.decode_kernel,
-            self.lora_rank, self.lora_slots,
+            self.lora_rank, self.lora_slots, self.conf_signal,
         )
         # overlapped-pipeline state: the last dispatched block's final
         # (tokens, active, remaining) as DEVICE arrays, plus the host-side
@@ -789,6 +847,9 @@ class GenerativeModel:
             self._mh_decode_cont_key = self.driver.register_unique(
                 f"gen:{name}:decode_cont", self._exec_decode_cont
             )
+            self._mh_embed_key = self.driver.register_unique(
+                f"gen:{name}:embed", self._exec_embed
+            )
             # reset writes the pos vector with a cross-process sharding —
             # a device_put every process must participate in, so it's a
             # driven step too (warmup calls it; a coordinator-only reset
@@ -812,6 +873,12 @@ class GenerativeModel:
         # observability
         self.steps = 0
         self.prefills = 0
+        self.embeds = 0  # pooled-embedding forwards (docs/GRAPHS.md)
+        # per-block confidence stash (cascade routing): the last fetched
+        # block's (rows, S) top-2 logit margins, read by the scheduler's
+        # delivery loop exactly like last_block_s — None when conf_signal
+        # is off, so the fetch path stays sync-free either way
+        self.last_conf_seq: np.ndarray | None = None
         self.prefills_reused = 0  # prefills that skipped a reused prefix
         self.prefill_chunks = 0  # chunked-prefill chunk dispatches
         self.imports = 0  # disagg KV handoffs imported into this pool
@@ -851,6 +918,8 @@ class GenerativeModel:
             tag.append("kernel")
         if self.lora_rank:
             tag.append(f"lora{self.lora_rank}")
+        if self.conf_signal:
+            tag.append("conf")
         self.variant_sfx = ("[" + ",".join(tag) + "]") if tag else ""
         # per-slot inter-token latency ledger (fed by the scheduler's
         # delivery loop): bounded ring for the /stats/breakdown percentiles
@@ -2460,6 +2529,55 @@ class GenerativeModel:
             self.admit_dispatch(slot, prompt, temperature, seed, reserve_tokens)
         )
 
+    def _exec_embed(self, payload: dict):
+        """Pooled-embedding forward body (runs on every slice process)."""
+        tokens = np.asarray(payload["padded"], np.int32)
+        bucket = int(tokens.shape[1])
+        label = f"embed:b{bucket}{self.variant_sfx}"
+        fresh = bucket not in self._embed_buckets_seen
+        if fresh:
+            self._embed_buckets_seen.add(bucket)
+            self.program_compiles += 1
+        else:
+            self.program_hits += 1
+        with self._lock:
+            t0 = time.perf_counter()
+            with jax.profiler.TraceAnnotation(label):
+                vec = self._embed_jit(
+                    self.params, tokens, np.int32(payload["length"])
+                )
+            if fresh:
+                self._note_compile(label, time.perf_counter() - t0)
+            self.embeds += 1
+        return vec
+
+    def embed_dispatch(self, prompt: np.ndarray):
+        """Enqueue one pooled-embedding forward; returns the (E,) device
+        vector WITHOUT fetching (the scheduler batches fetches across the
+        embed wave — one sync for N dispatches)."""
+        if not hasattr(self.family, "embed_pooled"):
+            raise GraphUnitError(
+                f"generative family {self.family.__name__} has no "
+                "pooled-embedding path"
+            )
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise GraphUnitError("empty prompt")
+        L = int(prompt.size)
+        bucket = self.fit_bucket(L)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :L] = prompt
+        payload = {"padded": padded, "length": L}
+        if self.driver is not None:
+            return self.driver.lead(self._mh_embed_key, payload)
+        return self._exec_embed(payload)
+
+    def embed(self, prompt: np.ndarray) -> np.ndarray:
+        """Fetch one prompt's mean-pooled final hidden state (E,) float32."""
+        vec = self.embed_dispatch(prompt)
+        # sct: host-sync-ok unbatched embed fetch
+        return np.asarray(jax.device_get(vec), np.float32)
+
     def _window_for(self, active: np.ndarray, extra: int) -> int:
         """Smallest power-of-two cache window covering every ACTIVE slot's
         position ceiling after ``extra`` more tokens (min 64, capped at
@@ -2488,7 +2606,7 @@ class GenerativeModel:
         with self._lock:
             t0 = time.perf_counter()
             with jax.profiler.TraceAnnotation(label):
-                toks, self._cache = fn(
+                res = fn(
                     self.params,
                     np.asarray(payload["tokens"], np.int32),
                     np.asarray(payload["active"], bool),
@@ -2498,10 +2616,15 @@ class GenerativeModel:
                     self._lora,
                     self._cache,
                 )
+            if self.conf_signal:
+                toks, conf, self._cache = res
+            else:
+                toks, self._cache = res
+                conf = None
             if fresh:
                 self._note_compile(label, time.perf_counter() - t0)
             self.steps += 1
-        return toks
+        return (toks, conf) if self.conf_signal else toks
 
     def step(
         self,
@@ -2523,13 +2646,25 @@ class GenerativeModel:
             payload["aid"] = self._slot_aidx.copy()
         t0 = time.perf_counter()
         if self.driver is not None:
-            toks = self.driver.lead(self._mh_decode_key, payload)
+            res = self.driver.lead(self._mh_decode_key, payload)
         else:
-            toks = self._exec_decode(payload)
+            res = self._exec_decode(payload)
         self._pos_ceiling[np.asarray(active, bool)] += 1
-        out = np.asarray(  # sct: host-sync-ok unfused single-step fetch
-            jax.device_get(toks)
-        )
+        if self.conf_signal:
+            # tokens + confidence margins ride ONE fetch: the single-step
+            # audit budget (one sync per step) holds with cascades on
+            toks, conf = res
+            # sct: host-sync-ok unfused single-step fetch
+            out_np, conf_np = jax.device_get((toks, conf))
+            # sct: host-sync-ok host copies of the fetch above, no new sync
+            out = np.asarray(out_np)
+            # sct: host-sync-ok host copy of the fetch above, no new sync
+            self.last_conf_seq = np.asarray(conf_np, np.float32)[None]
+        else:
+            out = np.asarray(  # sct: host-sync-ok unfused single-step fetch
+                jax.device_get(res)
+            )
+            self.last_conf_seq = None
         step_s = time.perf_counter() - t0
         # usage attribution: in single-step mode (decode_block=1) each
         # step IS the fused block, so the meter's token-share split reads
@@ -2594,12 +2729,14 @@ class GenerativeModel:
             payload["aid"] = self._slot_aidx.copy()
         t0 = time.perf_counter()
         if self.driver is not None:
-            toks_seq, act_seq = self.driver.lead(self._mh_decode_k_key, payload)
+            res = self.driver.lead(self._mh_decode_k_key, payload)
         else:
-            toks_seq, act_seq = self._exec_decode_k(payload)
+            res = self._exec_decode_k(payload)
+        toks_seq, act_seq = res[0], res[1]
+        conf_seq = res[2] if len(res) > 2 else None
         act = np.asarray(active, bool)
         self._pos_ceiling[act] += k * self._tps
-        return (toks_seq, act_seq, t0, act, int(k))
+        return (toks_seq, act_seq, conf_seq, t0, act, int(k))
 
     def step_k_continue(
         self, active: np.ndarray, seed: int, k: int, window: int | None = None
@@ -2618,24 +2755,36 @@ class GenerativeModel:
         }
         t0 = time.perf_counter()
         if self.driver is not None:
-            toks_seq, act_seq = self.driver.lead(self._mh_decode_cont_key, payload)
+            res = self.driver.lead(self._mh_decode_cont_key, payload)
         else:
-            toks_seq, act_seq = self._exec_decode_cont(payload)
+            res = self._exec_decode_cont(payload)
+        toks_seq, act_seq = res[0], res[1]
+        conf_seq = res[2] if len(res) > 2 else None
         act = np.asarray(active, bool)
         self._pos_ceiling[act] += k * self._tps
         self.overlapped += 1
-        return (toks_seq, act_seq, t0, act, int(k))
+        return (toks_seq, act_seq, conf_seq, t0, act, int(k))
 
     def step_k_fetch(self, handle: tuple) -> tuple[np.ndarray, np.ndarray]:
         """Materialize a dispatched block's ``(rows, S)`` tokens + emitted
         mask (``rows = k`` plain, ``k * (1 + spec_draft)`` speculative).
         ONE device_get for both arrays: two separate fetches would pay two
         host round trips per block on a tunnel-attached chip."""
-        toks_seq, act_seq, t0, disp_active, k = handle
+        toks_seq, act_seq, conf_seq, t0, disp_active, k = handle
         # the runtime audit (tests/test_perf.py) budgets exactly one
-        # host sync per fused k-block: this is it
+        # host sync per fused k-block: this is it — confidence margins
+        # (conf_signal) ride the SAME fetch, never a second one
+        pull = (
+            (toks_seq, act_seq, conf_seq)
+            if conf_seq is not None
+            else (toks_seq, act_seq)
+        )
         # sct: host-sync-ok THE one fused-block fetch
-        toks_np, act_np = jax.device_get((toks_seq, act_seq))
+        fetched = jax.device_get(pull)
+        toks_np, act_np = fetched[0], fetched[1]
+        self.last_conf_seq = (
+            np.asarray(fetched[2], np.float32) if len(fetched) > 2 else None
+        )
         act_np = np.asarray(act_np)
         if self.spec_draft and disp_active is not None and disp_active.any():
             # speculation accounting + ceiling tightening: dispatch assumed
@@ -2700,7 +2849,7 @@ class GenerativeModel:
             aid = self._aid_vec(payload)
             t0 = time.perf_counter()
             with jax.profiler.TraceAnnotation(label):
-                (toks_seq, act_seq, tok_c, act_c, rem_c, self._cache) = fn(
+                res = fn(
                     self.params,
                     np.asarray(payload["tokens"], np.int32),
                     np.asarray(payload["active"], bool),
@@ -2712,6 +2861,12 @@ class GenerativeModel:
                     self._lora,
                     self._cache,
                 )
+            if self.conf_signal:
+                (toks_seq, act_seq, conf_seq,
+                 tok_c, act_c, rem_c, self._cache) = res
+            else:
+                (toks_seq, act_seq, tok_c, act_c, rem_c, self._cache) = res
+                conf_seq = None
             if fresh:
                 self._note_compile(label, time.perf_counter() - t0)
             self._carry = (tok_c, act_c, rem_c)
@@ -2719,6 +2874,8 @@ class GenerativeModel:
             # release), so the continue path reuses the dispatched ids
             self._carry_aux = (temps, eos, aid)
             self.steps += k
+        if self.conf_signal:
+            return toks_seq, act_seq, conf_seq
         return toks_seq, act_seq
 
     def _exec_decode_cont(self, payload: dict):
@@ -2738,7 +2895,7 @@ class GenerativeModel:
             temps, eos, aid = self._carry_aux
             t0 = time.perf_counter()
             with jax.profiler.TraceAnnotation(label):
-                (toks_seq, act_seq, tok_c, act_c, rem_c, self._cache) = fn(
+                res = fn(
                     self.params,
                     tok_c,
                     act_c,
@@ -2750,10 +2907,18 @@ class GenerativeModel:
                     self._lora,
                     self._cache,
                 )
+            if self.conf_signal:
+                (toks_seq, act_seq, conf_seq,
+                 tok_c, act_c, rem_c, self._cache) = res
+            else:
+                (toks_seq, act_seq, tok_c, act_c, rem_c, self._cache) = res
+                conf_seq = None
             if fresh:
                 self._note_compile(label, time.perf_counter() - t0)
             self._carry = (tok_c, act_c, rem_c)
             self.steps += k
+        if self.conf_signal:
+            return toks_seq, act_seq, conf_seq
         return toks_seq, act_seq
 
     def warmup(self) -> int:
@@ -2876,6 +3041,19 @@ class GenerativeModel:
                     )
                     n += 1
                 self.prefills, self.prefills_reused = pf, pfr
+            # pooled-embedding programs: one per prompt bucket, same set the
+            # /embeddings route serves (pure forward — no slot, no reset
+            # interaction; warmed last so generation readiness is unchanged
+            # when the endpoint is off)
+            if self.embed_enabled:
+                for b in self.prefill_buckets:
+                    t0 = time.perf_counter()
+                    self.embed(np.ones(b, np.int32))
+                    self.warmup_program_seconds[f"embed:b{b}{sfx}"] = (
+                        time.perf_counter() - t0
+                    )
+                    self.warmup_programs.append(f"embed:b{b}{sfx}")
+                    n += 1
             # warmup wrote garbage into slot 0 and advanced nothing real
             self.reset()
             self._in_warmup = False
@@ -3046,6 +3224,15 @@ class _Request:
     u_saved_tokens: int = 0
     u_saved_tier: str = ""
     u_terminal_metered: bool = False
+    # embeddings (docs/GRAPHS.md): a pooled-embedding request rides the
+    # same bounded intake + QoS pops but consumes no slot or KV — the run
+    # loop batches the wave at a sync point and resolves with the vector
+    embed_only: bool = False
+    # cascade confidence (docs/GRAPHS.md): sum/count of per-token top-2
+    # logit margins delivered to this request, accumulated by _deliver
+    # from the stash the fused-block fetch fills — zero extra syncs
+    conf_sum: float = 0.0
+    conf_n: int = 0
 
 
 class GenerationScheduler:
@@ -3259,13 +3446,17 @@ class GenerationScheduler:
         eos_id: int | None = None,
         on_token: "Callable[[int], None] | None" = None,
         adapter: str | None = None,
+        info: dict | None = None,
     ) -> np.ndarray:
         """Generate up to ``max_new_tokens`` ids for a 1-D prompt.
 
         ``on_token`` (optional) fires per sampled token in event-loop
         context — the streaming hook; tokens arrive ``decode_block`` at a
         time per device fetch.  ``adapter`` names a resident LoRA adapter
-        to decode through (docs/MULTITENANT.md)."""
+        to decode through (docs/MULTITENANT.md).  ``info`` (optional) is an
+        out-param dict stamped with per-request extras on completion —
+        today the cascade confidence signal (docs/GRAPHS.md): mean top-2
+        logit margin over delivered tokens, when ``conf_signal`` is on."""
         if self._closed:
             raise RuntimeError("GenerationScheduler is closed")
         prompt = np.asarray(prompt, np.int32).ravel()
@@ -3322,7 +3513,11 @@ class GenerationScheduler:
         self._waiting.append(req)
         self._wake.set()
         try:
-            return await fut
+            out = await fut
+            if info is not None and req.conf_n:
+                info["confidence"] = req.conf_sum / req.conf_n
+                info["conf_tokens"] = req.conf_n
+            return out
         except asyncio.CancelledError:
             # cancel-on-disconnect: the client is gone — withdraw before a
             # slot/prefill is spent (in-slot requests are reaped by the run
@@ -3408,6 +3603,67 @@ class GenerationScheduler:
         self._begin_tl(req, kind="prefill")
         self._enqueue(req)
         return await self._await_withdrawing(req)
+
+    async def submit_embed(self, prompt: np.ndarray) -> np.ndarray:
+        """Pooled-embedding admission (docs/GRAPHS.md): ride the same
+        bounded intake, QoS priority pops, and deadline reaping as
+        generation, but consume no slot or KV — the run loop batches the
+        waiting embed wave at its next sync point and resolves each with
+        its (E,) float32 vector."""
+        if self._closed:
+            raise RuntimeError("GenerationScheduler is closed")
+        prompt = self._validate_prompt(prompt)
+        from seldon_core_tpu.obs import current_span
+
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        req = _Request(
+            prompt, 1, 0.0, None, fut,
+            t0=time.perf_counter(), span=current_span(),
+            priority=qos.get_priority(), deadline=qos.get_deadline(),
+        )
+        req.embed_only = True
+        self._begin_tl(req, kind="embed")
+        self._enqueue(req)
+        return await self._await_withdrawing(req)
+
+    async def _admit_embeds(self, reqs: list["_Request"]) -> None:
+        """Serve one wave of embed-only requests: dispatch every forward
+        first (async), then ONE device_get for the whole wave — N prompts
+        cost one host sync, mirroring the fused-block discipline."""
+
+        def dispatch_and_fetch():
+            placed: list[tuple[_Request, Any]] = []
+            errors: list[tuple[_Request, Exception]] = []
+            for req in reqs:
+                try:
+                    placed.append((req, self.model.embed_dispatch(req.prompt)))
+                except Exception as e:  # per-request: one bad prompt
+                    errors.append((req, e))  # must not fail the wave
+            # sct: host-sync-ok embed wave sync point
+            vecs = jax.device_get([v for _, v in placed]) if placed else []
+            return placed, errors, vecs
+
+        t0 = time.perf_counter()
+        placed, errors, vecs = await asyncio.to_thread(dispatch_and_fetch)
+        batch_s = time.perf_counter() - t0
+        total_toks = sum(int(r.prompt.size) for r, _ in placed) or 1
+        for (req, _), vec in zip(placed, vecs):
+            share_s = batch_s * int(req.prompt.size) / total_toks
+            req.u_device_s += share_s
+            METER.add(
+                self.model.name, req.adapter or "", req.priority,
+                device_s=share_s, tokens_prefill=int(req.prompt.size),
+            )
+            self._note_queue_wait(req)
+            self._tl(req, "embed", tokens=int(req.prompt.size))
+            arr = np.asarray(vec, np.float32)
+            if not req.future.done():
+                req.future.set_result(arr)
+            self._end_tl(req, "embedded", dim=int(arr.shape[-1]))
+        for req, e in errors:
+            if not req.future.done():
+                req.future.set_exception(e)
+            self._end_tl(req, "error", stage="embed")
 
     async def submit_imported(
         self,
@@ -4082,6 +4338,13 @@ class GenerationScheduler:
         now = time.perf_counter()
         reqs = list(slots)  # completions below null the live entries
         counts = [0] * S
+        # cascade confidence (docs/GRAPHS.md): the block's per-token top-2
+        # logit margins, stashed by the same fetch that brought the tokens
+        # — accumulated here per delivered token, zero extra syncs.
+        # getattr: duck-typed stand-in models (tests) predate the signal.
+        conf_seq = getattr(self.model, "last_conf_seq", None)
+        if conf_seq is not None and conf_seq.shape != toks_seq.shape:
+            conf_seq = None  # stale stash (shape mismatch): never misattribute
         for step_i in range(toks_seq.shape[0]):
             for i in range(S):
                 if not act_seq[step_i, i] or slots[i] is None:
@@ -4090,6 +4353,9 @@ class GenerationScheduler:
                 tok = int(toks_seq[step_i, i])
                 cur[i] = tok
                 counts[i] += 1
+                if conf_seq is not None:
+                    req.conf_sum += float(conf_seq[step_i, i])
+                    req.conf_n += 1
                 if self._token_done(req, tok):
                     self._complete(req)
                     slots[i] = None
@@ -4295,6 +4561,15 @@ class GenerationScheduler:
                         else S - int(active.sum()) - len(self._external)
                         - len(self._prefill_slots)
                     )
+                    # embed-only requests consume no slot or KV: the whole
+                    # waiting wave serves this sync point regardless of
+                    # cap_free (a preempted scheduler holds them — the
+                    # device belongs to the co-tenant)
+                    embeds: list[_Request] = []
+                    if not self._preempt:
+                        embeds = [r for r in self._waiting if r.embed_only]
+                        for r in embeds:
+                            self._waiting.remove(r)
                     while self._overflow and len(batch) < cap_free:
                         batch.append(self._overflow.pop(0))
                     if self._waiting and len(batch) < cap_free:
@@ -4303,12 +4578,14 @@ class GenerationScheduler:
                         )
                         while self._waiting and len(batch) < cap_free:
                             batch.append(self._waiting.pop(0))
-                    if batch or self._prefilling or active.any():
+                    if batch or embeds or self._prefilling or active.any():
                         # packed chip (docs/PACKING.md): all device work
                         # below — prefills, chunk advances, the fused
                         # block dispatch — runs under the device grant;
                         # a co-tenant's block never interleaves inside it
                         await self._arb_acquire()
+                    if embeds:
+                        await self._admit_embeds(embeds)
                     if batch:
                         await self._admit_batch(batch, slots, cur, temps, active)
                     if self._prefilling:
@@ -4832,6 +5109,16 @@ class GenerativeComponent(SeldonComponent):
         )
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
+        # greedy decode is a pure function of the prompt, so a temperature-0
+        # deployment participates in the caching plane (exact + semantic
+        # response tiers both gate on whole-graph determinism); sampled
+        # decode (default temperature > 0) draws from a per-process seed and
+        # must never be cached.  Per-request temperature overrides are safe:
+        # cache keys cover the full body, so an override that turns sampling
+        # on can at worst replay its own first sample, never another
+        # request's bytes.  Instance-level on purpose — the walker reads the
+        # flag per component.
+        self.DETERMINISTIC = self.temperature == 0.0
         self.eos_id = eos_id
         # deployment-default LoRA adapter (docs/MULTITENANT.md): requests
         # may override per call with the strData "adapter" field; the A/B
@@ -4904,6 +5191,12 @@ class GenerativeComponent(SeldonComponent):
                 "type": "GAUGE",
                 "value": self.model.prefill_chunks,
             })
+        if self.model.embed_enabled or self.model.embeds:
+            out.append({
+                "key": f"{self.model.name}_embeds",
+                "type": "GAUGE",
+                "value": self.model.embeds,
+            })
         return out
 
     async def _generate_rows(
@@ -4913,7 +5206,11 @@ class GenerativeComponent(SeldonComponent):
         temperature: float,
         eos_id: int | None,
         adapter: str | None = None,
+        infos: list[dict] | None = None,
     ) -> list[np.ndarray]:
+        if infos is not None:
+            infos.clear()
+            infos.extend({} for _ in rows)
         return list(
             await asyncio.gather(
                 *(
@@ -4923,11 +5220,22 @@ class GenerativeComponent(SeldonComponent):
                         temperature=temperature,
                         eos_id=eos_id,
                         adapter=adapter,
+                        info=infos[i] if infos is not None else None,
                     )
-                    for row in rows
+                    for i, row in enumerate(rows)
                 )
             )
         )
+
+    async def embed_rows(self, rows: list[np.ndarray]) -> np.ndarray:
+        """Mean-pooled final hidden states for a batch of prompts — the
+        /embeddings serving path (docs/GRAPHS.md): each row rides the
+        scheduler's bounded intake and QoS pops, the run loop serves the
+        wave with one device sync.  Returns (B, E) float32."""
+        outs = await asyncio.gather(
+            *(self.scheduler.submit_embed(row) for row in rows)
+        )
+        return np.stack([np.asarray(o, np.float32) for o in outs])
 
     @staticmethod
     def _pad_rows(outs: list[np.ndarray]) -> np.ndarray:
@@ -5025,16 +5333,28 @@ class GenerativeComponent(SeldonComponent):
             raise GraphUnitError(f"bad generative request: {e}") from e
         eos = body.get("eos_id", self.eos_id)
         adapter = body.get("adapter", self.adapter)
+        # cascade routing (docs/GRAPHS.md): with the on-device confidence
+        # signal compiled in, every strData response carries the per-row
+        # mean top-2 logit margin — the router reads it from the child's
+        # reply, so the payload a non-escalated request returns stays
+        # byte-identical to calling the tier directly (tokens unchanged,
+        # confidence additive)
+        infos: list[dict] | None = [] if self.model.conf_signal else None
         outs = await self._generate_rows(
             rows,
             int(body.get("max_new_tokens", self.max_new_tokens)),
             float(body.get("temperature", self.temperature)),
             int(eos) if eos is not None else None,
             str(adapter) if adapter else None,
+            infos=infos,
         )
         result = [o.tolist() for o in outs]
+        reply: dict = {"tokens": result[0] if single else result}
+        if infos is not None:
+            confs = [i.get("confidence") for i in infos]
+            reply["confidence"] = confs[0] if single else confs
         return Payload(
-            json.dumps({"tokens": result[0] if single else result}),
+            json.dumps(reply),
             [],
             DataKind.STRING,
             p.meta,

@@ -132,9 +132,14 @@ class GatewayApp:
             SingleFlight,
             cache_deployments,
             response_cache_from_env,
+            semantic_cache_from_env,
         )
 
         self.cache = response_cache_from_env("gateway")
+        # semantic tier handle (cache/semantic.py): the gateway owns the
+        # CR watch, so it drives BOTH tiers' invalidation — a spec roll
+        # flushes a deployment's exact and semantic namespaces together
+        self.semcache = semantic_cache_from_env()
         self._cache_deployments = cache_deployments()
         self.collapse = SingleFlight()
         # multi-upstream replica routing (docs/DISAGGREGATION.md): prefix-
@@ -191,13 +196,18 @@ class GatewayApp:
         if event == "removed":
             self.tokens.revoke_for_key(rec.oauth_key)
             self._qos.pop(rec.oauth_key, None)
-        if event in ("removed", "updated") and self.cache is not None and spec_rolled:
+        if event in ("removed", "updated") and spec_rolled:
             # rolling update / teardown: the deployment NAMESPACE flushes —
             # one namespace per deployment regardless of replica count, so
             # every replica's cached responses go stale together.  The
             # flush is spec-hash-driven: endpoint-only churn (an autoscale
-            # grow/shrink) keeps the hash and keeps the cache.
-            self.cache.flush(rec.oauth_key)
+            # grow/shrink) keeps the hash and keeps the cache.  BOTH tiers
+            # flush: a paraphrase hit against a pre-update answer is just
+            # as stale as an exact one (docs/CACHING.md).
+            if self.cache is not None:
+                self.cache.flush(rec.oauth_key)
+            if self.semcache is not None:
+                self.semcache.flush(rec.oauth_key)
         if event in ("removed", "updated"):
             # diff the replica sets and evict ONLY the departed replicas'
             # pools — survivors keep their warm connections across scale
@@ -796,6 +806,8 @@ class GatewayApp:
         }
         if self.cache is not None:
             out["response"] = self.cache.snapshot()
+        if self.semcache is not None:
+            out["semantic"] = self.semcache.snapshot()
         if self._cache_deployments is not None:
             out["deployments"] = sorted(self._cache_deployments)
         return out

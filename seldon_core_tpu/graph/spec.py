@@ -20,6 +20,12 @@ class UnitType(str, enum.Enum):
     COMBINER = "COMBINER"
     TRANSFORMER = "TRANSFORMER"
     OUTPUT_TRANSFORMER = "OUTPUT_TRANSFORMER"
+    # LLM graph plane (docs/GRAPHS.md): a CASCADE_ROUTER walks its ordered
+    # children cheapest-first and escalates on the on-device confidence
+    # signal; a GUARDRAIL is a policy transformer (pre- via
+    # TRANSFORM_INPUT, post- via an explicit methods override)
+    CASCADE_ROUTER = "CASCADE_ROUTER"
+    GUARDRAIL = "GUARDRAIL"
 
 
 class Implementation(str, enum.Enum):
@@ -38,6 +44,8 @@ class Implementation(str, enum.Enum):
     MAHALANOBIS_OUTLIER = "MAHALANOBIS_OUTLIER"
     JAX_MODEL = "JAX_MODEL"
     JAX_GENERATIVE = "JAX_GENERATIVE"
+    CASCADE_ROUTER = "CASCADE_ROUTER"
+    GUARDRAIL = "GUARDRAIL"
 
 
 class Method(str, enum.Enum):
@@ -78,6 +86,12 @@ TYPE_METHODS: dict[UnitType, list[Method]] = {
     UnitType.COMBINER: [Method.AGGREGATE],
     UnitType.TRANSFORMER: [Method.TRANSFORM_INPUT],
     UnitType.OUTPUT_TRANSFORMER: [Method.TRANSFORM_OUTPUT],
+    # the walker special-cases cascade execution (sequential tiers, not
+    # route-then-one-child), so only feedback resolves through methods
+    UnitType.CASCADE_ROUTER: [Method.SEND_FEEDBACK],
+    # pre-guardrail by default; declare ``methods: [TRANSFORM_OUTPUT]``
+    # on the unit for a post-guardrail (resolved_methods honors it)
+    UnitType.GUARDRAIL: [Method.TRANSFORM_INPUT],
 }
 
 

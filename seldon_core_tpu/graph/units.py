@@ -251,7 +251,17 @@ _BUILTINS: dict[Implementation, Callable[..., Any]] = {
     Implementation.MAHALANOBIS_OUTLIER: MahalanobisOutlier,
     Implementation.JAX_MODEL: lambda **p: _jax_model(p),
     Implementation.JAX_GENERATIVE: lambda **p: _jax_generative(p),
+    # LLM graph plane (docs/GRAPHS.md) — lazy imports: the graphllm
+    # package pulls runtime settings the plain graph path never needs
+    Implementation.CASCADE_ROUTER: lambda **p: _graphllm("CascadeRouter", p),
+    Implementation.GUARDRAIL: lambda **p: _graphllm("Guardrail", p),
 }
+
+
+def _graphllm(cls_name: str, parameters: dict[str, Any]) -> Any:
+    import seldon_core_tpu.graphllm as graphllm
+
+    return getattr(graphllm, cls_name)(**parameters)
 
 
 def _parse_dtype(raw: Any, impl_name: str) -> Any:
@@ -363,7 +373,10 @@ def _jax_generative(parameters: dict[str, Any]) -> Any:
     / ``adapter`` (batched multi-LoRA serving, docs/MULTITENANT.md),
     ``pack_class`` / ``pack_slo_ms`` (chip packing: this deployment's QoS
     class and queue-wait SLO band on a time-shared device,
-    docs/PACKING.md), plus model-config overrides.
+    docs/PACKING.md), ``conf_signal`` (compile the cascade confidence
+    signal into the fused decode programs) and ``embed`` (warm the
+    pooled-embedding programs for the /embeddings route — docs/GRAPHS.md),
+    plus model-config overrides.
     """
     from seldon_core_tpu.models import registry as model_registry
 

@@ -449,6 +449,8 @@ class GraphWalker:
     async def _execute_inner(
         self, node: _NodeState, p: Payload, timings: dict | None = None
     ) -> Payload:
+        if node.spec.type == UnitType.CASCADE_ROUTER and node.children:
+            return await self._execute_cascade(node, p, timings)
         methods = node.methods
         if Method.TRANSFORM_INPUT in methods:
             if (
@@ -492,6 +494,59 @@ class GraphWalker:
         if Method.TRANSFORM_OUTPUT in methods:
             p = await node.client.transform_output(p)
         return p
+
+    async def _execute_cascade(
+        self, node: _NodeState, p: Payload, timings: dict | None = None
+    ) -> Payload:
+        """CASCADE_ROUTER (docs/GRAPHS.md): walk the ordered tier list
+        cheapest-first.  After each non-final tier answers, the component's
+        ``decide`` reads the on-device confidence signal from the reply and
+        the request's remaining deadline budget; escalation re-walks the
+        NEXT tier with the ORIGINAL payload (prefix reuse via the tiered
+        prefix store makes the repeat prefill cheap).  The chosen tier's
+        reply ships unmodified — byte-identical to calling that tier
+        directly — and the final tier index lands in ``meta.routing`` so
+        the feedback walk replays the served path."""
+        comp = getattr(node.client, "component", None)
+        if comp is None or not callable(getattr(comp, "decide", None)):
+            raise GraphUnitError(
+                f"unit {node.spec.name!r} is a CASCADE_ROUTER but its "
+                "component has no decide() policy"
+            )
+        n_tiers = len(node.children)
+        tier = 0
+        out = await self._execute(node.children[0], p, timings)
+        while tier < n_tiers - 1:
+            confidence = comp.read_confidence(out)
+            escalate, reason = comp.decide(confidence, tier, n_tiers)
+            # the routing decision is a first-class span: tier, confidence
+            # and WHY — the stitched trace shows exactly where a request's
+            # answer came from and what the escalation weighed
+            with RECORDER.span(
+                "cascade.route",
+                service=node.spec.name,
+                stage=STAGE_NODE,
+                attrs={
+                    "tier": tier,
+                    "confidence": confidence,
+                    "escalate": escalate,
+                    "reason": reason,
+                },
+            ):
+                if not escalate:
+                    break
+                note_esc = getattr(comp, "note_escalation", None)
+                if callable(note_esc):
+                    note_esc()
+                tier += 1
+                out = await self._execute(node.children[tier], p, timings)
+        p.meta.routing[node.spec.name] = tier
+        note = getattr(comp, "note_served", None)
+        if callable(note):
+            note(tier)
+        if hasattr(node.client, "_annotate"):
+            node.client._annotate(out)
+        return out
 
     async def _model_cached(self, node: _NodeState, p: Payload) -> Payload:
         """Serve a deterministic MODEL node from the node-tier response

@@ -1,0 +1,21 @@
+"""LLM graph plane (docs/GRAPHS.md): the inference graph as the unit of
+value in the LLM era.
+
+Three unit families ride the existing :class:`~seldon_core_tpu.graph.walker.
+GraphWalker`:
+
+* :class:`CascadeRouter` — FrugalGPT-style model cascades: the cheap tier
+  answers first, an on-device confidence signal (mean top-2 logit margin,
+  fetched with the tokens — zero extra host syncs) decides escalation to
+  the next tier, gated by the request's remaining deadline budget.
+* :class:`Guardrail` — pre/post policy stages declared in the CR: regex
+  block, PII scrub, length/stop-token policy, pluggable classifier hook.
+* The embeddings path (``POST /api/v0.1/embeddings``) lives on the
+  generative unit itself (executor/generation.py ``embed_rows``); this
+  package is graph-side only.
+"""
+
+from seldon_core_tpu.graphllm.cascade import CascadeRouter  # noqa: F401
+from seldon_core_tpu.graphllm.guardrail import Guardrail  # noqa: F401
+
+__all__ = ["CascadeRouter", "Guardrail"]

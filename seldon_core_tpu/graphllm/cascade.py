@@ -1,0 +1,166 @@
+"""Cascade router: answer cheap when you can, escalate when you must.
+
+A ``CASCADE_ROUTER`` unit's children are an ORDERED tier list (cheapest
+first).  The walker executes tier 0, reads the on-device confidence signal
+the generative unit folded into its reply (mean top-2 logit margin over
+the generated tokens — computed inside the fused decode programs and
+fetched with the tokens, so the signal costs zero extra host syncs), and
+asks this component whether to escalate.  Escalation re-walks the NEXT
+tier with the ORIGINAL request payload; when both tiers share a prompt
+prefix the PR 11 tiered prefix store makes the big tier's prefill reuse
+whatever KV the deployment already holds — escalation pays for new work,
+not repeated work.
+
+Escalation is deadline-aware: when the request's remaining QoS budget
+cannot fit the big tier's expected TTFT (``ttft_ms`` /
+``SCT_CASCADE_TTFT_MS``), the cheap answer ships — a late good answer
+loses to an on-time acceptable one.
+
+NOT deterministic: the same input escalates or not depending on runtime
+confidence and the request's deadline, so the whole-graph response cache
+must never cache across a cascade (graph/walker.py ``deterministic``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from seldon_core_tpu import qos
+from seldon_core_tpu.graph.units import SeldonComponent
+from seldon_core_tpu.utils.metrics import DEFAULT as DEFAULT_METRICS
+
+
+class CascadeRouter(SeldonComponent):
+    """Decision policy for a CASCADE_ROUTER node (the walker owns the
+    tier loop; this component owns "escalate or ship").
+
+    Graph parameters: ``threshold`` (mean top-2 logit margin below which
+    the cheap answer is not trusted; env ``SCT_CASCADE_CONF``),
+    ``ttft_ms`` (expected next-tier time-to-first-token — escalation is
+    skipped when the remaining deadline budget is smaller; env
+    ``SCT_CASCADE_TTFT_MS``; 0 disables the gate), ``name`` (metrics
+    label; defaults to the unit name at annotation time).
+    """
+
+    INLINE_SYNC = True  # microseconds of python math; skip the executor hop
+    # escalation depends on runtime confidence + deadline budget: caching
+    # a cascade's response would replay one tier's answer for both paths
+    DETERMINISTIC = False
+    # annotations are cumulative counters that tolerate racing; locking
+    # them would serialize every request through the cascade
+    SAFE_ANNOTATIONS = True
+
+    def __init__(
+        self,
+        threshold: float | None = None,
+        ttft_ms: float | None = None,
+        name: str = "cascade",
+        **_: Any,
+    ):
+        if threshold is None:
+            threshold = float(os.environ.get("SCT_CASCADE_CONF", "2.0"))
+        if ttft_ms is None:
+            ttft_ms = float(os.environ.get("SCT_CASCADE_TTFT_MS", "0"))
+        self.threshold = float(threshold)
+        self.ttft_ms = float(ttft_ms)
+        self.name = str(name)
+        # observability: served-by-tier + escalation ledger (also exported
+        # as the seldon_cascade_* Prometheus families)
+        self.served_by_tier: dict[int, int] = {}
+        self.escalations = 0
+        self.last_confidence: float | None = None
+
+    # -- confidence extraction --------------------------------------------
+
+    def read_confidence(self, payload: Any) -> float | None:
+        """Mean confidence of a tier's reply, or None when the reply
+        carries no signal (numeric payloads, conf_signal off)."""
+        data = getattr(payload, "data", None)
+        if not isinstance(data, (str, bytes)):
+            return None
+        try:
+            body = json.loads(data)
+        except (ValueError, TypeError):
+            return None
+        conf = body.get("confidence") if isinstance(body, dict) else None
+        if conf is None:
+            return None
+        if isinstance(conf, (list, tuple)):
+            vals = [float(c) for c in conf if c is not None]
+            if not vals:
+                return None
+            return sum(vals) / len(vals)
+        try:
+            return float(conf)
+        except (TypeError, ValueError):
+            return None
+
+    # -- the decision ------------------------------------------------------
+
+    def decide(
+        self, confidence: float | None, tier: int, n_tiers: int
+    ) -> tuple[bool, str]:
+        """(escalate?, reason).  Called after tier ``tier`` answered;
+        never called for the last tier (nothing left to escalate to)."""
+        self.last_confidence = confidence
+        if confidence is not None:
+            try:
+                DEFAULT_METRICS.cascade_confidence.labels(self.name).set(
+                    confidence
+                )
+            except Exception:
+                pass
+        if confidence is None:
+            # no signal (conf_signal off / non-generative tier): trust the
+            # cheap tier rather than escalate blind
+            return False, "no-signal"
+        if confidence >= self.threshold:
+            return False, "confident"
+        if self.ttft_ms > 0:
+            rem = qos.remaining_s()
+            if rem is not None and rem * 1e3 < self.ttft_ms:
+                # the big tier can't answer in time: the cheap answer on
+                # time beats a better answer after the deadline
+                return False, "deadline-budget"
+        return True, "low-confidence"
+
+    def note_escalation(self) -> None:
+        self.escalations += 1
+        try:
+            DEFAULT_METRICS.cascade_escalations.labels(self.name).inc()
+        except Exception:
+            pass
+
+    def note_served(self, tier: int) -> None:
+        self.served_by_tier[tier] = self.served_by_tier.get(tier, 0) + 1
+        try:
+            DEFAULT_METRICS.cascade_requests.labels(self.name, str(tier)).inc()
+        except Exception:
+            pass
+
+    # -- graph-unit surface ------------------------------------------------
+
+    def tags(self) -> dict[str, Any]:
+        if self.last_confidence is None:
+            return {}
+        return {"cascade_confidence": round(self.last_confidence, 4)}
+
+    def metrics(self) -> list[dict[str, Any]]:
+        out: list[dict[str, Any]] = [
+            {
+                "key": f"{self.name}_cascade_escalations",
+                "type": "GAUGE",
+                "value": self.escalations,
+            }
+        ]
+        for tier, n in sorted(self.served_by_tier.items()):
+            out.append(
+                {
+                    "key": f"{self.name}_cascade_served_tier{tier}",
+                    "type": "GAUGE",
+                    "value": n,
+                }
+            )
+        return out

@@ -534,6 +534,33 @@ def prefill_slot(
     return h @ params["head"], cache
 
 
+def embed_pooled(
+    params: dict,
+    tokens: jax.Array,
+    length: jax.Array,
+    cfg: Config,
+    *,
+    mesh: Mesh | None = None,
+    seq_impl: str = "dense",
+) -> jax.Array:
+    """Mean-pooled final hidden state of one prompt: the embeddings path.
+
+    ``tokens`` is ``(1, Lpad)`` right-padded to a bucket length; ``length``
+    is the true prompt length (traced — one compiled program per bucket,
+    exactly like :func:`prefill_slot`).  Pure forward: no KV cache is
+    written and no slot is consumed, so the scheduler can batch these
+    alongside decode without spending pool blocks.  Returns the final-norm
+    hidden states averaged over the real (unpadded) rows, ``(E,) float32``
+    — padding rows are masked out of the mean so the vector is invariant
+    to the bucket the prompt landed in.
+    """
+    x, _ = _prefill_core(params, tokens, cfg, _select_attn(mesh, seq_impl))
+    h = _rmsnorm(x[0], params["ln_f"], cfg.norm_eps).astype(jnp.float32)
+    mask = (jnp.arange(h.shape[0]) < length).astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return (h * mask[:, None]).sum(axis=0) / denom
+
+
 # ---------------------------------------------------------------------------
 # paged KV cache (block pool + per-slot block tables)
 # ---------------------------------------------------------------------------

@@ -233,6 +233,8 @@ def build_generative_component(
     adapter: str | None = None,
     pack_class: str | None = None,
     pack_slo_ms: float | None = None,
+    conf_signal: bool | None = None,
+    embed: bool | None = None,
     **overrides,
 ):
     """Build a continuous-batching generative graph unit (JAX_GENERATIVE).
@@ -256,7 +258,12 @@ def build_generative_component(
     ``pack_class`` (``interactive``/``batch``) and ``pack_slo_ms`` set
     this deployment's QoS class and queue-wait SLO band on a packed chip
     (docs/PACKING.md) — read when the engine registers co-resident
-    deployments with the device arbiter."""
+    deployments with the device arbiter.
+    ``conf_signal`` compiles the cascade confidence signal (per-token
+    top-2 logit margin) into the fused decode programs and ``embed`` warms
+    the pooled-embedding programs for the /embeddings route
+    (docs/GRAPHS.md); env fallbacks ``SCT_CASCADE_CONF_SIGNAL`` /
+    ``SCT_EMBED``."""
     from seldon_core_tpu.executor.generation import (
         GenerativeComponent,
         GenerativeModel,
@@ -307,6 +314,8 @@ def build_generative_component(
         lora_slots=lora_slots,
         lora_targets=lora_targets,
         lora_adapters=lora_adapters,
+        conf_signal=conf_signal,
+        embed=embed,
     )
     return GenerativeComponent(
         model,

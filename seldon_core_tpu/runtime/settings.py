@@ -211,6 +211,45 @@ declare("SCT_CACHE_MAX_BYTES", "67108864", "int",
 declare("SCT_CACHE_TTL_S", "60", "float",
         "Response-cache entry TTL (seconds).",
         section="cache")
+declare("SCT_SEMCACHE", "0", "bool",
+        "Semantic cache tier: cosine-similarity hits over pooled prompt "
+        "embeddings (needs SCT_EMBED on the unit; docs/CACHING.md).",
+        section="cache")
+declare("SCT_SEMCACHE_SIM", "0.95", "float",
+        "Cosine-similarity threshold for a semantic cache hit.",
+        section="cache")
+declare("SCT_SEMCACHE_MAX_ENTRIES", "2048", "int",
+        "Semantic-cache entry cap.",
+        section="cache")
+declare("SCT_SEMCACHE_MAX_BYTES", "33554432", "int",
+        "Semantic-cache byte cap (vectors + cached response bytes).",
+        section="cache")
+declare("SCT_SEMCACHE_TTL_S", "300", "float",
+        "Semantic-cache entry TTL (seconds).",
+        section="cache")
+
+# -- LLM inference graphs (docs/GRAPHS.md) ----------------------------------
+declare("SCT_EMBED", "0", "bool",
+        "Pooled-embedding path on generative units: POST /embeddings + "
+        "the semantic cache tier's vector source (docs/GRAPHS.md).",
+        section="graphllm")
+declare("SCT_CASCADE_CONF_SIGNAL", "0", "bool",
+        "Fold the per-step top-2 logit margin into the fused decode "
+        "programs so replies carry a confidence signal for cascade "
+        "routing (zero extra host syncs; docs/GRAPHS.md).",
+        section="graphllm")
+declare("SCT_CASCADE_CONF", "2.0", "float",
+        "Mean logit-margin threshold below which a cascade tier's answer "
+        "is escalated to the next tier.",
+        section="graphllm")
+declare("SCT_CASCADE_TTFT_MS", "0", "float",
+        "Expected next-tier TTFT: escalation is skipped when the "
+        "remaining deadline budget is smaller (0 = gate off).",
+        section="graphllm")
+declare("SCT_GUARDRAIL_CLASS", "interactive", "str",
+        "Default QoS class guardrail units re-seed for their downstream "
+        "walk (``interactive``/``batch``; docs/GRAPHS.md).",
+        section="graphllm")
 
 # -- QoS admission (engine SCT_QOS_*, gateway SCT_GW_QOS_*) -----------------
 for _pfx, _where in (("SCT_QOS", "engine"), ("SCT_GW_QOS", "gateway")):
@@ -576,6 +615,7 @@ _SECTION_TITLES = {
     "lora": "Multi-LoRA adapter plane",
     "memory": "HBM + host-DRAM memory ledgers",
     "cache": "Prefix + response caching",
+    "graphllm": "LLM inference graphs (cascades, embeddings, guardrails)",
     "qos": "QoS admission (engine `SCT_QOS_*`, gateway `SCT_GW_QOS_*`)",
     "packing": "Chip packing / device arbiter",
     "disagg": "Disaggregated prefill/decode",

@@ -372,6 +372,60 @@ class MetricsRegistry:
             ["model_name"],
             registry=self.registry,
         )
+        # LLM graph plane (docs/GRAPHS.md): cascade routing + the semantic
+        # response-cache tier
+        self.cascade_requests = Counter(
+            "seldon_cascade_requests",
+            "Requests whose final answer came from this cascade tier "
+            "(tier is the 0-based position in the ordered tier list)",
+            ["name", "tier"],
+            registry=self.registry,
+        )
+        self.cascade_escalations = Counter(
+            "seldon_cascade_escalations",
+            "Cascade escalations to the next tier, by reason "
+            "(low-confidence)",
+            ["name"],
+            registry=self.registry,
+        )
+        self.cascade_confidence = Gauge(
+            "seldon_cascade_confidence",
+            "Last observed cheap-tier confidence (mean top-2 logit margin) "
+            "at this cascade router",
+            ["name"],
+            registry=self.registry,
+        )
+        self.semcache_hits = Counter(
+            "seldon_semcache_hits",
+            "Semantic cache-tier hits (cosine >= threshold) per namespace",
+            ["name"],
+            registry=self.registry,
+        )
+        self.semcache_misses = Counter(
+            "seldon_semcache_misses",
+            "Semantic cache-tier misses per namespace",
+            ["name"],
+            registry=self.registry,
+        )
+        self.semcache_entries = Gauge(
+            "seldon_semcache_entries",
+            "Live semantic cache-tier entries",
+            [],
+            registry=self.registry,
+        )
+        self.semcache_bytes = Gauge(
+            "seldon_semcache_bytes",
+            "Bytes held by the semantic cache tier (vectors + responses)",
+            [],
+            registry=self.registry,
+        )
+        self.guardrail_actions = Counter(
+            "seldon_guardrail_actions",
+            "Guardrail-unit outcomes (action: pass / scrub / truncate / "
+            "stop / block) per unit name",
+            ["name", "action"],
+            registry=self.registry,
+        )
         self.kv_slots_per_chip = Gauge(
             "seldon_kv_slots_per_chip",
             "Max-seq sequences the paged-KV layout fits per chip after "

@@ -461,6 +461,7 @@ class TestProgramKeyAudit:
         )
         assert model._program_config == (
             3, 0, model.spec_ngram, model.spec_hist, None, 32, True, 0, 0,
+            False,
         )
 
 

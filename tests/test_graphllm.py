@@ -1,0 +1,854 @@
+"""LLM-native inference graph tests (docs/GRAPHS.md).
+
+Covers the graph plane this PR adds — cascade routing, the embeddings
+endpoint, the semantic cache tier, and guardrail nodes — plus its
+acceptance gates: escalation and non-escalation paths each produce a
+stitched trace (``cascade.route`` span with tier + confidence) and
+BIT-IDENTICAL tokens to calling the chosen tier directly; a semantic
+paraphrase hit spends ZERO generation device steps; the confidence
+signal adds ZERO host syncs per request; pooled embedding vectors are
+pinned-stable, tp=2 mesh included; a CR spec roll flushes the exact AND
+semantic namespaces together; and the determinism contract audits both
+ways (a cascade never engages the whole-graph response cache, a
+classifier-free guardrail never disengages it).
+"""
+
+import asyncio
+import base64
+import json
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from seldon_core_tpu.cache import ResponseCache, SemanticCache
+from seldon_core_tpu.contract import DataKind, Payload
+from seldon_core_tpu.engine.app import EngineApp
+from seldon_core_tpu.engine.service import PredictionService
+from seldon_core_tpu.gateway.app import GatewayApp
+from seldon_core_tpu.gateway.store import DeploymentRecord, DeploymentStore
+from seldon_core_tpu.graph.spec import PredictorSpec
+from seldon_core_tpu.graph.units import GraphUnitError
+from seldon_core_tpu.graphllm import CascadeRouter, Guardrail
+
+run = asyncio.run
+
+
+# ---------------------------------------------------------------------------
+# spec helpers
+# ---------------------------------------------------------------------------
+
+
+def _tier(name: str, n_layers: int) -> dict:
+    """One generative cascade tier: tiny llama, layer count = the tier's
+    'size' (same preset + rng -> per-shape deterministic weights, so a
+    solo build of the same spec answers bit-identically)."""
+    return {
+        "name": name, "type": "MODEL", "implementation": "JAX_GENERATIVE",
+        "parameters": [
+            {"name": "family", "value": "llama", "type": "STRING"},
+            {"name": "preset", "value": "tiny", "type": "STRING"},
+            {"name": "n_layers", "value": str(n_layers), "type": "INT"},
+            {"name": "n_slots", "value": "2", "type": "INT"},
+            {"name": "max_new_tokens", "value": "4", "type": "INT"},
+            {"name": "conf_signal", "value": "true", "type": "BOOL"},
+        ],
+    }
+
+
+def _cascade_spec() -> dict:
+    return {
+        "name": "llmcasc",
+        "graph": {
+            "name": "casc", "type": "CASCADE_ROUTER",
+            "implementation": "CASCADE_ROUTER",
+            "parameters": [
+                {"name": "threshold", "value": "2.0", "type": "FLOAT"},
+            ],
+            "children": [_tier("small", 2), _tier("large", 4)],
+        },
+    }
+
+
+EMBED_SPEC = {
+    "name": "emb",
+    "graph": {
+        "name": "gen", "type": "MODEL", "implementation": "JAX_GENERATIVE",
+        "parameters": [
+            {"name": "family", "value": "llama", "type": "STRING"},
+            {"name": "preset", "value": "tiny", "type": "STRING"},
+            {"name": "n_slots", "value": "2", "type": "INT"},
+            {"name": "max_new_tokens", "value": "4", "type": "INT"},
+            {"name": "embed", "value": "true", "type": "BOOL"},
+        ],
+    },
+}
+
+SIMPLE = {
+    "name": "p",
+    "graph": {"name": "m", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+}
+
+PROMPT = [5, 9, 2, 17]
+GEN_BODY = {"strData": json.dumps({"tokens": PROMPT, "max_new_tokens": 4})}
+
+
+async def _engine_client(spec, *, service=None) -> tuple[TestClient, PredictionService]:
+    if service is None:
+        service = PredictionService(PredictorSpec.model_validate(spec))
+    app = EngineApp(service).build()
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client, service
+
+
+async def _gateway_client(engine_port: int) -> tuple[TestClient, GatewayApp, str]:
+    store = DeploymentStore()
+    store.put(DeploymentRecord(
+        name="dep", oauth_key="key1", oauth_secret="sec1",
+        engine_host="127.0.0.1", engine_rest_port=engine_port,
+    ))
+    gw = GatewayApp(store)
+    client = TestClient(TestServer(gw.build()))
+    await client.start_server()
+    resp = await client.post(
+        "/oauth/token", data={"client_id": "key1", "client_secret": "sec1"}
+    )
+    token = (await resp.json())["access_token"]
+    return client, gw, token
+
+
+def _tokens(body: dict) -> list:
+    return json.loads(body["strData"])["tokens"]
+
+
+# ---------------------------------------------------------------------------
+# unit: cascade decision policy
+# ---------------------------------------------------------------------------
+
+
+class TestCascadeRouterUnit:
+    def test_confident_answer_ships(self):
+        r = CascadeRouter(threshold=2.0)
+        assert r.decide(3.5, 0, 2) == (False, "confident")
+        assert r.last_confidence == 3.5
+
+    def test_low_confidence_escalates(self):
+        r = CascadeRouter(threshold=2.0)
+        assert r.decide(0.4, 0, 2) == (True, "low-confidence")
+
+    def test_no_signal_trusts_cheap_tier(self):
+        # conf_signal off / non-generative tier: never escalate blind
+        r = CascadeRouter(threshold=2.0)
+        assert r.decide(None, 0, 2) == (False, "no-signal")
+
+    def test_deadline_budget_blocks_escalation(self):
+        from seldon_core_tpu import qos
+
+        r = CascadeRouter(threshold=2.0, ttft_ms=50.0)
+        qos.set_budget_ms(20.0)  # 20ms left < 50ms expected TTFT
+        try:
+            assert r.decide(0.1, 0, 2) == (False, "deadline-budget")
+        finally:
+            qos.set_budget_ms(None)
+
+    def test_read_confidence_forms(self):
+        r = CascadeRouter()
+
+        def p(data):
+            return Payload(data, [], DataKind.STRING)
+
+        assert r.read_confidence(p(json.dumps({"confidence": 1.5}))) == 1.5
+        # batch replies carry a list; the mean drives the decision
+        assert r.read_confidence(p(json.dumps({"confidence": [1.0, 3.0]}))) == 2.0
+        assert r.read_confidence(p(json.dumps({"tokens": [1]}))) is None
+        assert r.read_confidence(p("not json")) is None
+        assert r.read_confidence(Payload(np.zeros(2), [], DataKind.NDARRAY)) is None
+
+    def test_ledger_and_metrics_surface(self):
+        r = CascadeRouter(name="c")
+        r.note_served(0)
+        r.note_served(1)
+        r.note_escalation()
+        r.decide(1.25, 0, 2)
+        keys = {m["key"]: m["value"] for m in r.metrics()}
+        assert keys["c_cascade_escalations"] == 1
+        assert keys["c_cascade_served_tier0"] == 1
+        assert keys["c_cascade_served_tier1"] == 1
+        assert r.tags() == {"cascade_confidence": 1.25}
+
+
+# ---------------------------------------------------------------------------
+# unit: guardrail policy pipeline
+# ---------------------------------------------------------------------------
+
+
+class TestGuardrailUnit:
+    def test_block_regex_rejects(self):
+        g = Guardrail(block="forbidden,secret")
+        with pytest.raises(GraphUnitError, match="blocked"):
+            g.apply("this is ForBidden text")  # IGNORECASE
+        assert g.actions["block"] == 1
+
+    def test_pii_scrub_all_patterns(self):
+        g = Guardrail()
+        clean, actions = g.apply(
+            "mail a.user+x@example.com ssn 123-45-6789 phone (415) 555-1234"
+        )
+        assert "example.com" not in clean
+        assert "123-45-6789" not in clean
+        assert "555-1234" not in clean
+        assert clean.count("[REDACTED]") == 3
+        assert actions == ["scrub"]
+
+    def test_stop_tokens_and_truncate(self):
+        g = Guardrail(scrub_pii="0", stop_tokens="END", max_chars=4)
+        clean, actions = g.apply("abcdefEND tail")
+        # stop cut first ("abcdef"), then the length policy to 4 chars
+        assert clean == "abcd"
+        assert actions == ["stop", "truncate"]
+
+    def test_classifier_hook_verdicts(self):
+        allow = Guardrail(classifier=lambda t: True)
+        assert allow.apply("ok")[0] == "ok"
+        deny = Guardrail(classifier=lambda t: (False, "policy"))
+        with pytest.raises(GraphUnitError, match="policy"):
+            deny.apply("ok")
+
+    def test_clean_text_passes_untouched(self):
+        g = Guardrail()
+        clean, actions = g.apply("hello world")
+        assert clean == "hello world" and actions == []
+        assert g.actions["pass"] == 1
+
+    def test_non_string_payload_passes_through(self):
+        g = Guardrail()
+        p = Payload(np.array([[1, 2]]), [], DataKind.NDARRAY)
+        assert g.transform_input_raw(p) is p
+
+    def test_pre_guardrail_reseeds_qos_class(self):
+        from seldon_core_tpu import qos
+
+        g = Guardrail(qos_class="batch")
+        qos.set_priority("interactive")
+        out = g.transform_input_raw(Payload("hi", [], DataKind.STRING))
+        try:
+            # downstream of a PRE-guardrail runs under ITS class
+            assert qos.get_priority() == "batch"
+            assert json_safe(out.data) == "hi"
+        finally:
+            qos.set_priority("interactive")
+
+    def test_determinism_contract(self):
+        # pure regex/length policies keep the caching plane engaged ...
+        assert Guardrail().DETERMINISTIC is True
+        # ... a (possibly stateful) classifier hook disengages it
+        assert Guardrail(classifier=lambda t: True).DETERMINISTIC is False
+
+
+def json_safe(v):
+    return v if isinstance(v, str) else v.decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# unit: semantic cache tier
+# ---------------------------------------------------------------------------
+
+
+class TestSemanticCacheUnit:
+    V = np.array([1.0, 0.0, 0.0], np.float32)
+
+    def test_similarity_threshold(self):
+        c = SemanticCache(sim_threshold=0.9)
+        c.put("ns", self.V, b"answer", "tag")
+        near = np.array([0.99, 0.05, 0.0], np.float32)  # cos ~0.9987
+        far = np.array([0.5, 0.86, 0.0], np.float32)  # cos ~0.5
+        assert c.lookup("ns", near, "tag") == b"answer"
+        assert c.lookup("ns", far, "tag") is None
+        assert (c.hits, c.misses) == (1, 1)
+        assert c.last_sim is None  # miss resets the gauge
+
+    def test_namespace_and_tag_isolation(self):
+        c = SemanticCache(sim_threshold=0.9)
+        c.put("a", self.V, b"va", "t1")
+        # other namespace: invisible
+        assert c.lookup("b", self.V, "t1") is None
+        # same namespace, rolled spec-hash: unhittable by construction
+        assert c.lookup("a", self.V, "t2") is None
+        assert c.lookup("a", self.V, "t1") == b"va"
+
+    def test_ttl_expiry(self):
+        c = SemanticCache(ttl_s=0.0)
+        c.put("ns", self.V, b"v", "t")
+        assert c.lookup("ns", self.V, "t") is None
+        assert c.expirations == 1
+
+    def test_entry_and_byte_bounds_evict_oldest(self):
+        c = SemanticCache(max_entries=2, max_bytes=10_000)
+        for i in range(4):
+            vec = np.zeros(3, np.float32)
+            vec[i % 3] = 1.0
+            c.put("ns", vec, bytes([i]), "t")
+        assert len(c._entries) == 2
+        assert c.evictions == 2
+        big = SemanticCache(max_bytes=64)
+        big.put("ns", self.V, b"x" * 1000, "t")  # oversized: uncacheable
+        assert len(big._entries) == 0
+
+    def test_flush_counts_per_namespace(self):
+        c = SemanticCache()
+        c.put("a", self.V, b"1", "t")
+        c.put("b", self.V, b"2", "t")
+        assert c.flush("a") == 1
+        assert c.flush("a") == 0  # empty flush doesn't count
+        assert c.flush() == 1  # clear-all drops the rest
+        snap = c.snapshot()
+        assert snap["flushes"] == 2
+        assert snap["flushes_by_namespace"] == {"a": 1, "b": 1}
+        assert snap["entries"] == 0 and snap["bytes"] == 0
+
+    def test_snapshot_shape(self):
+        c = SemanticCache(sim_threshold=0.8)
+        c.put("ns", self.V, b"v", "t")
+        c.lookup("ns", self.V, "t")
+        snap = c.snapshot()
+        assert snap["tier"] == "semantic"
+        assert snap["hits"] == 1 and snap["hit_rate"] == 1.0
+        assert snap["last_similarity"] == 1.0
+        assert snap["sim_threshold"] == 0.8
+
+
+class TestResponseCacheNamespaceFlush:
+    def test_exact_tier_counts_flushes_per_namespace(self):
+        """Small-fix satellite: /stats/cache attributes flushes to the
+        deployment namespace that rolled, not just a global count."""
+        c = ResponseCache("t")
+        c.put("a", "k", b"1")
+        c.put("b", "k", b"2")
+        c.flush("a")
+        c.flush(None)
+        snap = c.snapshot()
+        assert snap["flushes_by_namespace"] == {"a": 1, "b": 1}
+
+
+# ---------------------------------------------------------------------------
+# e2e: cascade through gateway -> walker -> both tiers
+# ---------------------------------------------------------------------------
+
+
+class TestCascadeE2E:
+    """The pinned graph-spec acceptance flow: one two-tier cascade engine
+    behind the gateway; forcing the threshold to the extremes drives BOTH
+    paths, each bit-identical to the chosen tier built solo."""
+
+    def _solo_tokens(self, n_layers: int) -> list:
+        """Build the tier's spec standalone and call it directly — the
+        bit-identity baseline for the cascade's answer."""
+        from seldon_core_tpu.models.registry import build_generative_component
+
+        async def go():
+            comp = build_generative_component(
+                "llama", preset="tiny", n_layers=n_layers, n_slots=2,
+                max_new_tokens=4, conf_signal=True,
+            )
+            try:
+                out = await comp.predict_raw(
+                    Payload(GEN_BODY["strData"], [], DataKind.STRING)
+                )
+                return _tokens({"strData": out.data})
+            finally:
+                await comp.close()
+
+        return run(go())
+
+    def test_both_paths_pinned(self, monkeypatch):
+        monkeypatch.setenv("ENGINE_WARMUP", "0")
+        from seldon_core_tpu.obs import RECORDER
+
+        async def go():
+            service = PredictionService(
+                PredictorSpec.model_validate(_cascade_spec())
+            )
+            # determinism audit: wiring the whole-graph tiers must NOT
+            # engage — the cascade is non-deterministic by contract — but
+            # the node tier still serves the deterministic tier children
+            service.response_cache = ResponseCache("engine")
+            service.semantic_cache = SemanticCache()
+            service.node_cache = ResponseCache("node")
+            engine, service = await _engine_client(None, service=service)
+            gw, gwapp, token = await _gateway_client(engine.server.port)
+            hdrs = {"Authorization": f"Bearer {token}"}
+            router = next(
+                comp for _n, comp in service.walker.iter_components()
+                if isinstance(comp, CascadeRouter)
+            )
+
+            async def ask():
+                r = await gw.post(
+                    "/api/v0.1/predictions", json=GEN_BODY, headers=hdrs
+                )
+                assert r.status == 200, await r.text()
+                return await r.json(), r.headers.get("x-sct-cache")
+
+            router.threshold = -1e9  # any confidence clears: never escalate
+            cheap, _ = await ask()
+            cheap2, hdr2 = await ask()  # exact repeat -> node-tier hit
+            router.threshold = 1e9  # nothing clears: always escalate
+            escalated, _ = await ask()
+            stats = (await (await engine.get("/stats/cache")).json())["cache"]
+            tr = RECORDER.stats(100)["traces"]
+            await gw.close()
+            await gwapp.close()
+            await engine.close()
+            return cheap, cheap2, hdr2, escalated, stats, tr, router
+
+        cheap, cheap2, hdr2, escalated, stats, traces, router = run(go())
+
+        # non-escalation path: tier 0's answer, bit-identical to solo
+        assert cheap["meta"]["routing"]["casc"] == 0
+        assert _tokens(cheap) == self._solo_tokens(2)
+        # escalation path: tier 1's answer, bit-identical to solo
+        assert escalated["meta"]["routing"]["casc"] == 1
+        assert _tokens(escalated) == self._solo_tokens(4)
+        assert _tokens(escalated) != _tokens(cheap)
+        # the on-device signal rode the reply on both paths
+        for body in (cheap, escalated):
+            conf = json.loads(body["strData"])["confidence"]
+            assert isinstance(conf, float) and np.isfinite(conf)
+        assert cheap["meta"]["tags"]["cascade_confidence"] == round(
+            json.loads(cheap["strData"])["confidence"], 4
+        )
+
+        # determinism audit: the cascade never caches whole-graph (neither
+        # exact nor semantic tier engaged even though both were wired) ...
+        assert stats["graph_deterministic"] is False
+        assert stats["response"]["hits"] == 0
+        assert stats["semantic"]["hits"] + stats["semantic"]["misses"] == 0
+        assert hdr2 is None
+        assert _tokens(cheap2) == _tokens(cheap)
+        # ... but the deterministic tier children still node-cache
+        assert stats["node"]["hits"] >= 1
+        # served/escalation ledger
+        assert router.served_by_tier == {0: 2, 1: 1}
+        assert router.escalations == 1
+
+        # stitched trace: cascade.route spans with tier/confidence/reason,
+        # in the SAME trace as the engine's root span
+        routes = []
+        for t in traces:
+            names = {s["name"] for s in t["spans"]}
+            for s in t["spans"]:
+                if s["name"] == "cascade.route":
+                    assert "engine.predict" in names, names
+                    routes.append(s["attrs"])
+        assert len(routes) >= 3
+        assert any(
+            a["escalate"] is True and a["reason"] == "low-confidence"
+            for a in routes
+        )
+        assert any(
+            a["escalate"] is False and a["reason"] == "confident"
+            for a in routes
+        )
+        assert all(
+            a["tier"] == 0 and isinstance(a["confidence"], float)
+            for a in routes
+        )
+
+    def test_cascade_over_numeric_tiers_trusts_cheap(self, monkeypatch):
+        """No confidence signal (non-generative tiers) -> no blind
+        escalation: tier 0 answers, routing recorded."""
+        monkeypatch.setenv("ENGINE_WARMUP", "0")
+        spec = {
+            "name": "p",
+            "graph": {
+                "name": "casc", "type": "CASCADE_ROUTER",
+                "implementation": "CASCADE_ROUTER",
+                "children": [
+                    {"name": "a", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                    {"name": "b", "type": "MODEL", "implementation": "SIMPLE_MODEL"},
+                ],
+            },
+        }
+
+        async def go():
+            engine, service = await _engine_client(spec)
+            r = await engine.post(
+                "/api/v0.1/predictions",
+                json={"data": {"ndarray": [[1.0, 2.0]]}},
+            )
+            body = await r.json()
+            det = service.graph_deterministic()
+            await engine.close()
+            return r.status, body, det
+
+        status, body, det = run(go())
+        assert status == 200
+        assert body["meta"]["routing"]["casc"] == 0
+        assert det is False  # CASCADE_ROUTER poisons whole-graph determinism
+
+
+# ---------------------------------------------------------------------------
+# e2e: guardrails in a walked graph
+# ---------------------------------------------------------------------------
+
+
+class _Echo:
+    """Componentless MODEL node: the walker's identity fallback echoes the
+    payload, so the guardrail's rewrite is the only transformation."""
+
+    DETERMINISTIC = True
+
+
+class TestGuardrailE2E:
+    def test_pre_guardrail_scrubs_and_blocks_over_rest(self, monkeypatch):
+        monkeypatch.setenv("ENGINE_WARMUP", "0")
+        spec = {
+            "name": "p",
+            "graph": {
+                "name": "guard", "type": "GUARDRAIL",
+                "implementation": "GUARDRAIL",
+                "parameters": [
+                    {"name": "block", "value": "attack", "type": "STRING"},
+                ],
+                "children": [{"name": "echo", "type": "MODEL"}],
+            },
+        }
+
+        async def go():
+            service = PredictionService(
+                PredictorSpec.model_validate(spec),
+                components={"echo": _Echo()},
+            )
+            engine, service = await _engine_client(None, service=service)
+            r1 = await engine.post(
+                "/api/v0.1/predictions",
+                json={"strData": "reach me at me@example.com please"},
+            )
+            b1 = await r1.json()
+            r2 = await engine.post(
+                "/api/v0.1/predictions", json={"strData": "an ATTACK text"}
+            )
+            det = service.graph_deterministic()
+            await engine.close()
+            return b1, r2.status, det
+
+        b1, blocked_status, det = run(go())
+        assert "[REDACTED]" in b1["strData"]
+        assert "example.com" not in b1["strData"]
+        assert blocked_status == 500  # GraphUnitError surface
+        # classifier-free guardrail + identity model: caching stays viable
+        assert det is True
+
+    def test_classifier_component_clears_graph_determinism(self):
+        spec = {
+            "name": "p",
+            "graph": {
+                "name": "guard", "type": "GUARDRAIL",
+                "children": [{"name": "echo", "type": "MODEL"}],
+            },
+        }
+
+        async def go():
+            service = PredictionService(
+                PredictorSpec.model_validate(spec),
+                components={
+                    "guard": Guardrail(classifier=lambda t: True),
+                    "echo": _Echo(),
+                },
+            )
+            await service.start()
+            det = service.graph_deterministic()
+            await service.close()
+            return det
+
+        assert run(go()) is False
+
+
+# ---------------------------------------------------------------------------
+# e2e: embeddings endpoint + semantic cache tier
+# ---------------------------------------------------------------------------
+
+
+class TestEmbeddingsAndSemanticE2E:
+    def test_embeddings_route_and_paraphrase_hits(self, monkeypatch):
+        monkeypatch.setenv("ENGINE_WARMUP", "0")
+
+        async def go():
+            service = PredictionService(PredictorSpec.model_validate(EMBED_SPEC))
+            service.semantic_cache = SemanticCache(sim_threshold=0.9)
+            engine, service = await _engine_client(None, service=service)
+
+            # -- embeddings endpoint: rawTensor, flat + batch, pinned ----
+            r1 = await engine.post(
+                "/api/v0.1/embeddings", json={"tokens": PROMPT}
+            )
+            b1 = await r1.json()
+            r2 = await engine.post(
+                "/api/v0.1/embeddings",
+                json={"tokens": [PROMPT, [7, 8, 9]]},
+            )
+            b2 = await r2.json()
+            r3 = await engine.post(
+                "/api/v0.1/embeddings", json={"tokens": PROMPT}
+            )
+            b3 = await r3.json()
+            bad = await engine.post("/api/v0.1/embeddings", json={"nope": 1})
+
+            # -- semantic tier: exact repeat then paraphrase ------------
+            model = service.generative_units()[0].model
+            p1 = await engine.post("/api/v0.1/predictions", json=GEN_BODY)
+            miss_hdr = p1.headers.get("x-sct-cache")
+            pb1 = await p1.json()
+            steps_before = model.steps
+            p2 = await engine.post("/api/v0.1/predictions", json=GEN_BODY)
+            exact_hdr = p2.headers.get("x-sct-cache")
+            pb2 = await p2.json()
+            para_body = {
+                "strData": json.dumps(
+                    {"tokens": [5, 9, 2, 18], "max_new_tokens": 4}
+                )
+            }
+            p3 = await engine.post("/api/v0.1/predictions", json=para_body)
+            para_hdr = p3.headers.get("x-sct-cache")
+            pb3 = await p3.json()
+            steps_after = model.steps
+            embeds = model.embeds
+            stats = (await (await engine.get("/stats/cache")).json())["cache"]
+            await engine.close()
+            return (
+                (r1.status, b1), (r2.status, b2), b3, bad.status,
+                miss_hdr, (exact_hdr, pb1, pb2), (para_hdr, pb3),
+                steps_before, steps_after, embeds, stats,
+            )
+
+        (
+            (s1, b1), (s2, b2), b3, bad_status,
+            miss_hdr, (exact_hdr, pb1, pb2), (para_hdr, pb3),
+            steps_before, steps_after, embeds, stats,
+        ) = run(go())
+
+        # embeddings: (B, E) float32 through the typed rawTensor codec
+        assert (s1, s2) == (200, 200)
+        rt = b1["rawTensor"]
+        assert rt["shape"] == [1, 64] and rt["dtype"] == "float32"
+        assert b2["rawTensor"]["shape"] == [2, 64]
+        vec = np.frombuffer(
+            base64.b64decode(rt["data"]), np.float32
+        )
+        assert np.isfinite(vec).all() and float(np.abs(vec).sum()) > 0
+        # pinned-stable: byte-identical on repeat
+        assert b3["rawTensor"]["data"] == rt["data"]
+        assert bad_status == 400
+
+        # semantic tier: miss, exact hit, paraphrase hit — zero GENERATION
+        # device steps for the hits (the embed pass is the lookup's cost)
+        assert miss_hdr is None
+        assert exact_hdr == "semantic" and pb2 == pb1
+        assert para_hdr == "semantic" and pb3 == pb1
+        assert steps_after == steps_before, (steps_before, steps_after)
+        assert embeds >= 3  # every prediction request embedded its prompt
+        sem = stats["semantic"]
+        assert sem["hits"] == 2 and sem["misses"] == 1
+        assert sem["last_similarity"] is not None
+        assert 0.9 <= sem["last_similarity"] < 1.0  # the paraphrase, not 1.0
+
+    def test_embeddings_400_without_embed_unit(self):
+        async def go():
+            engine, _ = await _engine_client(SIMPLE)
+            r = await engine.post(
+                "/api/v0.1/embeddings", json={"tokens": [1, 2, 3]}
+            )
+            body = await r.json()
+            await engine.close()
+            return r.status, body
+
+        status, body = run(go())
+        assert status == 400
+        assert "SCT_EMBED" in body["status"]["info"]
+
+    def test_pooled_vectors_pinned_under_tp2_mesh(self):
+        """Acceptance: the tp-sharded mesh neither destabilizes nor
+        meaningfully moves the pooled vectors."""
+        import jax
+
+        from seldon_core_tpu.executor.generation import (
+            GenerationScheduler,
+            GenerativeModel,
+        )
+        from seldon_core_tpu.models import llama
+        from seldon_core_tpu.parallel import best_mesh
+
+        def build(mesh, name):
+            cfg = llama.Config.tiny(max_seq=128)
+            params = llama.init_params(jax.random.PRNGKey(0), cfg)
+            return GenerativeModel(
+                cfg, params, n_slots=2, kv_block_size=16, embed=True,
+                mesh=mesh,
+                param_axes=(
+                    llama.param_logical_axes(params) if mesh is not None else None
+                ),
+                name=name,
+            )
+
+        async def vecs(model):
+            s = GenerationScheduler(model)
+            a = await s.submit_embed(np.asarray(PROMPT, np.int32))
+            b = await s.submit_embed(np.asarray(PROMPT, np.int32))
+            await s.close()
+            return a, b
+
+        base_a, base_b = run(vecs(build(None, "emb-host")))
+        mesh = best_mesh(2, tp=2)
+        tp_a, tp_b = run(vecs(build(mesh, "emb-tp2")))
+        # pinned-stable within each layout ...
+        assert np.array_equal(base_a, base_b)
+        assert np.array_equal(tp_a, tp_b)
+        # ... and the sharded layout agrees with the host layout
+        assert base_a.shape == tp_a.shape == (64,)
+        np.testing.assert_allclose(tp_a, base_a, atol=2e-5, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# gateway: one spec roll flushes BOTH tiers
+# ---------------------------------------------------------------------------
+
+
+class TestGatewayBothTierFlush:
+    def test_spec_roll_flushes_exact_and_semantic_namespaces(self):
+        store = DeploymentStore()
+        gw = GatewayApp(store)
+        gw.cache = ResponseCache("gateway")
+        gw.semcache = SemanticCache()
+        rec = DeploymentRecord(name="dep", oauth_key="k", oauth_secret="s")
+        store.put(rec)
+        vec = np.array([1.0, 0.0], np.float32)
+        gw.cache.put("k", "some-key", b"stale-exact")
+        gw.semcache.put("k", vec, b"stale-para", "oldhash")
+        # CR spec edit: annotations change -> spec_hash rolls -> listener
+        store.put(DeploymentRecord(
+            name="dep", oauth_key="k", oauth_secret="s",
+            annotations={"img": "v2"},
+        ))
+        assert gw.cache.get("k", "some-key") is None
+        assert gw.semcache.lookup("k", vec, "oldhash") is None
+        snap = gw.cache_snapshot()
+        assert snap["response"]["flushes_by_namespace"] == {"k": 1}
+        assert snap["semantic"]["flushes_by_namespace"] == {"k": 1}
+
+    def test_endpoint_only_churn_keeps_both_tiers(self):
+        store = DeploymentStore()
+        gw = GatewayApp(store)
+        gw.cache = ResponseCache("gateway")
+        gw.semcache = SemanticCache()
+        # watch-stamped hash: the CR watch hashes the SPEC, so endpoint
+        # moves keep it (a directly-built record would derive a hash over
+        # its endpoint fields instead)
+        rec = DeploymentRecord(name="dep", oauth_key="k", oauth_secret="s",
+                               engine_rest_port=9000, spec_hash="h1")
+        store.put(rec)
+        vec = np.array([1.0, 0.0], np.float32)
+        tag = rec.spec_hash
+        gw.cache.put("k", "key", b"warm")
+        gw.semcache.put("k", vec, b"warm", tag)
+        # autoscale grow/shrink: endpoints move, the spec hash doesn't
+        store.put(DeploymentRecord(name="dep", oauth_key="k", oauth_secret="s",
+                                   engine_rest_port=9001, spec_hash="h1"))
+        assert gw.cache.get("k", "key").value == b"warm"
+        assert gw.semcache.lookup("k", vec, tag) == b"warm"
+
+
+# ---------------------------------------------------------------------------
+# audits: host-sync parity + fleet merge
+# ---------------------------------------------------------------------------
+
+
+class TestConfidenceSignalHostSyncParity:
+    def test_conf_signal_adds_zero_host_syncs(self):
+        """Acceptance: the confidence margins ride the SAME fused fetch as
+        the tokens — per-request host-sync deltas are EQUAL with the
+        signal on and off."""
+        import jax
+
+        from seldon_core_tpu.executor.generation import (
+            GenerationScheduler,
+            GenerativeModel,
+        )
+        from seldon_core_tpu.models import llama
+        from seldon_core_tpu.obs import host_sync_snapshot
+
+        def build(conf, name):
+            cfg = llama.Config.tiny(max_seq=128)
+            params = llama.init_params(jax.random.PRNGKey(0), cfg)
+            return GenerativeModel(
+                cfg, params, n_slots=2, kv_block_size=16,
+                conf_signal=conf, name=name,
+            )
+
+        def syncs_per_request(model):
+            prompt = np.asarray(PROMPT, np.int32)
+            infos = []
+
+            async def go():
+                # overlap=False: the overlapped pipeline's trailing
+                # carry-consume sync lands inside the measurement window
+                # timing-dependently on a loaded box; the sequential loop
+                # makes the per-request count deterministic, and the
+                # parity claim is about the conf signal, not overlap
+                s = GenerationScheduler(model, overlap=False)
+                # warm the compile; the measured request is steady-state
+                await s.submit(prompt, max_new_tokens=8, temperature=0.0)
+                before = dict(host_sync_snapshot())
+                info = {}
+                toks = await s.submit(
+                    prompt, max_new_tokens=8, temperature=0.0, info=info
+                )
+                after = dict(host_sync_snapshot())
+                await s.close()
+                infos.append(info)
+                key = next(k for k in after if model.name in k)
+                return after.get(key, 0) - before.get(key, 0), toks
+
+            delta, toks = run(go())
+            return delta, toks, infos[0]
+
+        d_off, toks_off, info_off = syncs_per_request(build(False, "hsoff"))
+        d_on, toks_on, info_on = syncs_per_request(build(True, "hson"))
+        assert d_on == d_off, (d_off, d_on)
+        # the signal arrived (and tokens are untouched by carrying it)
+        assert "confidence" not in info_off
+        assert np.isfinite(info_on["confidence"])
+        # margins cover the decode steps; the prefill-sampled first token
+        # carries none
+        assert info_on["conf_tokens"] == 7
+        assert np.array_equal(toks_on, toks_off)
+
+
+class TestFleetSemcacheMerge:
+    def test_semantic_section_merges_counter_exactly(self):
+        """Two replicas' /stats/cache payloads: the fleet collector's
+        numeric merge must sum the semantic tier like any other counter
+        family — including the per-namespace flush map."""
+        from seldon_core_tpu.obs.fleet import _merge_numeric
+
+        def replica(hits, misses, flushes, ns_flushes):
+            return {
+                "cache": {
+                    "graph_deterministic": True,  # bool: never summed
+                    "semantic": {
+                        "tier": "semantic",
+                        "hits": hits, "misses": misses,
+                        "flushes": flushes,
+                        "flushes_by_namespace": ns_flushes,
+                    },
+                }
+            }
+
+        into: dict = {}
+        _merge_numeric(into, replica(3, 1, 1, {"dep": 1}))
+        _merge_numeric(into, replica(2, 4, 2, {"dep": 1, "other": 1}))
+        sem = into["cache"]["semantic"]
+        assert sem["hits"] == 5 and sem["misses"] == 5
+        assert sem["flushes"] == 3
+        assert sem["flushes_by_namespace"] == {"dep": 2, "other": 1}
+        assert "graph_deterministic" not in into["cache"]

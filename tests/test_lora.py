@@ -593,9 +593,9 @@ class TestProgramKeyAudit:
         model = GenerativeModel(
             cfg, params, n_slots=2, decode_block=2, top_k=3, **LORA_KW
         )
-        assert model._program_config[-2:] == (2, 4)
+        assert model._program_config[-3:-1] == (2, 4)
         off = GenerativeModel(cfg, params, n_slots=2, decode_block=2, top_k=3)
-        assert off._program_config[-2:] == (0, 0)
+        assert off._program_config[-3:-1] == (0, 0)
         assert model._program_config != off._program_config
 
     def test_decode_k_keys_fold_lora(self, tiny):

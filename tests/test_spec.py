@@ -598,7 +598,8 @@ class TestProgramKeyAudit:
                                          model.spec_hist, "int8",
                                          model.prefill_chunk,
                                          model.decode_kernel,
-                                         model.lora_rank, model.lora_slots)
+                                         model.lora_rank, model.lora_slots,
+                                         model.conf_signal)
 
 
 class TestWarmupVariants:
