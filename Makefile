@@ -69,9 +69,15 @@ disagg-check:
 # tp=2 mesh, disagg handoff), host-sync audit still <= 1 sync per fused
 # block with speculation on, int8 handoff round-trip bit-exactness +
 # checkpoint round-trip, the repetitive-text acceptance-rate floor, and
-# the program cache-key audit; then a CPU smoke of the spec bench stage
+# the program cache-key audit — plus the learned-proposer matrix
+# (Medusa-style heads + co-resident draft model: pinned-equal across
+# suspend/resume, drain/migration, disagg, the codec-v5 envelope, the
+# arbiter's batch-class draft registrant, per-method telemetry, and the
+# decode_block=1 rider error); then a CPU smoke of the spec bench stage
+# (per-proposer natural-text acceptance)
 spec-check:
-	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_spec.py -q
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_spec.py \
+		tests/test_spec_learned.py -q
 	JAX_PLATFORMS=cpu BENCH_ONLY=SPEC BENCH_RUNS=1 BENCH_SPEC_TOKENS=16 \
 		$(PYTHON) bench.py
 

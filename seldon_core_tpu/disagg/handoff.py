@@ -43,7 +43,13 @@ HANDOFF_KEY = "sct:kv-handoff"
 # attention deltas, so the decode pool must resolve the same named adapter
 # or reject the frame (the sender then falls back to unified local
 # decode).  v1-v3 frames decode unchanged.
-HANDOFF_VERSION = 4
+# v5: adds the optional speculation-state envelope (docs/PERFORMANCE.md
+# §6) — ``spec_method`` plus, for Medusa-style heads, the slot's
+# ``spec_hlast`` hidden vector (the proposer input the next verify pass
+# would have refreshed).  The field is pure ACCEPTANCE state: a v≤4 frame
+# (or an importer that drops it) still decodes bit-identically, it just
+# pays a cold first speculative block.  v1-v4 frames decode unchanged.
+HANDOFF_VERSION = 5
 
 # Prefix-chain frames (the peer-replica tier of the tiered prefix store,
 # docs/CACHING.md) ride the same step framing under their own key: a
@@ -96,6 +102,7 @@ def encode_handoff(
     deadline_ms: float | None = None,
     priority: str | None = None,
     adapter: str | None = None,
+    spec_state: dict[str, Any] | None = None,
 ) -> bytes:
     """Frame one prefilled request for the engine→engine handoff.
 
@@ -134,6 +141,15 @@ def encode_handoff(
         payload["priority"] = str(priority)
     if adapter:
         payload["adapter"] = str(adapter)
+    if spec_state and spec_state.get("method"):
+        # v5 speculation envelope: carrying it keeps the importer's first
+        # speculative block warm; dropping it costs acceptance, never bits
+        payload["spec_method"] = str(spec_state["method"])
+        hlast = spec_state.get("hlast")
+        if hlast is not None:
+            hl, hl_dtype = _pack_kv(np.ascontiguousarray(hlast))
+            payload["spec_hlast"] = hl
+            payload["spec_hlast_dtype"] = hl_dtype
     if quant:
         ks, scale_dtype = _pack_kv(np.ascontiguousarray(k_scale))
         vs, _ = _pack_kv(np.ascontiguousarray(v_scale))
@@ -177,6 +193,14 @@ def decode_handoff(buf: bytes) -> dict[str, Any]:
         sdt = str(payload["scale_dtype"])
         payload["k_scale"] = _unpack_kv(payload["k_scale"], sdt)
         payload["v_scale"] = _unpack_kv(payload["v_scale"], sdt)
+    if payload.get("spec_method"):
+        spec: dict[str, Any] = {"method": str(payload["spec_method"])}
+        if "spec_hlast" in payload:
+            spec["hlast"] = _unpack_kv(
+                payload["spec_hlast"],
+                str(payload.get("spec_hlast_dtype", "float32")),
+            )
+        payload["spec_state"] = spec
     return payload
 
 
@@ -292,6 +316,7 @@ def build_handoff_frame(
     out = model.export_slot_kv(slot, int(np.asarray(prompt).size))
     k, v = out[0], out[1]
     k_scale, v_scale = (out[2], out[3]) if len(out) == 4 else (None, None)
+    spec = getattr(model, "export_spec_state", lambda s: None)(slot)
     tp = get_traceparent()
     parsed = parse_traceparent(tp)
     remaining = qos.remaining_s()
@@ -311,6 +336,7 @@ def build_handoff_frame(
         deadline_ms=remaining * 1e3 if remaining is not None else None,
         priority=qos.get_priority(),
         adapter=adapter,
+        spec_state=spec,
     )
 
 
@@ -382,4 +408,5 @@ async def apply_handoff(component: Any, payload: dict[str, Any]) -> np.ndarray:
         k_scale=payload.get("k_scale"),
         v_scale=payload.get("v_scale"),
         adapter=str(adapter) if adapter else None,
+        spec_state=payload.get("spec_state"),
     )

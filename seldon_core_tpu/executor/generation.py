@@ -123,6 +123,10 @@ class GenerativeModel:
         spec_draft: int | None = None,
         spec_ngram: int | None = None,
         spec_hist: int = 64,
+        spec_method: str | None = None,
+        spec_heads: int | None = None,
+        spec_heads_path: str | None = None,
+        spec_draft_model: str | None = None,
         kv_cache_dtype: str | None = None,
         prefill_chunk: int | None = None,
         decode_kernel: bool | None = None,
@@ -189,12 +193,45 @@ class GenerativeModel:
         self.spec_hist = max(8, int(spec_hist))
         if self.spec_draft and self.decode_block <= 1:
             # the draft/verify/accept loop lives inside the fused k-step
-            # program; the single-token step has no verify pass to fuse into
-            log.warning(
-                "generative model %r: spec_draft needs decode_block > 1; "
-                "speculative decoding disabled", name,
+            # program; the single-token step has no verify pass to fuse
+            # into.  Loud build-time error — silently dropping speculation
+            # here used to ship deployments whose operators believed spec
+            # was on while every token paid full price.
+            raise GraphUnitError(
+                f"generative model {name!r}: spec_draft={self.spec_draft} "
+                f"requires decode_block > 1, got decode_block="
+                f"{self.decode_block} — the draft/verify/accept loop fuses "
+                "into the k-step decode program.  Raise decode_block "
+                "(graph param or SCT_DECODE_BLOCK) or unset spec_draft "
+                "(graph param or SCT_SPEC_DRAFT)."
             )
-            self.spec_draft = 0
+        # learned speculation (docs/PERFORMANCE.md §6): the draft source.
+        #   ngram — PR 7 self-speculation from the per-slot history ring
+        #   heads — Medusa-style multi-token heads over the post-ln_f
+        #           hidden, drafted inside the same fused step
+        #   draft — a co-resident layer-truncated (or preset) draft model
+        #           with its own paged KV, greedily unrolled in-program
+        # All three feed the SAME verify/accept pass, so greedy output is
+        # bit-identical to spec-off regardless of method — only the
+        # acceptance rate differs.
+        if spec_method is None:
+            spec_method = os.environ.get("SCT_SPEC_METHOD", "") or "ngram"
+        spec_method = str(spec_method).lower()
+        if spec_method not in ("ngram", "heads", "draft"):
+            raise GraphUnitError(
+                f"spec_method must be 'ngram', 'heads', or 'draft', got "
+                f"{spec_method!r}"
+            )
+        self.spec_method = spec_method if self.spec_draft else None
+        if spec_heads is None:
+            spec_heads = int(os.environ.get("SCT_SPEC_HEADS", "0") or 0)
+        if spec_heads_path is None:
+            spec_heads_path = os.environ.get("SCT_SPEC_HEADS_PATH") or None
+        if spec_draft_model is None:
+            spec_draft_model = os.environ.get("SCT_SPEC_DRAFT_MODEL") or None
+        self.spec_heads = 0
+        self.spec_heads_path = None
+        self._draft_geom: tuple | None = None
         if self.spec_draft:
             if not hasattr(family_mod, "decode_slots_spec_paged"):
                 raise GraphUnitError(
@@ -206,6 +243,19 @@ class GenerativeModel:
                 raise GraphUnitError(
                     f"spec_hist {self.spec_hist} must exceed spec_ngram "
                     f"{self.spec_ngram} + spec_draft {self.spec_draft}"
+                )
+            if self.spec_method == "heads":
+                self.spec_heads = max(self.spec_draft, int(spec_heads or 0))
+                self.spec_heads_path = spec_heads_path
+                if not hasattr(family_mod, "apply_medusa_heads"):
+                    raise GraphUnitError(
+                        f"generative family {family_mod.__name__} has no "
+                        "apply_medusa_heads; spec_method='heads' needs the "
+                        "Medusa head block"
+                    )
+            elif self.spec_method == "draft":
+                self._draft_geom = self._parse_draft_model(
+                    spec_draft_model, name
                 )
         # tokens a slot can emit per fused decode step (verify width)
         self._tps = 1 + self.spec_draft
@@ -490,6 +540,130 @@ class GenerativeModel:
             cache["hist"] = jnp.zeros(
                 (self.n_slots, self.spec_hist), jnp.int32
             )
+        # learned proposer state (docs/PERFORMANCE.md §6).  _spec_ps rides
+        # every decode-k dispatch as a plain (non-donated) argument like
+        # the base params: the Medusa head block for 'heads', the draft
+        # model's weights for 'draft', None for 'ngram'.
+        self._spec_ps = None
+        self._draft_cfg = None
+        self.spec_heads_bytes = 0
+        self.draft_weight_bytes = 0
+        self.draft_kv_bytes = 0
+        if self.spec_method == "heads":
+            import jax.numpy as jnp
+
+            if self.spec_heads_path:
+                # trained heads from an .npz checkpoint (executor/checkpoint)
+                from seldon_core_tpu.executor.checkpoint import load_params
+
+                heads = load_params(self.spec_heads_path)
+                w1 = heads.get("w1") if isinstance(heads, dict) else None
+                hd = heads.get("head") if isinstance(heads, dict) else None
+                if (
+                    w1 is None or hd is None
+                    or np.shape(w1)[:1] != np.shape(hd)[:1]
+                    or np.shape(w1)[0] < self.spec_draft
+                    or np.shape(hd)[-1] != cfg.vocab_size
+                ):
+                    raise GraphUnitError(
+                        f"generative model {name!r}: Medusa checkpoint "
+                        f"{self.spec_heads_path!r} must hold w1 (K, E, E) + "
+                        f"head (K, E, V) with K >= spec_draft="
+                        f"{self.spec_draft} and V == {cfg.vocab_size}"
+                    )
+                self.spec_heads = int(np.shape(w1)[0])
+                heads = {
+                    "w1": jnp.asarray(w1, cache_dtype),
+                    "head": jnp.asarray(hd, cache_dtype),
+                }
+            else:
+                # synthesized from the base lm_head: untrained heads draft
+                # "repeat the argmax" — harmless (verify still emits the
+                # real tokens) and enough for the pinned-equal matrix
+                heads = family_mod.init_medusa_heads(
+                    jax.random.PRNGKey(0), cfg, self.spec_heads,
+                    base_head=params["head"], dtype=cache_dtype,
+                )
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                heads = jax.device_put(heads, NamedSharding(mesh, P()))
+            else:
+                heads = jax.device_put(heads)
+            self._spec_ps = heads
+            self.spec_heads_bytes = sum(
+                int(x.nbytes) for x in jax.tree.leaves(heads)
+            )
+            # per-slot post-ln_f hidden of the LAST emitted token — the
+            # heads' draft input, refreshed by every prefill/verify pass
+            cache["hlast"] = jnp.zeros(
+                (self.n_slots, cfg.hidden), cache_dtype
+            )
+        elif self.spec_method == "draft":
+            import dataclasses
+
+            import jax.numpy as jnp
+
+            kind, geo = self._draft_geom
+            if kind == "truncate":
+                # the target's own first-N layers: sliced layer stacks are
+                # fresh (billed) arrays, everything else shared by ref
+                dcfg = dataclasses.replace(cfg, n_layers=int(geo))
+                dparams = family_mod.truncate_params(params, int(geo))
+                self.draft_weight_bytes = sum(
+                    int(x.nbytes) for x in jax.tree.leaves(dparams["layers"])
+                )
+            else:
+                from seldon_core_tpu.models.registry import resolve_config
+
+                fam_name = family_mod.__name__.rsplit(".", 1)[-1]
+                dcfg = resolve_config(fam_name, geo, max_seq=cfg.max_seq)
+                if dcfg.vocab_size != cfg.vocab_size:
+                    raise GraphUnitError(
+                        f"generative model {name!r}: draft preset {geo!r} "
+                        f"vocab {dcfg.vocab_size} != target vocab "
+                        f"{cfg.vocab_size}; drafts would index a different "
+                        "token space"
+                    )
+                dparams = family_mod.init_params(
+                    jax.random.PRNGKey(0), dcfg,
+                )
+                if dtype is not None:
+                    dparams = jax.tree.map(_cast, dparams)
+                if mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec as P
+
+                    dparams = jax.device_put(
+                        dparams, NamedSharding(mesh, P())
+                    )
+                else:
+                    dparams = jax.device_put(dparams)
+                self.draft_weight_bytes = sum(
+                    int(x.nbytes) for x in jax.tree.leaves(dparams)
+                )
+            self._spec_ps = dparams
+            self._draft_cfg = dcfg
+            # draft paged KV: same pool geometry, STATIC per-slot block
+            # ownership — slot i owns [1 + i*mb, 1 + (i+1)*mb), block 0 the
+            # sink.  No allocator, no refcounts: zero leaked draft blocks
+            # by construction, and drift after import/resume self-heals
+            # (the verify pass re-syncs d_pos and the next draft step
+            # rewrites the row).
+            mbd = dcfg.max_seq // kv_block_size
+            d_blocks = 1 + self.n_slots * mbd
+            dkv = family_mod.init_paged_cache(
+                dcfg, self.n_slots, d_blocks, kv_block_size,
+                dtype=cache_dtype,
+            )
+            cache["d_k"] = dkv["k"]
+            cache["d_v"] = dkv["v"]
+            cache["d_pos"] = dkv["pos"]
+            cache["d_table"] = jnp.asarray(
+                1 + np.arange(self.n_slots * mbd, dtype=np.int32).reshape(
+                    self.n_slots, mbd
+                )
+            )
+            self.draft_kv_bytes = int(dkv["k"].nbytes) + int(dkv["v"].nbytes)
         if mesh is not None:
             # KV heads ride the tp axis like the attention weights; blocks
             # and rows stay local (decode is latency-, not FLOP-bound)
@@ -509,6 +683,22 @@ class GenerativeModel:
                 placed["v_scale"] = jax.device_put(cache["v_scale"], sc_sh)
             if "hist" in cache:
                 placed["hist"] = jax.device_put(cache["hist"], rep)
+            if "hlast" in cache:
+                placed["hlast"] = jax.device_put(cache["hlast"], rep)
+            if "d_k" in cache:
+                # draft KV shards like the target pool when its head count
+                # divides the tp axis (always true for truncate — same
+                # heads); odd preset geometries replicate
+                tp = int(mesh.shape.get("tp", 1))
+                d_sh = (
+                    kv_sh
+                    if self._draft_cfg.n_kv_heads % max(tp, 1) == 0
+                    else rep
+                )
+                placed["d_k"] = jax.device_put(cache["d_k"], d_sh)
+                placed["d_v"] = jax.device_put(cache["d_v"], d_sh)
+                placed["d_pos"] = jax.device_put(cache["d_pos"], rep)
+                placed["d_table"] = jax.device_put(cache["d_table"], rep)
             cache = placed
         self._cache = cache
         self.prefill_buckets = tuple(
@@ -551,6 +741,13 @@ class GenerativeModel:
         spec_d = self.spec_draft
         spec_n = self.spec_ngram
         spec_H = self.spec_hist
+        # STATIC proposer selection (a _program_config member): the three
+        # methods are different compiled programs, never shared
+        spec_m = self.spec_method
+        # _draft_cfg is fully determined by _draft_geom (a _program_config
+        # member) plus the base model config — same geometry, same draft
+        # sct: program-key-ok _draft_geom pins it
+        dcfg = self._draft_cfg
         # static decode-attention implementation choice: the Pallas kernel
         # path when enabled, the XLA gather path otherwise (both ride the
         # program cache keys via _program_config)
@@ -571,10 +768,22 @@ class GenerativeModel:
 
         def _prefill(params, tokens, length, slot, blocks, temperature, seed,
                      hist_seed, aid, lora, cache):
-            logits, cache = fam.prefill_slot_paged(
-                params, tokens, length, slot, blocks, cache, cfg,
-                mesh=mesh, seq_impl=seq_impl, lora=lora, adapter_id=aid,
-            )
+            if spec_m == "heads":
+                # stash the post-ln_f hidden at the sampled position: the
+                # Medusa heads draft from it at the first decode block
+                logits, cache, hid = fam.prefill_slot_paged(
+                    params, tokens, length, slot, blocks, cache, cfg,
+                    mesh=mesh, seq_impl=seq_impl, lora=lora, adapter_id=aid,
+                    return_hidden=True,
+                )
+                cache["hlast"] = cache["hlast"].at[slot].set(
+                    hid.astype(cache["hlast"].dtype)
+                )
+            else:
+                logits, cache = fam.prefill_slot_paged(
+                    params, tokens, length, slot, blocks, cache, cfg,
+                    mesh=mesh, seq_impl=seq_impl, lora=lora, adapter_id=aid,
+                )
             key = jax.random.PRNGKey(seed)
             tok = _sample(logits[None], temperature[None], key)[0]
             if spec_d:
@@ -619,7 +828,8 @@ class GenerativeModel:
             import jax.numpy as jnp
 
             def fn(params, tokens, active, temperature, seed, eos, remaining,
-                   aid, lora, cache):
+                   aid, lora, spec_ps, cache):
+                del spec_ps  # uniform decode-k signature; ngram/off use None
                 base_key = jax.random.PRNGKey(seed)
 
                 def body(carry, i):
@@ -663,26 +873,33 @@ class GenerativeModel:
         def _decode_k_spec(k, window):
             """k fused SPECULATIVE verify passes in one device dispatch
             (docs/PERFORMANCE.md): each pass drafts ``spec_draft`` tokens
-            from the slot's on-device history ring, scores current +
-            drafts in one batched model call, accepts the longest agreeing
-            prefix, and emits 1..(1+draft) tokens — so accepted tokens
-            cost ~one device step apiece-divided-by-acceptance.  Same
-            contract as :func:`_decode_k` with ``k * (1 + draft)`` result
-            rows: the second output is the per-row EMITTED mask (exactly
-            the role the was-active mask plays in the plain block), and
-            the ``(tokens, active, remaining)`` carry stays device-
-            resident for the overlapped pipeline.  Zero acceptance
-            degrades to the plain single-token step: row 0 of a pass is
-            bit-identical to the non-speculative program's output."""
+            — from the slot's on-device history ring (``ngram``), from the
+            Medusa head block over the last verified hidden (``heads``), or
+            by greedily unrolling the co-resident draft model over its own
+            paged KV (``draft``) — scores current + drafts in one batched
+            model call, accepts the longest agreeing prefix, and emits
+            1..(1+draft) tokens — so accepted tokens cost ~one device step
+            apiece-divided-by-acceptance.  Same contract as
+            :func:`_decode_k` with ``k * (1 + draft)`` result rows: the
+            second output is the per-row EMITTED mask (exactly the role
+            the was-active mask plays in the plain block), and the
+            ``(tokens, active, remaining)`` carry stays device-resident
+            for the overlapped pipeline.  The proposer feeds ONLY the
+            draft lanes — row 0 of a pass is bit-identical to the
+            non-speculative program's output, so greedy output never
+            depends on the method (only the acceptance rate does)."""
             from jax import lax
             import jax.numpy as jnp
 
-            from seldon_core_tpu.executor.speculative import propose_ngram
+            from seldon_core_tpu.executor.speculative import (
+                propose_heads,
+                propose_ngram,
+            )
 
             L = 1 + spec_d
 
             def fn(params, tokens, active, temperature, seed, eos, remaining,
-                   aid, lora, cache):
+                   aid, lora, spec_ps, cache):
                 base_key = jax.random.PRNGKey(seed)
                 S = tokens.shape[0]
                 offs = jnp.arange(L)[None, :]
@@ -692,17 +909,62 @@ class GenerativeModel:
                     tokens, active, remaining, cache = carry
                     hist = cache["hist"]
                     pos = cache["pos"]
-                    drafts = propose_ngram(
-                        hist, pos, tokens, n=spec_n, draft=spec_d
-                    )
+                    if spec_m == "heads":
+                        head_logits = fam.apply_medusa_heads(
+                            spec_ps, cache["hlast"]
+                        )
+                        drafts = propose_heads(head_logits, draft=spec_d)
+                    elif spec_m == "draft":
+                        # greedy unroll of the co-resident draft model over
+                        # its own paged KV (block-granular view of the same
+                        # donated cache dict).  Each step writes the row it
+                        # consumed, so draft KV rows < d_pos always hold
+                        # the TRUE sequence (accepted prefix) — and the
+                        # post-verify d_pos re-sync below heals any drift
+                        # from imports/resume by letting the next unroll
+                        # rewrite from the synced row.
+                        dc = {
+                            "k": cache["d_k"], "v": cache["d_v"],
+                            "pos": cache["d_pos"], "table": cache["d_table"],
+                        }
+
+                        def dbody(dcarry, _):
+                            cur, dc = dcarry
+                            dlogits, dc = fam.decode_slots_paged(
+                                spec_ps, cur, dc, active, dcfg,
+                                window=window,
+                            )
+                            nxt = jnp.argmax(dlogits, axis=-1).astype(
+                                jnp.int32
+                            )
+                            return (nxt, dc), nxt
+
+                        (_, dc), drafts_t = lax.scan(
+                            dbody, (tokens, dc), None, length=spec_d
+                        )
+                        drafts = drafts_t.T
+                        cache["d_k"], cache["d_v"] = dc["k"], dc["v"]
+                        cache["d_pos"] = dc["pos"]
+                    else:
+                        drafts = propose_ngram(
+                            hist, pos, tokens, n=spec_n, draft=spec_d
+                        )
                     qtoks = jnp.concatenate([tokens[:, None], drafts], axis=1)
                     # writes past the slot's reserved blocks (drafts beyond
                     # the remaining budget) route to the sink block
                     qvalid = active[:, None] & (offs < remaining[:, None])
-                    logits, cache = fam.decode_slots_spec_paged(
-                        params, qtoks, cache, active, qvalid, cfg,
-                        window=window, lora=lora, adapter_ids=aid, **dec_kw,
-                    )
+                    if spec_m == "heads":
+                        logits, cache, hid = fam.decode_slots_spec_paged(
+                            params, qtoks, cache, active, qvalid, cfg,
+                            window=window, lora=lora, adapter_ids=aid,
+                            return_hidden=True, **dec_kw,
+                        )
+                    else:
+                        logits, cache = fam.decode_slots_spec_paged(
+                            params, qtoks, cache, active, qvalid, cfg,
+                            window=window, lora=lora, adapter_ids=aid,
+                            **dec_kw,
+                        )
                     key = jax.random.fold_in(base_key, i)
                     V = logits.shape[-1]
                     out = _sample(
@@ -735,6 +997,28 @@ class GenerativeModel:
                         jnp.where(emitted, out, old)
                     )
                     cache["pos"] = jnp.where(active, pos + n_em, pos)
+                    if spec_m == "heads":
+                        # next pass drafts from the hidden of the LAST
+                        # emitted token — the verify forward already
+                        # computed it, so heads drafting stays free of
+                        # extra model calls
+                        new_h = jnp.take_along_axis(
+                            hid, last[:, None, None], axis=1
+                        )[:, 0]
+                        cache["hlast"] = jnp.where(
+                            active[:, None],
+                            new_h.astype(cache["hlast"].dtype),
+                            cache["hlast"],
+                        )
+                    elif spec_m == "draft":
+                        # re-sync the draft clock to the accepted position:
+                        # rows < pos already hold the true sequence, and
+                        # the next unroll rewrites row pos with the new
+                        # current token — self-healing after any import/
+                        # resume drift
+                        cache["d_pos"] = jnp.where(
+                            active, cache["pos"], cache["d_pos"]
+                        )
                     ys = (
                         (out.T, emitted.T, _conf_margin(logits).T)
                         if conf_on
@@ -763,11 +1047,21 @@ class GenerativeModel:
             def fn(params, tokens, prefix_len, length, slot, blocks_row,
                    suffix_blocks, temperature, seed, hist_seed, aid, lora,
                    cache):
-                logits, cache = fam.prefill_suffix_paged(
-                    params, tokens, prefix_len, length, slot, blocks_row,
-                    suffix_blocks, cache, cfg, prefix_window=pw,
-                    lora=lora, adapter_id=aid,
-                )
+                if spec_m == "heads":
+                    logits, cache, hid = fam.prefill_suffix_paged(
+                        params, tokens, prefix_len, length, slot, blocks_row,
+                        suffix_blocks, cache, cfg, prefix_window=pw,
+                        lora=lora, adapter_id=aid, return_hidden=True,
+                    )
+                    cache["hlast"] = cache["hlast"].at[slot].set(
+                        hid.astype(cache["hlast"].dtype)
+                    )
+                else:
+                    logits, cache = fam.prefill_suffix_paged(
+                        params, tokens, prefix_len, length, slot, blocks_row,
+                        suffix_blocks, cache, cfg, prefix_window=pw,
+                        lora=lora, adapter_id=aid,
+                    )
                 key = jax.random.PRNGKey(seed)
                 tok = _sample(logits[None], temperature[None], key)[0]
                 if spec_d:
@@ -776,6 +1070,26 @@ class GenerativeModel:
                 return _replicate(tok), cache
 
             return fn
+
+        def _draft_prefill(spec_ps, tokens, length, slot, cache):
+            """Draft-model prompt prefill (``spec_method='draft'``): write
+            the prompt's K/V into the draft pool so block-one drafting
+            sees real context instead of zeros.  Output-invisible — only
+            ``d_*`` cache keys change, and the verify pass never reads
+            them for emission — so a skipped/deferred run costs acceptance,
+            never correctness.  One compiled program per prompt bucket."""
+            dc = {
+                "k": cache["d_k"], "v": cache["d_v"],
+                "pos": cache["d_pos"], "table": cache["d_table"],
+            }
+            _, dc = fam.prefill_slot_paged(
+                spec_ps, tokens, length, slot, dc["table"][slot], dc, dcfg,
+                mesh=mesh, seq_impl=seq_impl,
+            )
+            cache["d_k"], cache["d_v"] = dc["k"], dc["v"]
+            cache["d_pos"] = dc["pos"]
+            cache["d_table"] = dc["table"]
+            return cache
 
         def _embed(params, tokens, length):
             """Pooled-embedding forward (docs/GRAPHS.md): pure — no cache
@@ -792,6 +1106,13 @@ class GenerativeModel:
         # (the lora pool arg is NOT donated — it persists across steps
         # like the base params)
         self._prefill = jax.jit(_prefill, donate_argnums=(10,))
+        # draft-model prefill: built only for spec_method='draft'; batch-
+        # class work a DeviceArbiter can defer (scheduler run loop)
+        self._draft_prefill = (
+            jax.jit(_draft_prefill, donate_argnums=(4,))
+            if self.spec_method == "draft"
+            else None
+        )
         self._prefill_suffix_factory = _prefill_suffix
         self._prefill_suffix_jit: dict[tuple, Any] = {}
         self._decode_factory = _decode
@@ -810,6 +1131,7 @@ class GenerativeModel:
         # hold this)
         self._program_config = (
             self.top_k, self.spec_draft, self.spec_ngram, self.spec_hist,
+            self.spec_method, self.spec_heads, self._draft_geom,
             self.kv_dtype, self.prefill_chunk, self.decode_kernel,
             self.lora_rank, self.lora_slots, self.conf_signal,
         )
@@ -820,6 +1142,12 @@ class GenerativeModel:
         self._carry: tuple | None = None
         self._carry_aux: tuple | None = None
         self.overlapped = 0  # blocks dispatched from the on-device carry
+        # deferred draft-model prefills (spec_method='draft' + arbiter):
+        # batch-class payloads the scheduler drains at sync points instead
+        # of running inline at admission
+        self._pending_draft_prefill: list[dict] = []
+        self.defer_draft_prefill = False
+        self.draft_prefills = 0  # draft-pool prompt prefills dispatched
         # host-side per-slot position CEILING (>= true device position; the
         # device may stop early on eos).  Drives the attention-window bucket:
         # decode reads only cache rows [0, window) — the bandwidth bill once
@@ -835,6 +1163,11 @@ class GenerativeModel:
             )
             self._mh_prefill_suffix_key = self.driver.register_unique(
                 f"gen:{name}:prefill_suffix", self._exec_prefill_suffix
+            )
+            # draft-model prompt prefill is a driven step too: it writes
+            # draft pool state on every process of the slice
+            self._mh_draft_prefill_key = self.driver.register_unique(
+                f"gen:{name}:draft_prefill", self._exec_draft_prefill
             )
             self._mh_decode_key = self.driver.register_unique(
                 f"gen:{name}:decode", self._exec_decode
@@ -909,7 +1242,16 @@ class GenerativeModel:
         # TraceAnnotations, and compile telemetry (e.g. "[spec4,int8]")
         tag = []
         if self.spec_draft:
-            tag.append(f"spec{self.spec_draft}")
+            # ngram (the PR 7 default) stays the bare "specN" tag; the
+            # learned proposers name themselves + their geometry
+            sfx = f"spec{self.spec_draft}"
+            if self.spec_method == "heads":
+                sfx += f"+heads{self.spec_heads}"
+            elif self.spec_method == "draft":
+                kind, geo = self._draft_geom
+                sfx += f"+draft:{kind}{geo}" if kind == "truncate" \
+                    else f"+draft:{geo}"
+            tag.append(sfx)
         if self.kv_dtype:
             tag.append(self.kv_dtype)
         if self.prefill_chunk:
@@ -981,6 +1323,12 @@ class GenerativeModel:
                 "kv_pool": kv_bytes,
                 "kv_scales": scale_bytes,
                 "adapter_pool": self.lora_bytes,
+                # learned speculation (docs/MULTITENANT.md "draft-model
+                # HBM accounting"): resident head block / draft weights /
+                # the draft model's own paged KV pool
+                "spec_heads": self.spec_heads_bytes,
+                "draft_weights": self.draft_weight_bytes,
+                "draft_kv": self.draft_kv_bytes,
             },
         )
         # graph-declared adapters ("name", "name:seed", comma-separated or
@@ -1005,6 +1353,50 @@ class GenerativeModel:
         # from here on, adapter registrations are dynamic: on a multi-host
         # slice they broadcast as driven steps instead of local writes
         self._built = True
+
+    def _parse_draft_model(self, spec: str | None, name: str) -> tuple:
+        """Resolve a ``spec_draft_model`` string into a STATIC geometry
+        tuple (a ``_program_config`` member):
+
+        - ``truncate:N`` — LayerSkip-style self-draft from the target's
+          own first N layers (shared weights, no second checkpoint)
+        - ``truncate:auto`` — N = max(1, n_layers // 8)
+        - ``preset:NAME`` — a separate tiny preset of the same family
+          (vocab must match the target's; max_seq is forced to it)
+        """
+        spec = str(spec or "truncate:auto").strip()
+        kind, _, arg = spec.partition(":")
+        kind = kind.lower()
+        if kind == "truncate":
+            arg = (arg or "auto").strip().lower()
+            if not hasattr(self.family, "truncate_params"):
+                raise GraphUnitError(
+                    f"generative family {self.family.__name__} has no "
+                    "truncate_params; spec_draft_model='truncate:...' needs "
+                    "the layer-truncation helper"
+                )
+            if arg == "auto":
+                n = max(1, int(self.cfg.n_layers) // 8)
+            else:
+                try:
+                    n = int(arg)
+                except ValueError:
+                    raise GraphUnitError(
+                        f"generative model {name!r}: bad truncate layer "
+                        f"count in spec_draft_model={spec!r}"
+                    ) from None
+            if not 1 <= n < int(self.cfg.n_layers):
+                raise GraphUnitError(
+                    f"generative model {name!r}: truncate:{n} must keep "
+                    f"1 <= N < n_layers ({self.cfg.n_layers})"
+                )
+            return ("truncate", n)
+        if kind == "preset" and arg.strip():
+            return ("preset", arg.strip())
+        raise GraphUnitError(
+            f"generative model {name!r}: spec_draft_model must be "
+            f"'truncate:N', 'truncate:auto', or 'preset:NAME', got {spec!r}"
+        )
 
     def note_itl(self, seconds: float) -> None:
         """One inter-token-latency sample (scheduler delivery loop)."""
@@ -1498,6 +1890,83 @@ class GenerativeModel:
                 return k, v, ks, vs
         return k, v
 
+    def export_spec_state(self, slot: int) -> dict | None:
+        """Proposer state for a handoff/suspend frame (codec v5): the
+        method tag plus, for ``heads``, the slot's Medusa hidden — the one
+        piece an importer cannot recompute without a forward pass.  The
+        ``draft`` method ships no tensor: the importer re-prefills the
+        draft pool from the carried token history and ``d_pos``
+        self-heals at the first verify pass.  ``None`` for ngram/off —
+        the history ring already travels as the frame's prompt."""
+        if not self.spec_method or self.spec_method == "ngram":
+            return None
+        state: dict = {"method": self.spec_method}
+        if self.spec_method == "heads":
+            with self._lock:
+                # once per migrated slot, off the per-token path
+                state["hlast"] = np.asarray(  # sct: host-sync-ok handoff export
+                    jax.device_get(self._cache["hlast"][int(slot)])
+                )
+        return state
+
+    def draft_prefill_dispatch(self, slot: int, prompt: np.ndarray):
+        """Prefill the co-resident draft model's paged KV for ``slot``
+        (``spec_method='draft'``).  Batch-class work: with a DeviceArbiter
+        attached the scheduler defers it to the next sync point
+        (:meth:`drain_draft_prefills`) under the draft registrant, so
+        interactive verify blocks never queue behind it.  Skipping or
+        delaying it costs acceptance only — the verify pass never reads
+        draft KV for emission, and ``d_pos`` re-syncs every pass."""
+        if self._draft_prefill is None:
+            return None
+        prompt = np.asarray(prompt, np.int32).ravel()
+        L = min(int(prompt.size), self.cfg.max_seq)
+        if L < 1:
+            return None
+        bucket = self.fit_bucket(L)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :L] = prompt[:L]
+        payload = {"padded": padded, "length": L, "slot": int(slot)}
+        if self.defer_draft_prefill and not self._in_warmup:
+            self._pending_draft_prefill.append(payload)
+            return None
+        if self.driver is not None:
+            return self.driver.lead(self._mh_draft_prefill_key, payload)
+        return self._exec_draft_prefill(payload)
+
+    def drain_draft_prefills(self) -> int:
+        """Run the deferred draft-model prefills (scheduler sync points,
+        under the arbiter's batch-class draft registrant)."""
+        n = 0
+        while self._pending_draft_prefill:
+            payload = self._pending_draft_prefill.pop(0)
+            if self.driver is not None:
+                self.driver.lead(self._mh_draft_prefill_key, payload)
+            else:
+                self._exec_draft_prefill(payload)
+            n += 1
+        return n
+
+    def _exec_draft_prefill(self, payload: dict):
+        """Symmetric draft-prefill body (runs on every slice process).
+        No token output and nothing fetched: a dispatch-only call, so the
+        ≤1-host-sync-per-fused-block audit is untouched."""
+        label = (
+            f"draft_prefill:b{int(payload['padded'].shape[1])}"
+            f"{self.variant_sfx}"
+        )
+        with self._lock:
+            with jax.profiler.TraceAnnotation(label):
+                self._cache = self._draft_prefill(
+                    self._spec_ps,
+                    payload["padded"],
+                    np.int32(payload["length"]),
+                    np.int32(payload["slot"]),
+                    self._cache,
+                )
+            self.draft_prefills += 1
+        return None
+
     def attach_imported(
         self,
         slot: int,
@@ -1510,6 +1979,7 @@ class GenerativeModel:
         v_scale: np.ndarray | None = None,
         first_token: int | None = None,
         adapter: str | None = None,
+        spec_state: dict | None = None,
     ) -> None:
         """Install another engine's exported prompt KV into ``slot``:
         reserve blocks (longest-prefix reuse applies — blocks this pool
@@ -1578,10 +2048,25 @@ class GenerativeModel:
             if first_token is not None:
                 row_h[L % self.spec_hist] = int(first_token)
             payload["hist_seed"] = row_h
+        if self.spec_method == "heads":
+            # carried Medusa hidden (handoff codec v5) — or zeros for a
+            # pre-v5 frame: the first verify pass refreshes it, so an old
+            # frame only costs the FIRST block's acceptance, never output
+            hl = (spec_state or {}).get("hlast")
+            payload["hlast"] = (
+                np.asarray(hl)
+                if hl is not None
+                else np.zeros(self.cfg.hidden, np.float32)
+            )
         if self.driver is not None:
             self.driver.lead(self._mh_import_key, payload)
         else:
             self._exec_import(payload)
+        if self.spec_method == "draft":
+            # rebuild the draft pool's context from the carried token
+            # history: without it the draft proposes from zero context
+            # (output-identical, acceptance-poor) until rows refill
+            self.draft_prefill_dispatch(slot, prompt)
         self._pos_ceiling[int(slot)] = L
         self.imports += 1
 
@@ -1702,6 +2187,16 @@ class GenerativeModel:
                 if self.mesh is not None:
                     hist = jax.device_put(hist, c["hist"].sharding)
                 out["hist"] = hist
+            if "hlast" in payload and "hlast" in c:
+                hl = self._unpack_bf16(
+                    np.asarray(payload["hlast"]), c["hlast"].dtype
+                )
+                hlast = c["hlast"].at[int(slot)].set(
+                    jnp.asarray(hl).astype(c["hlast"].dtype)
+                )
+                if self.mesh is not None:
+                    hlast = jax.device_put(hlast, c["hlast"].sharding)
+                out["hlast"] = hlast
             self._cache = out
 
     # --------------------------------------------- tiered prefix store
@@ -2124,6 +2619,11 @@ class GenerativeModel:
                 payload["aid"] = int(self._slot_aidx[int(slot)])
             if self.spec_draft:
                 payload["hist_seed"] = self._hist_seed(prompt)
+            if self.spec_method == "draft":
+                # draft pool has no prefix reuse: it prefills the FULL
+                # prompt (the draft model is tiny; correctness is
+                # unaffected either way)
+                self.draft_prefill_dispatch(slot, prompt)
             if self.driver is not None:
                 return self.driver.lead(self._mh_prefill_suffix_key, payload)
             return self._exec_prefill_suffix(payload)
@@ -2142,6 +2642,8 @@ class GenerativeModel:
             payload["aid"] = int(self._slot_aidx[int(slot)])
         if self.spec_draft:
             payload["hist_seed"] = self._hist_seed(prompt)
+        if self.spec_method == "draft":
+            self.draft_prefill_dispatch(slot, prompt)
         if self.driver is not None:
             return self.driver.lead(self._mh_prefill_key, payload)
         return self._exec_prefill(payload)
@@ -2228,7 +2730,8 @@ class GenerativeModel:
             if self.spec_draft:
                 payloads[-1][1]["hist_seed"] = self._hist_seed(prompt[:e])
         return {"slot": int(slot), "payloads": payloads,
-                "prefix_len": prefix_len}
+                "prefix_len": prefix_len,
+                "prompt": prompt if self.spec_method == "draft" else None}
 
     def prefill_chunk_dispatch(self, plan: dict, i: int):
         """Dispatch chunk ``i`` of an :meth:`admit_chunk_plan` admission.
@@ -2237,6 +2740,12 @@ class GenerativeModel:
         chunks' samples are discarded unfetched, so chunking adds zero host
         syncs over the monolithic path."""
         kind, payload = plan["payloads"][i]
+        if i == 0 and self.spec_method == "draft":
+            # one full-prompt draft prefill rides the first chunk: the
+            # draft model is ~n_layers/8 of the target, so it does not
+            # reintroduce the stall chunking removed — and deferring it
+            # (arbiter) stays an acceptance-only decision
+            self.draft_prefill_dispatch(plan["slot"], plan["prompt"])
         if kind == "prefill":
             if self.driver is not None:
                 return self.driver.lead(self._mh_prefill_key, payload)
@@ -2416,10 +2925,27 @@ class GenerativeModel:
             "spec_draft": self.spec_draft,
             "spec_ngram": self.spec_ngram if self.spec_draft else None,
             "spec_hist": self.spec_hist if self.spec_draft else None,
+            # learned speculation (docs/PERFORMANCE.md §6): which proposer
+            # this deployment runs + its geometry, and the acceptance
+            # ledger keyed by it — one deployment runs ONE proposer, so
+            # the per-method split is the labeled ledger itself
+            "spec_method": self.spec_method,
+            "spec_heads": self.spec_heads or None,
+            "spec_draft_model": (
+                f"{self._draft_geom[0]}:{self._draft_geom[1]}"
+                if self._draft_geom else None
+            ),
             "spec_verify_passes": self.spec_verify_passes,
             "spec_emitted_tokens": self.spec_emitted_tokens,
             "accepted_tokens_per_step": (
                 round(ratio, 4) if ratio is not None else None
+            ),
+            "accepted_tokens_per_step_by_method": (
+                {
+                    self.spec_method: round(ratio, 4),
+                }
+                if ratio is not None and self.spec_method
+                else {}
             ),
             "kv_dtype": self.kv_dtype or str(self._cache["k"].dtype),
             "kv_bytes_per_slot": self.kv_bytes_per_slot(),
@@ -2812,6 +3338,17 @@ class GenerativeModel:
                 productive
             )
             DEFAULT_METRICS.spec_accepted_per_step.labels(self.name).set(ratio)
+            # per-proposer split (ngram/heads/draft) of the same ledger
+            method = self.spec_method or "ngram"
+            DEFAULT_METRICS.spec_emitted_by_method.labels(
+                self.name, method
+            ).inc(int(emitted.sum()))
+            DEFAULT_METRICS.spec_verify_passes_by_method.labels(
+                self.name, method
+            ).inc(productive)
+            DEFAULT_METRICS.spec_accepted_per_step_by_method.labels(
+                self.name, method
+            ).set(ratio)
         step_s = time.perf_counter() - t0
         # stashed for the delivery loop's usage attribution: this block's
         # measured device seconds get split across the slots it served by
@@ -2830,7 +3367,8 @@ class GenerativeModel:
             # cache: each block consumes its predecessor's buffers in place,
             # so the overlapped pipeline holds one live carry, not two
             fn = jax.jit(
-                self._decode_k_factory(k, window), donate_argnums=(1, 2, 6, 9)
+                self._decode_k_factory(k, window),
+                donate_argnums=(1, 2, 6, 10),
             )
             self._decode_k_jit[key] = fn
             self.program_compiles += 1
@@ -2859,6 +3397,7 @@ class GenerativeModel:
                     np.asarray(payload["remaining"], np.int32),
                     aid,
                     self._lora,
+                    self._spec_ps,
                     self._cache,
                 )
             if self.conf_signal:
@@ -2905,6 +3444,7 @@ class GenerativeModel:
                     rem_c,
                     aid,
                     self._lora,
+                    self._spec_ps,
                     self._cache,
                 )
             if self.conf_signal:
@@ -3085,7 +3625,15 @@ class GenerativeModel:
             zero = jax.device_put(
                 np.zeros(self.n_slots, np.int32), self._cache["pos"].sharding
             )
-            self._cache = {**self._cache, "pos": zero}
+            out = {**self._cache, "pos": zero}
+            if "d_pos" in out:
+                # the draft clock resets with the target's (rows above it
+                # become unreachable, same as the main pool)
+                out["d_pos"] = jax.device_put(
+                    np.zeros(self.n_slots, np.int32),
+                    self._cache["d_pos"].sharding,
+                )
+            self._cache = out
 
     def reset(self) -> None:
         """Zero every slot position and reclaim every block reservation
@@ -3105,6 +3653,7 @@ class GenerativeModel:
         self._peer_chains.clear()
         self._slot_tier.clear()
         self._slot_promoted.clear()
+        self._pending_draft_prefill.clear()
         if self.driver is not None:
             self.driver.lead(self._mh_reset_key, {})
             return
@@ -3300,6 +3849,9 @@ class GenerationScheduler:
         # frames) and resume bit-exactly at a later sync point
         self._arbiter = None
         self._arb_key: str | None = None
+        # batch-class registrant for the co-resident draft model's prompt
+        # prefills (spec_method='draft'; attach_arbiter sets it)
+        self._arb_draft_key: str | None = None
         self._preempt = False
         self._suspended: list[dict] = []
         self._suspend_store = None
@@ -3679,6 +4231,7 @@ class GenerationScheduler:
         k_scale: np.ndarray | None = None,
         v_scale: np.ndarray | None = None,
         adapter: str | None = None,
+        spec_state: dict | None = None,
     ) -> np.ndarray:
         """Disagg decode-side admission: continue a generation whose
         prompt KV (``k``/``v``) and first sampled token arrived from a
@@ -3705,6 +4258,7 @@ class GenerationScheduler:
         req.imported = {
             "first_token": int(first_token), "k": k, "v": v,
             "k_scale": k_scale, "v_scale": v_scale,
+            "spec": spec_state,
         }
         self._begin_tl(req, kind="imported")
         self._enqueue(req)
@@ -3787,17 +4341,50 @@ class GenerationScheduler:
     ) -> None:
         """Join a packed chip: register with ``arbiter`` under this
         model's name (the arbiter de-duplicates colliding names) and
-        start bracketing fused blocks with its grant."""
+        start bracketing fused blocks with its grant.  With a co-resident
+        draft model (``spec_method='draft'``) a SECOND, batch-class
+        registrant covers its prompt prefills: they stop running inline
+        at admission and drain at sync points under the draft grant, so
+        interactive verify blocks never queue behind draft warm-up work
+        (docs/PACKING.md + PERFORMANCE.md §6)."""
         self._arbiter = arbiter
         self._arb_key = arbiter.register(
             self.model.name, scheduler=self, priority=priority, slo_ms=slo_ms
         )
+        if getattr(self.model, "spec_method", None) == "draft":
+            self._arb_draft_key = arbiter.register(
+                f"{self.model.name}/draft", scheduler=self,
+                priority=qos.PRIO_BATCH,
+            )
+            self.model.defer_draft_prefill = True
 
     def detach_arbiter(self) -> None:
         if self._arbiter is not None:
+            if self._arb_draft_key is not None:
+                self._arbiter.unregister(self._arb_draft_key)
+                self._arb_draft_key = None
+                self.model.defer_draft_prefill = False
             self._arbiter.unregister(self._arb_key)
             self._arbiter = None
             self._arb_key = None
+
+    async def _drain_draft_prefills(self) -> None:
+        """Run deferred draft-model prefills under the batch-class draft
+        grant (sync points only — never between a dispatch and its
+        fetch, so the one-sync-per-block audit holds)."""
+        if not getattr(self.model, "_pending_draft_prefill", None):
+            return
+        # local refs: close() detaches the arbiter concurrently with the
+        # run loop, and the release must pair with the acquire we made
+        arb, key = self._arbiter, self._arb_draft_key
+        if arb is not None and key is not None:
+            await arb.acquire(key)
+            try:
+                await asyncio.to_thread(self.model.drain_draft_prefills)
+            finally:
+                arb.release(key)
+            return
+        await asyncio.to_thread(self.model.drain_draft_prefills)
 
     async def _arb_acquire(self) -> None:
         if self._arbiter is not None:
@@ -3904,6 +4491,9 @@ class GenerationScheduler:
                 kv = self.model.export_slot_kv(slot, int(hist.size))
                 k, v = kv[0], kv[1]
                 ks, vs = (kv[2], kv[3]) if len(kv) == 4 else (None, None)
+                spec = getattr(
+                    self.model, "export_spec_state", lambda s: None
+                )(slot)
                 return encode_handoff(
                     hist, carry, k, v,
                     block_size=self.model.kv_block_size,
@@ -3913,6 +4503,7 @@ class GenerationScheduler:
                     k_scale=ks, v_scale=vs,
                     priority=req.priority,
                     adapter=req.adapter,
+                    spec_state=spec,
                 )
 
             try:
@@ -3996,6 +4587,7 @@ class GenerationScheduler:
                 "prompt": np.asarray(payload["prompt"], np.int32),
                 "reserve_tokens": int(payload["max_new_tokens"]),
                 "resumed": True,
+                "spec": payload.get("spec_state"),
             }
             self.resumes += 1
             self._tl(req, "resume-queued", span=False)
@@ -4410,10 +5002,18 @@ class GenerationScheduler:
                 block_s * counts[i] / block_tokens if block_tokens else 0.0
             )
             req.u_device_s += share_s
+            # per-proposer acceptance attribution (ISSUE 20 satellite):
+            # the active spec_method is a build-time constant, so the
+            # whole block's accepted tokens belong to one proposer row
+            mkw = {}
+            if accepted:
+                m = getattr(self.model, "spec_method", None) or "ngram"
+                mkw[f"tokens_spec_accepted_{m}"] = accepted
             METER.add(
                 self.model.name, req.adapter or "", req.priority,
                 device_s=share_s, tokens_decode=counts[i],
                 tokens_spec_accepted=accepted,
+                **mkw,
             )
             if req.timeline is not None or req.span is not None:
                 attrs = {"tokens": counts[i]}
@@ -4475,6 +5075,11 @@ class GenerationScheduler:
                     # peer-pulled chains: the install scatter takes pool
                     # blocks, legal only with no decode block in flight
                     await self._drain_prefix_installs()
+                if pending is None:
+                    # draft prefills deferred by the arbiter (batch-class
+                    # registrant) run at this sync point, off the decode
+                    # block's critical path
+                    await self._drain_draft_prefills()
                 if pending is None and self._preempt and active.any():
                     # preemption verb (docs/PACKING.md): at this sync
                     # point, export every active slot into the suspend
@@ -4852,6 +5457,13 @@ class GenerationScheduler:
                         # prompt + tokens emitted before suspension) and
                         # the frame's remaining-token reservation.
                         imp = req.imported
+                        # spec kwarg only when a state rode the frame:
+                        # duck-typed stand-in models predate speculation
+                        skw = (
+                            {"spec_state": imp["spec"]}
+                            if imp.get("spec") is not None
+                            else {}
+                        )
                         self.model.attach_imported(
                             slot, imp.get("prompt", req.prompt),
                             imp["k"], imp["v"],
@@ -4861,7 +5473,7 @@ class GenerationScheduler:
                             k_scale=imp.get("k_scale"),
                             v_scale=imp.get("v_scale"),
                             first_token=imp["first_token"],
-                            **akw,
+                            **akw, **skw,
                         )
                         placed.append((req, slot, imp["first_token"]))
                         continue
@@ -4905,6 +5517,10 @@ class GenerationScheduler:
         # timeline admit events come from host-side reservation bookkeeping
         # (reuse depth, block split) — getattr: stand-in models predate it
         resnap = getattr(self.model, "reservation_snapshot", lambda s: None)
+        # stamp the active proposer on admit events so a timeline reader
+        # can attribute acceptance-rate shifts to the speculation config
+        specm = getattr(self.model, "spec_method", None)
+        smkw = {"spec_method": specm} if specm else {}
         for req, slot, plan in chunked:
             if req.future.done():  # client vanished while we reserved
                 self.model.release_slot(slot)
@@ -4920,7 +5536,7 @@ class GenerationScheduler:
             self._meter_admit(req, snap)
             self._tl(
                 req, "admit", slot=slot, chunked=True,
-                chunks=len(plan["payloads"]), **akw, **snap,
+                chunks=len(plan["payloads"]), **akw, **snap, **smkw,
             )
         for req in starved:
             self._tl(req, "kv-starved", span=False)
@@ -4946,7 +5562,7 @@ class GenerationScheduler:
                     self._meter_admit(req, snap)
                     self._tl(
                         req, "admit", slot=slot, prefill_only=True,
-                        **akw, **snap,
+                        **akw, **snap, **smkw,
                     )
                     req.future.set_result((slot, int(tok)))
                     self._end_tl(req, "exported", slot=slot)
@@ -4978,7 +5594,7 @@ class GenerationScheduler:
                 continue
             if req.imported is not None:
                 attrs["imported"] = True
-            self._tl(req, "admit", slot=slot, **attrs)
+            self._tl(req, "admit", slot=slot, **attrs, **smkw)
             if self._token_done(req, int(tok)):
                 self._complete(req)
                 self._finish_tl(req)

@@ -32,6 +32,7 @@ from seldon_core_tpu.runtime import settings
 #: DRAM, not chip memory
 CLASSES = (
     "weights", "kv_pool", "kv_scales", "adapter_pool",
+    "spec_heads", "draft_weights", "draft_kv",
     "prefix_dram", "suspend_dram",
 )
 

@@ -77,6 +77,20 @@ def propose_ngram(
     return jnp.where(any_match[:, None], cand, fallback).astype(hist.dtype)
 
 
+def propose_heads(head_logits: jnp.ndarray, *, draft: int) -> jnp.ndarray:
+    """Draft ``draft`` tokens per slot from Medusa-style head logits.
+
+    ``head_logits`` is ``(S, K, V)`` — head ``j`` scores the token
+    ``j + 1`` positions past the current one (produced by
+    ``models/llama.py::apply_medusa_heads`` from the post-``ln_f`` hidden
+    the previous verify pass returned).  ``draft <= K`` is STATIC.  Greedy
+    argmax per head: the verify/accept pass emits the real model's tokens
+    regardless, so head quality only moves the acceptance rate, never the
+    output values.
+    """
+    return jnp.argmax(head_logits[:, :draft, :], axis=-1).astype(jnp.int32)
+
+
 def seed_history(prompt, hist_len: int):
     """Host-side history-ring row for a freshly admitted prompt: the last
     ``hist_len - 1`` prompt tokens at their ``p % H`` rows (one row is

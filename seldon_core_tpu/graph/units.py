@@ -365,7 +365,10 @@ def _jax_generative(parameters: dict[str, Any]) -> Any:
     ``decode_block``, ``overlap`` (overlapped decode pipeline,
     docs/PERFORMANCE.md), ``kv_prefix_reuse``, ``prefix_dram_gb``
     (host-DRAM prefix tier, docs/CACHING.md), ``spec_draft`` /
-    ``spec_ngram`` / ``spec_hist`` (fused self-speculative decoding),
+    ``spec_ngram`` / ``spec_hist`` (fused self-speculative decoding) with
+    ``spec_method`` / ``spec_heads`` / ``spec_heads_path`` /
+    ``spec_draft_model`` (learned proposers: fused Medusa-style heads or a
+    co-resident draft model, docs/PERFORMANCE.md §6),
     ``kv_cache_dtype`` (``int8`` paged-KV quantization), ``prefill_chunk``
     (Sarathi-style chunked prefill interleaved with decode),
     ``decode_kernel`` (fused Pallas paged decode-attention kernel),

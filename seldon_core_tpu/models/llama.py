@@ -681,7 +681,8 @@ def prefill_slot_paged(
     seq_impl: str = "dense",
     lora: dict | None = None,
     adapter_id: jax.Array | None = None,
-) -> tuple[jax.Array, dict]:
+    return_hidden: bool = False,
+) -> tuple[jax.Array, dict] | tuple[jax.Array, dict, jax.Array]:
     """Prefill ONE request's prompt into the blocks reserved for ``slot``.
 
     ``tokens`` is ``(1, Lpad)`` right-padded to a bucket that is a multiple
@@ -733,6 +734,10 @@ def prefill_slot_paged(
     cache["table"] = cache["table"].at[slot].set(blocks_row)
     h = jax.lax.dynamic_index_in_dim(x[0], length - 1, axis=0, keepdims=False)
     h = _rmsnorm(h, params["ln_f"], cfg.norm_eps)
+    if return_hidden:
+        # post-ln_f hidden at the sampled position — the Medusa heads'
+        # input (executor/generation.py stashes it per slot)
+        return h @ params["head"], cache, h
     return h @ params["head"], cache
 
 
@@ -750,7 +755,8 @@ def prefill_suffix_paged(
     prefix_window: int,
     lora: dict | None = None,
     adapter_id: jax.Array | None = None,
-) -> tuple[jax.Array, dict]:
+    return_hidden: bool = False,
+) -> tuple[jax.Array, dict] | tuple[jax.Array, dict, jax.Array]:
     """Prefill only the SUFFIX of a prompt whose first ``prefix_len``
     tokens already have K/V in the slot's table blocks (KV prefix reuse,
     cache/prefix.py).
@@ -882,6 +888,8 @@ def prefill_suffix_paged(
         x[0], length - prefix_len - 1, axis=0, keepdims=False
     )
     h = _rmsnorm(h, params["ln_f"], cfg.norm_eps)
+    if return_hidden:
+        return h @ params["head"], cache, h
     return h @ params["head"], cache
 
 
@@ -928,7 +936,8 @@ def decode_slots_spec_paged(
     kernel: bool = False,
     lora: dict | None = None,
     adapter_ids: jax.Array | None = None,
-) -> tuple[jax.Array, dict]:
+    return_hidden: bool = False,
+) -> tuple[jax.Array, dict] | tuple[jax.Array, dict, jax.Array]:
     """Speculative verify pass: score ``L = 1 + draft`` query positions per
     slot in ONE model call (docs/PERFORMANCE.md).
 
@@ -942,18 +951,22 @@ def decode_slots_spec_paged(
     rejected positions stay above ``pos``, invisible to every later read
     and overwritten by the next pass before they can be accepted.
 
-    Returns ``(logits (S, L, V), cache)``.
+    Returns ``(logits (S, L, V), cache)`` — plus the post-``ln_f`` hidden
+    states ``(S, L, E)`` when ``return_hidden`` (STATIC) is set, so the
+    Medusa-heads proposer can draft from the verified hidden without a
+    second forward.
     """
     return _decode_paged_multi(
         params, qtokens, cache, active, qvalid, cfg, window=window,
         kernel=kernel, lora=lora, adapter_ids=adapter_ids,
+        return_hidden=return_hidden,
     )
 
 
 def _decode_paged_multi(
     params, qtokens, cache, active, qvalid, cfg: Config, *, window,
     kernel: bool = False, lora: dict | None = None,
-    adapter_ids: jax.Array | None = None,
+    adapter_ids: jax.Array | None = None, return_hidden: bool = False,
 ):
     """Shared L-query decode body: ``L=1`` is the classic decode step,
     ``L>1`` the fused speculative verify.  The per-row contraction shapes
@@ -1099,7 +1112,77 @@ def _decode_paged_multi(
         out["k_scale"] = new_ks
         out["v_scale"] = new_vs
     x = _rmsnorm(x, params["ln_f"], cfg.norm_eps)
+    if return_hidden:
+        return x @ params["head"], out, x
     return x @ params["head"], out
+
+
+# ---------------------------------------------------------------------------
+# learned speculation (docs/PERFORMANCE.md §6): Medusa-style decode heads
+# and layer-truncated self-draft weights.  Both are DRAFT sources only —
+# the fused verify/accept pass scores their proposals against the real
+# model, so neither can change emitted tokens, only the acceptance rate.
+# ---------------------------------------------------------------------------
+
+
+def init_medusa_heads(
+    rng: jax.Array,
+    cfg: Config,
+    n_heads: int,
+    base_head: jax.Array | None = None,
+    dtype=jnp.float32,
+) -> dict:
+    """``n_heads`` Medusa-style draft heads: head ``j`` predicts the token
+    ``j + 1`` positions past the one the input hidden state emitted.
+
+    Each head is the standard Medusa residual block over the post-``ln_f``
+    hidden ``h``: ``logits_j = (h + silu(h @ w1[j])) @ head[j]``.  With
+    ``base_head`` (the base model's ``lm_head``) the output projections
+    start as copies of it and ``w1`` near zero — untrained heads then draft
+    "repeat the next-token argmax", a harmless self-draft for the pinned
+    bit-identity tests.  Real (trained) heads load by path through
+    ``executor/checkpoint.py`` instead (``spec_heads_path``)."""
+    n_heads = int(n_heads)
+    e, v = cfg.hidden, cfg.vocab_size
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0) if rng is None else rng)
+    w1 = 0.01 * jax.random.normal(k1, (n_heads, e, e), dtype=jnp.float32)
+    if base_head is not None:
+        head = jnp.broadcast_to(
+            jnp.asarray(base_head, jnp.float32)[None], (n_heads, e, v)
+        )
+    else:
+        head = 0.02 * jax.random.normal(k2, (n_heads, e, v), dtype=jnp.float32)
+    return {"w1": w1.astype(dtype), "head": jnp.asarray(head, dtype)}
+
+
+def apply_medusa_heads(heads: dict, h: jax.Array) -> jax.Array:
+    """Head logits ``(S, K, V)`` from per-slot hidden states ``h (S, E)``.
+    Pure jnp with static shapes: runs INSIDE the fused decode program, so
+    heads drafting costs zero extra host syncs."""
+    w1 = heads["w1"]
+    hx = h.astype(w1.dtype)
+    hk = hx[:, None, :] + jax.nn.silu(jnp.einsum("se,kef->skf", hx, w1))
+    return jnp.einsum("ske,kev->skv", hk, heads["head"])
+
+
+def medusa_head_bytes(cfg: Config, n_heads: int, dtype=jnp.float32) -> int:
+    """HBM bytes ``n_heads`` resident Medusa heads cost (MemoryManager
+    accounting, docs/MULTITENANT.md)."""
+    itemsize = jnp.dtype(dtype).itemsize
+    e, v = cfg.hidden, cfg.vocab_size
+    return int(n_heads) * (e * e + e * v) * itemsize
+
+
+def truncate_params(params: dict, n_layers: int) -> dict:
+    """LayerSkip-style self-draft weights: the target's OWN first
+    ``n_layers`` transformer blocks with its embedding, final norm, and
+    lm_head — a co-resident draft model at ``n_layers / cfg.n_layers`` of
+    the per-token cost with no second checkpoint.  The stacked layer
+    leaves are sliced (new device arrays); everything else is shared by
+    reference."""
+    n = int(n_layers)
+    layers = {k: v[:n] for k, v in params["layers"].items()}
+    return {**params, "layers": layers}
 
 
 def decode_slots(

@@ -223,6 +223,10 @@ def build_generative_component(
     spec_draft: int | None = None,
     spec_ngram: int | None = None,
     spec_hist: int = 64,
+    spec_method: str | None = None,
+    spec_heads: int | None = None,
+    spec_heads_path: str | None = None,
+    spec_draft_model: str | None = None,
     kv_cache_dtype: str | None = None,
     prefill_chunk: int | None = None,
     decode_kernel: bool | None = None,
@@ -246,7 +250,11 @@ def build_generative_component(
     promote back with one fused scatter (docs/CACHING.md "Tiered prefix
     store"; env fallback ``SCT_PREFIX_DRAM_GB``).
     ``spec_draft``/``spec_ngram``/``spec_hist`` turn on fused
-    self-speculative decoding; ``kv_cache_dtype="int8"`` stores the paged
+    self-speculative decoding; ``spec_method`` picks the proposer
+    (``ngram``/``heads``/``draft``) with ``spec_heads``/``spec_heads_path``
+    sizing/loading Medusa-style heads and ``spec_draft_model`` naming the
+    co-resident draft geometry (docs/PERFORMANCE.md §6);
+    ``kv_cache_dtype="int8"`` stores the paged
     pool quantized with per-(position, head) scales;
     ``prefill_chunk`` enables Sarathi-style chunked prefill interleaved
     with decode and ``decode_kernel`` the fused Pallas paged
@@ -307,6 +315,10 @@ def build_generative_component(
         spec_draft=spec_draft,
         spec_ngram=spec_ngram,
         spec_hist=spec_hist,
+        spec_method=spec_method,
+        spec_heads=spec_heads,
+        spec_heads_path=spec_heads_path,
+        spec_draft_model=spec_draft_model,
         kv_cache_dtype=kv_cache_dtype,
         prefill_chunk=prefill_chunk,
         decode_kernel=decode_kernel,

@@ -59,6 +59,12 @@ FIELDS = (
     "tokens_prefill",      # prompt tokens actually prefilled on device
     "tokens_decode",       # tokens emitted by fused decode blocks
     "tokens_spec_accepted",  # of those, accepted speculative drafts
+    # per-proposer split of tokens_spec_accepted (PR 20: ngram history
+    # ring / fused Medusa-style heads / co-resident draft model) — keeps
+    # cost attribution honest when deployments mix speculation methods
+    "tokens_spec_accepted_ngram",
+    "tokens_spec_accepted_heads",
+    "tokens_spec_accepted_draft",
     "tokens_saved_hbm",    # prefix tokens NOT prefilled: HBM-resident hit
     "tokens_saved_dram",   # ... promoted from the host-DRAM tier
     "tokens_saved_peer",   # ... pulled from a peer replica

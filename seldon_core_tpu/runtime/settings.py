@@ -145,6 +145,24 @@ declare("SCT_SPEC_DRAFT", "0", "int",
 declare("SCT_SPEC_NGRAM", "3", "int",
         "N-gram order of the on-device draft history ring.",
         section="executor")
+declare("SCT_SPEC_METHOD", "ngram", "str",
+        "Speculative proposer when SCT_SPEC_DRAFT > 0: ``ngram`` (history "
+        "ring), ``heads`` (fused Medusa-style decode heads), or ``draft`` "
+        "(co-resident draft model; docs/PERFORMANCE.md §6).",
+        section="executor")
+declare("SCT_SPEC_HEADS", "0", "int",
+        "Medusa-style head count for ``heads`` speculation (0 = match "
+        "SCT_SPEC_DRAFT; must be >= the draft length).",
+        section="executor")
+declare("SCT_SPEC_HEADS_PATH", None, "str",
+        "Checkpoint directory for trained speculation heads (unset = "
+        "synthesize from the base lm_head; executor/checkpoint.py layout).",
+        section="executor")
+declare("SCT_SPEC_DRAFT_MODEL", "truncate:auto", "str",
+        "Draft model geometry for ``draft`` speculation: ``truncate:N`` "
+        "(first N base layers), ``truncate:auto``, or ``preset:NAME`` "
+        "(family preset sharing the base vocab).",
+        section="executor")
 declare("SCT_PREFILL_CHUNK", "0", "int",
         "Chunked-prefill chunk size in tokens (0 = monolithic prefill; "
         "docs/PERFORMANCE.md §7).",

@@ -61,6 +61,9 @@ _USAGE_TOKEN_KINDS = (
     ("prefill", "tokens_prefill"),
     ("decode", "tokens_decode"),
     ("spec_accepted", "tokens_spec_accepted"),
+    ("spec_accepted_ngram", "tokens_spec_accepted_ngram"),
+    ("spec_accepted_heads", "tokens_spec_accepted_heads"),
+    ("spec_accepted_draft", "tokens_spec_accepted_draft"),
     ("saved_hbm", "tokens_saved_hbm"),
     ("saved_dram", "tokens_saved_dram"),
     ("saved_peer", "tokens_saved_peer"),
@@ -370,6 +373,30 @@ class MetricsRegistry:
             "Cumulative tokens emitted per verify pass (speculative decode "
             "acceptance; 1.0 = no draft ever accepted)",
             ["model_name"],
+            registry=self.registry,
+        )
+        # per-proposer split of the same ledger (ngram / heads / draft):
+        # the unlabeled series above stay backward-compatible; these let a
+        # fleet compare proposers across deployments on one dashboard
+        self.spec_emitted_by_method = Counter(
+            "seldon_spec_emitted_tokens_by_method",
+            "Tokens emitted by fused speculative verify passes, split by "
+            "proposer (spec_method)",
+            ["model_name", "spec_method"],
+            registry=self.registry,
+        )
+        self.spec_verify_passes_by_method = Counter(
+            "seldon_spec_verify_passes_by_method",
+            "Per-slot speculative verify passes, split by proposer "
+            "(spec_method)",
+            ["model_name", "spec_method"],
+            registry=self.registry,
+        )
+        self.spec_accepted_per_step_by_method = Gauge(
+            "seldon_spec_accepted_tokens_per_step_by_method",
+            "Cumulative tokens emitted per verify pass, split by proposer "
+            "(spec_method)",
+            ["model_name", "spec_method"],
             registry=self.registry,
         )
         # LLM graph plane (docs/GRAPHS.md): cascade routing + the semantic

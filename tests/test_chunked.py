@@ -460,8 +460,8 @@ class TestProgramKeyAudit:
             prefill_chunk=32, decode_kernel=True,
         )
         assert model._program_config == (
-            3, 0, model.spec_ngram, model.spec_hist, None, 32, True, 0, 0,
-            False,
+            3, 0, model.spec_ngram, model.spec_hist, None, 0, None, None,
+            32, True, 0, 0, False,
         )
 
 

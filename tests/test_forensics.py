@@ -409,7 +409,7 @@ class TestHandoffV3:
         qos.set_priority(qos.PRIO_BATCH)
         _, _, _, frame = self._frame_payload(tiny)
         payload = decode_handoff(frame)
-        assert payload["hv"] == HANDOFF_VERSION == 4
+        assert payload["hv"] == HANDOFF_VERSION == 5
         assert payload["traceparent"] == tp
         assert payload["origin_span"] == parse_traceparent(tp)[1]
         assert 0 < payload["deadline_ms"] <= 5000.0

@@ -852,3 +852,123 @@ class TestFleetSemcacheMerge:
         assert sem["flushes"] == 3
         assert sem["flushes_by_namespace"] == {"dep": 2, "other": 1}
         assert "graph_deterministic" not in into["cache"]
+
+
+# ---------------------------------------------------------------------------
+# program-key audit: graph-built learned-speculation units (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def _spec_gen(name: str, extra: list[dict]) -> dict:
+    return {
+        "name": name,
+        "graph": {
+            "name": "gen", "type": "MODEL",
+            "implementation": "JAX_GENERATIVE",
+            "parameters": [
+                {"name": "family", "value": "llama", "type": "STRING"},
+                {"name": "preset", "value": "tiny", "type": "STRING"},
+                {"name": "n_slots", "value": "2", "type": "INT"},
+                {"name": "decode_block", "value": "2", "type": "INT"},
+                {"name": "max_new_tokens", "value": "4", "type": "INT"},
+                {"name": "spec_draft", "value": "2", "type": "INT"},
+                *extra,
+            ],
+        },
+    }
+
+
+class TestProgramKeyAudit:
+    """Graph-built generative units: every knob that changes the fused
+    program's BODY must be a `_program_config` member — a collision would
+    run the wrong compiled scan for the deployment's spec'd proposer."""
+
+    @staticmethod
+    def _built(spec) -> object:
+        async def go():
+            service = PredictionService(PredictorSpec.model_validate(spec))
+            await service.start()
+            try:
+                return service.generative_units()[0].model
+            finally:
+                await service.close()
+
+        return run(go())
+
+    def test_heads_unit_program_config_pinned(self, monkeypatch):
+        monkeypatch.setenv("ENGINE_WARMUP", "0")
+        model = self._built(_spec_gen(
+            "heads",
+            [
+                {"name": "spec_method", "value": "heads", "type": "STRING"},
+                {"name": "spec_heads", "value": "3", "type": "INT"},
+            ],
+        ))
+        assert model._program_config == (
+            0, 2, model.spec_ngram, model.spec_hist, "heads", 3, None,
+            None, model.prefill_chunk, model.decode_kernel,
+            model.lora_rank, model.lora_slots, model.conf_signal,
+        )
+
+    def test_draft_unit_program_config_pinned(self, monkeypatch):
+        monkeypatch.setenv("ENGINE_WARMUP", "0")
+        model = self._built(_spec_gen(
+            "draft",
+            [
+                {"name": "spec_method", "value": "draft", "type": "STRING"},
+                {
+                    "name": "spec_draft_model", "value": "truncate:1",
+                    "type": "STRING",
+                },
+            ],
+        ))
+        assert model._program_config == (
+            0, 2, model.spec_ngram, model.spec_hist, "draft", 0,
+            ("truncate", 1), None, model.prefill_chunk,
+            model.decode_kernel, model.lora_rank, model.lora_slots,
+            model.conf_signal,
+        )
+
+    def test_breakdown_surfaces_per_method_acceptance(self, monkeypatch):
+        """Satellite: /stats/breakdown splits acceptance by proposer for
+        every generative unit (one deployment runs one proposer, so the
+        split is the method-keyed snapshot map)."""
+        monkeypatch.setenv("ENGINE_WARMUP", "0")
+
+        async def go():
+            engine, service = await _engine_client(_spec_gen(
+                "bd",
+                [
+                    {
+                        "name": "spec_method", "value": "heads",
+                        "type": "STRING",
+                    },
+                    {"name": "spec_heads", "value": "2", "type": "INT"},
+                ],
+            ))
+            await engine.post("/api/v0.1/predictions", json=GEN_BODY)
+            body = await (await engine.get("/stats/breakdown")).json()
+            await engine.close()
+            return body
+
+        body = run(go())
+        (gen,) = body["generation"].values()  # keyed by model name
+        assert gen["spec_method"] == "heads"
+        assert gen["spec_heads"] == 2
+        by = gen["accepted_tokens_per_step_by_method"]
+        assert set(by) <= {"heads"}
+        if by:
+            assert by["heads"] == gen["accepted_tokens_per_step"]
+
+    def test_decode_block_one_with_spec_is_build_error(self, monkeypatch):
+        """Rider regression at the GRAPH layer: the loud error surfaces
+        through spec validation, naming both knobs."""
+        monkeypatch.setenv("ENGINE_WARMUP", "0")
+        spec = _spec_gen("bad", [])
+        for p in spec["graph"]["parameters"]:
+            if p["name"] == "decode_block":
+                p["value"] = "1"
+        with pytest.raises(GraphUnitError) as ei:
+            self._built(spec)
+        msg = str(ei.value)
+        assert "decode_block" in msg and "spec_draft" in msg

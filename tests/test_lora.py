@@ -525,7 +525,7 @@ class TestHandoffAdapter:
     def test_frame_carries_adapter_v4(self, tiny):
         prompt, frame = self._prefill_frame(tiny, "alpha")
         payload = decode_handoff(frame)
-        assert payload["hv"] == HANDOFF_VERSION == 4
+        assert payload["hv"] == HANDOFF_VERSION == 5
         assert payload["adapter"] == "alpha"
 
     def test_decode_pool_miss_rejects(self, tiny):

@@ -595,7 +595,8 @@ class TestProgramKeyAudit:
             kv_cache_dtype="int8",
         )
         assert model._program_config == (3, 2, model.spec_ngram,
-                                         model.spec_hist, "int8",
+                                         model.spec_hist, "ngram", 0, None,
+                                         "int8",
                                          model.prefill_chunk,
                                          model.decode_kernel,
                                          model.lora_rank, model.lora_slots,
